@@ -1,0 +1,18 @@
+"""jaxmc — a TPU-native TLA+/PlusCal model checker.
+
+A from-scratch, TPU-first model-checking framework with the capabilities of the
+reference spec corpus's TLC harness (see /root/reference/Makefile:1-7): parse
+TLA+ modules and TLC .cfg models, enumerate reachable states, check
+invariants/deadlock, and report counterexample traces — with the hot BFS loop
+compiled to XLA and run on a TPU mesh.
+
+Layout (maps onto the standard models/ops/parallel/utils split):
+  front/    TLA+ lexer/parser, .cfg parser, PlusCal translator   (the "models")
+  sem/      value domain, evaluator, Init/Next enumeration        (semantics)
+  engine/   host BFS oracle engine, traces, checkpointing
+  compile/  model grounder + AST->jnp kernel compiler             (the "ops")
+  tpu/      device-resident BFS, mesh sharding, collectives       ("parallel")
+  utils/    shared helpers
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,14 @@
+import os
+import sys
+
+# Tests run on CPU with a virtual 8-device mesh so multi-chip sharding logic is
+# exercised without TPU hardware (the driver separately dry-runs multichip).
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault(
+    "XLA_FLAGS",
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8",
+)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REFERENCE = os.environ.get("JAXMC_REFERENCE", "/root/reference")
