@@ -1,0 +1,123 @@
+r"""jaxmc command-line interface.
+
+    python -m jaxmc check SPEC.tla [--cfg F.cfg] [--backend interp|jax]
+    python -m jaxmc info SPEC.tla
+
+Mirrors the reference's `make test` contract (tlc *tla, Makefile:6-7): check a
+spec against its model config, print TLC-style progress and a counterexample
+trace on violation. Exit status 0 = no error, 1 = violation, 2 = usage/error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+
+def _load_model(spec_path: str, cfg_path, no_deadlock: bool):
+    from .front.cfg import parse_cfg, ModelConfig
+    from .sem.modules import Loader, bind_model
+
+    if cfg_path is None:
+        guess = os.path.splitext(spec_path)[0] + ".cfg"
+        if os.path.exists(guess):
+            cfg_path = guess
+    if cfg_path:
+        cfg = parse_cfg(open(cfg_path, encoding="utf-8",
+                             errors="replace").read())
+    else:
+        cfg = ModelConfig(specification="Spec")
+    if no_deadlock:
+        cfg.check_deadlock = False
+    ldr = Loader([os.path.dirname(os.path.abspath(spec_path))])
+    mod = ldr.load_path(spec_path)
+    return bind_model(mod, cfg)
+
+
+def cmd_check(args) -> int:
+    from .engine.explore import Explorer, format_trace
+
+    t0 = time.time()
+    model = _load_model(args.spec, args.cfg, args.no_deadlock)
+    log = (lambda s: None) if args.quiet else print
+    if args.backend == "interp":
+        ex = Explorer(model, log=log, max_states=args.max_states,
+                      progress_every=args.progress_every)
+        res = ex.run()
+    else:
+        try:
+            from .tpu.bfs import TpuExplorer
+        except ImportError as e:
+            print(f"error: the jax backend is not available in this build "
+                  f"({e})", file=sys.stderr)
+            return 2
+        res = TpuExplorer(model, log=log,
+                          max_states=args.max_states).run()
+    wall = time.time() - t0
+    print(f"{res.generated} states generated, {res.distinct} distinct states "
+          f"found ({res.generated / max(res.wall_s, 1e-9):.0f} states/sec, "
+          f"backend={args.backend}, wall {wall:.2f}s)")
+    for w in getattr(res, "warnings", []):
+        print(f"Warning: {w}")
+    if res.ok:
+        if getattr(res, "truncated", False):
+            print("Search TRUNCATED at state limit - no error found in the "
+                  "explored prefix.")
+        else:
+            print("Model checking completed. No error has been found.")
+        return 0
+    print(format_trace(res.violation))
+    return 1
+
+
+def cmd_info(args) -> int:
+    from .sem.modules import Loader
+    from .front import tla_ast as A
+
+    ldr = Loader([os.path.dirname(os.path.abspath(args.spec))])
+    mod = ldr.load_path(args.spec)
+    print(f"module {mod.name}")
+    print(f"  extends:   {', '.join(mod.ast.extends) or '-'}")
+    print(f"  constants: {', '.join(n for n, _ in mod.constants) or '-'}")
+    print(f"  variables: {', '.join(mod.variables) or '-'}")
+    ops = [u.name for u in mod.ast.units if isinstance(u, A.OpDef)]
+    print(f"  operators: {len(ops)}")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="jaxmc")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    c = sub.add_parser("check", help="model-check a spec")
+    c.add_argument("spec")
+    c.add_argument("--cfg", default=None)
+    c.add_argument("--backend", choices=["interp", "jax"], default="interp")
+    c.add_argument("--max-states", type=int, default=None)
+    c.add_argument("--no-deadlock", action="store_true",
+                   help="disable deadlock checking")
+    c.add_argument("--quiet", action="store_true")
+    c.add_argument("--progress-every", type=float, default=30.0)
+    c.set_defaults(fn=cmd_check)
+
+    i = sub.add_parser("info", help="parse a spec and print a summary")
+    i.add_argument("spec")
+    i.set_defaults(fn=cmd_info)
+
+    args = ap.parse_args(argv)
+    try:
+        return args.fn(args)
+    except FileNotFoundError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    except Exception as e:
+        print(f"error: {type(e).__name__}: {e}", file=sys.stderr)
+        if os.environ.get("JAXMC_DEBUG"):
+            raise
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,231 @@
+r"""Host BFS model-checking engine (the exact oracle path, BACKEND=interp).
+
+Reproduces TLC's observable behavior (SURVEY.md §3.2): enumerate Init states,
+breadth-first apply Next, dedup on full states, check invariants and
+constraints on every new distinct state, detect deadlock, report progress in
+TLC's format (testout1:3-9) and shortest counterexample traces with action
+provenance (README.md:268-318).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..sem.values import EvalError, fmt, sort_key
+from ..sem.eval import TLCAssertFailure, eval_expr, _bool
+from ..sem.enumerate import enumerate_init, enumerate_next, label_str
+from ..sem.modules import Model
+
+
+@dataclass
+class Violation:
+    kind: str  # 'invariant' | 'assert' | 'deadlock' | 'constraint-eval' | 'error'
+    name: str
+    trace: List[Tuple[Dict[str, Any], str]]  # (state, action label)
+    message: str = ""
+
+
+@dataclass
+class CheckResult:
+    ok: bool
+    distinct: int
+    generated: int
+    diameter: int
+    violation: Optional[Violation] = None
+    wall_s: float = 0.0
+    prints: List[Any] = field(default_factory=list)
+    truncated: bool = False
+    warnings: List[str] = field(default_factory=list)
+
+    @property
+    def states_per_sec(self) -> float:
+        return self.generated / self.wall_s if self.wall_s > 0 else 0.0
+
+
+def _state_key(state: Dict[str, Any], vars: Tuple[str, ...]):
+    return tuple(state[v] for v in vars)
+
+
+class Explorer:
+    def __init__(self, model: Model, log: Callable[[str], None] = None,
+                 max_states: Optional[int] = None,
+                 progress_every: float = 30.0,
+                 trace_parents: bool = True):
+        self.model = model
+        self.log = log or (lambda s: None)
+        self.max_states = max_states
+        self.progress_every = progress_every
+        self.trace_parents = trace_parents
+        self.prints: List[Any] = []
+
+    def _ctx(self, state=None, primes=None):
+        return self.model.ctx(state, primes, on_print=self.prints.append)
+
+    def _check_state_preds(self, state) -> Optional[str]:
+        """Returns the name of a violated invariant, else None."""
+        ctx = self._ctx(state=state)
+        for name, expr in self.model.invariants:
+            if not _bool(eval_expr(expr, ctx), f"invariant {name}"):
+                return name
+        return None
+
+    def _satisfies_action_constraints(self, state, succ) -> bool:
+        ctx = self._ctx(state=state, primes=succ)
+        for name, expr in self.model.action_constraints:
+            if not _bool(eval_expr(expr, ctx),
+                         f"action constraint {name}"):
+                return False
+        return True
+
+    def _satisfies_constraints(self, state) -> bool:
+        ctx = self._ctx(state=state)
+        for name, expr in self.model.constraints:
+            if not _bool(eval_expr(expr, ctx), f"constraint {name}"):
+                return False
+        return True
+
+    def _trace_to(self, sid, parents, states, labels) -> List[Tuple[Dict, str]]:
+        out = []
+        while sid is not None:
+            out.append((states[sid], labels[sid]))
+            sid = parents[sid]
+        out.reverse()
+        return out
+
+    def run(self) -> CheckResult:
+        model = self.model
+        vars = model.vars
+        t0 = time.time()
+        base_ctx = self._ctx()
+
+        # state table
+        seen: Dict[tuple, int] = {}
+        states: List[Dict[str, Any]] = []
+        parents: List[Optional[int]] = []
+        labels: List[str] = []
+        queue = deque()
+        generated = 0
+        depth_of: List[int] = []
+        diameter = 0
+        last_progress = time.time()
+
+        def add_state(st, parent, label, depth):
+            nonlocal generated
+            key = _state_key(st, vars)
+            sid = seen.get(key)
+            if sid is not None:
+                return sid, False
+            sid = len(states)
+            seen[key] = sid
+            states.append(st)
+            parents.append(parent)
+            labels.append(label)
+            depth_of.append(depth)
+            return sid, True
+
+        warnings = []
+        if model.properties:
+            names = ", ".join(n for n, _ in model.properties)
+            warnings.append(
+                f"temporal properties NOT checked (unimplemented): {names}")
+
+        def result(ok, violation=None, truncated=False):
+            return CheckResult(ok=ok, distinct=len(states),
+                               generated=generated, diameter=diameter,
+                               violation=violation, wall_s=time.time() - t0,
+                               prints=self.prints, truncated=truncated,
+                               warnings=warnings)
+
+        # ---- initial states ----
+        try:
+            inits = enumerate_init(model.init, base_ctx, vars)
+        except TLCAssertFailure as ex:
+            return result(False, Violation("assert", "Init", [], str(ex.out)))
+        init_count = 0
+        for st in inits:
+            sid, new = add_state(st, None, "Initial predicate", 0)
+            if not new:
+                continue
+            init_count += 1
+            generated += 1
+            bad = self._check_state_preds(st)
+            if bad is not None:
+                return result(False, Violation(
+                    "invariant", bad,
+                    self._trace_to(sid, parents, states, labels)))
+            if self._satisfies_constraints(st):
+                queue.append(sid)
+        self.log(f"Finished computing initial states: {init_count} distinct "
+                 f"state{'s' if init_count != 1 else ''} generated.")
+
+        # ---- BFS ----
+        while queue:
+            sid = queue.popleft()
+            st = states[sid]
+            depth = depth_of[sid]
+            diameter = max(diameter, depth)
+            succ_count = 0
+            try:
+                for succ, label in enumerate_next(model.next, base_ctx, vars,
+                                                  st):
+                    succ_count += 1
+                    generated += 1
+                    if model.action_constraints and not \
+                            self._satisfies_action_constraints(st, succ):
+                        continue
+                    nid, new = add_state(succ, sid, label_str(label),
+                                         depth + 1)
+                    if not new:
+                        continue
+                    bad = self._check_state_preds(succ)
+                    if bad is not None:
+                        return result(False, Violation(
+                            "invariant", bad,
+                            self._trace_to(nid, parents, states, labels)))
+                    if self._satisfies_constraints(succ):
+                        queue.append(nid)
+                    if self.max_states and len(states) >= self.max_states:
+                        self.log("-- state limit reached, search truncated")
+                        return result(True, truncated=True)
+            except TLCAssertFailure as ex:
+                trace = self._trace_to(sid, parents, states, labels)
+                return result(False, Violation("assert", "Assert", trace,
+                                               str(ex.out)))
+            if succ_count == 0 and model.check_deadlock:
+                return result(False, Violation(
+                    "deadlock", "deadlock",
+                    self._trace_to(sid, parents, states, labels)))
+            now = time.time()
+            if now - last_progress >= self.progress_every:
+                last_progress = now
+                self.log(f"Progress({depth}): {generated} states generated, "
+                         f"{len(states)} distinct states found, "
+                         f"{len(queue)} states left on queue.")
+
+        self.log(f"Model checking completed. No error has been found.")
+        self.log(f"{generated} states generated, {len(states)} distinct "
+                 f"states found, 0 states left on queue.")
+        self.log(f"The depth of the complete state graph search is "
+                 f"{diameter + 1}.")
+        return result(True)
+
+
+def format_trace(violation: Violation) -> str:
+    lines = []
+    if violation.kind == "invariant":
+        lines.append(f"Error: Invariant {violation.name} is violated.")
+    elif violation.kind == "assert":
+        lines.append(f"Error: Assertion failed: {violation.message}")
+    elif violation.kind == "deadlock":
+        lines.append("Error: Deadlock reached.")
+    lines.append("The behavior up to this point is:")
+    for i, (st, label) in enumerate(violation.trace):
+        head = "Initial predicate" if i == 0 else f"Action {label}"
+        lines.append(f"State {i + 1}: <{head}>")
+        for k in sorted(st.keys()):
+            lines.append(f"  {k} = {fmt(st[k])}")
+        lines.append("")
+    return "\n".join(lines)

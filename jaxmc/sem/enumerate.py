@@ -1,0 +1,274 @@
+r"""TLC-style state enumeration: walking Init and Next as assignment programs.
+
+This is the loop reconstructed in SURVEY.md §3.2: a conjunction is processed
+left-to-right threading partial assignments; `v = e` assigns (or filters, if
+already assigned), `v \in S` branches over S's elements, disjunctions and
+\E branch, user operator applications expand, everything else is a boolean
+guard. The same walker serves Init (unprimed targets), Next (primed targets),
+and ENABLED.
+
+Action labels: the innermost named operator expanded before the action's
+first guard or assignment is evaluated (Restart(s1), Receive(m), ...) — the
+provenance TLC prints in counterexample traces
+(/root/reference/README.md:278-311). A label is a (name, args, frozen)
+triple: operator expansion overwrites it until frozen by the first
+guard/assignment.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from ..front import tla_ast as A
+from .values import EvalError, Fcn, enumerate_set, fmt, in_set, tla_eq
+from .eval import (Ctx, OpClosure, BuiltinOp, UnassignedPrime, _arg_value,
+                   _bool, _resolve, bind_pattern, eval_expr, iter_binders,
+                   make_let_defs)
+
+
+class Walker:
+    """mode 'init': assign unprimed variables; mode 'next': assign primes."""
+
+    def __init__(self, mode: str, vars: Tuple[str, ...], state=None):
+        assert mode in ("init", "next")
+        self.mode = mode
+        self.vars = set(vars)
+        self.var_order = tuple(vars)
+        self.state = state  # fixed pre-state in next mode
+
+    def _ctx(self, base: Ctx, partial: Dict[str, Any]) -> Ctx:
+        if self.mode == "init":
+            return Ctx(base.defs, base.bound, partial, None, self.var_order,
+                       base.on_print)
+        return Ctx(base.defs, base.bound, self.state, partial, self.var_order,
+                   base.on_print)
+
+    def _target(self, e: A.Node, ctx: Ctx) -> Optional[str]:
+        """Variable name if e is an assignable occurrence in this mode."""
+        if self.mode == "next":
+            if isinstance(e, A.Prime) and isinstance(e.expr, A.Ident) \
+                    and e.expr.name in self.vars:
+                return e.expr.name
+            return None
+        if isinstance(e, A.Ident) and e.name in self.vars \
+                and e.name not in ctx.bound:
+            return e.name
+        return None
+
+    def walk(self, e: A.Node, ctx: Ctx, partial: Dict[str, Any],
+             label) -> Iterator[Tuple[Dict[str, Any], Any]]:
+        """Yield (complete-or-partial assignment, action label) pairs."""
+        ectx = self._ctx(ctx, partial)
+
+        if isinstance(e, A.OpApp):
+            name = e.name
+            if name == "/\\":
+                for p1, l1 in self.walk(e.args[0], ctx, partial, label):
+                    yield from self.walk(e.args[1], ctx, p1, l1)
+                return
+            if name == "\\/":
+                for arm in e.args:
+                    yield from self.walk(arm, ctx, dict(partial), label)
+                return
+            if name == "=":
+                tgt = self._target(e.args[0], ctx)
+                if tgt is not None:
+                    label = _freeze(label)
+                    if tgt in partial:
+                        # second assignment acts as an equality filter
+                        rhs = eval_expr(e.args[1], ectx)
+                        if tla_eq(partial[tgt], rhs):
+                            yield partial, label
+                        return
+                    rhs = eval_expr(e.args[1], ectx)
+                    partial[tgt] = rhs
+                    yield partial, label
+                    return
+                # fall through to guard evaluation
+            if name == "\\in":
+                tgt = self._target(e.args[0], ctx)
+                if tgt is not None:
+                    label = _freeze(label)
+                    sval = eval_expr(e.args[1], ectx)
+                    if tgt in partial:
+                        if in_set(partial[tgt], sval):
+                            yield partial, label
+                        return
+                    for v in enumerate_set(sval):
+                        p = dict(partial)
+                        p[tgt] = v
+                        yield p, label
+                    return
+            if name == "!sel":
+                base, num = e.args
+                if isinstance(base, A.Ident):
+                    d = _resolve(base.name, ctx)
+                    if isinstance(d, OpClosure):
+                        conjs = _flatten(d.body, "/\\")
+                        idx = num.val
+                        if 1 <= idx <= len(conjs):
+                            yield from self.walk(conjs[idx - 1], ctx, partial,
+                                                 label)
+                            return
+            # user-defined operator application → expand as action
+            target = ctx.bound[name] if name in ctx.bound else ctx.defs.get(name)
+            if isinstance(target, OpClosure):
+                args = [_arg_value(a, ectx) for a in e.args]
+                inner = ctx
+                if target.defs is not None:
+                    inner = Ctx(target.defs, ctx.bound, ctx.state, ctx.primes,
+                                ctx.vars, ctx.on_print)
+                inner = inner.with_bound(
+                    {**target.bound, **dict(zip(target.params, args))})
+                new_label = label
+                if label is None or not label[2]:
+                    new_label = (name, tuple(args), False)
+                yield from self.walk(target.body, inner, partial, new_label)
+                return
+            # else: boolean guard below
+
+        elif isinstance(e, A.Ident):
+            target = ctx.bound[e.name] if e.name in ctx.bound \
+                else ctx.defs.get(e.name)
+            if isinstance(target, OpClosure) and not target.params:
+                inner = ctx
+                if target.defs is not None:
+                    inner = Ctx(target.defs, ctx.bound, ctx.state, ctx.primes,
+                                ctx.vars, ctx.on_print)
+                if target.bound:
+                    inner = inner.with_bound(target.bound)
+                new_label = label
+                if label is None or not label[2]:
+                    new_label = (e.name, (), False)
+                yield from self.walk(target.body, inner, partial, new_label)
+                return
+
+        elif isinstance(e, A.Quant):
+            if e.kind == "E":
+                for b in iter_binders(e.binders, ectx, eval_expr):
+                    yield from self.walk(e.body, ctx.with_bound(b),
+                                         dict(partial), label)
+                return
+            # \A as guard (fall through)
+
+        elif isinstance(e, A.If):
+            c = _bool(eval_expr(e.cond, ectx), "IF condition")
+            yield from self.walk(e.then if c else e.els, ctx, partial, label)
+            return
+
+        elif isinstance(e, A.Case):
+            for g, b in e.arms:
+                if _bool(eval_expr(g, ectx), "CASE guard"):
+                    yield from self.walk(b, ctx, partial, label)
+                    return
+            if e.other is not None:
+                yield from self.walk(e.other, ctx, partial, label)
+                return
+            raise EvalError("CASE: no guard matched")
+
+        elif isinstance(e, A.Let):
+            new = make_let_defs(e.defs, ectx)
+            inner = ctx.with_defs(new)
+            for v in new.values():
+                if isinstance(v, OpClosure):
+                    v.defs = inner.defs
+            yield from self.walk(e.body, inner, partial, label)
+            return
+
+        elif isinstance(e, A.Unchanged):
+            if self.mode != "next":
+                raise EvalError("UNCHANGED in Init")
+            label = _freeze(label)
+            p = dict(partial)
+            if self._unchanged(e.expr, ctx, p):
+                yield p, label
+            return
+
+        elif isinstance(e, A.Bool):
+            if e.val:
+                yield partial, label
+            return
+
+        # default: boolean guard
+        label = _freeze(label)
+        v = eval_expr(e, ectx)
+        if _bool(v, "action conjunct"):
+            yield partial, label
+
+    def _unchanged(self, e: A.Node, ctx: Ctx, partial) -> bool:
+        """Assign v' = v for every variable under e; returns False if an
+        existing assignment contradicts."""
+        if isinstance(e, A.Ident):
+            if e.name in self.vars:
+                old = self.state[e.name]
+                if e.name in partial:
+                    return tla_eq(partial[e.name], old)
+                partial[e.name] = old
+                return True
+            target = ctx.bound[e.name] if e.name in ctx.bound \
+                else ctx.defs.get(e.name)
+            if isinstance(target, OpClosure) and not target.params:
+                inner = ctx
+                if target.defs is not None:
+                    inner = Ctx(target.defs, ctx.bound, ctx.state, ctx.primes,
+                                ctx.vars, ctx.on_print)
+                return self._unchanged(target.body, inner, partial)
+            raise EvalError(f"UNCHANGED of non-variable {e.name}")
+        if isinstance(e, A.TupleExpr):
+            return all(self._unchanged(x, ctx, partial) for x in e.items)
+        raise EvalError(f"unsupported UNCHANGED argument {e!r}")
+
+
+def _freeze(label):
+    if label is not None and not label[2]:
+        return (label[0], label[1], True)
+    return label
+
+
+def _flatten(e: A.Node, op: str):
+    if isinstance(e, A.OpApp) and e.name == op and len(e.args) == 2:
+        return _flatten(e.args[0], op) + _flatten(e.args[1], op)
+    return [e]
+
+
+def label_str(label) -> str:
+    if label is None:
+        return "Next"
+    name, args = label[0], label[1]
+    if not args:
+        return name
+    return f"{name}({', '.join(fmt(a) for a in args)})"
+
+
+def enumerate_init(init: A.Node, base_ctx: Ctx,
+                   vars: Tuple[str, ...]) -> List[Dict[str, Any]]:
+    w = Walker("init", vars)
+    out = []
+    for partial, _ in w.walk(init, base_ctx, {}, None):
+        missing = [v for v in vars if v not in partial]
+        if missing:
+            raise EvalError(f"Init leaves variables unassigned: {missing}")
+        out.append(partial)
+    return out
+
+
+def enumerate_next(next_expr: A.Node, base_ctx: Ctx, vars: Tuple[str, ...],
+                   state: Dict[str, Any]):
+    """Yield (successor-state dict, label) for every enabled instance."""
+    w = Walker("next", vars, state)
+    for partial, label in w.walk(next_expr, base_ctx, {}, None):
+        missing = [v for v in vars if v not in partial]
+        if missing:
+            raise EvalError(
+                f"action {label_str(label)} leaves {missing} unassigned")
+        yield partial, label
+
+
+def action_enabled(action: A.Node, ctx: Ctx) -> bool:
+    """ENABLED A: does any assignment complete A from the current state?"""
+    if ctx.state is None:
+        raise EvalError("ENABLED outside a behavior")
+    w = Walker("next", tuple(ctx.vars), dict(ctx.state))
+    for _ in w.walk(action, ctx, {}, None):
+        return True
+    return False

@@ -1,0 +1,265 @@
+r"""TLA+ value domain for the reference interpreter.
+
+Python natives carry most of the weight: int, bool, str, frozenset. Functions
+(which subsume sequences, tuples, records, and bags — e.g. raft's message bag
+is a function Message -> Nat, /root/reference/examples/raft.tla:33-36) are the
+immutable Fcn class. Model values come from cfg CONSTANT bindings.
+
+A total deterministic order over all values (sort_key) fixes CHOOSE witnesses
+and canonical display order, mirroring TLC's deterministic enumeration.
+
+Known deviation: Python's True == 1 means a set or function mixing BOOLEAN
+and 0/1 int values collapses them ({TRUE, 1} has cardinality 1 here). TLC
+raises a comparability error on such mixes; specs that TLC accepts without
+error never hit this. in_set() disambiguates membership tests, and tla_eq
+raises on direct bool-int comparison, but frozenset/dict construction cannot
+be intercepted without wrapping every boolean.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Tuple
+
+
+class EvalError(Exception):
+    pass
+
+
+class ModelValue:
+    """An uninterpreted model value (cfg `Ident = Ident`). Compares unequal
+    to every other value, equal only to itself."""
+    __slots__ = ("name",)
+    _interned: Dict[str, "ModelValue"] = {}
+
+    def __new__(cls, name: str):
+        mv = cls._interned.get(name)
+        if mv is None:
+            mv = object.__new__(cls)
+            mv.name = name
+            cls._interned[name] = mv
+        return mv
+
+    def __repr__(self):
+        return self.name
+
+    def __hash__(self):
+        return hash(("$mv", self.name))
+
+    def __eq__(self, other):
+        return self is other
+
+
+class Fcn:
+    """Immutable TLA+ function. Sequences are functions with domain 1..n,
+    records functions with string domain — all compare uniformly."""
+    __slots__ = ("_d", "_hash")
+
+    def __init__(self, mapping: Iterable):
+        d = dict(mapping)
+        self._d = d
+        self._hash = None
+
+    @property
+    def d(self) -> dict:
+        return self._d
+
+    def domain(self) -> frozenset:
+        return frozenset(self._d.keys())
+
+    def apply(self, arg):
+        try:
+            return self._d[arg]
+        except KeyError:
+            raise EvalError(f"function applied outside domain: {fmt(arg)} "
+                            f"not in {fmt(self.domain())}")
+        except TypeError:
+            raise EvalError(f"unhashable function argument {arg!r}")
+
+    def is_seq(self) -> bool:
+        n = len(self._d)
+        return all(isinstance(k, int) for k in self._d) and \
+            set(self._d.keys()) == set(range(1, n + 1))
+
+    def is_record(self) -> bool:
+        return len(self._d) > 0 and all(isinstance(k, str) for k in self._d)
+
+    def as_list(self) -> List[Any]:
+        n = len(self._d)
+        return [self._d[i] for i in range(1, n + 1)]
+
+    def __len__(self):
+        return len(self._d)
+
+    def __eq__(self, other):
+        if not isinstance(other, Fcn):
+            return NotImplemented
+        return self._d == other._d
+
+    def __hash__(self):
+        if self._hash is None:
+            self._hash = hash(frozenset(self._d.items()))
+        return self._hash
+
+    def __repr__(self):
+        return fmt(self)
+
+
+EMPTY_FCN = Fcn({})
+
+
+def mk_seq(items: Iterable) -> Fcn:
+    return Fcn({i + 1: v for i, v in enumerate(items)})
+
+
+def mk_record(fields: Dict[str, Any]) -> Fcn:
+    return Fcn(fields)
+
+
+class InfiniteSet:
+    """Sentinel for Nat, Int, STRING, Seq(S): supports membership, refuses
+    enumeration (TLC behaves the same way)."""
+    __slots__ = ("kind", "param")
+
+    def __init__(self, kind: str, param=None):
+        self.kind = kind
+        self.param = param
+
+    def contains(self, v) -> bool:
+        if self.kind == "Nat":
+            return isinstance(v, int) and not isinstance(v, bool) and v >= 0
+        if self.kind == "Int":
+            return isinstance(v, int) and not isinstance(v, bool)
+        if self.kind == "STRING":
+            return isinstance(v, str)
+        if self.kind == "Seq":
+            return isinstance(v, Fcn) and (len(v) == 0 or v.is_seq()) and \
+                all(in_set(x, self.param) for x in v.as_list())
+        if self.kind == "Real":
+            return isinstance(v, (int, float)) and not isinstance(v, bool)
+        raise EvalError(f"unknown infinite set {self.kind}")
+
+    def __repr__(self):
+        return self.kind if self.param is None else f"Seq({fmt(self.param)})"
+
+    def __eq__(self, other):
+        return isinstance(other, InfiniteSet) and self.kind == other.kind \
+            and self.param == other.param
+
+    def __hash__(self):
+        return hash(("$inf", self.kind, self.param))
+
+
+NAT = InfiniteSet("Nat")
+INT = InfiniteSet("Int")
+REAL = InfiniteSet("Real")
+STRING_SET = InfiniteSet("STRING")
+BOOLEAN_SET = frozenset({True, False})
+
+
+def in_set(v, s) -> bool:
+    if isinstance(s, frozenset):
+        # Python's True == 1 must not leak into TLA+ semantics where
+        # TRUE /= 1: disambiguate bool/int hash collisions by scan.
+        if isinstance(v, bool):
+            return any(x is v for x in s)
+        if isinstance(v, int) and v in (0, 1):
+            return any(x == v and not isinstance(x, bool) for x in s)
+        return v in s
+    if isinstance(s, InfiniteSet):
+        return s.contains(v)
+    raise EvalError(f"\\in applied to non-set {fmt(s)}")
+
+
+def enumerate_set(s) -> List[Any]:
+    """Deterministically ordered elements; raises on infinite sets."""
+    if isinstance(s, frozenset):
+        return sorted(s, key=sort_key)
+    if isinstance(s, InfiniteSet):
+        raise EvalError(f"cannot enumerate infinite set {s!r}")
+    raise EvalError(f"expected a set, got {fmt(s)}")
+
+
+_TYPE_RANK = {bool: 0, int: 1, str: 2, ModelValue: 3, frozenset: 4, Fcn: 5,
+              InfiniteSet: 6}
+
+
+def sort_key(v):
+    t = type(v)
+    if t is bool:
+        return (0, v)
+    if t is int:
+        return (1, v)
+    if t is str:
+        return (2, v)
+    if t is ModelValue:
+        return (3, v.name)
+    if t is frozenset:
+        return (4, len(v), tuple(sort_key(x) for x in sorted(v, key=sort_key)))
+    if t is Fcn:
+        items = sorted(v.d.items(), key=lambda kv: sort_key(kv[0]))
+        return (5, len(items),
+                tuple((sort_key(k), sort_key(x)) for k, x in items))
+    if t is InfiniteSet:
+        return (6, v.kind)
+    raise EvalError(f"unorderable value {v!r}")
+
+
+def values_comparable(a, b) -> bool:
+    """TLC-style comparability: model values compare (unequal) with anything;
+    otherwise kinds must match."""
+    if isinstance(a, ModelValue) or isinstance(b, ModelValue):
+        return True
+    ka, kb = _kind(a), _kind(b)
+    return ka == kb
+
+
+def _kind(v):
+    if isinstance(v, bool):
+        return "bool"
+    if isinstance(v, int):
+        return "int"
+    if isinstance(v, str):
+        return "str"
+    if isinstance(v, frozenset) or isinstance(v, InfiniteSet):
+        return "set"
+    if isinstance(v, Fcn):
+        return "fcn"
+    return "other"
+
+
+def tla_eq(a, b) -> bool:
+    if isinstance(a, ModelValue) or isinstance(b, ModelValue):
+        return a is b
+    if not values_comparable(a, b):
+        raise EvalError(f"attempted to compare {fmt(a)} with {fmt(b)}")
+    if isinstance(a, InfiniteSet) or isinstance(b, InfiniteSet):
+        return a == b
+    return a == b
+
+
+def fmt(v) -> str:
+    """TLC-style display, used for counterexample traces
+    (format reference: /root/reference/README.md:268-318)."""
+    if isinstance(v, bool):
+        return "TRUE" if v else "FALSE"
+    if isinstance(v, int):
+        return str(v)
+    if isinstance(v, str):
+        return f'"{v}"'
+    if isinstance(v, ModelValue):
+        return v.name
+    if isinstance(v, frozenset):
+        return "{" + ", ".join(fmt(x) for x in sorted(v, key=sort_key)) + "}"
+    if isinstance(v, Fcn):
+        if len(v) == 0:
+            return "<<>>"
+        if v.is_seq():
+            return "<<" + ", ".join(fmt(x) for x in v.as_list()) + ">>"
+        if v.is_record():
+            return "[" + ", ".join(f"{k} |-> {fmt(x)}"
+                                   for k, x in sorted(v.d.items())) + "]"
+        items = sorted(v.d.items(), key=lambda kv: sort_key(kv[0]))
+        return "(" + " @@ ".join(f"{fmt(k)} :> {fmt(x)}" for k, x in items) + ")"
+    if isinstance(v, InfiniteSet):
+        return repr(v)
+    return repr(v)

@@ -1,0 +1,29 @@
+------------------------- MODULE pcal_intro_buggy -------------------------
+\* The README's race-condition variant of the money transfer: the balance
+\* check (Transfer), the debit (A), and the credit (B) are separate atomic
+\* steps, so two processes can interleave and drive alice_account negative.
+\* Reference behavior: TLC stops at the assertion violation after
+\* "9097 states generated, 6164 distinct states found" at search depth 7
+\* (/root/reference/README.md:265-321). This spec is jaxmc's regression
+\* fixture for that oracle run (algorithm from README.md:222-241).
+EXTENDS Naturals, TLC
+
+(* --algorithm transfer
+variables alice_account = 10, bob_account = 10,
+          account_total = alice_account + bob_account
+
+process TransProc \in 1..2
+  variables money \in 1..20;
+begin
+  Transfer:
+    if alice_account >= money then
+      A: alice_account := alice_account - money;
+      B: bob_account := bob_account + money;
+    end if;
+C: assert alice_account >= 0;
+end process
+
+end algorithm *)
+
+MoneyInvariant == alice_account + bob_account = account_total
+=============================================================================

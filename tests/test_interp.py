@@ -1,0 +1,237 @@
+r"""Interpreter + engine tests: evaluator semantics, enumeration, and the
+corpus oracle runs recorded in the reference (SURVEY.md §6).
+"""
+
+import os
+
+import pytest
+
+from jaxmc.front.parser import parse_expr_text
+from jaxmc.front.cfg import ModelConfig, parse_cfg
+from jaxmc.sem.values import Fcn, ModelValue, fmt, mk_seq
+from jaxmc.sem.eval import Ctx, eval_expr
+from jaxmc.sem.modules import Loader, bind_model, BASE_IDENTS
+from jaxmc.sem.enumerate import enumerate_init, enumerate_next
+from jaxmc.engine.explore import Explorer, format_trace
+
+from conftest import REFERENCE
+
+SPECS = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                     "specs")
+
+
+def ev(src, **bound):
+    ctx = Ctx(dict(BASE_IDENTS), bound=bound)
+    return eval_expr(parse_expr_text(src), ctx)
+
+
+class TestEval:
+    def test_arith(self):
+        assert ev("2 + 3 * 4") == 14
+        assert ev("7 \\div 2") == 3
+        assert ev("7 % 2") == 1
+        assert ev("2 ^ 10") == 1024
+        assert ev("-(5) + 1") == -4
+
+    def test_sets(self):
+        assert ev("1 .. 3") == frozenset({1, 2, 3})
+        assert ev("{1, 2} \\cup {2, 3}") == frozenset({1, 2, 3})
+        assert ev("{x \\in 1..10 : x % 2 = 0}") == frozenset({2, 4, 6, 8, 10})
+        assert ev("{x * x : x \\in 1..3}") == frozenset({1, 4, 9})
+        assert ev("Cardinality(SUBSET (1..3))") == 8
+        assert ev("UNION {{1}, {2, 3}}") == frozenset({1, 2, 3})
+        assert ev("{1} \\subseteq {1, 2}") is True
+        assert ev("1 \\in Nat") is True
+        assert ev("-1 \\in Nat") is False
+        assert ev("-1 \\in Int") is True
+
+    def test_bool_int_distinct(self):
+        assert ev("TRUE \\in {1, 2}") is False
+        assert ev("1 \\in {TRUE, FALSE}") is False
+
+    def test_functions(self):
+        assert ev("[x \\in 1..3 |-> x * 2][2]") == 4
+        assert ev("DOMAIN [x \\in 1..3 |-> x]") == frozenset({1, 2, 3})
+        assert ev('[a |-> 1, b |-> 2].b') == 2
+        assert ev("[f EXCEPT ![2] = @ + 10][2]",
+                  f=Fcn({1: 1, 2: 2})) == 12
+        assert ev("Cardinality([b: {0, 1}, c: {0, 1}])") == 4
+        assert ev("Cardinality([{1, 2} -> {1, 2, 3}])") == 9
+        assert ev("(1 :> 2 @@ 3 :> 4)[3]") == 4
+
+    def test_sequences(self):
+        assert ev("Len(<<1, 2, 3>>)") == 3
+        assert ev("Append(<<1>>, 2)") == mk_seq([1, 2])
+        assert ev("Head(<<1, 2>>)") == 1
+        assert ev("Tail(<<1, 2>>)") == mk_seq([2])
+        assert ev("<<1, 2>> \\o <<3>>") == mk_seq([1, 2, 3])
+        assert ev("SubSeq(<<1, 2, 3, 4>>, 2, 3)") == mk_seq([2, 3])
+        assert ev("<<1, 2>> \\in Seq(Nat)") is True
+        # a sequence IS the function with domain 1..n
+        assert ev("<<4, 5>> = [i \\in 1..2 |-> i + 3]") is True
+
+    def test_quantifiers_choose(self):
+        assert ev("\\A x \\in 1..5 : x < 6") is True
+        assert ev("\\E x \\in 1..5 : x = 3") is True
+        assert ev("CHOOSE x \\in 1..5 : x * x = 9") == 3
+        # deterministic lowest witness
+        assert ev("CHOOSE x \\in 1..5 : x > 2") == 3
+
+    def test_if_case_let(self):
+        assert ev("IF 1 < 2 THEN 10 ELSE 20") == 10
+        assert ev("CASE 1 > 2 -> 0 [] 2 > 1 -> 5 [] OTHER -> 9") == 5
+        assert ev("LET sq(x) == x * x IN sq(7)") == 49
+        assert ev("LET a == 3 b == a + 1 IN a * b") == 12
+
+    def test_recursive_let(self):
+        assert ev("LET RECURSIVE f(_) f(n) == IF n = 0 THEN 1 "
+                  "ELSE n * f(n - 1) IN f(5)") == 120
+
+    def test_recursive_fn_constructor(self):
+        assert ev("LET f[n \\in 0..5] == IF n = 0 THEN 1 ELSE n * f[n - 1] "
+                  "IN f[5]") == 120
+
+    def test_tuples_products(self):
+        assert ev("Cardinality({1, 2} \\X {3, 4} \\X {5})") == 4
+        v = ev("CHOOSE <<a, b>> \\in {1} \\X {2} : TRUE")
+        assert v == mk_seq([1, 2])
+
+    def test_strings_model_values(self):
+        assert ev('"abc" = "abc"') is True
+        assert ev('"abc" \\in STRING') is True
+
+
+def run_spec(path, cfg=None, **kw):
+    ldr = Loader([os.path.dirname(os.path.abspath(path))])
+    m = ldr.load_path(path)
+    model = bind_model(m, cfg or ModelConfig(specification="Spec"))
+    return Explorer(model, **kw).run()
+
+
+class TestEngine:
+    def test_atomic_add(self):
+        r = run_spec(os.path.join(REFERENCE, "atomic_add.tla"))
+        assert r.ok
+        assert r.distinct == 5
+        assert r.generated == 7
+
+    def test_pcal_intro_fixed_passes(self):
+        cfg = parse_cfg(open(os.path.join(REFERENCE, "pcal_intro.cfg")).read())
+        r = run_spec(os.path.join(REFERENCE, "pcal_intro.tla"), cfg)
+        assert r.ok
+        assert r.distinct == 3800
+        assert r.generated == 5850
+
+    def test_pcal_intro_buggy_matches_tlc_oracle(self):
+        # the recorded TLC run: 9097 generated / 6164 distinct at the
+        # assertion violation (/root/reference/README.md:319-320)
+        r = run_spec(os.path.join(SPECS, "pcal_intro_buggy.tla"))
+        assert not r.ok
+        assert r.violation.kind == "assert"
+        assert r.generated == 9097
+        assert r.distinct == 6164
+        assert len(r.violation.trace) == 6
+        # README's trace: both at Transfer, money <<1, 10>>
+        st0 = r.violation.trace[0][0]
+        assert fmt(st0["money"]) == "<<1, 10>>"
+        assert fmt(st0["pc"]) == '<<"Transfer", "Transfer">>'
+
+    def test_buggy_invariant_violation_found(self):
+        cfg = ModelConfig(specification="Spec",
+                          invariants=["MoneyInvariant"])
+        r = run_spec(os.path.join(SPECS, "pcal_intro_buggy.tla"), cfg)
+        assert not r.ok and r.violation.kind == "invariant"
+        assert r.violation.name == "MoneyInvariant"
+
+    def test_trace_labels(self):
+        r = run_spec(os.path.join(SPECS, "pcal_intro_buggy.tla"))
+        labels = [lbl for _, lbl in r.violation.trace]
+        assert labels[0] == "Initial predicate"
+        assert labels[1].startswith("Transfer(")
+
+    def test_deadlock_detection(self):
+        # two processes that each await the other's increment never fire
+        import tempfile
+        src = """---- MODULE dl ----
+EXTENDS Naturals
+VARIABLES x, y
+Init == x = 0 /\\ y = 0
+Next == \\/ x > 0 /\\ y' = y + 1 /\\ x' = x
+        \\/ y > 0 /\\ x' = x + 1 /\\ y' = y
+====
+"""
+        with tempfile.NamedTemporaryFile("w", suffix=".tla",
+                                         delete=False) as f:
+            f.write(src)
+            p = f.name
+        cfg = ModelConfig(init="Init", next="Next")
+        r = run_spec(p, cfg)
+        assert not r.ok and r.violation.kind == "deadlock"
+        cfg2 = ModelConfig(init="Init", next="Next", check_deadlock=False)
+        r2 = run_spec(p, cfg2)
+        assert r2.ok
+        os.unlink(p)
+
+
+class TestHourClock:
+    def test_hourclock(self):
+        d = os.path.join(REFERENCE, "examples/SpecifyingSystems/HourClock")
+        cfg = parse_cfg(open(os.path.join(d, "HourClock.cfg")).read())
+        r = run_spec(os.path.join(d, "HourClock.tla"), cfg)
+        assert r.ok
+        assert r.distinct == 12
+
+
+class TestPcalSemantics:
+    def test_sequential_assignment_reads_updated_value(self):
+        # PlusCal statements in one step execute sequentially: `x := 1; y := x`
+        # must set y to the NEW x (p-manual semantics; review finding repro)
+        import tempfile
+        src = """---- MODULE seqassign ----
+EXTENDS Naturals, TLC
+(* --algorithm seqassign
+variables x = 0, y = 0
+process P \\in {1}
+begin
+Step:
+  x := 1;
+  y := x;
+  assert y = 1;
+end process
+end algorithm *)
+====
+"""
+        with tempfile.NamedTemporaryFile("w", suffix=".tla",
+                                         delete=False) as f:
+            f.write(src)
+            p = f.name
+        r = run_spec(p, ModelConfig(specification="Spec"))
+        os.unlink(p)
+        assert r.ok
+
+    def test_while_loop(self):
+        import tempfile
+        src = """---- MODULE wl ----
+EXTENDS Naturals, TLC
+(* --algorithm wl
+variables total = 0
+process P \\in {1}
+  variables i = 0;
+begin
+Loop:
+  while i < 3 do
+    total := total + 1;
+    i := i + 1;
+  end while;
+Done1: assert total = 3;
+end process
+end algorithm *)
+====
+"""
+        with tempfile.NamedTemporaryFile("w", suffix=".tla",
+                                         delete=False) as f:
+            f.write(src)
+            p = f.name
+        r = run_spec(p, ModelConfig(specification="Spec"))
+        os.unlink(p)
+        assert r.ok
