@@ -1,0 +1,26 @@
+# jaxmc build/check driver — mirrors the reference's Makefile contract
+# (/root/reference/Makefile:1-7: all = transpile + test) with the checker
+# backend selectable: BACKEND=interp (exact Python oracle) | jax (TPU path).
+
+BACKEND ?= interp
+SPEC    ?= specs/transfer_scaled.tla
+PY      ?= python3
+
+all: test
+
+# model-check one spec (auto-discovers <spec>.cfg)
+check:
+	$(PY) -m jaxmc check $(SPEC) --backend $(BACKEND)
+
+# check every checkable spec the way `tlc *tla` does
+check-corpus:
+	$(PY) -m jaxmc check /root/reference/pcal_intro.tla --backend $(BACKEND)
+	$(PY) -m jaxmc check /root/reference/atomic_add.tla --backend $(BACKEND)
+
+test:
+	$(PY) -m pytest tests/ -q
+
+bench:
+	$(PY) bench.py
+
+.PHONY: all check check-corpus test bench

@@ -1,0 +1,71 @@
+r"""jaxmc benchmark: states/sec of the device BFS backend.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "states/sec", "vs_baseline": R}
+
+Workload: exhaustive search of specs/transfer_scaled.tla (the README
+money-transfer race generalized; raft 3-server is the round-2+ metric of
+record per BASELINE.md). vs_baseline is the speedup over the exact Python
+reference interpreter measured on the same machine — the stand-in for TLC,
+which is not installable in this image (no JVM; BASELINE.md documents that
+the TLC baseline must be measured where a JVM exists).
+
+Runs on whatever accelerator jax selects (the driver runs this on one real
+TPU chip); falls back to CPU if the TPU plugin fails to initialize.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+_REPO = os.path.dirname(os.path.abspath(__file__))
+
+
+def main():
+    import jax
+    try:
+        devs = jax.devices()
+        platform = devs[0].platform
+    except Exception:
+        jax.config.update("jax_platforms", "cpu")
+        devs = jax.devices()
+        platform = "cpu (tpu init failed)"
+
+    from jaxmc.sem.modules import Loader, bind_model
+    from jaxmc.front.cfg import parse_cfg
+    from jaxmc.tpu.bfs import TpuExplorer
+    from jaxmc.engine.explore import Explorer
+
+    spec = os.path.join(_REPO, "specs", "transfer_scaled.tla")
+    cfg = parse_cfg(open(os.path.join(_REPO, "specs",
+                                      "transfer_scaled.cfg")).read())
+    model = bind_model(Loader([]).load_path(spec), cfg)
+
+    # device backend: warm-up run compiles all (seen_cap, frontier_cap)
+    # buckets; the timed run reuses the jit cache
+    ex = TpuExplorer(model, store_trace=False)
+    r_warm = ex.run()
+    t0 = time.time()
+    r = ex.run()
+    jax_wall = time.time() - t0
+    assert r.ok and r.distinct == r_warm.distinct
+    jax_rate = r.generated / jax_wall
+
+    # interpreter baseline on a capped prefix (full run is minutes)
+    ri = Explorer(model, max_states=20000).run()
+    interp_rate = ri.generated / ri.wall_s
+
+    out = {
+        "metric": f"states/sec exhaustive transfer_scaled "
+                  f"({r.distinct} distinct states, {platform})",
+        "value": round(jax_rate, 1),
+        "unit": "states/sec",
+        "vs_baseline": round(jax_rate / interp_rate, 3),
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -53,8 +53,15 @@ def cmd_check(args) -> int:
             print(f"error: the jax backend is not available in this build "
                   f"({e})", file=sys.stderr)
             return 2
-        res = TpuExplorer(model, log=log,
-                          max_states=args.max_states).run()
+        from .compile.ground import CompileError
+        try:
+            res = TpuExplorer(model, log=log,
+                              max_states=args.max_states).run()
+        except CompileError as e:
+            print(f"error: this spec is outside the jax backend's "
+                  f"compilable subset ({e}); re-run with "
+                  f"--backend interp", file=sys.stderr)
+            return 2
     wall = time.time() - t0
     print(f"{res.generated} states generated, {res.distinct} distinct states "
           f"found ({res.generated / max(res.wall_s, 1e-9):.0f} states/sec, "

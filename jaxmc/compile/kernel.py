@@ -1,0 +1,739 @@
+r"""Kernel compiler: grounded actions -> jit/vmap-able transition kernels
+(SURVEY.md §7.4).
+
+Each GroundedAction compiles to f(row: i32[W]) -> (enabled: bool,
+assert_ok: bool, succ_row: i32[W]); invariants compile to row -> bool.
+The compiler is a symbolic evaluator over the same AST the interpreter
+walks: state variables decode to trees of traced jnp scalars, guards fold
+into an enabled mask, IF on a traced condition becomes jnp.where, and
+anything outside the compilable subset raises CompileError so the caller
+falls back to the interpreter.
+
+TPU notes: everything is i32/bool lanes — no dynamic shapes, no python
+control flow on traced values, so XLA fuses each action into straight-line
+vector code that vmaps over the frontier.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..front import tla_ast as A
+from ..sem.values import EvalError, Fcn, ModelValue, fmt, in_set, sort_key
+from ..sem.eval import Ctx, OpClosure, eval_expr, bind_pattern
+from ..sem.modules import Model
+from .ground import (CompileError, EnumUniverse, GroundedAction, Spec_,
+                     StateLayout, ground_actions)
+
+
+# ---- symbolic values ----
+# int  -> jnp i32 scalar or python int
+# bool -> jnp bool scalar or python bool
+# enum -> SEnum (index, possibly traced)
+# fcn  -> SFcn {static key -> symbolic value}
+# sets/strings stay static python values
+
+class SEnum:
+    __slots__ = ("idx",)
+
+    def __init__(self, idx):
+        self.idx = idx
+
+
+class SFcn:
+    __slots__ = ("d",)
+
+    def __init__(self, d: Dict[Any, Any]):
+        self.d = d
+
+
+def _is_traced(v) -> bool:
+    return isinstance(v, jnp.ndarray) or hasattr(v, "aval")
+
+
+def _sym_decode(row, spec: Spec_, off: int, uni: EnumUniverse):
+    if spec.kind == "int":
+        return row[off], off + 1
+    if spec.kind == "bool":
+        return row[off] != 0, off + 1
+    if spec.kind == "enum":
+        return SEnum(row[off]), off + 1
+    if spec.kind == "fcn":
+        d = {}
+        for k, es in zip(spec.dom, spec.elems):
+            d[k], off = _sym_decode(row, es, off, uni)
+        return SFcn(d), off
+    if spec.kind == "set":
+        d = {}
+        for m in spec.dom:
+            d[m] = row[off] != 0
+            off += 1
+        return ("$symset", d), off
+    raise CompileError(f"cannot symbolically decode {spec.kind}")
+
+
+def _sym_encode(v, spec: Spec_, uni: EnumUniverse, out: List):
+    if spec.kind == "int":
+        out.append(_as_int(v))
+    elif spec.kind == "bool":
+        b = _as_bool(v)
+        out.append(jnp.where(b, 1, 0) if _is_traced(b) else (1 if b else 0))
+    elif spec.kind == "enum":
+        out.append(_enum_idx(v, uni))
+    elif spec.kind == "fcn":
+        if isinstance(v, Fcn):
+            v = SFcn(dict(v.d))
+        if not isinstance(v, SFcn):
+            raise CompileError(f"expected function value, got {v!r}")
+        if set(map(_key, v.d.keys())) != set(map(_key, spec.dom)):
+            raise CompileError("function domain drifted from layout")
+        lookup = { _key(k): val for k, val in v.d.items() }
+        for k, es in zip(spec.dom, spec.elems):
+            _sym_encode(lookup[_key(k)], es, uni, out)
+    elif spec.kind == "set":
+        if isinstance(v, tuple) and len(v) == 2 and v[0] == "$symset":
+            d = v[1]
+            for m in spec.dom:
+                b = d.get(m, False)
+                out.append(jnp.where(b, 1, 0) if _is_traced(b)
+                           else (1 if b else 0))
+        elif isinstance(v, frozenset):
+            extra = v - frozenset(spec.dom)
+            if extra:
+                raise CompileError(f"set outside universe: {fmt(extra)}")
+            for m in spec.dom:
+                out.append(1 if m in v else 0)
+        else:
+            raise CompileError(f"expected set value, got {v!r}")
+    else:
+        raise AssertionError(spec.kind)
+
+
+def _key(k):
+    return (type(k).__name__, k.name if isinstance(k, ModelValue) else k)
+
+
+def _as_int(v):
+    if isinstance(v, bool):
+        raise CompileError("boolean used as integer")
+    if isinstance(v, int) or _is_traced(v):
+        return v
+    raise CompileError(f"expected integer, got {v!r}")
+
+
+def _as_bool(v):
+    if isinstance(v, bool) or _is_traced(v):
+        return v
+    raise CompileError(f"expected boolean, got {v!r}")
+
+
+def _enum_idx(v, uni: EnumUniverse):
+    if isinstance(v, SEnum):
+        return v.idx
+    if isinstance(v, (str, ModelValue)):
+        return uni.index(v)
+    raise CompileError(f"expected enum value, got {v!r}")
+
+
+def _land(a, b):
+    if a is True:
+        return b
+    if b is True:
+        return a
+    if a is False or b is False:
+        return False
+    return jnp.logical_and(a, b)
+
+
+def _lor(a, b):
+    if a is False:
+        return b
+    if b is False:
+        return a
+    if a is True or b is True:
+        return True
+    return jnp.logical_or(a, b)
+
+
+def _lnot(a):
+    if isinstance(a, bool):
+        return not a
+    return jnp.logical_not(a)
+
+
+def _where(c, a, b):
+    """Symbolic IF merging two symbolic values of matching structure."""
+    if isinstance(c, bool):
+        return a if c else b
+    if isinstance(a, SEnum) or isinstance(b, SEnum):
+        return SEnum(jnp.where(c, _sel(a, "enum"), _sel(b, "enum")))
+    if isinstance(a, SFcn) or isinstance(b, SFcn):
+        da = a.d if isinstance(a, SFcn) else dict(a.d)  # Fcn static
+        db = b.d if isinstance(b, SFcn) else dict(b.d)
+        ka = {_key(k): k for k in da}
+        kb = {_key(k): k for k in db}
+        if set(ka) != set(kb):
+            raise CompileError("IF branches build different function domains")
+        return SFcn({ka[k]: _where(c, da[ka[k]], db[kb[k]]) for k in ka})
+    return jnp.where(c, a, b)
+
+
+def _sel(v, kind):
+    if kind == "enum":
+        if isinstance(v, SEnum):
+            return v.idx
+        raise CompileError(f"IF branch mixes enum with {v!r}")
+    return v
+
+
+class SymCtx:
+    __slots__ = ("model", "uni", "bound", "state", "primes")
+
+    def __init__(self, model, uni, bound, state, primes):
+        self.model = model
+        self.uni = uni
+        self.bound = bound    # static + symbolic bindings
+        self.state = state    # var -> symbolic tree
+        self.primes = primes  # var -> symbolic tree (partial)
+
+    def with_bound(self, extra):
+        return SymCtx(self.model, self.uni, {**self.bound, **extra},
+                      self.state, self.primes)
+
+
+def _sym_eq(a, b, uni):
+    """Symbolic equality; returns bool or traced bool."""
+    # unwrap static Fcn to SFcn for uniform handling
+    if isinstance(a, Fcn):
+        a = SFcn(dict(a.d))
+    if isinstance(b, Fcn):
+        b = SFcn(dict(b.d))
+    if isinstance(a, SEnum) or isinstance(b, SEnum):
+        ia, ib = _enum_idx(a, uni), _enum_idx(b, uni)
+        if isinstance(ia, int) and isinstance(ib, int):
+            return ia == ib
+        return jnp.equal(ia, ib)
+    if isinstance(a, SFcn) and isinstance(b, SFcn):
+        ka = {_key(k): k for k in a.d}
+        kb = {_key(k): k for k in b.d}
+        if set(ka) != set(kb):
+            return False
+        acc = True
+        for k in ka:
+            acc = _land(acc, _sym_eq(a.d[ka[k]], b.d[kb[k]], uni))
+        return acc
+    if isinstance(a, bool) or isinstance(b, bool):
+        if isinstance(a, bool) and isinstance(b, bool):
+            return a == b
+        return jnp.equal(a, b)
+    if isinstance(a, int) and isinstance(b, int):
+        return a == b
+    sa = isinstance(a, tuple) and len(a) == 2 and a[0] == "$symset"
+    sb = isinstance(b, tuple) and len(b) == 2 and b[0] == "$symset"
+    if sa or sb:
+        da = a[1] if sa else ({m: True for m in a}
+                              if isinstance(a, frozenset) else None)
+        db = b[1] if sb else ({m: True for m in b}
+                              if isinstance(b, frozenset) else None)
+        if da is None or db is None:
+            raise CompileError("set compared with non-set value")
+        acc = True
+        for m in set(map(_key, da)) | set(map(_key, db)):
+            la = {_key(k): v for k, v in da.items()}.get(m, False)
+            lb = {_key(k): v for k, v in db.items()}.get(m, False)
+            ea = la if not isinstance(la, bool) or la else la
+            same = jnp.equal(la, lb) if (_is_traced(la) or _is_traced(lb))                 else (la == lb)
+            acc = _land(acc, same)
+        return acc
+    if _is_traced(a) or _is_traced(b):
+        return jnp.equal(a, b)
+    # both static non-traced values
+    from ..sem.values import tla_eq
+    from ..sem.values import EvalError as _EE
+    try:
+        return tla_eq(a, b)
+    except _EE as ex:
+        raise CompileError(str(ex))
+
+
+def sym_eval(e: A.Node, s: SymCtx):
+    """Symbolic evaluation; returns a symbolic value or raises CompileError."""
+    uni = s.uni
+    t = type(e)
+    if t is A.Num:
+        return e.val
+    if t is A.Str:
+        return SEnum(uni.index(e.val)) if e.val in uni.to_idx else e.val
+    if t is A.Bool:
+        return e.val
+    if t is A.Ident:
+        name = e.name
+        if name in s.bound:
+            return _wrap_static(s.bound[name], uni)
+        if name in s.state:
+            return s.state[name]
+        d = s.model.defs.get(name)
+        if isinstance(d, OpClosure):
+            if d.params:
+                raise CompileError(f"operator {name} used as value")
+            return sym_eval(d.body, s)
+        if d is not None:
+            return _wrap_static(d, uni)
+        raise CompileError(f"unknown identifier {name}")
+    if t is A.Prime:
+        if not isinstance(e.expr, A.Ident):
+            raise CompileError("primed non-variable")
+        name = e.expr.name
+        if name not in s.primes:
+            raise CompileError(f"{name}' read before assignment")
+        return s.primes[name]
+    if t is A.OpApp:
+        return _sym_opapp(e, s)
+    if t is A.FnApp:
+        f = sym_eval(e.fn, s)
+        args = [sym_eval(a, s) for a in e.args]
+        return _sym_apply(f, args, s)
+    if t is A.Dot:
+        f = sym_eval(e.expr, s)
+        return _sym_apply(f, [e.fld], s)
+    if t is A.If:
+        c = sym_eval(e.cond, s)
+        if isinstance(c, bool):
+            return sym_eval(e.then if c else e.els, s)
+        a = sym_eval(e.then, s)
+        b = sym_eval(e.els, s)
+        return _where(c, a, b)
+    if t is A.Case:
+        # fold to nested IF
+        node = None
+        for g, b in reversed(e.arms):
+            if node is None:
+                if e.other is not None:
+                    node = A.If(g, b, e.other)
+                else:
+                    node = b  # last guard assumed true when taken
+            else:
+                node = A.If(g, b, node)
+        return sym_eval(node, s)
+    if t is A.Except:
+        f = sym_eval(e.fn, s)
+        if isinstance(f, Fcn):
+            f = SFcn(dict(f.d))
+        if not isinstance(f, SFcn):
+            raise CompileError("EXCEPT on non-function")
+        d = dict(f.d)
+        for path, rhs in e.updates:
+            d = _sym_except(d, list(path), rhs, s)
+        return SFcn(d)
+    if t is A.TupleExpr:
+        return SFcn({i + 1: sym_eval(x, s) for i, x in enumerate(e.items)})
+    if t is A.FnDef:
+        # [x \in S |-> body] with static S
+        entries = {}
+        binders = []
+        for names, sexpr in e.binders:
+            sval = _static_set(sexpr, s)
+            for pat in names:
+                binders.append((pat, sval))
+        if len(binders) != 1:
+            raise CompileError("multi-binder function constructors "
+                               "not compilable yet")
+        pat, sval = binders[0]
+        for v in sorted(sval, key=sort_key):
+            b = bind_pattern(pat, v) if isinstance(pat, tuple) else {pat: v}
+            entries[v] = sym_eval(e.body, s.with_bound(b))
+        return SFcn(entries)
+    if t is A.Quant:
+        acc = True if e.kind == "A" else False
+        for b in _static_bindings(e.binders, s):
+            v = _as_bool(sym_eval(e.body, s.with_bound(b)))
+            acc = _land(acc, v) if e.kind == "A" else _lor(acc, v)
+        return acc
+    if t is A.SetFilter:
+        # only static filtering is compilable
+        sval = _static_set(e.set, s)
+        out = []
+        for v in sorted(sval, key=sort_key):
+            b = bind_pattern(e.var, v) if isinstance(e.var, tuple) \
+                else {e.var: v}
+            p = sym_eval(e.pred, s.with_bound(b))
+            if not isinstance(p, bool):
+                raise CompileError("set filter over traced predicate")
+            if p:
+                out.append(v)
+        return frozenset(out)
+    if t is A.Let:
+        defs = {}
+        for d in e.defs:
+            if isinstance(d, A.OpDef) and not d.params:
+                defs[d.name] = ("$letdef", d.body)
+            elif isinstance(d, A.OpDef):
+                defs[d.name] = ("$letop", d)
+            else:
+                raise CompileError("non-operator LET in compiled expression")
+        return sym_eval(e.body, s.with_bound(defs))
+    if t is A.Choose:
+        # static CHOOSE only
+        sval = _static_set(e.set, s) if e.set is not None else None
+        if sval is None:
+            raise CompileError("unbounded CHOOSE")
+        for v in sorted(sval, key=sort_key):
+            b = bind_pattern(e.var, v) if isinstance(e.var, tuple) \
+                else {e.var: v}
+            p = sym_eval(e.pred, s.with_bound(b))
+            if not isinstance(p, bool):
+                raise CompileError("CHOOSE over traced predicate")
+            if p:
+                return v
+        raise CompileError("CHOOSE: no witness")
+    raise CompileError(f"cannot compile {t.__name__} node")
+
+
+def _wrap_static(v, uni):
+    if isinstance(v, tuple) and len(v) == 2 and v[0] == "$letdef":
+        raise CompileError("internal: unexpanded let")
+    if isinstance(v, (str, ModelValue)) and v in uni.to_idx:
+        return SEnum(uni.index(v))
+    return v
+
+
+def _static_set(sexpr, s: SymCtx):
+    from ..sem.values import enumerate_set
+    try:
+        ctx = Ctx(s.model.defs, {k: v for k, v in s.bound.items()
+                                 if not _symbolic(v)}, None, None, ())
+        return frozenset(enumerate_set(eval_expr(sexpr, ctx)))
+    except EvalError as ex:
+        raise CompileError(f"non-static set in compiled position: {ex}")
+
+
+def _symbolic(v):
+    return isinstance(v, (SEnum, SFcn)) or _is_traced(v)
+
+
+def _static_bindings(binders, s: SymCtx):
+    import itertools
+    groups = []
+    for names, sexpr in binders:
+        sval = sorted(_static_set(sexpr, s), key=sort_key)
+        for pat in names:
+            groups.append((pat, sval))
+    for combo in itertools.product(*[g[1] for g in groups]):
+        b = {}
+        for (pat, _), v in zip(groups, combo):
+            if isinstance(pat, tuple):
+                b.update(bind_pattern(pat, v))
+            else:
+                b[pat] = v
+        yield b
+
+
+def _sym_apply(f, args, s: SymCtx):
+    if isinstance(f, tuple) and len(f) == 2 and f[0] == "$letdef":
+        raise CompileError("internal: let in apply")
+    if isinstance(f, Fcn):
+        f = SFcn(dict(f.d))
+    if isinstance(f, SFcn):
+        key = args[0] if len(args) == 1 else tuple(args)
+        if isinstance(key, SEnum):
+            if isinstance(key.idx, int):
+                key = s.uni.value(key.idx)
+            else:
+                # symbolic index: select across domain
+                acc = None
+                for k, v in f.d.items():
+                    if not isinstance(k, (str, ModelValue)):
+                        raise CompileError("symbolic application over "
+                                           "non-enum domain")
+                    cond = jnp.equal(key.idx, s.uni.index(k))
+                    acc = v if acc is None else _where(cond, v, acc)
+                return acc
+        if _is_traced(key):
+            # symbolic integer index over int-keyed domain
+            acc = None
+            for k, v in f.d.items():
+                if not isinstance(k, int):
+                    raise CompileError("symbolic int application over "
+                                       "non-int domain")
+                cond = jnp.equal(key, k)
+                acc = v if acc is None else _where(cond, v, acc)
+            return acc
+        lookup = {_key(k): v for k, v in f.d.items()}
+        kk = _key(key)
+        if kk not in lookup:
+            raise CompileError(f"application outside static domain: {key!r}")
+        return lookup[kk]
+    raise CompileError(f"cannot apply {f!r}")
+
+
+def _sym_except(d: Dict, path, rhs, s: SymCtx):
+    kind, arg = path[0]
+    if kind == "idx":
+        keys = [sym_eval(a, s) for a in arg]
+        key = keys[0] if len(keys) == 1 else tuple(keys)
+        if isinstance(key, SEnum):
+            if not isinstance(key.idx, int):
+                raise CompileError("EXCEPT with traced key")
+            key = s.uni.value(key.idx)
+        if _is_traced(key):
+            raise CompileError("EXCEPT with traced key")
+    else:
+        key = arg
+    lookup = {_key(k): k for k in d}
+    kk = _key(key)
+    if kk not in lookup:
+        raise CompileError(f"EXCEPT key outside domain: {key!r}")
+    real_key = lookup[kk]
+    old = d[real_key]
+    out = dict(d)
+    if len(path) == 1:
+        out[real_key] = sym_eval(rhs, s.with_bound({"@": old}))
+    else:
+        inner = old
+        if isinstance(inner, Fcn):
+            inner = SFcn(dict(inner.d))
+        if not isinstance(inner, SFcn):
+            raise CompileError("EXCEPT path into non-function")
+        out[real_key] = SFcn(_sym_except(dict(inner.d), path[1:], rhs, s))
+    return out
+
+
+_INT_OPS = {
+    "+": jnp.add, "-": jnp.subtract, "*": jnp.multiply,
+}
+_CMP_OPS = {
+    "<": jnp.less, ">": jnp.greater, "<=": jnp.less_equal,
+    "=<": jnp.less_equal, "\\leq": jnp.less_equal,
+    ">=": jnp.greater_equal, "\\geq": jnp.greater_equal,
+}
+
+
+def _sym_opapp(e: A.OpApp, s: SymCtx):
+    name = e.name
+    uni = s.uni
+    if e.path:
+        raise CompileError("instance paths not compilable yet")
+    if name == "/\\":
+        return _land(_as_bool(sym_eval(e.args[0], s)),
+                     _as_bool(sym_eval(e.args[1], s)))
+    if name == "\\/":
+        return _lor(_as_bool(sym_eval(e.args[0], s)),
+                    _as_bool(sym_eval(e.args[1], s)))
+    if name == "~":
+        return _lnot(_as_bool(sym_eval(e.args[0], s)))
+    if name == "=>":
+        return _lor(_lnot(_as_bool(sym_eval(e.args[0], s))),
+                    _as_bool(sym_eval(e.args[1], s)))
+    if name in ("<=>", "\\equiv"):
+        a = _as_bool(sym_eval(e.args[0], s))
+        b = _as_bool(sym_eval(e.args[1], s))
+        if isinstance(a, bool) and isinstance(b, bool):
+            return a == b
+        return jnp.equal(a, b)
+    if name == "=":
+        return _sym_eq(sym_eval(e.args[0], s), sym_eval(e.args[1], s), uni)
+    if name in ("/=", "#"):
+        return _lnot(_sym_eq(sym_eval(e.args[0], s),
+                             sym_eval(e.args[1], s), uni))
+    if name in _INT_OPS:
+        a = _as_int(sym_eval(e.args[0], s))
+        b = _as_int(sym_eval(e.args[1], s))
+        if isinstance(a, int) and isinstance(b, int):
+            return {"+": a + b, "-": a - b, "*": a * b}[name]
+        return _INT_OPS[name](a, b)
+    if name in _CMP_OPS:
+        a = _as_int(sym_eval(e.args[0], s))
+        b = _as_int(sym_eval(e.args[1], s))
+        if isinstance(a, int) and isinstance(b, int):
+            return {"<": a < b, ">": a > b, "<=": a <= b, "=<": a <= b,
+                    "\\leq": a <= b, ">=": a >= b,
+                    "\\geq": a >= b}[name]
+        return _CMP_OPS[name](a, b)
+    if name == "\\div":
+        a = _as_int(sym_eval(e.args[0], s))
+        b = _as_int(sym_eval(e.args[1], s))
+        if isinstance(a, int) and isinstance(b, int):
+            return a // b
+        return jnp.floor_divide(a, b)
+    if name == "%":
+        a = _as_int(sym_eval(e.args[0], s))
+        b = _as_int(sym_eval(e.args[1], s))
+        if isinstance(a, int) and isinstance(b, int):
+            return a % b
+        return jnp.mod(a, b)
+    if name == "-.":
+        a = _as_int(sym_eval(e.args[0], s))
+        return -a if isinstance(a, int) else jnp.negative(a)
+    if name == "\\in":
+        v = sym_eval(e.args[0], s)
+        sv = sym_eval(e.args[1], s)
+        if isinstance(sv, frozenset):
+            if not _symbolic(v):
+                return in_set(v, sv)
+            acc = False
+            for m in sorted(sv, key=sort_key):
+                acc = _lor(acc, _sym_eq(v, _wrap_static(m, uni), uni))
+            return acc
+        if isinstance(sv, tuple) and len(sv) == 2 and sv[0] == "$symset":
+            d = sv[1]
+            if _symbolic(v):
+                acc = False
+                for m, memb in d.items():
+                    acc = _lor(acc, _land(
+                        memb, _sym_eq(v, _wrap_static(m, uni), uni)))
+                return acc
+            lookup = {_key(k): b for k, b in d.items()}
+            return lookup.get(_key(v), False)
+        raise CompileError("\\in over non-static set")
+    if name == "\\notin":
+        return _lnot(_sym_opapp(A.OpApp("\\in", e.args), s))
+    if name == "..":
+        a = sym_eval(e.args[0], s)
+        b = sym_eval(e.args[1], s)
+        if isinstance(a, int) and isinstance(b, int):
+            return frozenset(range(a, b + 1))
+        raise CompileError("traced interval bounds")
+    if name == "Assert":
+        raise CompileError("Assert in non-guard position")
+    if name == "DOMAIN":
+        f = sym_eval(e.args[0], s)
+        if isinstance(f, Fcn):
+            return f.domain()
+        if isinstance(f, SFcn):
+            return frozenset(f.d.keys())
+        raise CompileError("DOMAIN of non-function")
+    if name in ("\\cup", "\\union", "\\cap", "\\intersect", "\\",
+                "SUBSET", "UNION", "Cardinality", "\\X", "\\subseteq"):
+        # static set algebra only
+        args = [sym_eval(a, s) for a in e.args]
+        if any(_symbolic(a) for a in args):
+            raise CompileError(f"{name} over symbolic operand")
+        from ..sem.stdlib import BUILTIN_OPS
+        ctx = Ctx(s.model.defs, {}, None, None, ())
+        return BUILTIN_OPS[name](args, ctx)
+    # user-defined operator
+    d = s.model.defs.get(name) if name not in s.bound else s.bound[name]
+    if isinstance(d, tuple) and len(d) == 2 and d[0] == "$letdef":
+        if e.args:
+            raise CompileError("let-operator with args")
+        return sym_eval(d[1], s)
+    if isinstance(d, tuple) and len(d) == 2 and d[0] == "$letop":
+        od = d[1]
+        args = [sym_eval(a, s) for a in e.args]
+        return sym_eval(od.body, s.with_bound(dict(zip(od.params, args))))
+    if isinstance(d, OpClosure):
+        args = [sym_eval(a, s) for a in e.args]
+        return sym_eval(d.body, s.with_bound(dict(zip(d.params, args))))
+    if d is not None and not e.args:
+        return _wrap_static(d, uni)
+    raise CompileError(f"cannot compile operator {name}")
+
+
+# ---- action compilation ----
+
+@dataclass
+class CompiledAction:
+    label: str
+    fn: Callable  # row -> (enabled, assert_ok, succ_row)
+
+
+def compile_action(model: Model, layout: StateLayout,
+                   ga: GroundedAction) -> CompiledAction:
+    uni = layout.uni
+    vars = layout.vars
+
+    def fn(row):
+        state = {}
+        off = 0
+        for v in vars:
+            state[v], off = _sym_decode(row, layout.specs[v], off, uni)
+        primes: Dict[str, Any] = {}
+        enabled = True
+        assert_ok = True
+
+        for expr, bound in ga.items:
+            sctx = SymCtx(model, uni, dict(bound), state, primes)
+            tgt = _prime_target(expr, vars)
+            if tgt is not None:
+                var, rhs = tgt
+                if var in primes:
+                    # equality filter on second assignment
+                    enabled = _land(enabled, _as_bool(
+                        _sym_eq(primes[var], sym_eval(rhs, sctx), uni)))
+                else:
+                    primes[var] = sym_eval(rhs, sctx)
+                continue
+            if isinstance(expr, A.Unchanged):
+                _apply_unchanged(expr.expr, model, state, primes, vars)
+                continue
+            if isinstance(expr, A.OpApp) and expr.name == "Assert":
+                cond = _as_bool(sym_eval(expr.args[0], sctx))
+                # assert fires only if the action is otherwise taken
+                if cond is True:
+                    continue
+                bad = _land(enabled, _lnot(cond))
+                assert_ok = _land(assert_ok, _lnot(bad))
+                continue
+            g = _as_bool(sym_eval(expr, sctx))
+            enabled = _land(enabled, g)
+        missing = [v for v in vars if v not in primes]
+        if missing:
+            raise CompileError(
+                f"action {ga.label} leaves {missing} unassigned")
+        out: List = []
+        for v in vars:
+            _sym_encode(primes[v], layout.specs[v], uni, out)
+        succ = jnp.stack([jnp.asarray(x, dtype=jnp.int32) for x in out])
+        en = enabled if _is_traced(enabled) else jnp.asarray(bool(enabled))
+        ak = assert_ok if _is_traced(assert_ok) else jnp.asarray(bool(assert_ok))
+        return en, ak, succ
+
+    return CompiledAction(ga.label, fn)
+
+
+def _prime_target(e: A.Node, vars) -> Optional[Tuple[str, A.Node]]:
+    if isinstance(e, A.OpApp) and e.name == "=" and \
+            isinstance(e.args[0], A.Prime) and \
+            isinstance(e.args[0].expr, A.Ident) and \
+            e.args[0].expr.name in vars:
+        return e.args[0].expr.name, e.args[1]
+    return None
+
+
+def _apply_unchanged(e: A.Node, model: Model, state, primes, vars):
+    if isinstance(e, A.Ident):
+        if e.name in vars:
+            if e.name not in primes:
+                primes[e.name] = state[e.name]
+            return
+        d = model.defs.get(e.name)
+        if isinstance(d, OpClosure) and not d.params:
+            _apply_unchanged(d.body, model, state, primes, vars)
+            return
+        raise CompileError(f"UNCHANGED of non-variable {e.name}")
+    if isinstance(e, A.TupleExpr):
+        for x in e.items:
+            _apply_unchanged(x, model, state, primes, vars)
+        return
+    raise CompileError(f"unsupported UNCHANGED {e!r}")
+
+
+def compile_predicate(model: Model, layout: StateLayout,
+                      expr: A.Node) -> Callable:
+    """Compile a state predicate (invariant/constraint) to row -> bool."""
+    uni = layout.uni
+
+    def fn(row):
+        state = {}
+        off = 0
+        for v in layout.vars:
+            state[v], off = _sym_decode(row, layout.specs[v], off, uni)
+        sctx = SymCtx(model, uni, {}, state, {})
+        r = _as_bool(sym_eval(expr, sctx))
+        return r if _is_traced(r) else jnp.asarray(bool(r))
+
+    return fn

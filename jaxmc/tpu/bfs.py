@@ -1,0 +1,370 @@
+r"""Device-resident BFS engine (BACKEND=jax) — SURVEY.md §7.5.
+
+The hot loop reconstructed in SURVEY.md §3.2, as array programs: the frontier
+and the seen-set live on the accelerator as i32[cap, W] row matrices; one
+jitted level step expands every (state x grounded action) pair with vmap,
+masks disabled instances, and deduplicates EXACTLY by lexicographic
+multi-key sort (jax.lax.sort over the W state lanes) — no fingerprint
+collisions, unlike TLC's probabilistic hashing (testout2:261-264).
+
+Capacities are power-of-two buckets that grow on demand, so jit recompiles
+O(log N) times; all shapes inside a step are static (XLA/TPU requirement).
+Parent provenance rides the sorts as a non-key operand and is streamed to
+host per level for counterexample reconstruction — disable with
+store_trace=False for benchmark runs.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..sem.modules import Model
+from ..sem.enumerate import enumerate_init
+from ..engine.explore import CheckResult, Violation
+from ..compile.ground import (CompileError, StateLayout, build_layout,
+                              ground_actions)
+from ..compile.kernel import compile_action, compile_predicate
+
+SENTINEL = np.int32(2**31 - 1)
+
+
+def _pow2_at_least(n: int, lo: int = 256) -> int:
+    c = lo
+    while c < n:
+        c *= 2
+    return c
+
+
+class TpuExplorer:
+    def __init__(self, model: Model, log: Callable[[str], None] = None,
+                 max_states: Optional[int] = None, store_trace: bool = True,
+                 progress_every: float = 30.0):
+        self.model = model
+        self.log = log or (lambda s: None)
+        self.max_states = max_states
+        self.store_trace = store_trace
+        self.progress_every = progress_every
+
+        base_ctx = model.ctx()
+        self.init_states = enumerate_init(model.init, base_ctx, model.vars)
+        self.layout = build_layout(model, self.init_states)
+        self.actions = ground_actions(model)
+        self.compiled = [compile_action(model, self.layout, ga)
+                         for ga in self.actions]
+        self.inv_fns = [(nm, compile_predicate(model, self.layout, ex))
+                        for nm, ex in model.invariants]
+        self.constraint_fns = [(nm, compile_predicate(model, self.layout, ex))
+                               for nm, ex in model.constraints]
+        if model.action_constraints:
+            raise CompileError("action constraints not compiled yet - "
+                               "use the interp backend")
+        self.A = len(self.compiled)
+        self.W = self.layout.width
+        self._step_cache: Dict[Tuple[int, int], Callable] = {}
+
+    # ---- jitted level step, compiled per (seen_cap, frontier_cap) ----
+    def _get_step(self, SC: int, FC: int) -> Callable:
+        key = (SC, FC)
+        if key in self._step_cache:
+            return self._step_cache[key]
+        A, W = self.A, self.W
+        acts = self.compiled
+        inv_fns = self.inv_fns
+        con_fns = self.constraint_fns
+
+        def expand(frontier):
+            ens, aoks, succs = [], [], []
+            for ca in acts:
+                en, aok, succ = jax.vmap(ca.fn)(frontier)
+                ens.append(en)
+                aoks.append(aok)
+                succs.append(succ)
+            return (jnp.stack(ens), jnp.stack(aoks), jnp.stack(succs))
+
+        @jax.jit
+        def step(seen, frontier, fcount):
+            fvalid = jnp.arange(FC) < fcount
+            en, aok, succ = expand(frontier)          # [A,FC] [A,FC] [A,FC,W]
+            valid = en & fvalid[None, :]
+            assert_bad = (~aok) & fvalid[None, :]
+            dead = fvalid & ~jnp.any(en, axis=0)
+            gen = jnp.sum(valid)
+
+            C = A * FC
+            cand = succ.reshape(C, W)
+            cvalid = valid.reshape(C)
+            prov = jnp.arange(C, dtype=jnp.int32)
+            cand = jnp.where(cvalid[:, None], cand, SENTINEL)
+
+            allr = jnp.concatenate([seen, cand])       # [SC+C, W]
+            flag = jnp.concatenate([
+                jnp.zeros(SC, jnp.int32), jnp.ones(C, jnp.int32)])
+            aprov = jnp.concatenate([
+                jnp.full(SC, -1, jnp.int32), prov])
+            ops = tuple(allr[:, i] for i in range(W)) + (flag, aprov)
+            sorted_ = lax.sort(ops, num_keys=W + 1, is_stable=True)
+            rows = jnp.stack(sorted_[:W], axis=1)
+            sflag, sprov = sorted_[W], sorted_[W + 1]
+            rvalid = rows[:, 0] != SENTINEL
+            neq_prev = jnp.concatenate([
+                jnp.array([True]),
+                jnp.any(rows[1:] != rows[:-1], axis=1)])
+            new = (sflag == 1) & rvalid & neq_prev
+            new_count = jnp.sum(new)
+
+            # compact new rows (and their provenance) to the front, keeping
+            # lexicographic order (stable single-key sort)
+            ops2 = ((1 - new.astype(jnp.int32)),) + \
+                tuple(rows[:, i] for i in range(W)) + (sprov,)
+            comp = lax.sort(ops2, num_keys=1, is_stable=True)
+            new_rows = jnp.stack(comp[1:W + 1], axis=1)[:C]
+            new_prov = comp[W + 1][:C]
+            nvalid = jnp.arange(C) < new_count
+
+            # merged seen-set, compacted and still sorted
+            keep = ((sflag == 0) & rvalid) | new
+            ops3 = ((1 - keep.astype(jnp.int32)),) + \
+                tuple(rows[:, i] for i in range(W))
+            comp3 = lax.sort(ops3, num_keys=1, is_stable=True)
+            seen2 = jnp.stack(comp3[1:], axis=1)[:SC]
+            seen_count2 = jnp.sum(keep)
+
+            # invariants over the new distinct states
+            inv_bad_any = jnp.asarray(False)
+            inv_bad_idx = jnp.asarray(0, jnp.int32)
+            inv_bad_which = jnp.asarray(-1, jnp.int32)
+            for wi, (nm, f) in enumerate(inv_fns):
+                ok = jax.vmap(f)(new_rows)
+                bad = nvalid & ~ok
+                any_ = jnp.any(bad)
+                idx = jnp.argmax(bad)
+                first = jnp.logical_and(any_, ~inv_bad_any)
+                inv_bad_idx = jnp.where(first, idx, inv_bad_idx)
+                inv_bad_which = jnp.where(first, wi, inv_bad_which)
+                inv_bad_any = inv_bad_any | any_
+            # constraints: violating states stay in seen but leave the search
+            explore = nvalid
+            for nm, f in con_fns:
+                explore = explore & jax.vmap(f)(new_rows)
+            explore_count = jnp.sum(explore)
+            # push explored rows to the front for the next frontier
+            ops4 = ((1 - explore.astype(jnp.int32)),) + \
+                tuple(new_rows[:, i] for i in range(W)) + (new_prov,)
+            comp4 = lax.sort(ops4, num_keys=1, is_stable=True)
+            front_rows = jnp.stack(comp4[1:W + 1], axis=1)[:C]
+            front_prov = comp4[W + 1][:C]
+
+            return dict(gen=gen, dead=dead, assert_bad=assert_bad,
+                        seen=seen2, seen_count=seen_count2,
+                        new_rows=new_rows, new_prov=new_prov,
+                        new_count=new_count,
+                        front_rows=front_rows, front_prov=front_prov,
+                        front_count=explore_count,
+                        inv_bad_any=inv_bad_any, inv_bad_idx=inv_bad_idx,
+                        inv_bad_which=inv_bad_which)
+
+        self._step_cache[key] = step
+        return step
+
+    # ---- host-side search loop ----
+    def run(self) -> CheckResult:
+        t0 = time.time()
+        model = self.model
+        layout = self.layout
+        W = self.W
+        warnings = []
+        if model.properties:
+            names = ", ".join(n for n, _ in model.properties)
+            warnings.append(
+                f"temporal properties NOT checked (unimplemented): {names}")
+
+        # initial states (dedup on host; tiny)
+        rows = {}
+        for st in self.init_states:
+            rows[layout.encode(st).tobytes()] = st
+        init_rows = np.stack([np.frombuffer(k, dtype=np.int32)
+                              for k in rows.keys()]) \
+            if rows else np.zeros((0, W), np.int32)
+        n_init = len(init_rows)
+        generated = n_init
+        distinct = n_init
+        self.log(f"Finished computing initial states: {n_init} distinct "
+                 f"state{'s' if n_init != 1 else ''} generated.")
+
+        # invariants + constraints on init states (host-side interpreter)
+        from ..sem.eval import eval_expr, _bool
+        explored_init = []
+        for i, row in enumerate(init_rows):
+            st = layout.decode(row)
+            ctx = model.ctx(state=st)
+            for nm, ex in model.invariants:
+                if not _bool(eval_expr(ex, ctx), f"invariant {nm}"):
+                    return self._mk_result(
+                        False, distinct, generated, 0, t0, warnings,
+                        Violation("invariant", nm,
+                                  [(st, "Initial predicate")]))
+            if all(_bool(eval_expr(ex, ctx), f"constraint {nm}")
+                   for nm, ex in model.constraints):
+                explored_init.append(i)
+
+        # capacities
+        FC = _pow2_at_least(max(n_init, 1))
+        SC = _pow2_at_least(4 * max(n_init, 1))
+
+        front_init = init_rows[explored_init] if n_init else init_rows
+        n_front = len(front_init)
+        frontier = np.full((FC, W), SENTINEL, np.int32)
+        frontier[:n_front] = front_init
+        frontier = jnp.asarray(frontier)
+        fcount = n_front
+        seen = np.full((SC, W), SENTINEL, np.int32)
+        if n_init:
+            order = np.lexsort(tuple(init_rows[:, i]
+                                     for i in reversed(range(W))))
+            seen[:n_init] = init_rows[order]
+        seen = jnp.asarray(seen)
+        seen_count = n_init
+
+        # trace bookkeeping: per level (rows np, prov np, frontier_cap)
+        trace_levels: List[Tuple[np.ndarray, Optional[np.ndarray], int]] = []
+        trace_levels.append((np.asarray(init_rows), None, 0))
+        frontier_maps: List[np.ndarray] = [np.asarray(explored_init,
+                                                      dtype=np.int64)]
+
+        depth = 0
+        last_progress = time.time()
+        while fcount > 0:
+            # capacity management
+            C = self.A * FC
+            if seen_count + C > SC:
+                SC2 = _pow2_at_least(seen_count + C, SC)
+                pad = jnp.full((SC2 - SC, W), SENTINEL, jnp.int32)
+                seen = jnp.concatenate([seen, pad])
+                SC = SC2
+            step = self._get_step(SC, FC)
+            out = step(seen, frontier, fcount)
+
+            # violations first (device->host sync points)
+            if bool(jnp.any(out["assert_bad"])):
+                ab = np.asarray(out["assert_bad"])
+                a, f = np.unravel_index(np.argmax(ab), ab.shape)
+                trace = self._trace_to(trace_levels, frontier_maps,
+                                       depth, int(f))
+                trace.append((None, self.actions[int(a)].label))
+                return self._mk_result(
+                    False, distinct, generated, depth, t0, warnings,
+                    Violation("assert", "Assert",
+                              [x for x in trace if x[0] is not None],
+                              f"assertion in {self.actions[int(a)].label}"))
+            if model.check_deadlock and bool(jnp.any(out["dead"])):
+                f = int(jnp.argmax(out["dead"]))
+                trace = self._trace_to(trace_levels, frontier_maps,
+                                       depth, f)
+                return self._mk_result(
+                    False, distinct, generated, depth, t0, warnings,
+                    Violation("deadlock", "deadlock", trace))
+
+            new_count = int(out["new_count"])
+            generated += int(out["gen"])
+            distinct += new_count
+            seen = out["seen"]
+            seen_count = int(out["seen_count"])
+
+            if self.store_trace:
+                new_rows_h = np.asarray(out["new_rows"][:max(new_count, 1)])
+                new_prov_h = np.asarray(out["new_prov"][:max(new_count, 1)])
+                trace_levels.append(
+                    (new_rows_h[:new_count], new_prov_h[:new_count], FC))
+            if bool(out["inv_bad_any"]):
+                idx = int(out["inv_bad_idx"])
+                which = int(out["inv_bad_which"])
+                nm = self.inv_fns[which][0]
+                trace = self._trace_to(trace_levels, frontier_maps,
+                                       depth + 1, idx, from_new=True)
+                return self._mk_result(
+                    False, distinct, generated, depth + 1, t0, warnings,
+                    Violation("invariant", nm, trace))
+
+            front_count = int(out["front_count"])
+            if self.store_trace:
+                # map frontier positions back to new_rows positions: the
+                # frontier is the explore-compacted permutation of new rows;
+                # recover by matching provenance
+                fp = np.asarray(out["front_prov"][:max(front_count, 1)])
+                npv = np.asarray(out["new_prov"][:max(new_count, 1)])
+                pos = {int(p): i for i, p in enumerate(npv[:new_count])}
+                frontier_maps.append(
+                    np.asarray([pos[int(p)] for p in fp[:front_count]],
+                               dtype=np.int64))
+            depth += 1
+
+            if self.max_states and distinct >= self.max_states:
+                self.log("-- state limit reached, search truncated")
+                return self._mk_result(True, distinct, generated, depth, t0,
+                                       warnings, None, truncated=True)
+
+            # next frontier
+            if front_count > FC:
+                FC = _pow2_at_least(front_count, FC)
+            nf = jnp.full((FC, W), SENTINEL, jnp.int32)
+            nf = nf.at[:min(front_count, FC)].set(
+                out["front_rows"][:min(front_count, FC)])
+            frontier = nf
+            fcount = front_count
+
+            now = time.time()
+            if now - last_progress >= self.progress_every:
+                last_progress = now
+                self.log(f"Progress({depth}): {generated} states generated, "
+                         f"{distinct} distinct states found, "
+                         f"{fcount} states left on queue.")
+
+        self.log("Model checking completed. No error has been found.")
+        self.log(f"{generated} states generated, {distinct} distinct states "
+                 f"found, 0 states left on queue.")
+        self.log(f"The depth of the complete state graph search is "
+                 f"{depth}.")
+        return self._mk_result(True, distinct, generated, depth - 1, t0,
+                               warnings)
+
+    def _mk_result(self, ok, distinct, generated, diameter, t0, warnings,
+                   violation=None, truncated=False) -> CheckResult:
+        return CheckResult(ok=ok, distinct=distinct, generated=generated,
+                           diameter=max(diameter, 0), violation=violation,
+                           wall_s=time.time() - t0, truncated=truncated,
+                           warnings=warnings)
+
+    def _trace_to(self, trace_levels, frontier_maps, level: int, idx: int,
+                  from_new: bool = False) -> List[Tuple[Dict, str]]:
+        """Reconstruct the path to frontier index idx at `level` (or to
+        new-row index idx when from_new)."""
+        if not self.store_trace:
+            return []
+        out = []
+        lvl = level
+        cur = idx
+        if not from_new and lvl < len(frontier_maps):
+            cur = int(frontier_maps[lvl][cur])
+        while lvl >= 0:
+            rows, prov, par_FC = trace_levels[lvl]
+            row = rows[cur]
+            st = self.layout.decode(row)
+            if prov is None:
+                out.append((st, "Initial predicate"))
+                break
+            p = int(prov[cur])
+            a, f = p // par_FC, p % par_FC
+            out.append((st, self.actions[a].label))
+            lvl -= 1
+            cur = int(frontier_maps[lvl][f]) if lvl < len(frontier_maps) \
+                else f
+        out.reverse()
+        return out

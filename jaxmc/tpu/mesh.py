@@ -1,0 +1,329 @@
+r"""Multi-chip BFS over a jax.sharding.Mesh (SURVEY.md §2.3, §5).
+
+Frontier data-parallelism + fingerprint-space sharding: each device owns
+(a) a shard of the frontier (expanded locally with the same compiled action
+kernels as the single-chip path) and (b) a hash range of the seen-set.
+Per level, every device expands its frontier shard, the candidate successors
+are all_gather'd over the ICI axis, and each device keeps exactly the rows
+whose row-hash lands in its range — the structural analogue of
+ring-partitioned attention state for a model checker (SURVEY.md §5
+"long-context" row). Dedup within a shard is the same exact lexicographic
+sort as tpu/bfs.py; totals are psum'd.
+
+The driver validates this path with N virtual CPU devices via
+__graft_entry__.dryrun_multichip (no multi-chip hardware needed).
+Collective-efficiency upgrades (hash-routed ppermute/all_to_all instead of
+all_gather) are planned once profiling on real multi-chip hardware exists.
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..sem.modules import Model
+from ..sem.enumerate import enumerate_init
+from ..engine.explore import CheckResult, Violation
+from ..compile.ground import CompileError, build_layout, ground_actions
+from ..compile.kernel import compile_action, compile_predicate
+from .bfs import SENTINEL, _pow2_at_least
+
+
+def _row_hash(rows, xp=jnp):
+    """Deterministic FNV-1a row hash for owner routing (uint32 lanes).
+    xp=jnp on device, xp=np for host-side init-state routing — ONE
+    implementation so the two can never diverge."""
+    h = xp.full(rows.shape[:-1], 2166136261, xp.uint32)
+    for i in range(rows.shape[-1]):
+        h = (h ^ rows[..., i].astype(xp.uint32)) * xp.uint32(16777619)
+    return h
+
+
+class MeshExplorer:
+    """BFS with the frontier and seen-set sharded across a device mesh."""
+
+    def __init__(self, model: Model, mesh: Optional[Mesh] = None,
+                 log: Callable[[str], None] = None,
+                 max_states: Optional[int] = None,
+                 progress_every: float = 30.0):
+        self.model = model
+        self.log = log or (lambda s: None)
+        self.max_states = max_states
+        self.progress_every = progress_every
+        if mesh is None:
+            mesh = Mesh(np.array(jax.devices()), ("d",))
+        self.mesh = mesh
+        self.D = mesh.devices.size
+
+        base_ctx = model.ctx()
+        self.init_states = enumerate_init(model.init, base_ctx, model.vars)
+        self.layout = build_layout(model, self.init_states)
+        self.actions = ground_actions(model)
+        self.compiled = [compile_action(model, self.layout, ga)
+                         for ga in self.actions]
+        self.inv_fns = [(nm, compile_predicate(model, self.layout, ex))
+                        for nm, ex in model.invariants]
+        self.con_fns = [(nm, compile_predicate(model, self.layout, ex))
+                        for nm, ex in model.constraints]
+        if model.action_constraints:
+            raise CompileError("action constraints not compiled yet")
+        self.A = len(self.compiled)
+        self.W = self.layout.width
+        self._step_cache: Dict[Tuple[int, int], Callable] = {}
+
+    def _get_step(self, SC: int, FC: int) -> Callable:
+        """Per-device seen capacity SC, per-device frontier capacity FC."""
+        key = (SC, FC)
+        if key in self._step_cache:
+            return self._step_cache[key]
+        A, W, D = self.A, self.W, self.D
+        acts = self.compiled
+        inv_fns = self.inv_fns
+        con_fns = self.con_fns
+
+        def device_step(seen, frontier, fcount):
+            # per-device blocks: seen [SC,W], frontier [FC,W], fcount [1]
+            seen = seen.reshape(SC, W)
+            frontier = frontier.reshape(FC, W)
+            me = lax.axis_index("d")
+            fvalid = jnp.arange(FC) < fcount[0]
+            ens, aoks, succs = [], [], []
+            for ca in acts:
+                en, aok, succ = jax.vmap(ca.fn)(frontier)
+                ens.append(en)
+                aoks.append(aok)
+                succs.append(succ)
+            en = jnp.stack(ens)
+            aok = jnp.stack(aoks)
+            succ = jnp.stack(succs)
+            valid = en & fvalid[None, :]
+            assert_bad = jnp.any((~aok) & fvalid[None, :])
+            dead_local = jnp.any(fvalid & ~jnp.any(en, axis=0))
+            gen_local = jnp.sum(valid)
+
+            C = A * FC
+            cand = jnp.where(valid.reshape(C)[:, None],
+                             succ.reshape(C, W), SENTINEL)
+            # ICI exchange: gather all candidates, keep my hash range
+            allc = lax.all_gather(cand, "d", tiled=True)     # [D*C, W]
+            owner = (_row_hash(allc) % jnp.uint32(D)).astype(jnp.int32)
+            mine = (owner == me) & (allc[:, 0] != SENTINEL)
+            allc = jnp.where(mine[:, None], allc, SENTINEL)
+
+            # exact dedup against my seen shard
+            G = D * C
+            rows_all = jnp.concatenate([seen, allc])
+            flag = jnp.concatenate([jnp.zeros(SC, jnp.int32),
+                                    jnp.ones(G, jnp.int32)])
+            ops = tuple(rows_all[:, i] for i in range(W)) + (flag,)
+            sorted_ = lax.sort(ops, num_keys=W + 1, is_stable=True)
+            rows = jnp.stack(sorted_[:W], axis=1)
+            sflag = sorted_[W]
+            rvalid = rows[:, 0] != SENTINEL
+            neq_prev = jnp.concatenate([
+                jnp.array([True]), jnp.any(rows[1:] != rows[:-1], axis=1)])
+            new = (sflag == 1) & rvalid & neq_prev
+            new_count = jnp.sum(new)
+
+            # hash skew can route up to G new rows to one device, so the
+            # compacted buffers are G-sized — truncating to C would silently
+            # drop states
+            ops2 = ((1 - new.astype(jnp.int32)),) + \
+                tuple(rows[:, i] for i in range(W))
+            comp = lax.sort(ops2, num_keys=1, is_stable=True)
+            new_rows = jnp.stack(comp[1:], axis=1)[:max(G, 1)]
+
+            keep = ((sflag == 0) & rvalid) | new
+            ops3 = ((1 - keep.astype(jnp.int32)),) + \
+                tuple(rows[:, i] for i in range(W))
+            comp3 = lax.sort(ops3, num_keys=1, is_stable=True)
+            seen2 = jnp.stack(comp3[1:], axis=1)[:SC]
+            seen_count2 = jnp.sum(keep)
+
+            inv_bad = jnp.asarray(False)
+            nvalid = jnp.arange(new_rows.shape[0]) < new_count
+            for nm, f in inv_fns:
+                inv_bad = inv_bad | jnp.any(nvalid & ~jax.vmap(f)(new_rows))
+            explore = nvalid
+            for nm, f in con_fns:
+                explore = explore & jax.vmap(f)(new_rows)
+            ops4 = ((1 - explore.astype(jnp.int32)),) + \
+                tuple(new_rows[:, i] for i in range(W))
+            comp4 = lax.sort(ops4, num_keys=1, is_stable=True)
+            front_rows = jnp.stack(comp4[1:], axis=1)[:max(G, 1)]
+            front_count = jnp.sum(explore)
+
+            # global reductions over ICI
+            tot_gen = lax.psum(gen_local, "d")
+            tot_new = lax.psum(new_count, "d")
+            any_dead = lax.psum(dead_local.astype(jnp.int32), "d") > 0
+            any_assert = lax.psum(assert_bad.astype(jnp.int32), "d") > 0
+            any_inv = lax.psum(inv_bad.astype(jnp.int32), "d") > 0
+            tot_front = lax.psum(front_count, "d")
+
+            return (seen2.reshape(1, SC, W), seen_count2.reshape(1),
+                    front_rows.reshape(1, -1, W), front_count.reshape(1),
+                    tot_gen.reshape(1), tot_new.reshape(1),
+                    any_dead.reshape(1), any_assert.reshape(1),
+                    any_inv.reshape(1), tot_front.reshape(1))
+
+        try:
+            from jax import shard_map
+        except ImportError:  # older jax
+            from jax.experimental.shard_map import shard_map
+        step = jax.jit(shard_map(
+            device_step, mesh=self.mesh,
+            in_specs=(P("d"), P("d"), P("d")),
+            out_specs=(P("d"), P("d"), P("d"), P("d"), P("d"), P("d"),
+                       P("d"), P("d"), P("d"), P("d"))))
+        self._step_cache[key] = step
+        return step
+
+    def run(self) -> CheckResult:
+        t0 = time.time()
+        model = self.model
+        layout = self.layout
+        D, W = self.D, self.W
+        warnings = []
+        if model.properties:
+            warnings.append("temporal properties NOT checked (unimplemented)"
+                            f": {', '.join(n for n, _ in model.properties)}")
+
+        # encode + host-dedup init states, distribute by owner hash
+        rows = {}
+        for st in self.init_states:
+            rows[layout.encode(st).tobytes()] = None
+        init_rows = np.stack([np.frombuffer(k, dtype=np.int32)
+                              for k in rows]) if rows \
+            else np.zeros((0, W), np.int32)
+        n_init = len(init_rows)
+        generated = n_init
+        distinct = n_init
+        self.log(f"Finished computing initial states: {n_init} distinct "
+                 f"state{'s' if n_init != 1 else ''} generated.")
+
+        # invariants + constraints on init states (host-side interpreter)
+        from ..sem.eval import eval_expr, _bool
+        explored_mask = np.ones(n_init, bool)
+        for i, row in enumerate(init_rows):
+            st = layout.decode(row)
+            ctx = model.ctx(state=st)
+            for nm, ex2 in model.invariants:
+                if not _bool(eval_expr(ex2, ctx), f"invariant {nm}"):
+                    return self._mk(False, distinct, generated, 0, t0,
+                                    warnings, Violation(
+                                        "invariant", nm,
+                                        [(st, "Initial predicate")]))
+            if not all(_bool(eval_expr(ex2, ctx), f"constraint {nm}")
+                       for nm, ex2 in model.constraints):
+                explored_mask[i] = False
+
+        owner = (_row_hash(init_rows, xp=np) % np.uint32(D)).astype(np.int64)
+
+        per_dev = [init_rows[(owner == d) & explored_mask]
+                   for d in range(D)]
+        seen_per_dev = [init_rows[owner == d] for d in range(D)]
+        FC = _pow2_at_least(
+            max(max((len(p) for p in per_dev), default=1), 1), lo=64)
+        SC = _pow2_at_least(4 * FC, lo=256)
+
+        frontier = np.full((D, FC, W), SENTINEL, np.int32)
+        seen = np.full((D, SC, W), SENTINEL, np.int32)
+        fcount = np.zeros((D,), np.int32)
+        for d in range(D):
+            p = per_dev[d]
+            frontier[d, :len(p)] = p
+            sp = seen_per_dev[d]
+            if len(sp):
+                order = np.lexsort(tuple(sp[:, i]
+                                         for i in reversed(range(W))))
+                seen[d, :len(sp)] = sp[order]
+            fcount[d] = len(p)
+        frontier = jnp.asarray(frontier)
+        seen = jnp.asarray(seen)
+        fcount = jnp.asarray(fcount)
+        seen_counts = np.array([len(p) for p in seen_per_dev], np.int64)
+
+        depth = 0
+        last_progress = time.time()
+        while int(np.sum(np.asarray(fcount))) > 0:
+            C = self.A * FC
+            if int(seen_counts.max(initial=0)) + D * C > SC:
+                SC2 = _pow2_at_least(int(seen_counts.max(initial=0)) + D * C,
+                                     SC)
+                pad = jnp.full((D, SC2 - SC, W), SENTINEL, jnp.int32)
+                seen = jnp.concatenate([seen, pad], axis=1)
+                SC = SC2
+            step = self._get_step(SC, FC)
+            (seen, seen_cnt, front_rows, front_cnt, tot_gen, tot_new,
+             any_dead, any_assert, any_inv, tot_front) = step(
+                seen, frontier, fcount)
+
+            if model.check_deadlock and bool(np.asarray(any_dead)[0]):
+                return self._mk(False, distinct, generated, depth, t0,
+                                warnings, Violation(
+                                    "deadlock", "deadlock", [],
+                                    "deadlock found (mesh backend has no "
+                                    "trace reconstruction yet)"))
+            if bool(np.asarray(any_assert)[0]):
+                return self._mk(False, distinct, generated, depth, t0,
+                                warnings, Violation(
+                                    "assert", "Assert", [],
+                                    "assertion violated (mesh backend has "
+                                    "no trace reconstruction yet)"))
+
+            generated += int(np.asarray(tot_gen)[0])
+            new_total = int(np.asarray(tot_new)[0])
+            distinct += new_total
+            seen_counts = np.asarray(seen_cnt).astype(np.int64)
+
+            if bool(np.asarray(any_inv)[0]):
+                return self._mk(False, distinct, generated, depth + 1, t0,
+                                warnings, Violation(
+                                    "invariant", "invariant", [],
+                                    "invariant violated (mesh backend has "
+                                    "no trace reconstruction yet)"))
+            depth += 1
+            if self.max_states and distinct >= self.max_states:
+                self.log("-- state limit reached, search truncated")
+                return self._mk(True, distinct, generated, depth, t0,
+                                warnings, truncated=True)
+
+            # next frontier: per-device new rows, capacity = max new count
+            fcount = front_cnt
+            max_front = int(np.asarray(front_cnt).max(initial=0))
+            if max_front > FC:
+                FC = _pow2_at_least(max_front, FC)
+                fr = np.asarray(front_rows)
+                k = min(fr.shape[1], FC)
+                nf = np.full((D, FC, W), SENTINEL, np.int32)
+                nf[:, :k] = fr[:, :k]
+                frontier = jnp.asarray(nf)
+            else:
+                frontier = front_rows[:, :FC]
+
+            now = time.time()
+            if now - last_progress >= self.progress_every:
+                last_progress = now
+                self.log(f"Progress({depth}): {generated} generated, "
+                         f"{distinct} distinct, "
+                         f"{int(np.asarray(tot_front)[0])} on queue.")
+
+        self.log("Model checking completed. No error has been found.")
+        self.log(f"{generated} states generated, {distinct} distinct states "
+                 f"found, 0 states left on queue.")
+        return self._mk(True, distinct, generated, depth - 1, t0, warnings)
+
+    def _mk(self, ok, distinct, generated, diameter, t0, warnings,
+            violation=None, truncated=False):
+        return CheckResult(ok=ok, distinct=distinct, generated=generated,
+                           diameter=max(diameter, 0), violation=violation,
+                           wall_s=time.time() - t0, truncated=truncated,
+                           warnings=warnings)

@@ -1,13 +1,19 @@
 import os
 import sys
 
-# Tests run on CPU with a virtual 8-device mesh so multi-chip sharding logic is
-# exercised without TPU hardware (the driver separately dry-runs multichip).
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-os.environ.setdefault(
-    "XLA_FLAGS",
-    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8",
-)
+# Tests run on CPU with a virtual 8-device mesh so multi-chip sharding logic
+# is exercised without TPU hardware (the driver separately dry-runs
+# multichip). The axon TPU plugin registers itself in sitecustomize at
+# interpreter start, so setting JAX_PLATFORMS in os.environ here is too late
+# — jax.config.update is the reliable runtime switch.
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=8")
+
+try:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+except ImportError:
+    pass
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

@@ -1,0 +1,137 @@
+r"""JAX backend tests: kernel compilation, device BFS, mesh sharding.
+
+Equivalence contract (BASELINE.json): identical reachable-state counts
+between BACKEND=interp and BACKEND=jax on full (non-violating) runs; same
+verdicts on violating ones. Runs on CPU; conftest provides an 8-device
+virtual mesh.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from jaxmc.front.cfg import ModelConfig, parse_cfg
+from jaxmc.sem.modules import Loader, bind_model
+
+from conftest import REFERENCE
+
+SPECS = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                     "specs")
+
+
+def load(path, cfg=None):
+    m = Loader([os.path.dirname(os.path.abspath(path))]).load_path(path)
+    return bind_model(m, cfg or ModelConfig(specification="Spec"))
+
+
+@pytest.fixture(scope="module")
+def pcal_model():
+    cfg = parse_cfg(open(os.path.join(REFERENCE, "pcal_intro.cfg")).read())
+    return load(os.path.join(REFERENCE, "pcal_intro.tla"), cfg)
+
+
+class TestLayout:
+    def test_roundtrip(self, pcal_model):
+        from jaxmc.compile.ground import build_layout
+        from jaxmc.sem.enumerate import enumerate_init
+        inits = enumerate_init(pcal_model.init, pcal_model.ctx(),
+                               pcal_model.vars)
+        lay = build_layout(pcal_model, inits)
+        for st in inits[:10]:
+            row = lay.encode(st)
+            back = lay.decode(row)
+            assert back == st
+
+    def test_grounding_labels(self, pcal_model):
+        from jaxmc.compile.ground import ground_actions
+        gas = ground_actions(pcal_model)
+        labels = {g.label for g in gas}
+        assert any(l.startswith("Transfer(") for l in labels)
+        assert "Terminating" in labels
+
+
+class TestDeviceBFS:
+    def test_atomic_add_counts(self):
+        from jaxmc.tpu.bfs import TpuExplorer
+        model = load(os.path.join(REFERENCE, "atomic_add.tla"))
+        r = TpuExplorer(model).run()
+        assert r.ok and r.distinct == 5 and r.generated == 7
+
+    def test_pcal_intro_matches_interp(self, pcal_model):
+        from jaxmc.tpu.bfs import TpuExplorer
+        r = TpuExplorer(pcal_model).run()
+        assert r.ok
+        assert r.distinct == 3800     # == interpreter == oracle counts
+        assert r.generated == 5850
+
+    def test_buggy_assert_found_with_trace(self):
+        from jaxmc.tpu.bfs import TpuExplorer
+        model = load(os.path.join(SPECS, "pcal_intro_buggy.tla"))
+        r = TpuExplorer(model).run()
+        assert not r.ok and r.violation.kind == "assert"
+        assert len(r.violation.trace) == 6  # same depth as TLC's trace
+        # the trace must be a genuine behavior: replay it on the interpreter
+        from jaxmc.sem.enumerate import enumerate_init, enumerate_next
+        ctx = model.ctx()
+        inits = enumerate_init(model.init, ctx, model.vars)
+        assert r.violation.trace[0][0] in inits
+        for (st, _), (succ, _) in zip(r.violation.trace,
+                                      r.violation.trace[1:]):
+            succs = []
+            try:
+                for s2, _lbl in enumerate_next(model.next, ctx, model.vars,
+                                               st):
+                    succs.append(s2)
+            except Exception:
+                pass  # assert may fire during full expansion
+            assert succ in succs
+
+    def test_invariant_violation(self):
+        from jaxmc.tpu.bfs import TpuExplorer
+        cfg = ModelConfig(specification="Spec",
+                          invariants=["MoneyInvariant"])
+        model = load(os.path.join(SPECS, "pcal_intro_buggy.tla"), cfg)
+        r = TpuExplorer(model).run()
+        assert not r.ok and r.violation.kind == "invariant"
+        assert r.violation.name == "MoneyInvariant"
+        # violating state really violates it
+        st = r.violation.trace[-1][0]
+        assert st["alice_account"] + st["bob_account"] != st["account_total"]
+
+
+class TestMesh:
+    def test_pcal_intro_mesh_counts(self, pcal_model):
+        import jax
+        from jaxmc.tpu.mesh import MeshExplorer
+        assert len(jax.devices()) >= 8
+        r = MeshExplorer(pcal_model).run()
+        assert r.ok
+        assert r.distinct == 3800
+        assert r.generated == 5850
+
+    def test_atomic_add_mesh(self):
+        from jaxmc.tpu.mesh import MeshExplorer
+        model = load(os.path.join(REFERENCE, "atomic_add.tla"))
+        r = MeshExplorer(model).run()
+        assert r.ok and r.distinct == 5 and r.generated == 7
+
+
+class TestGraftEntry:
+    def test_entry_compiles(self):
+        import sys
+        sys.path.insert(0, os.path.dirname(SPECS))
+        import importlib
+        import __graft_entry__ as g
+        importlib.reload(g)
+        import jax
+        fn, args = g.entry()
+        en, succ = jax.jit(fn)(*args)
+        assert en.shape[1] == args[0].shape[0]
+        assert succ.shape[-1] == args[0].shape[1]
+
+    def test_dryrun_multichip(self):
+        import sys
+        sys.path.insert(0, os.path.dirname(SPECS))
+        import __graft_entry__ as g
+        g.dryrun_multichip(8)
