@@ -33,15 +33,11 @@ def main():
         devs = jax.devices()
         platform = "cpu (tpu init failed)"
 
-    from jaxmc.sem.modules import Loader, bind_model
-    from jaxmc.front.cfg import parse_cfg
     from jaxmc.tpu.bfs import TpuExplorer
     from jaxmc.engine.explore import Explorer
+    from __graft_entry__ import _load_flagship
 
-    spec = os.path.join(_REPO, "specs", "transfer_scaled.tla")
-    cfg = parse_cfg(open(os.path.join(_REPO, "specs",
-                                      "transfer_scaled.cfg")).read())
-    model = bind_model(Loader([]).load_path(spec), cfg)
+    model = _load_flagship()
 
     # device backend: warm-up run compiles all (seen_cap, frontier_cap)
     # buckets; the timed run reuses the jit cache

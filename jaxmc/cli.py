@@ -36,10 +36,49 @@ def _load_model(spec_path: str, cfg_path, no_deadlock: bool):
     return bind_model(mod, cfg)
 
 
+def _check_assumes(spec_path: str, cfg_path) -> int:
+    """TLC's "No Behavior Spec" mode: evaluate the module's ASSUMEs as a
+    calculator / unit-test harness (SimpleMath.cfg:4-11, PrintValues.tla —
+    SURVEY.md §4.4)."""
+    from .front.cfg import parse_cfg, ModelConfig
+    from .sem.modules import Loader, bind_model_defs
+    from .sem.eval import Ctx, eval_expr
+    from .sem.values import fmt
+
+    cfg = parse_cfg(open(cfg_path, encoding="utf-8", errors="replace").read()) \
+        if cfg_path else ModelConfig()
+    ldr = Loader([os.path.dirname(os.path.abspath(spec_path))])
+    mod = ldr.load_path(spec_path)
+    defs = bind_model_defs(mod, cfg)
+    prints = []
+    ctx = Ctx(defs, {}, None, None, (), on_print=lambda v: prints.append(v))
+    failed = 0
+    for a in mod.assumes:
+        v = eval_expr(a.expr, ctx)
+        nm = a.name or "ASSUME"
+        if v is not True:
+            print(f"Assumption {nm} is violated (evaluated to {fmt(v)}).")
+            failed += 1
+    for v in prints:
+        print(fmt(v) if not isinstance(v, str) else v)
+    if failed:
+        return 1
+    print(f"{len(mod.assumes)} assumption"
+          f"{'s' if len(mod.assumes) != 1 else ''} checked. "
+          "No error has been found.")
+    return 0
+
+
 def cmd_check(args) -> int:
     from .engine.explore import Explorer, format_trace
+    from .front.cfg import parse_cfg
 
     t0 = time.time()
+    if args.cfg or os.path.exists(os.path.splitext(args.spec)[0] + ".cfg"):
+        cfgp = args.cfg or os.path.splitext(args.spec)[0] + ".cfg"
+        c = parse_cfg(open(cfgp, encoding="utf-8", errors="replace").read())
+        if not c.specification and not c.init:
+            return _check_assumes(args.spec, cfgp)
     model = _load_model(args.spec, args.cfg, args.no_deadlock)
     log = (lambda s: None) if args.quiet else print
     if args.backend == "interp":

@@ -245,7 +245,6 @@ def _sym_eq(a, b, uni):
         for m in set(map(_key, da)) | set(map(_key, db)):
             la = {_key(k): v for k, v in da.items()}.get(m, False)
             lb = {_key(k): v for k, v in db.items()}.get(m, False)
-            ea = la if not isinstance(la, bool) or la else la
             same = jnp.equal(la, lb) if (_is_traced(la) or _is_traced(lb))                 else (la == lb)
             acc = _land(acc, same)
         return acc

@@ -113,6 +113,22 @@ class Walker:
             # user-defined operator application → expand as action
             target = ctx.bound[name] if name in ctx.bound else ctx.defs.get(name)
             if isinstance(target, OpClosure):
+                from ..front.subst import (contains_prime, primes_params,
+                                           subst)
+                if (any(contains_prime(a) for a in e.args)
+                        or primes_params(target.body, target.params)) \
+                        and target.defs is None:
+                    # call-by-name: an argument carries a primed variable
+                    # (Lose(msgQ) assigning q', Send(..., memInt') through an
+                    # operator constant) — substitute argument ASTs so the
+                    # assignment target survives into the body
+                    body = subst(target.body,
+                                 dict(zip(target.params, e.args)))
+                    new_label = label
+                    if label is None or not label[2]:
+                        new_label = (name, (), False)
+                    yield from self.walk(body, ctx, partial, new_label)
+                    return
                 args = [_arg_value(a, ectx) for a in e.args]
                 inner = ctx
                 if target.defs is not None:
@@ -182,6 +198,17 @@ class Walker:
             p = dict(partial)
             if self._unchanged(e.expr, ctx, p):
                 yield p, label
+            return
+
+        elif isinstance(e, A.BoxAction):
+            # [A]_v as an action: A \/ (v' = v)  (MCRealTimeHourClock's
+            # BigNext composes subactions this way)
+            if self.mode != "next":
+                raise EvalError("[A]_v in Init")
+            yield from self.walk(e.action, ctx, dict(partial), label)
+            p = dict(partial)
+            if self._unchanged(e.sub, ctx, p):
+                yield p, _freeze(label)
             return
 
         elif isinstance(e, A.Bool):

@@ -13,9 +13,10 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..front import tla_ast as A
-from .values import (EvalError, Fcn, InfiniteSet, ModelValue, BOOLEAN_SET,
-                     EMPTY_FCN, INT, NAT, REAL, STRING_SET, enumerate_set,
-                     fmt, in_set, mk_record, mk_seq, sort_key, tla_eq)
+from .values import (EvalError, Fcn, FcnSetV, InfiniteSet, ModelValue,
+                     BOOLEAN_SET, EMPTY_FCN, INT, NAT, REAL, STRING_SET,
+                     enumerate_set, fmt, in_set, mk_record, mk_seq,
+                     sort_key, tla_eq)
 
 
 class TLCAssertFailure(EvalError):
@@ -270,9 +271,14 @@ def _ev_prime(e, ctx):
     name = e.expr.name
     if ctx.primes is None:
         raise EvalError(f"{name}' used outside an action")
-    if name not in ctx.primes:
-        raise UnassignedPrime(name)
-    return ctx.primes[name]
+    if name in ctx.vars or name in ctx.primes:
+        if name not in ctx.primes:
+            raise UnassignedPrime(name)
+        return ctx.primes[name]
+    # primed DEFINITION (opId', InnerSerial.tla:6): evaluate its body with
+    # the primed state as the state
+    sub = Ctx(ctx.defs, ctx.bound, ctx.primes, None, ctx.vars, ctx.on_print)
+    return eval_expr(e.expr, sub)
 
 
 def apply_op(opv, args: List[Any], ctx: Ctx):
@@ -455,14 +461,10 @@ def _ev_fndef(e: A.FnDef, ctx: Ctx):
 
 
 def _ev_fnset(e: A.FnSet, ctx: Ctx):
+    from .values import FcnSetV
     dom = eval_expr(e.dom, ctx)
     rng = eval_expr(e.rng, ctx)
-    delems = enumerate_set(dom)
-    relems = enumerate_set(rng)
-    out = []
-    for combo in itertools.product(relems, repeat=len(delems)):
-        out.append(Fcn(dict(zip(delems, combo))))
-    return frozenset(out)
+    return FcnSetV(dom, rng)
 
 
 def _ev_record(e: A.RecordExpr, ctx: Ctx):

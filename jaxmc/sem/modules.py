@@ -207,7 +207,13 @@ def _cfg_value(v):
 
 
 def _split_spec(expr: A.Node, defs: Dict[str, Any]):
-    """Extract Init and Next from Spec == Init /\\ [][Next]_vars /\\ fairness."""
+    """Extract Init and Next from Spec == Init /\\ [][Next]_vars /\\ fairness.
+
+    A conjunct that is a plain name (LSpec == HC /\\ WF_hr(HCnxt),
+    Liveness/LiveHourClock.tla:9) is expanded when its definition contains
+    a [][N]_v somewhere — so nested Spec definitions resolve — and treated
+    as the initial predicate otherwise."""
+    from ..front.subst import contains_box
     init = None
     nxt = None
     fair = []
@@ -220,19 +226,20 @@ def _split_spec(expr: A.Node, defs: Dict[str, Any]):
             return
         if isinstance(e, A.OpApp) and e.name == "[]" and \
                 isinstance(e.args[0], A.BoxAction):
+            if nxt is not None:
+                raise EvalError("specification has two [][Next]_vars "
+                                "conjuncts")
             nxt = e.args[0].action
             return
-        if isinstance(e, (A.Fair,)):
+        if isinstance(e, (A.Fair, A.Quant)):
             fair.append(e)
             return
-        if isinstance(e, A.Quant):
-            fair.append(e)  # quantified fairness
-            return
-        if isinstance(e, A.Ident) and isinstance(defs.get(e.name), OpClosure) \
-                and init is not None and nxt is None:
-            # rare: Spec == Init /\ NextDef where NextDef == [][N]_v
-            walk(defs[e.name].body)
-            return
+        if isinstance(e, A.Ident):
+            d = defs.get(e.name)
+            if isinstance(d, OpClosure) and not d.params \
+                    and contains_box(d.body):
+                walk(d.body)
+                return
         if init is None:
             init = e
         else:
@@ -245,8 +252,8 @@ def _split_spec(expr: A.Node, defs: Dict[str, Any]):
     return init, nxt, fair
 
 
-def bind_model(module: LoadedModule, cfg: ModelConfig) -> Model:
-    """Bind cfg constants/overrides and resolve the checked formulas."""
+def bind_model_defs(module: LoadedModule, cfg: ModelConfig) -> Dict[str, Any]:
+    """Bind cfg constants/overrides into a definition table."""
     defs = dict(module.defs)
     declared = {n for n, _ in module.constants}
     for cname, val in cfg.constants.items():
@@ -267,7 +274,12 @@ def bind_model(module: LoadedModule, cfg: ModelConfig) -> Model:
     missing = [n for n in declared if n not in defs]
     if missing:
         raise EvalError(f"constants not bound by cfg: {missing}")
+    return defs
 
+
+def bind_model(module: LoadedModule, cfg: ModelConfig) -> Model:
+    """Bind cfg constants/overrides and resolve the checked formulas."""
+    defs = bind_model_defs(module, cfg)
     vars = tuple(module.variables)
 
     def named(nm):

@@ -33,6 +33,9 @@ def _int(v, op):
 def _set(v, op):
     if isinstance(v, frozenset):
         return v
+    from .values import FcnSetV
+    if isinstance(v, FcnSetV):
+        return v.materialize()
     raise EvalError(f"{op} applied to non-enumerable-set {fmt(v)}")
 
 

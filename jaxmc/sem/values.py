@@ -149,6 +149,49 @@ class InfiniteSet:
         return hash(("$inf", self.kind, self.param))
 
 
+class FcnSetV:
+    """Lazy [S -> T]: membership without materialization, so TypeOK-style
+    checks like opQ \\in [Proc -> Seq(opVal)] work with infinite ranges
+    (AdvancedExamples/InnerSerial.tla:24). Enumeration materializes."""
+    __slots__ = ("dom", "rng", "_mat")
+
+    def __init__(self, dom, rng):
+        self.dom = dom
+        self.rng = rng
+        self._mat = None
+
+    def contains(self, v) -> bool:
+        if not isinstance(v, Fcn):
+            return False
+        if v.domain() != (self.dom if isinstance(self.dom, frozenset)
+                          else frozenset(enumerate_set(self.dom))):
+            return False
+        return all(in_set(x, self.rng) for x in v.d.values())
+
+    def materialize(self) -> frozenset:
+        if self._mat is None:
+            import itertools
+            delems = enumerate_set(self.dom)
+            relems = enumerate_set(self.rng)
+            self._mat = frozenset(
+                Fcn(dict(zip(delems, combo)))
+                for combo in itertools.product(relems, repeat=len(delems)))
+        return self._mat
+
+    def __eq__(self, other):
+        if isinstance(other, FcnSetV):
+            return self.dom == other.dom and self.rng == other.rng
+        if isinstance(other, frozenset):
+            return self.materialize() == other
+        return NotImplemented
+
+    def __hash__(self):
+        return hash(self.materialize())
+
+    def __repr__(self):
+        return f"[{fmt(self.dom)} -> {fmt(self.rng)}]"
+
+
 NAT = InfiniteSet("Nat")
 INT = InfiniteSet("Int")
 REAL = InfiniteSet("Real")
@@ -157,6 +200,8 @@ BOOLEAN_SET = frozenset({True, False})
 
 
 def in_set(v, s) -> bool:
+    if isinstance(s, FcnSetV):
+        return s.contains(v)
     if isinstance(s, frozenset):
         # Python's True == 1 must not leak into TLA+ semantics where
         # TRUE /= 1: disambiguate bool/int hash collisions by scan.
@@ -172,6 +217,8 @@ def in_set(v, s) -> bool:
 
 def enumerate_set(s) -> List[Any]:
     """Deterministically ordered elements; raises on infinite sets."""
+    if isinstance(s, FcnSetV):
+        return sorted(s.materialize(), key=sort_key)
     if isinstance(s, frozenset):
         return sorted(s, key=sort_key)
     if isinstance(s, InfiniteSet):
@@ -201,6 +248,8 @@ def sort_key(v):
                 tuple((sort_key(k), sort_key(x)) for k, x in items))
     if t is InfiniteSet:
         return (6, v.kind)
+    if t is FcnSetV:
+        return sort_key(v.materialize())
     raise EvalError(f"unorderable value {v!r}")
 
 
@@ -220,7 +269,7 @@ def _kind(v):
         return "int"
     if isinstance(v, str):
         return "str"
-    if isinstance(v, frozenset) or isinstance(v, InfiniteSet):
+    if isinstance(v, (frozenset, InfiniteSet, FcnSetV)):
         return "set"
     if isinstance(v, Fcn):
         return "fcn"
@@ -232,8 +281,10 @@ def tla_eq(a, b) -> bool:
         return a is b
     if not values_comparable(a, b):
         raise EvalError(f"attempted to compare {fmt(a)} with {fmt(b)}")
-    if isinstance(a, InfiniteSet) or isinstance(b, InfiniteSet):
+    if isinstance(a, FcnSetV):
         return a == b
+    if isinstance(b, FcnSetV):
+        return b == a
     return a == b
 
 
@@ -260,6 +311,6 @@ def fmt(v) -> str:
                                    for k, x in sorted(v.d.items())) + "]"
         items = sorted(v.d.items(), key=lambda kv: sort_key(kv[0]))
         return "(" + " @@ ".join(f"{fmt(k)} :> {fmt(x)}" for k, x in items) + ")"
-    if isinstance(v, InfiniteSet):
+    if isinstance(v, (InfiniteSet, FcnSetV)):
         return repr(v)
     return repr(v)
