@@ -16,7 +16,8 @@ import sys
 import time
 
 
-def _load_model(spec_path: str, cfg_path, no_deadlock: bool):
+def _load_model(spec_path: str, cfg_path, no_deadlock: bool,
+                includes=()):
     from .front.cfg import parse_cfg, ModelConfig
     from .sem.modules import Loader, bind_model
 
@@ -31,12 +32,13 @@ def _load_model(spec_path: str, cfg_path, no_deadlock: bool):
         cfg = ModelConfig(specification="Spec")
     if no_deadlock:
         cfg.check_deadlock = False
-    ldr = Loader([os.path.dirname(os.path.abspath(spec_path))])
+    ldr = Loader([os.path.dirname(os.path.abspath(spec_path))] +
+                 list(includes))
     mod = ldr.load_path(spec_path)
     return bind_model(mod, cfg)
 
 
-def _check_assumes(spec_path: str, cfg_path) -> int:
+def _check_assumes(spec_path: str, cfg_path, includes=()) -> int:
     """TLC's "No Behavior Spec" mode: evaluate the module's ASSUMEs as a
     calculator / unit-test harness (SimpleMath.cfg:4-11, PrintValues.tla —
     SURVEY.md §4.4)."""
@@ -47,7 +49,8 @@ def _check_assumes(spec_path: str, cfg_path) -> int:
 
     cfg = parse_cfg(open(cfg_path, encoding="utf-8", errors="replace").read()) \
         if cfg_path else ModelConfig()
-    ldr = Loader([os.path.dirname(os.path.abspath(spec_path))])
+    ldr = Loader([os.path.dirname(os.path.abspath(spec_path))] +
+                 list(includes))
     mod = ldr.load_path(spec_path)
     defs = bind_model_defs(mod, cfg)
     prints = []
@@ -78,8 +81,9 @@ def cmd_check(args) -> int:
         cfgp = args.cfg or os.path.splitext(args.spec)[0] + ".cfg"
         c = parse_cfg(open(cfgp, encoding="utf-8", errors="replace").read())
         if not c.specification and not c.init:
-            return _check_assumes(args.spec, cfgp)
-    model = _load_model(args.spec, args.cfg, args.no_deadlock)
+            return _check_assumes(args.spec, cfgp, args.include)
+    model = _load_model(args.spec, args.cfg, args.no_deadlock,
+                        args.include)
     log = (lambda s: None) if args.quiet else print
     if args.backend == "interp":
         ex = Explorer(model, log=log, max_states=args.max_states,
@@ -92,9 +96,13 @@ def cmd_check(args) -> int:
             print(f"error: the jax backend is not available in this build "
                   f"({e})", file=sys.stderr)
             return 2
-        from .compile.ground import CompileError
+        from .compile.vspec import Bounds, CompileError
+        bounds = Bounds(seq_cap=args.seq_cap, grow_cap=args.grow_cap,
+                        kv_cap=args.kv_cap)
         try:
-            res = TpuExplorer(model, log=log,
+            res = TpuExplorer(model, log=log, bounds=bounds,
+                              store_trace=not args.no_trace,
+                              progress_every=args.progress_every,
                               max_states=args.max_states).run()
         except CompileError as e:
             print(f"error: this spec is outside the jax backend's "
@@ -140,12 +148,23 @@ def main(argv=None) -> int:
     c = sub.add_parser("check", help="model-check a spec")
     c.add_argument("spec")
     c.add_argument("--cfg", default=None)
+    c.add_argument("-I", "--include", action="append", default=[],
+                   help="extra module search directories (MC shims "
+                        "extending reference specs)")
     c.add_argument("--backend", choices=["interp", "jax"], default="interp")
     c.add_argument("--max-states", type=int, default=None)
     c.add_argument("--no-deadlock", action="store_true",
                    help="disable deadlock checking")
     c.add_argument("--quiet", action="store_true")
     c.add_argument("--progress-every", type=float, default=30.0)
+    c.add_argument("--seq-cap", type=int, default=4,
+                   help="jax backend: max sequence length lanes")
+    c.add_argument("--grow-cap", type=int, default=32,
+                   help="jax backend: max growing-set cardinality")
+    c.add_argument("--kv-cap", type=int, default=32,
+                   help="jax backend: max message-table domain size")
+    c.add_argument("--no-trace", action="store_true",
+                   help="jax backend: skip trace bookkeeping (benchmarks)")
     c.set_defaults(fn=cmd_check)
 
     i = sub.add_parser("info", help="parse a spec and print a summary")

@@ -280,7 +280,13 @@ def _static_ctx(model: Model) -> Ctx:
     return Ctx(model.defs, {}, None, None, ())
 
 
-def ground_actions(model: Model, max_actions: int = 4096) -> List[GroundedAction]:
+def ground_actions(model: Model, max_actions: int = 4096,
+                   dyn_slots: int = 0) -> List[GroundedAction]:
+    """Statically expand Next. dyn_slots > 0 additionally expands
+    \\E x \\in <state-dependent set> (raft's
+    \\E m \\in ValidMessage(messages), raft.tla:449-478) into one instance
+    per table slot; the kernel binds x to slot k's element guarded by the
+    slot's membership mask."""
     ctx = _static_ctx(model)
 
     def static_eval(e, bound):
@@ -307,25 +313,67 @@ def ground_actions(model: Model, max_actions: int = 4096) -> List[GroundedAction
         if isinstance(e, A.Quant) and e.kind == "E":
             try:
                 bindings = list(iter_binders(
-                    e.binders, ctx.with_bound(bound), eval_expr))
+                    e.binders, ctx.with_bound(_clean(bound)), eval_expr))
             except EvalError as ex:
-                raise CompileError(f"\\E over non-static domain: {ex}") from ex
+                if dyn_slots > 0 and len(e.binders) == 1 \
+                        and len(e.binders[0][0]) == 1 \
+                        and isinstance(e.binders[0][0][0], str):
+                    # one vectorized instance: the kernel binds the slot
+                    # element by a traced slot index and the engine vmaps
+                    # over slots (keeps trace size O(1) in table capacity)
+                    var = e.binders[0][0][0]
+                    sexpr = e.binders[0][1]
+                    nb = {**bound, var: ("$slotv", sexpr)}
+                    return walk2(e.body, nb, label)
+                raise CompileError(f"\\E over non-static domain: {ex}") \
+                    from ex
             out2 = []
             for b in bindings:
                 out2.extend(walk2(e.body, {**bound, **b}, label))
             return out2
-        if isinstance(e, A.OpApp) and e.name not in _LEAF_OPS and not e.path:
+        if isinstance(e, A.Let):
+            nb = dict(bound)
+            for d in e.defs:
+                if isinstance(d, A.OpDef) and not d.params:
+                    nb[d.name] = ("$letexpr", d.body)
+                elif isinstance(d, A.OpDef):
+                    nb[d.name] = ("$op", d, {})
+                else:
+                    raise CompileError("unsupported LET in action")
+            return walk2(e.body, nb, label)
+        if isinstance(e, A.OpApp) and e.name not in _LEAF_OPS and not e.path \
+                and e.name not in bound:
             d = model.defs.get(e.name)
             if isinstance(d, OpClosure) and len(d.params) == len(e.args):
-                try:
-                    args = [static_eval(a, bound) for a in e.args]
-                except EvalError:
-                    # state-dependent argument: leave the application as a
-                    # leaf — the kernel compiler evaluates it symbolically
-                    # (only \E/disjunction expansion needs static args)
+                args = []
+                argable = True
+                for a in e.args:
+                    # bound-marker references pass through symbolically
+                    if isinstance(a, A.Ident) and isinstance(
+                            bound.get(a.name), tuple):
+                        args.append(bound[a.name])
+                        continue
+                    try:
+                        args.append(static_eval(a, _clean(bound)))
+                    except EvalError:
+                        argable = False
+                        break
+                if not argable:
+                    from ..front.subst import contains_prime, subst
+                    if contains_prime(d.body):
+                        # the body assigns through its parameters or primes
+                        # variables (Reply, Send, the raft handlers):
+                        # call-by-name expansion keeps the assignment
+                        # structure visible to the action compiler
+                        body = subst(d.body, dict(zip(d.params, e.args)))
+                        return walk2(body, bound, _mk_label(e.name, []))
+                    # pure read: leave as a leaf for the kernel's symbolic
+                    # evaluator
                     return [(label, [(e, dict(bound))])]
                 nb = {**bound, **dict(zip(d.params, args))}
-                return walk2(d.body, nb, _mk_label(e.name, args))
+                return walk2(d.body, nb, _mk_label(
+                    e.name, [a for a in args
+                             if not isinstance(a, tuple)]))
         if isinstance(e, A.Ident):
             d = model.defs.get(e.name)
             if isinstance(d, OpClosure) and not d.params \
@@ -338,6 +386,11 @@ def ground_actions(model: Model, max_actions: int = 4096) -> List[GroundedAction
         if len(results) > max_actions:
             raise CompileError(f"more than {max_actions} grounded actions")
     return results
+
+
+def _clean(bound):
+    """Drop compile-time marker bindings before interpreter evaluation."""
+    return {k: v for k, v in bound.items() if not isinstance(v, tuple)}
 
 
 def _mk_label(name, args):

@@ -1,0 +1,2095 @@
+r"""Lanes-first kernel compiler: grounded actions -> jit/vmap transition
+kernels over vspec layouts (SURVEY.md §7.4).
+
+Every symbolic value is a SymV(spec, lanes): a vspec shape plus its encoded
+i32 lanes (python ints when static, traced scalars otherwise). Because
+encodings are canonical (vspec.py), equality is lane equality, IF is a
+lane-wise where, and containers are lane slices — one uniform rule set
+covers raft's sequences, message unions, bags, and history sets.
+
+Spec unification: before comparing/merging two values their specs are
+vspec.merge'd and both re-encoded (coerce) — e.g. a 2-entry log literal
+meets the cap-4 log layout, a concrete RequestVote record meets the
+message-union spec.
+
+Capacity overflow (Append past seq cap, bag insert past table cap, interval
+past the int-set universe) raises an overflow flag that the engine treats
+as a hard error — never silent truncation, counts stay exact
+(BASELINE.json).
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..front import tla_ast as A
+from ..sem.values import (EvalError, Fcn, InfiniteSet, ModelValue, fmt,
+                          in_set, mk_seq, sort_key, tla_eq)
+from ..sem.eval import Ctx, OpClosure, eval_expr, bind_pattern
+from ..sem.modules import Model, InstanceNamespace
+from .vspec import (Bounds, CompileError, EnumUniverse, SENTINEL_LANE, VS,
+                    encode as vs_encode, merge as vs_merge)
+
+BOOL = VS("bool")
+INT = VS("int")
+ENUM = VS("enum")
+
+
+def _is_traced(v) -> bool:
+    return isinstance(v, jnp.ndarray) or hasattr(v, "aval")
+
+
+class SymV:
+    __slots__ = ("spec", "lanes")
+
+    def __init__(self, spec: VS, lanes: List):
+        self.spec = spec
+        self.lanes = lanes
+
+    @property
+    def static(self) -> bool:
+        return all(not _is_traced(x) for x in self.lanes)
+
+    def __repr__(self):
+        return f"SymV({self.spec.kind}, {len(self.lanes)} lanes)"
+
+
+def _ite(c, a, b):
+    """where() on single lanes with static shortcuts."""
+    if isinstance(c, bool):
+        return a if c else b
+    if isinstance(a, (int, bool)) and isinstance(b, (int, bool)) and a == b:
+        return a
+    return jnp.where(c, a, b)
+
+
+def _land(a, b):
+    if a is True:
+        return b
+    if b is True:
+        return a
+    if a is False or b is False:
+        return False
+    return jnp.logical_and(a, b)
+
+
+def _lor(a, b):
+    if a is False:
+        return b
+    if b is False:
+        return a
+    if a is True or b is True:
+        return True
+    return jnp.logical_or(a, b)
+
+
+def _lnot(a):
+    return (not a) if isinstance(a, bool) else jnp.logical_not(a)
+
+
+def _eq_lane(a, b):
+    if not _is_traced(a) and not _is_traced(b):
+        return a == b
+    return jnp.equal(a, b)
+
+
+class KernelCtx:
+    """Compilation context for one model."""
+
+    def __init__(self, model: Model, layout, bounds: Bounds):
+        self.model = model
+        self.layout = layout
+        self.uni: EnumUniverse = layout.uni
+        self.bounds = bounds
+        self.iset_cap = max([bounds.seq_cap] +
+                            [s.cap for s in layout.specs.values()
+                             if s.kind == "seq"])
+
+
+class Frame:
+    """Per-expression evaluation frame."""
+    __slots__ = ("kc", "bound", "state", "primes", "overflow")
+
+    def __init__(self, kc: KernelCtx, bound, state, primes, overflow):
+        self.kc = kc
+        self.bound = bound      # name -> SymV | static python value
+        self.state = state      # var -> SymV
+        self.primes = primes    # var -> SymV
+        self.overflow = overflow  # list with one traced/py bool cell
+
+    def with_bound(self, extra):
+        return Frame(self.kc, {**self.bound, **extra}, self.state,
+                     self.primes, self.overflow)
+
+    def flag_overflow(self, cond):
+        self.overflow[0] = _lor(self.overflow[0], cond)
+
+
+def static_to_symv(v, kc: KernelCtx, spec: Optional[VS] = None) -> SymV:
+    """Encode a concrete interpreter value as lanes."""
+    if spec is None:
+        from .vspec import infer
+        spec = infer(v, kc.uni)
+        from .vspec import apply_bounds
+        spec = apply_bounds(spec, kc.bounds)
+    out: List[int] = []
+    vs_encode(v, spec, kc.uni, out)
+    return SymV(spec, out)
+
+
+def coerce(v: SymV, spec: VS, fr: Frame) -> SymV:
+    """Re-encode v's lanes under a (merged, wider) spec."""
+    if v.spec == spec:
+        return v
+    return SymV(spec, _coerce_lanes(v.spec, spec, v.lanes, fr))
+
+
+def _coerce_lanes(src: VS, dst: VS, lanes: List, fr: Frame) -> List:
+    if src == dst:
+        return list(lanes)
+    uni = fr.kc.uni
+    sk, dk = src.kind, dst.kind
+    if sk == "justempty":
+        if dk == "seq":
+            return [0] + [0] * (dst.cap * dst.elem.width)
+        if dk == "kvtable":
+            return [0] + [SENTINEL_LANE] * (dst.cap * (dst.elem.width +
+                                                       dst.val.width))
+        if dk == "pfcn":
+            out = []
+            for e in dst.elems:
+                out.append(0)
+                out.extend([0] * e.width)
+            return out
+        if dk == "fcn":
+            # an always-empty value flowing into a non-empty-domain layout
+            # slot: impossible at runtime unless the layout under-sampled —
+            # flag overflow so an enabled action taking this path aborts
+            # the run instead of producing wrong lanes
+            fr.flag_overflow(True)
+            return [0] * dst.width
+        raise CompileError(f"cannot coerce empty function to {dk}")
+    if sk == "emptyset" or (sk == "set" and not src.dom):
+        if dk == "set":
+            return [0] * len(dst.dom)
+        if dk == "growset":
+            return [0] + [SENTINEL_LANE] * (dst.cap * dst.elem.width)
+        if dk == "iset":
+            return [0] * len(dst.dom)
+        raise CompileError(f"cannot coerce empty set to {dk}")
+    if sk == dk == "seq":
+        if dst.cap < src.cap:
+            raise CompileError("sequence coercion would shrink capacity")
+        out = [lanes[0]]
+        for i in range(src.cap):
+            out.extend(_coerce_lanes(src.elem, dst.elem,
+                                     lanes[1 + i * src.elem.width:
+                                           1 + (i + 1) * src.elem.width], fr))
+        out.extend([0] * ((dst.cap - src.cap) * dst.elem.width))
+        return out
+    if sk == dk == "set":
+        if src.dom == dst.dom:
+            return list(lanes)
+        pos = {m: i for i, m in enumerate(src.dom)}
+        out = []
+        for m in dst.dom:
+            out.append(lanes[pos[m]] if m in pos else 0)
+        extra = set(src.dom) - set(dst.dom)
+        if extra:
+            raise CompileError(f"set coercion drops members {extra}")
+        return out
+    if sk == dk == "iset":
+        pos = {m: i for i, m in enumerate(src.dom)}
+        out = []
+        for m in dst.dom:
+            out.append(lanes[pos[m]] if m in pos else 0)
+        if set(src.dom) - set(dst.dom):
+            raise CompileError("iset coercion drops members")
+        return out
+    if sk == dk == "growset":
+        if dst.cap < src.cap or src.elem != dst.elem:
+            if src.elem != dst.elem:
+                raise CompileError("growset element coercion unsupported")
+            raise CompileError("growset coercion would shrink capacity")
+        out = [lanes[0]]
+        out.extend(lanes[1:])
+        out.extend([SENTINEL_LANE] * ((dst.cap - src.cap) * dst.elem.width))
+        return out
+    if sk == dk == "kvtable":
+        if src.elem != dst.elem or src.val != dst.val:
+            raise CompileError("kvtable element coercion unsupported")
+        if dst.cap < src.cap:
+            raise CompileError("kvtable coercion would shrink capacity")
+        out = list(lanes)
+        out.extend([SENTINEL_LANE] *
+                   ((dst.cap - src.cap) * (dst.elem.width + dst.val.width)))
+        return out
+    if sk == "fcn" and dk == "union":
+        names = tuple(k for k in src.dom)
+        for tag, (vnames, vfields) in enumerate(dst.variants):
+            if vnames == names:
+                out = [tag]
+                off = 0
+                for (kk, es), fs in zip(zip(src.dom, src.elems), vfields):
+                    out.extend(_coerce_lanes(es, fs,
+                                             lanes[off:off + es.width], fr))
+                    off += es.width
+                out.extend([0] * (dst.width - len(out)))
+                return out
+        raise CompileError(f"record {names} not a variant of the union")
+    if sk == "fcn" and dk == "pfcn":
+        srcmap = {}
+        off = 0
+        for kk, es in zip(src.dom, src.elems):
+            srcmap[kk] = (es, lanes[off:off + es.width])
+            off += es.width
+        out = []
+        for kk, es in zip(dst.dom, dst.elems):
+            if kk in srcmap:
+                ses, sl = srcmap[kk]
+                out.append(1)
+                out.extend(_coerce_lanes(ses, es, sl, fr))
+            else:
+                out.append(0)
+                out.extend([0] * es.width)
+        if set(srcmap) - set(dst.dom):
+            raise CompileError("pfcn coercion drops keys")
+        return out
+    if sk == "fcn" and dk == "seq":
+        if not all(isinstance(k, int) for k in src.dom):
+            raise CompileError("cannot coerce non-int function to sequence")
+        n = len(src.dom)
+        out = [n]
+        off = 0
+        for kk, es in zip(src.dom, src.elems):
+            out.extend(_coerce_lanes(es, dst.elem,
+                                     lanes[off:off + es.width], fr))
+            off += es.width
+        if n > dst.cap:
+            raise CompileError("sequence literal exceeds capacity")
+        out.extend([0] * ((dst.cap - n) * dst.elem.width))
+        return out
+    if sk == "fcn" and dk == "kvtable":
+        rows = []
+        off = 0
+        for kk, es in zip(src.dom, src.elems):
+            kb: List[int] = []
+            vs_encode(kk, dst.elem, uni, kb)
+            vlanes = _coerce_lanes(es, dst.val,
+                                   lanes[off:off + es.width], fr)
+            rows.append((kb, vlanes))
+            off += es.width
+        rows.sort(key=lambda r: r[0])
+        if len(rows) > dst.cap:
+            raise CompileError("table literal exceeds capacity")
+        out = [len(rows)]
+        for kb, vl in rows:
+            out.extend(kb)
+            out.extend(vl)
+        pad = dst.elem.width + dst.val.width
+        out.extend([SENTINEL_LANE] * ((dst.cap - len(rows)) * pad))
+        return out
+    if sk == "fcn" and dk == "fcn":
+        if tuple(src.dom) != tuple(dst.dom):
+            raise CompileError("function domains differ in coercion")
+        out = []
+        off = 0
+        for (kk, ses), des in zip(zip(src.dom, src.elems), dst.elems):
+            out.extend(_coerce_lanes(ses, des,
+                                     lanes[off:off + ses.width], fr))
+            off += ses.width
+        return out
+    if sk == "pfcn" and dk == "fcn":
+        # sound when every dst key is present; absent keys flag overflow
+        srcmap = {}
+        off = 0
+        for kk, es in zip(src.dom, src.elems):
+            srcmap[kk] = (lanes[off], es, lanes[off + 1:off + 1 + es.width])
+            off += 1 + es.width
+        out = []
+        for kk, es in zip(dst.dom, dst.elems):
+            if kk not in srcmap:
+                raise CompileError("pfcn->fcn coercion missing key")
+            pres, ses, sl = srcmap[kk]
+            fr.flag_overflow(_eq_lane(pres, 0))
+            out.extend(_coerce_lanes(ses, es, sl, fr))
+        return out
+    if dk == "justempty":
+        # storing into an only-ever-empty layout slot: exact as long as the
+        # value is empty at runtime; otherwise the overflow flag aborts the
+        # run with a clear error (deepen sampling / raise caps)
+        if sk == "seq":
+            fr.flag_overflow(_lnot(_eq_lane(lanes[0], 0)))
+            return []
+        if sk == "kvtable":
+            fr.flag_overflow(_lnot(_eq_lane(lanes[0], 0)))
+            return []
+        if sk == "pfcn":
+            off = 0
+            for kk, es in zip(src.dom, src.elems):
+                fr.flag_overflow(_eq_lane(lanes[off], 1))
+                off += 1 + es.width
+            return []
+        if sk == "fcn":
+            fr.flag_overflow(len(src.dom) > 0)
+            return []
+    if sk == "pfcn" and dk == "pfcn":
+        srcmap = {}
+        off = 0
+        for kk, es in zip(src.dom, src.elems):
+            srcmap[kk] = (lanes[off], es, lanes[off + 1:off + 1 + es.width])
+            off += 1 + es.width
+        out = []
+        for kk, es in zip(dst.dom, dst.elems):
+            if kk in srcmap:
+                pres, ses, sl = srcmap[kk]
+                out.append(pres)
+                out.extend(_coerce_lanes(ses, es, sl, fr))
+            else:
+                out.append(0)
+                out.extend([0] * es.width)
+        return out
+    if sk == "iset" and dk == "set":
+        raise CompileError("cannot view integer set as enum set")
+    if sk == "set" and dk == "iset":
+        pos = {m: i for i, m in enumerate(src.dom)}
+        out = []
+        for m in dst.dom:
+            out.append(lanes[pos[m]] if m in pos else 0)
+        if set(src.dom) - set(dst.dom):
+            raise CompileError("iset coercion drops members")
+        return out
+    raise CompileError(f"cannot coerce {sk} to {dk}")
+
+
+def unify(a: SymV, b: SymV, fr: Frame) -> Tuple[SymV, SymV]:
+    if a.spec == b.spec:
+        return a, b
+    m = vs_merge(a.spec, b.spec)
+    from .vspec import apply_bounds
+    m = apply_bounds(m, fr.kc.bounds)
+    return coerce(a, m, fr), coerce(b, m, fr)
+
+
+def sym_eq(a: SymV, b: SymV, fr: Frame):
+    a, b = unify(a, b, fr)
+    acc = True
+    for x, y in zip(a.lanes, b.lanes):
+        acc = _land(acc, _eq_lane(x, y))
+    return acc
+
+
+def lanes_lex_lt(a: List, b: List):
+    """Lexicographic a < b over equal-length lane lists."""
+    assert len(a) == len(b)
+    lt = False
+    eq = True
+    for x, y in zip(a, b):
+        xlt = x < y if (not _is_traced(x) and not _is_traced(y)) \
+            else jnp.less(x, y)
+        xeq = _eq_lane(x, y)
+        lt = _lor(lt, _land(eq, xlt))
+        eq = _land(eq, xeq)
+    return lt
+
+
+# ---------------------------------------------------------------------------
+# symbolic evaluation
+# ---------------------------------------------------------------------------
+
+def as_bool(v, fr: Frame):
+    if isinstance(v, bool):
+        return v
+    if isinstance(v, SymV):
+        if v.spec.kind != "bool":
+            raise CompileError(f"expected boolean, got {v.spec.kind}")
+        x = v.lanes[0]
+        if isinstance(x, int):
+            return bool(x)
+        return x != 0 if x.dtype != jnp.bool_ else x
+    if _is_traced(v):
+        return v
+    raise CompileError(f"expected boolean, got {v!r}")
+
+
+def as_int_lane(v):
+    if isinstance(v, SymV):
+        if v.spec.kind != "int":
+            raise CompileError(f"expected integer, got {v.spec.kind}")
+        return v.lanes[0]
+    if isinstance(v, bool):
+        raise CompileError("boolean used as integer")
+    if isinstance(v, int) or _is_traced(v):
+        return v
+    raise CompileError(f"expected integer, got {v!r}")
+
+
+def mk_bool(x) -> SymV:
+    if _is_traced(x) and x.dtype != jnp.bool_:
+        x = x != 0
+    return SymV(BOOL, [x if not isinstance(x, bool) else x])
+
+
+def mk_int(x) -> SymV:
+    return SymV(INT, [x])
+
+
+def _lift(v, fr: Frame) -> SymV:
+    """Lift a static python value to SymV."""
+    if isinstance(v, SymV):
+        return v
+    if isinstance(v, bool):
+        return SymV(BOOL, [v])
+    if isinstance(v, int):
+        return SymV(INT, [v])
+    return static_to_symv(v, fr.kc)
+
+
+def _seq_elem(v: SymV, i: int) -> List:
+    ew = v.spec.elem.width
+    return v.lanes[1 + i * ew: 1 + (i + 1) * ew]
+
+
+def _select_lanes(cond, a: List, b: List) -> List:
+    return [_ite(cond, x, y) for x, y in zip(a, b)]
+
+
+def sym_apply(f, args: List, fr: Frame) -> Any:
+    """Function application f[k]."""
+    if not isinstance(f, SymV):
+        # static python Fcn with possibly-symbolic argument
+        if isinstance(f, Fcn):
+            f = _lift(f, fr)
+        else:
+            raise CompileError(f"cannot apply {f!r}")
+    key = args[0] if len(args) == 1 else None
+    if key is None:
+        # f[a, b] == f[<<a, b>>]
+        raise CompileError("multi-argument application not supported yet")
+    sp = f.spec
+    if sp.kind == "fcn":
+        if isinstance(key, SymV) and key.static or not isinstance(key, SymV):
+            kk = _static_key_value(key, fr)
+            off = 0
+            for dk, es in zip(sp.dom, sp.elems):
+                if _keys_equal(dk, kk):
+                    return SymV(es, f.lanes[off:off + es.width])
+                off += es.width
+            raise CompileError(f"application outside static domain: {kk!r}")
+        # symbolic key: select across domain entries
+        ks = key
+        acc = None
+        off = 0
+        for dk, es in zip(sp.dom, sp.elems):
+            dk_s = static_to_symv(dk, fr.kc)
+            cond = sym_eq(ks, dk_s, fr)
+            cur = f.lanes[off:off + es.width]
+            acc = cur if acc is None else _select_lanes(cond, cur, acc)
+            off += es.width
+        espec = sp.elems[0]
+        for e in sp.elems[1:]:
+            if e != espec:
+                raise CompileError("symbolic application over heterogeneous "
+                                   "function")
+        return SymV(espec, acc)
+    if sp.kind == "pfcn":
+        kk = None
+        if not isinstance(key, SymV) or key.static:
+            kk = _static_key_value(key, fr)
+        off = 0
+        for dk, es in zip(sp.dom, sp.elems):
+            if kk is not None and _keys_equal(dk, kk):
+                # TLC errors on applying outside DOMAIN; compiled path
+                # returns the (zeroed-when-absent) value — guards in the
+                # spec keep this sound, as with TLC's lazy evaluation
+                return SymV(es, f.lanes[off + 1:off + 1 + es.width])
+            off += 1 + es.width
+        if kk is not None:
+            raise CompileError(f"pfcn key outside universe: {kk!r}")
+        acc = None
+        off = 0
+        espec = sp.elems[0]
+        for dk, es in zip(sp.dom, sp.elems):
+            cond = sym_eq(key, static_to_symv(dk, fr.kc), fr)
+            cur = f.lanes[off + 1:off + 1 + es.width]
+            acc = cur if acc is None else _select_lanes(cond, cur, acc)
+            off += 1 + es.width
+        return SymV(espec, acc)
+    if sp.kind == "seq":
+        idx = as_int_lane(key if not isinstance(key, SymV) else key)
+        if isinstance(key, SymV):
+            idx = as_int_lane(key)
+        if isinstance(idx, int):
+            if not 1 <= idx <= sp.cap:
+                raise CompileError(f"static sequence index {idx} out of "
+                                   f"capacity {sp.cap}")
+            return SymV(sp.elem, _seq_elem(f, idx - 1))
+        acc = _seq_elem(f, 0)
+        for i in range(1, sp.cap):
+            acc = _select_lanes(jnp.equal(idx, i + 1), _seq_elem(f, i), acc)
+        return SymV(sp.elem, acc)
+    if sp.kind == "kvtable":
+        # msgs[m]: match key lanes per slot
+        kw, vw = sp.elem.width, sp.val.width
+        kv = coerce(key if isinstance(key, SymV) else _lift(key, fr),
+                    sp.elem, fr)
+        acc = [0] * vw
+        for s in range(sp.cap):
+            base = 1 + s * (kw + vw)
+            cond = True
+            for x, y in zip(kv.lanes, f.lanes[base:base + kw]):
+                cond = _land(cond, _eq_lane(x, y))
+            acc = _select_lanes(cond, f.lanes[base + kw:base + kw + vw], acc)
+        return SymV(sp.val, acc)
+    if sp.kind == "union":
+        raise CompileError("cannot apply a record value")
+    if sp.kind == "justempty":
+        raise CompileError("application of an always-empty function")
+    raise CompileError(f"cannot apply value of kind {sp.kind}")
+
+
+def _static_key_value(key, fr: Frame):
+    if isinstance(key, SymV):
+        if key.spec.kind == "int":
+            return key.lanes[0]
+        if key.spec.kind == "enum":
+            return fr.kc.uni.value(key.lanes[0])
+        if key.spec.kind == "bool":
+            return bool(key.lanes[0])
+        raise CompileError(f"unsupported static key kind {key.spec.kind}")
+    return key
+
+
+def _keys_equal(a, b) -> bool:
+    if isinstance(a, ModelValue) or isinstance(b, ModelValue):
+        return a is b
+    if type(a) is not type(b) and not (isinstance(a, int)
+                                       and isinstance(b, int)):
+        return False
+    return a == b
+
+
+def sym_dot(v, fld: str, fr: Frame) -> SymV:
+    if not isinstance(v, SymV):
+        v = _lift(v, fr)
+    sp = v.spec
+    if sp.kind == "fcn":
+        return sym_apply(v, [fld], fr)
+    if sp.kind == "union":
+        acc = None
+        espec = None
+        for tag, (names, fields) in enumerate(sp.variants):
+            if fld not in names:
+                continue
+            off = 1
+            for nm, fs in zip(names, fields):
+                if nm == fld:
+                    cur = v.lanes[off:off + fs.width]
+                    espec = fs if espec is None else espec
+                    if fs != espec:
+                        cur = _coerce_lanes(fs, espec, cur, fr)
+                    cond = _eq_lane(v.lanes[0], tag)
+                    acc = cur if acc is None else _select_lanes(cond, cur,
+                                                                acc)
+                    break
+                off += fs.width
+        if acc is None:
+            raise CompileError(f"no union variant has field {fld}")
+        return SymV(espec, acc)
+    raise CompileError(f"field access .{fld} on {sp.kind}")
+
+
+# ---- sets ----
+
+def _set_of(v, fr: Frame):
+    """Normalize to ('static', frozenset) | ('sym', SymV with set/iset/
+    growset spec)."""
+    if isinstance(v, frozenset):
+        return ("static", v)
+    if isinstance(v, SymV) and v.spec.kind in ("set", "iset", "growset",
+                                               "emptyset"):
+        return ("sym", v)
+    if isinstance(v, InfiniteSet):
+        return ("inf", v)
+    raise CompileError(f"expected a set, got {v!r}")
+
+
+def sym_in(x, s, fr: Frame):
+    kind, sv = _set_of(s, fr)
+    if kind == "inf":
+        # membership in Nat/Int/Seq(S): type-level, true for well-shaped
+        # compiled values of the right kind
+        if isinstance(x, SymV):
+            if sv.kind == "Nat":
+                return jnp.greater_equal(as_int_lane(x), 0) \
+                    if _is_traced(as_int_lane(x)) else as_int_lane(x) >= 0
+            if sv.kind == "Int":
+                return True
+        raise CompileError(f"membership in {sv!r} not compilable")
+    if kind == "static":
+        if not isinstance(x, SymV) or x.static:
+            xv = x if not isinstance(x, SymV) else _decode_static(x, fr)
+            return in_set(xv, sv)
+        acc = False
+        for m in sorted(sv, key=sort_key):
+            acc = _lor(acc, sym_eq(x, static_to_symv(m, fr.kc), fr))
+        return acc
+    sp = sv.spec
+    if sp.kind in ("set", "iset"):
+        acc = False
+        for i, m in enumerate(sp.dom):
+            memb = sv.lanes[i]
+            acc = _lor(acc, _land(
+                memb if isinstance(memb, bool) else _eq_lane(memb, 1),
+                as_bool(sym_eq(_lift(x, fr), static_to_symv(m, fr.kc), fr),
+                        fr)))
+        return acc
+    if sp.kind == "growset":
+        xe = coerce(_lift(x, fr), sp.elem, fr)
+        acc = False
+        ew = sp.elem.width
+        for slot in range(sp.cap):
+            base = 1 + slot * ew
+            used = _lt_lane(slot, sv.lanes[0])
+            same = True
+            for a, b in zip(xe.lanes, sv.lanes[base:base + ew]):
+                same = _land(same, _eq_lane(a, b))
+            acc = _lor(acc, _land(used, same))
+        return acc
+    raise CompileError(f"membership in {sp.kind} not supported")
+
+
+def _lt_lane(a, b):
+    if not _is_traced(a) and not _is_traced(b):
+        return a < b
+    return jnp.less(a, b)
+
+
+def _decode_static(v: SymV, fr: Frame):
+    from .vspec import decode
+    val, _ = decode([int(x) for x in v.lanes], 0, v.spec, fr.kc.uni)
+    return val
+
+
+def set_elements(s, fr: Frame):
+    """Iterate a set as (guard, element) pairs — guards may be traced."""
+    kind, sv = _set_of(s, fr)
+    if kind == "static":
+        for m in sorted(sv, key=sort_key):
+            yield True, m
+        return
+    if kind == "inf":
+        raise CompileError(f"cannot enumerate {sv!r}")
+    sp = sv.spec
+    if sp.kind in ("set", "iset"):
+        for i, m in enumerate(sp.dom):
+            memb = sv.lanes[i]
+            yield (memb if isinstance(memb, bool)
+                   else _eq_lane(memb, 1)), m
+        return
+    if sp.kind == "growset":
+        ew = sp.elem.width
+        for slot in range(sp.cap):
+            base = 1 + slot * ew
+            used = _lt_lane(slot, sv.lanes[0])
+            yield used, SymV(sp.elem, sv.lanes[base:base + ew])
+        return
+    raise CompileError(f"cannot enumerate {sp.kind}")
+
+
+def grow_insert(s: SymV, x: SymV, fr: Frame) -> SymV:
+    """s \\cup {x} on a growset — sorted insertion, canonical."""
+    sp = s.spec
+    xe = coerce(x, sp.elem, fr)
+    ew = sp.elem.width
+    present = sym_in(xe, s, fr)
+    cnt = s.lanes[0]
+    # position where x belongs: number of used elements lex-< x
+    pos = 0
+    slots = []
+    for slot in range(sp.cap):
+        base = 1 + slot * ew
+        slots.append(s.lanes[base:base + ew])
+    for slot in range(sp.cap):
+        used = _lt_lane(slot, cnt)
+        lt = lanes_lex_lt(slots[slot], xe.lanes)
+        inc = _land(used, lt)
+        pos = pos + (_ite(inc, 1, 0) if not isinstance(inc, bool)
+                     else (1 if inc else 0))
+    new_lanes = [None] * len(s.lanes)
+    newcnt = _ite(present, cnt, cnt + 1 if isinstance(cnt, int)
+                  else cnt + 1)
+    new_lanes[0] = newcnt
+    fr.flag_overflow(_land(_lnot(present), _ge_lane(cnt, sp.cap)))
+    for slot in range(sp.cap):
+        base = 1 + slot * ew
+        # if inserting at pos: slots < pos keep; slot == pos takes x;
+        # slots > pos shift from slot-1
+        is_before = _lt_lane(slot, pos)
+        is_at = _eq_lane(slot, pos)
+        keep = slots[slot]
+        shifted = slots[slot - 1] if slot > 0 else [0] * ew
+        ins = _select_lanes(is_before, keep,
+                            _select_lanes(is_at, xe.lanes, shifted))
+        out = _select_lanes(present, keep, ins)
+        new_lanes[base:base + ew] = out
+    return SymV(sp, new_lanes)
+
+
+def _ge_lane(a, b):
+    if not _is_traced(a) and not _is_traced(b):
+        return a >= b
+    return jnp.greater_equal(a, b)
+
+
+def set_union(a, b, fr: Frame):
+    """a \\cup b with symbolic support."""
+    if isinstance(a, frozenset) and isinstance(b, frozenset):
+        return a | b
+    # growset target: insert the other side's (guarded) elements
+    if isinstance(a, SymV) and a.spec.kind == "growset":
+        out = a
+        for g, e in _elements(b, fr):
+            ev = _lift(e, fr) if not isinstance(e, SymV) else e
+            ins = grow_insert(out, ev, fr)
+            gb = g if isinstance(g, bool) else g
+            out = ins if gb is True else SymV(
+                out.spec, _select_lanes(gb, ins.lanes, out.lanes))
+        return out
+    if isinstance(b, SymV) and b.spec.kind == "growset":
+        return set_union(b, a, fr)
+    if isinstance(a, Elems) or isinstance(b, Elems):
+        # fold symbolic elements into a mask set when the other side is
+        # one (votesGranted[i] \cup {j} with slot-bound j, raft.tla:372)
+        other = b if isinstance(a, Elems) else a
+        el = a if isinstance(a, Elems) else b
+        try:
+            mask = _to_mask_set(other, fr)
+        except CompileError:
+            items = list(_elements(a, fr)) + list(_elements(b, fr))
+            return Elems(items)
+        lanes = list(mask.lanes)
+        for g, e in el.items:
+            ev = _lift(e, fr) if not isinstance(e, (SymV, frozenset, Fcn)) \
+                else e
+            for i, m in enumerate(mask.spec.dom):
+                hit = _land(g, as_bool(mk_bool(_generic_eq(
+                    ev, _lift(m, fr) if not isinstance(m, (frozenset, Fcn))
+                    else m, fr)), fr))
+                cur = lanes[i]
+                cb = cur if isinstance(cur, bool) else _eq_lane(cur, 1)
+                r = _lor(cb, hit)
+                lanes[i] = _ite(r, 1, 0) if not isinstance(r, bool) \
+                    else (1 if r else 0)
+        return SymV(mask.spec, lanes)
+    # enum/int mask sets
+    sa = _to_mask_set(a, fr)
+    sb = _to_mask_set(b, fr)
+    sa, sb = unify(sa, sb, fr)
+    lanes = [_lor(_eq_lane(x, 1) if not isinstance(x, bool) else x,
+                  _eq_lane(y, 1) if not isinstance(y, bool) else y)
+             for x, y in zip(sa.lanes, sb.lanes)]
+    return SymV(sa.spec, [_ite(l, 1, 0) if not isinstance(l, bool)
+                          else (1 if l else 0) for l in lanes])
+
+
+def _to_mask_set(v, fr: Frame) -> SymV:
+    kind, sv = _set_of(v, fr)
+    if kind == "sym":
+        if sv.spec.kind in ("set", "iset"):
+            return sv
+        raise CompileError("growset in mask-set position")
+    members = sorted(sv, key=sort_key)
+    if all(isinstance(m, (str, ModelValue)) for m in members):
+        return static_to_symv(sv, fr.kc, VS("set", dom=tuple(members)))
+    if all(isinstance(m, int) and not isinstance(m, bool) for m in members):
+        return SymV(VS("iset", dom=tuple(members)), [1] * len(members))
+    raise CompileError("heterogeneous static set")
+
+
+def interval_iset(lo, hi, fr: Frame) -> SymV:
+    """a..b with traced bounds -> iset over 1..iset_cap universe."""
+    lo_l = as_int_lane(lo)
+    hi_l = as_int_lane(hi)
+    cap = fr.kc.iset_cap
+    uni_members = tuple(range(0, cap + 2))
+    lanes = []
+    for m in uni_members:
+        memb = _land(_ge_lane(m, lo_l), _ge_lane(hi_l, m))
+        lanes.append(_ite(memb, 1, 0) if not isinstance(memb, bool)
+                     else (1 if memb else 0))
+    # overflow if the interval reaches beyond the universe
+    fr.flag_overflow(_land(_ge_lane(hi_l, cap + 2),
+                           _ge_lane(hi_l, lo_l)))
+    return SymV(VS("iset", dom=uni_members), lanes)
+
+
+# ---- sequences ----
+
+def seq_len(v: SymV) -> SymV:
+    if v.spec.kind == "seq":
+        return mk_int(v.lanes[0])
+    if v.spec.kind == "justempty":
+        return mk_int(0)
+    raise CompileError(f"Len of {v.spec.kind}")
+
+
+def seq_append(v: SymV, x, fr: Frame) -> SymV:
+    if v.spec.kind == "justempty":
+        # promote to a sequence of the appended element's shape; if the
+        # layout truly has no room the target coercion raises cleanly
+        xe = _lift(x, fr)
+        from .vspec import apply_bounds
+        sp = apply_bounds(VS("seq", cap=1, elem=xe.spec), fr.kc.bounds)
+        v = SymV(sp, [0] + [0] * (sp.cap * sp.elem.width))
+    sp = v.spec
+    xe = coerce(_lift(x, fr), sp.elem, fr)
+    n = v.lanes[0]
+    fr.flag_overflow(_ge_lane(n, sp.cap))
+    lanes = [n + 1 if isinstance(n, int) else n + 1]
+    for i in range(sp.cap):
+        at = _eq_lane(n, i)
+        lanes.extend(_select_lanes(at, xe.lanes, _seq_elem(v, i)))
+    return SymV(sp, lanes)
+
+
+def seq_subseq(v: SymV, m, n, fr: Frame) -> SymV:
+    """SubSeq(v, m, n) with traced bounds; empty when m > n."""
+    if v.spec.kind == "justempty":
+        # SubSeq of an always-empty sequence: empty unless m <= n, which
+        # would be out of domain — flag it
+        ml, nl = as_int_lane(m), as_int_lane(n)
+        fr.flag_overflow(_ge_lane(nl, ml))
+        return v
+    sp = v.spec
+    ml = as_int_lane(m)
+    nl = as_int_lane(n)
+    ew = sp.elem.width
+    outlen_raw = nl - ml + 1
+    neg = _lt_lane(outlen_raw, 0)
+    outlen = _ite(neg, 0, outlen_raw)
+    lanes = [outlen]
+    for i in range(sp.cap):
+        # out[i] = v[m - 1 + i]  when i < outlen, else zeros
+        src = ml + i  # 1-based source index
+        elem = [0] * ew
+        for j in range(sp.cap):
+            elem = _select_lanes(_eq_lane(src, j + 1), _seq_elem(v, j), elem)
+        inrange = _lt_lane(i, outlen)
+        lanes.extend(_select_lanes(inrange, elem, [0] * ew))
+    return SymV(sp, lanes)
+
+
+def seq_concat(a: SymV, b: SymV, fr: Frame) -> SymV:
+    if a.spec.kind == "justempty":
+        return b
+    if b.spec.kind == "justempty":
+        return a
+    sp = vs_merge(a.spec, b.spec)
+    from .vspec import apply_bounds
+    sp = apply_bounds(sp, fr.kc.bounds)
+    a = coerce(a, sp, fr)
+    b = coerce(b, sp, fr)
+    ew = sp.elem.width
+    na, nb = a.lanes[0], b.lanes[0]
+    total = na + nb
+    fr.flag_overflow(_ge_lane(total, sp.cap + 1))
+    lanes = [total]
+    for i in range(sp.cap):
+        from_a = _lt_lane(i, na)
+        bsrc = i - na  # 0-based into b
+        belem = [0] * ew
+        for j in range(sp.cap):
+            belem = _select_lanes(_eq_lane(bsrc, j), _seq_elem(b, j), belem)
+        lanes.extend(_select_lanes(from_a, _seq_elem(a, i), belem))
+    return SymV(sp, lanes)
+
+
+# ---- EXCEPT ----
+
+def sym_except(f: SymV, path, rhs_eval, fr: Frame) -> SymV:
+    """[f EXCEPT !path = rhs]; rhs_eval(old: SymV) -> value."""
+    sp = f.spec
+    kind, arg = path[0]
+    if sp.kind == "fcn":
+        key = arg if kind == "dot" else None
+        keysym = None
+        if key is None:
+            if isinstance(arg, list):
+                if len(arg) != 1:
+                    raise CompileError("multi-key EXCEPT not supported")
+                kv = arg[0]
+            else:
+                kv = arg
+            if not isinstance(kv, SymV) or kv.static:
+                key = _static_key_value(kv, fr)
+            else:
+                keysym = kv
+        if key is not None:
+            off = 0
+            for dk, es in zip(sp.dom, sp.elems):
+                if _keys_equal(dk, key):
+                    old = SymV(es, f.lanes[off:off + es.width])
+                    new = _apply_rest(old, path[1:], rhs_eval, fr)
+                    new = coerce(_lift(new, fr), es, fr)
+                    lanes = list(f.lanes)
+                    lanes[off:off + es.width] = new.lanes
+                    return SymV(sp, lanes)
+                off += es.width
+            raise CompileError(f"EXCEPT key {key!r} outside domain")
+        # symbolic key over homogeneous fcn
+        lanes = list(f.lanes)
+        off = 0
+        for dk, es in zip(sp.dom, sp.elems):
+            cond = as_bool(sym_eq(keysym, static_to_symv(dk, fr.kc), fr), fr)
+            old = SymV(es, f.lanes[off:off + es.width])
+            new = coerce(_lift(_apply_rest(old, path[1:], rhs_eval, fr), fr),
+                         es, fr)
+            lanes[off:off + es.width] = _select_lanes(
+                cond, new.lanes, f.lanes[off:off + es.width])
+            off += es.width
+        return SymV(sp, lanes)
+    if sp.kind == "seq":
+        kv = arg[0] if kind == "idx" else arg
+        idx = as_int_lane(kv if not isinstance(kv, SymV) else kv)
+        if isinstance(kv, SymV):
+            idx = as_int_lane(kv)
+        ew = sp.elem.width
+        lanes = list(f.lanes)
+        for i in range(sp.cap):
+            cond = _eq_lane(idx, i + 1)
+            old = SymV(sp.elem, _seq_elem(f, i))
+            new = coerce(_lift(_apply_rest(old, path[1:], rhs_eval, fr), fr),
+                         sp.elem, fr)
+            base = 1 + i * ew
+            lanes[base:base + ew] = _select_lanes(cond, new.lanes,
+                                                  f.lanes[base:base + ew])
+        return SymV(sp, lanes)
+    if sp.kind == "kvtable":
+        kv = arg[0] if kind == "idx" else arg
+        kl = coerce(_lift(kv, fr), sp.elem, fr)
+        kw, vw = sp.elem.width, sp.val.width
+        lanes = list(f.lanes)
+        for s in range(sp.cap):
+            base = 1 + s * (kw + vw)
+            cond = True
+            for x, y in zip(kl.lanes, f.lanes[base:base + kw]):
+                cond = _land(cond, _eq_lane(x, y))
+            old = SymV(sp.val, f.lanes[base + kw:base + kw + vw])
+            new = coerce(_lift(_apply_rest(old, path[1:], rhs_eval, fr), fr),
+                         sp.val, fr)
+            lanes[base + kw:base + kw + vw] = _select_lanes(
+                cond, new.lanes, f.lanes[base + kw:base + kw + vw])
+        return SymV(sp, lanes)
+    if sp.kind == "pfcn":
+        kv = arg[0] if kind == "idx" else arg
+        if isinstance(kv, SymV) and not kv.static and kind == "idx":
+            # traced key (voterLog[i] @@ (j :> ...) with slot-bound j):
+            # guarded update across the key universe
+            lanes = list(f.lanes)
+            off = 0
+            for dk, es in zip(sp.dom, sp.elems):
+                cond = as_bool(mk_bool(sym_eq(
+                    kv, static_to_symv(dk, fr.kc), fr)), fr)
+                old = SymV(es, f.lanes[off + 1:off + 1 + es.width])
+                new = coerce(_lift(_apply_rest(old, path[1:], rhs_eval, fr),
+                                   fr), es, fr)
+                lanes[off] = _ite(cond, 1, f.lanes[off])
+                lanes[off + 1:off + 1 + es.width] = _select_lanes(
+                    cond, new.lanes, f.lanes[off + 1:off + 1 + es.width])
+                off += 1 + es.width
+            return SymV(sp, lanes)
+        key = _static_key_value(kv, fr) if kind == "idx" else arg
+        off = 0
+        for dk, es in zip(sp.dom, sp.elems):
+            if _keys_equal(dk, key):
+                old = SymV(es, f.lanes[off + 1:off + 1 + es.width])
+                new = coerce(_lift(_apply_rest(old, path[1:], rhs_eval, fr),
+                                   fr), es, fr)
+                lanes = list(f.lanes)
+                lanes[off] = 1
+                lanes[off + 1:off + 1 + es.width] = new.lanes
+                return SymV(sp, lanes)
+            off += 1 + es.width
+        raise CompileError(f"EXCEPT key {key!r} outside pfcn universe")
+    raise CompileError(f"EXCEPT on {sp.kind}")
+
+
+def _apply_rest(old: SymV, rest, rhs_eval, fr: Frame):
+    if not rest:
+        return rhs_eval(old)
+    return sym_except(old, rest, rhs_eval, fr)
+
+
+def kv_merge_insert(f: SymV, key: SymV, val: SymV, fr: Frame) -> SymV:
+    """f @@ (key :> val): insert if key absent (f wins on overlap),
+    keeping the table sorted by key lanes."""
+    sp = f.spec
+    kl = coerce(key, sp.elem, fr)
+    vl = coerce(val, sp.val, fr)
+    kw, vw = sp.elem.width, sp.val.width
+    cnt = f.lanes[0]
+    present = False
+    keys = []
+    rows = []
+    for s in range(sp.cap):
+        base = 1 + s * (kw + vw)
+        krow = f.lanes[base:base + kw]
+        keys.append(krow)
+        rows.append(f.lanes[base:base + kw + vw])
+        used = _lt_lane(s, cnt)
+        same = True
+        for x, y in zip(kl.lanes, krow):
+            same = _land(same, _eq_lane(x, y))
+        present = _lor(present, _land(used, same))
+    pos = 0
+    for s in range(sp.cap):
+        used = _lt_lane(s, cnt)
+        lt = lanes_lex_lt(keys[s], kl.lanes)
+        inc = _land(used, lt)
+        pos = pos + (_ite(inc, 1, 0) if not isinstance(inc, bool)
+                     else (1 if inc else 0))
+    fr.flag_overflow(_land(_lnot(present), _ge_lane(cnt, sp.cap)))
+    newrow = list(kl.lanes) + list(vl.lanes)
+    lanes = [None] * len(f.lanes)
+    lanes[0] = _ite(present, cnt, cnt + 1)
+    for s in range(sp.cap):
+        base = 1 + s * (kw + vw)
+        before = _lt_lane(s, pos)
+        at = _eq_lane(s, pos)
+        shifted = rows[s - 1] if s > 0 else [0] * (kw + vw)
+        ins = _select_lanes(before, rows[s],
+                            _select_lanes(at, newrow, shifted))
+        lanes[base:base + kw + vw] = _select_lanes(present, rows[s], ins)
+    return SymV(sp, lanes)
+
+
+def kv_domain_slots(f: SymV):
+    """(used_guard, key SymV, val SymV) per slot of a kvtable."""
+    sp = f.spec
+    kw, vw = sp.elem.width, sp.val.width
+    cnt = f.lanes[0]
+    for s in range(sp.cap):
+        base = 1 + s * (kw + vw)
+        used = _lt_lane(s, cnt)
+        yield used, SymV(sp.elem, f.lanes[base:base + kw]), \
+            SymV(sp.val, f.lanes[base + kw:base + kw + vw])
+
+
+# ---------------------------------------------------------------------------
+# the expression evaluator
+# ---------------------------------------------------------------------------
+
+_ARITH = {"+", "-", "*", "\\div", "%", "^"}
+_CMP = {"<", ">", "<=", ">=", "=<", "\\leq", "\\geq"}
+
+
+class Elems:
+    """A set given extensionally as guarded symbolic elements — the result
+    of {e : x \\in S} (SetMap) before it lands in a union/membership."""
+    __slots__ = ("items",)
+
+    def __init__(self, items):
+        self.items = items  # list of (guard, SymV | static)
+
+
+def sym_eval2(e: A.Node, fr: Frame):
+    t = type(e)
+    kc = fr.kc
+    if t is A.Num:
+        return mk_int(e.val)
+    if t is A.Bool:
+        return SymV(BOOL, [e.val])
+    if t is A.Str:
+        if e.val in kc.uni.to_idx:
+            return SymV(ENUM, [kc.uni.index(e.val)])
+        return e.val
+    if t is A.Ident:
+        name = e.name
+        if name in fr.bound:
+            v = fr.bound[name]
+            if isinstance(v, tuple) and v:
+                if v[0] == "$letexpr":
+                    return sym_eval2(v[1], fr)
+                if v[0] == "$slot":
+                    raise CompileError("unresolved dynamic-set binding")
+                if v[0] == "$op":
+                    raise CompileError(f"operator {name} used as value")
+            return v
+        if name in fr.state:
+            return fr.state[name]
+        d = kc.model.defs.get(name)
+        if isinstance(d, OpClosure):
+            if d.params:
+                raise CompileError(f"operator {name} used as a value")
+            if isinstance(d.body, A.FnConstrDef):
+                raise CompileError("recursive functions not compilable")
+            return sym_eval2(d.body, fr)
+        if d is None:
+            raise CompileError(f"unknown identifier {name}")
+        return _static_const(d, fr)
+    if t is A.Prime:
+        if not isinstance(e.expr, A.Ident):
+            raise CompileError("primed non-variable")
+        nm = e.expr.name
+        if nm not in fr.primes:
+            raise CompileError(f"{nm}' read before assignment")
+        return fr.primes[nm]
+    if t is A.OpApp:
+        return _sym_opapp2(e, fr)
+    if t is A.FnApp:
+        f = sym_eval2(e.fn, fr)
+        args = [sym_eval2(a, fr) for a in e.args]
+        return sym_apply(f, args, fr)
+    if t is A.Dot:
+        return sym_dot(sym_eval2(e.expr, fr), e.fld, fr)
+    if t is A.If:
+        c = as_bool(sym_eval2(e.cond, fr), fr)
+        if isinstance(c, bool):
+            return sym_eval2(e.then if c else e.els, fr)
+        # traced condition: if one branch is uncompilable (e.g. applies an
+        # always-empty function), keep the other and flag overflow when the
+        # failing branch would have been taken — exactness preserved
+        try:
+            a = sym_eval2(e.then, fr)
+        except CompileError:
+            fr.flag_overflow(c)
+            return sym_eval2(e.els, fr)
+        try:
+            b = sym_eval2(e.els, fr)
+        except CompileError:
+            fr.flag_overflow(_lnot(c))
+            return a
+        return _merge_values(c, a, b, fr)
+    if t is A.Case:
+        node = None
+        for g, b in reversed(e.arms):
+            if node is None:
+                node = A.If(g, b, e.other) if e.other is not None else b
+            else:
+                node = A.If(g, b, node)
+        return sym_eval2(node, fr)
+    if t is A.TupleExpr:
+        items = [sym_eval2(x, fr) for x in e.items]
+        return _tuple_symv(items, fr)
+    if t is A.SetEnum:
+        items = [sym_eval2(x, fr) for x in e.items]
+        conc = _try_concrete(items, fr)
+        if conc is not None:
+            return frozenset(conc)
+        return Elems([(True, x) for x in items])
+    if t is A.RecordExpr:
+        fields = sorted(((k, sym_eval2(v, fr)) for k, v in e.fields),
+                        key=lambda kv: kv[0])
+        lanes: List = []
+        specs = []
+        for k, v in fields:
+            sv = _lift(v, fr)
+            specs.append(sv.spec)
+            lanes.extend(sv.lanes)
+        return SymV(VS("fcn", dom=tuple(k for k, _ in fields),
+                       elems=tuple(specs)), lanes)
+    if t is A.Except:
+        f = _lift(sym_eval2(e.fn, fr), fr)
+        for path, rhs in e.updates:
+            epath = []
+            for k, arg in path:
+                if k == "idx":
+                    epath.append(("idx", [sym_eval2(a, fr) for a in arg]))
+                else:
+                    epath.append(("dot", arg))
+
+            def rhs_eval(old, rhs=rhs):
+                return sym_eval2(rhs, fr.with_bound({"@": old}))
+            f = sym_except(f, epath, rhs_eval, fr)
+        return f
+    if t is A.At:
+        if "@" not in fr.bound:
+            raise CompileError("@ outside EXCEPT")
+        return fr.bound["@"]
+    if t is A.FnDef:
+        return _sym_fndef(e, fr)
+    if t is A.SetFilter:
+        return _sym_setfilter(e, fr)
+    if t is A.SetMap:
+        return _sym_setmap(e, fr)
+    if t is A.Quant:
+        acc = True if e.kind == "A" else False
+        for b in _binder_combos(e.binders, fr):
+            guard, bound = b
+            v = as_bool(sym_eval2(e.body, fr.with_bound(bound)), fr)
+            if e.kind == "A":
+                acc = _land(acc, _lor(_lnot(guard), v))
+            else:
+                acc = _lor(acc, _land(guard, v))
+        return mk_bool(acc)
+    if t is A.Choose:
+        return _sym_choose(e, fr)
+    if t is A.Let:
+        defs = {}
+        frame = fr
+        for d in e.defs:
+            if isinstance(d, A.OpDef) and not d.params:
+                defs[d.name] = sym_eval2(d.body, frame.with_bound(defs))
+            elif isinstance(d, A.OpDef):
+                defs[d.name] = ("$op", d, dict(defs))
+            else:
+                raise CompileError("unsupported LET body in compiled expr")
+        return sym_eval2(e.body, fr.with_bound(defs))
+    if t is A.Unchanged:
+        raise CompileError("UNCHANGED in expression position")
+    raise CompileError(f"cannot compile {t.__name__}")
+
+
+def _static_const(d, fr: Frame):
+    """A cfg-bound constant or plain value from the defs table."""
+    if isinstance(d, (int, bool, str, ModelValue, frozenset, Fcn)):
+        if isinstance(d, (frozenset, Fcn)) or isinstance(d, InfiniteSet):
+            return d
+        return _lift(d, fr)
+    if isinstance(d, InfiniteSet):
+        return d
+    raise CompileError(f"cannot compile constant {d!r}")
+
+
+def _tuple_symv(items, fr: Frame) -> SymV:
+    espec = None
+    lifted = []
+    for x in items:
+        sv = _lift(x, fr)
+        lifted.append(sv)
+        espec = sv.spec if espec is None else vs_merge(espec, sv.spec)
+    if espec is None:
+        return SymV(VS("justempty"), [])
+    from .vspec import apply_bounds
+    espec = apply_bounds(espec, fr.kc.bounds)
+    n = len(lifted)
+    lanes = [n]
+    for sv in lifted:
+        lanes.extend(coerce(sv, espec, fr).lanes)
+    cap = max(n, 1)
+    return SymV(VS("seq", cap=cap, elem=espec), lanes)
+
+
+def _merge_values(c, a, b, fr: Frame):
+    if isinstance(a, Elems) or isinstance(b, Elems):
+        raise CompileError("IF over extensional sets")
+    if not isinstance(a, SymV) and not isinstance(b, SymV) \
+            and isinstance(a, frozenset) and isinstance(b, frozenset):
+        a = _to_mask_set(a, fr) if a or b else a
+        if isinstance(a, frozenset):
+            return a  # both empty
+        b = _to_mask_set(b, fr)
+    a = _lift(a, fr)
+    b = _lift(b, fr)
+    a, b = unify(a, b, fr)
+    return SymV(a.spec, _select_lanes(c, a.lanes, b.lanes))
+
+
+def _binder_combos(binders, fr: Frame):
+    """Yield (guard, bound-dict) combinations for quantifier binders."""
+    groups = []
+    for names, sexpr in binders:
+        if sexpr is None:
+            raise CompileError("unbounded quantifier")
+        sval = sym_eval2(sexpr, fr)
+        elems = list(_elements(sval, fr))
+        for pat in names:
+            groups.append((pat, elems))
+    for combo in itertools.product(*[g[1] for g in groups]):
+        guard = True
+        bound = {}
+        for (pat, _), (g, v) in zip(groups, combo):
+            guard = _land(guard, g)
+            if isinstance(pat, tuple):
+                if isinstance(v, SymV):
+                    if v.spec.kind != "seq" or len(pat) > v.spec.cap:
+                        raise CompileError("cannot destructure value")
+                    for i, nm in enumerate(pat):
+                        bound[nm] = SymV(v.spec.elem, _seq_elem(v, i))
+                else:
+                    bound.update(bind_pattern(pat, v))
+            else:
+                bound[pat] = v
+        yield guard, bound
+
+
+def _elements(sval, fr: Frame):
+    if isinstance(sval, Elems):
+        for g, v in sval.items:
+            yield g, v
+        return
+    yield from set_elements(sval, fr)
+
+
+def _sym_fndef(e: A.FnDef, fr: Frame) -> SymV:
+    if len(e.binders) != 1 or len(e.binders[0][0]) != 1:
+        raise CompileError("multi-binder function constructor")
+    pat, sexpr = e.binders[0][0][0], e.binders[0][1]
+    sval = sym_eval2(sexpr, fr)
+    if isinstance(sval, frozenset) and not sval:
+        # [j \in {} |-> ...] — voterLog resets, raft.tla:190
+        return SymV(VS("justempty"), [])
+    if isinstance(sval, frozenset):
+        keys = sorted(sval, key=sort_key)
+        vals = []
+        specs = []
+        for k in keys:
+            b = bind_pattern(pat, k) if isinstance(pat, tuple) else {pat: k}
+            b = {nm: (_lift(v, fr) if not isinstance(v, (frozenset, Fcn))
+                      else v) for nm, v in b.items()}
+            v = _lift(sym_eval2(e.body, fr.with_bound(b)), fr)
+            vals.append(v)
+            specs.append(v.spec)
+        if all(isinstance(k, int) for k in keys) \
+                and list(keys) == list(range(1, len(keys) + 1)):
+            espec = specs[0]
+            for s in specs[1:]:
+                espec = vs_merge(espec, s)
+            from .vspec import apply_bounds
+            espec = apply_bounds(espec, fr.kc.bounds)
+            lanes = [len(keys)]
+            for v in vals:
+                lanes.extend(coerce(v, espec, fr).lanes)
+            return SymV(VS("seq", cap=len(keys), elem=espec), lanes)
+        lanes = []
+        for v in vals:
+            lanes.extend(v.lanes)
+        return SymV(VS("fcn", dom=tuple(keys), elems=tuple(specs)), lanes)
+    if isinstance(sval, SymV) and sval.spec.kind == "iset":
+        # [j \in 1..newCommitIndex |-> log[i][j]] -> a sequence
+        members = sval.spec.dom
+        ints = [m for m in members if isinstance(m, int) and m >= 1]
+        vals = []
+        length = 0
+        for m in sorted(ints):
+            idx = members.index(m)
+            g = sval.lanes[idx]
+            gb = g if isinstance(g, bool) else _eq_lane(g, 1)
+            b = {pat: mk_int(m)}
+            v = _lift(sym_eval2(e.body, fr.with_bound(b)), fr)
+            vals.append((gb, v))
+            length = length + (_ite(gb, 1, 0) if not isinstance(gb, bool)
+                               else (1 if gb else 0))
+        if not vals:
+            raise CompileError("empty iset function constructor")
+        espec = vals[0][1].spec
+        for _, v in vals[1:]:
+            espec = vs_merge(espec, v.spec)
+        from .vspec import apply_bounds
+        espec = apply_bounds(espec, fr.kc.bounds)
+        lanes = [length]
+        # contiguity: iset from 1..k is a prefix, so position = value - 1
+        for gb, v in vals:
+            cv = coerce(v, espec, fr)
+            lanes.extend(_select_lanes(gb, cv.lanes, [0] * espec.width))
+        return SymV(VS("seq", cap=len(vals), elem=espec), lanes)
+    raise CompileError("function constructor over non-static domain")
+
+
+def _sym_setfilter(e: A.SetFilter, fr: Frame):
+    sval = sym_eval2(e.set, fr)
+    if isinstance(sval, frozenset):
+        # static domain, possibly symbolic predicate -> mask set
+        members = sorted(sval, key=sort_key)
+        all_static = True
+        lanes = []
+        kept = []
+        for m in members:
+            b = bind_pattern(e.var, m) if isinstance(e.var, tuple) \
+                else {e.var: m}
+            b = {nm: (_lift(v, fr) if not isinstance(v, (frozenset, Fcn))
+                      else v) for nm, v in b.items()}
+            p = as_bool(sym_eval2(e.pred, fr.with_bound(b)), fr)
+            if isinstance(p, bool):
+                if p:
+                    kept.append(m)
+                lanes.append(1 if p else 0)
+            else:
+                all_static = False
+                lanes.append(_ite(p, 1, 0))
+        if all_static:
+            return frozenset(kept)
+        if all(isinstance(m, (str, ModelValue)) for m in members):
+            return SymV(VS("set", dom=tuple(members)), lanes)
+        if all(isinstance(m, int) for m in members):
+            return SymV(VS("iset", dom=tuple(members)), lanes)
+        raise CompileError("symbolic filter over heterogeneous set")
+    if isinstance(sval, SymV) and sval.spec.kind in ("set", "iset"):
+        lanes = []
+        for i, m in enumerate(sval.spec.dom):
+            b = {e.var: _lift(m, fr) if not isinstance(m, (frozenset, Fcn))
+                 else m} if not isinstance(e.var, tuple) else None
+            if b is None:
+                raise CompileError("pattern filter over mask set")
+            p = as_bool(sym_eval2(e.pred, fr.with_bound(b)), fr)
+            memb = sval.lanes[i]
+            mb = memb if isinstance(memb, bool) else _eq_lane(memb, 1)
+            both = _land(mb, p)
+            lanes.append(_ite(both, 1, 0) if not isinstance(both, bool)
+                         else (1 if both else 0))
+        return SymV(sval.spec, lanes)
+    if isinstance(sval, Elems) or (isinstance(sval, SymV)
+                                   and sval.spec.kind == "growset"):
+        out = []
+        for g, v in _elements(sval, fr):
+            b = {e.var: v}
+            p = as_bool(sym_eval2(e.pred, fr.with_bound(b)), fr)
+            out.append((_land(g, p), v))
+        return Elems(out)
+    raise CompileError("unsupported set filter")
+
+
+def _sym_setmap(e: A.SetMap, fr: Frame):
+    out = []
+    for guard, bound in _binder_combos(e.binders, fr):
+        v = sym_eval2(e.expr, fr.with_bound(bound))
+        out.append((guard, v))
+    if all(g is True for g, _ in out):
+        conc = _try_concrete([v for _, v in out], fr)
+        if conc is not None:
+            return frozenset(conc)
+    return Elems(out)
+
+
+def _try_concrete(items, fr: Frame):
+    """If every item is static, give back concrete python values."""
+    conc = []
+    for x in items:
+        if isinstance(x, SymV):
+            if not x.static:
+                return None
+            conc.append(_decode_static(x, fr))
+        elif isinstance(x, Elems):
+            return None
+        else:
+            conc.append(x)
+    return conc
+
+
+def _sym_choose(e: A.Choose, fr: Frame):
+    """CHOOSE x \\in S : P. Static sets resolve statically; the Min/Max
+    idiom (raft.tla:151-154) over symbolic int sets compiles to masked
+    min/max."""
+    if e.set is None:
+        raise CompileError("unbounded CHOOSE")
+    sval = sym_eval2(e.set, fr)
+    if isinstance(sval, frozenset):
+        for m in sorted(sval, key=sort_key):
+            b = bind_pattern(e.var, m) if isinstance(e.var, tuple) \
+                else {e.var: m}
+            b = {nm: (_lift(v, fr) if not isinstance(v, (frozenset, Fcn))
+                      else v) for nm, v in b.items()}
+            p = as_bool(sym_eval2(e.pred, fr.with_bound(b)), fr)
+            if not isinstance(p, bool):
+                raise CompileError("CHOOSE with traced predicate over "
+                                   "static set")
+            if p:
+                return _lift(m, fr) if not isinstance(m, (frozenset, Fcn)) \
+                    else m
+        raise CompileError(f"CHOOSE: no witness in static set {sval!r} (var {e.var}, pred {e.pred})")
+    mode = _minmax_pattern(e)
+    if mode and isinstance(sval, Elems):
+        # Min({Len(log[i]), nextIndex[i][j]}) — fold over guarded items
+        # (raft.tla:229)
+        best = None
+        for g, v in sval.items:
+            x = as_int_lane(_lift(v, fr))
+            masked = _ite(as_bool(mk_bool(g), fr) if not isinstance(g, bool)
+                          else g, x, -10**6 if mode == "max" else 10**6)
+            if best is None:
+                best = masked
+            else:
+                best = jnp.maximum(best, masked) if mode == "max" \
+                    else jnp.minimum(best, masked)
+        if best is None:
+            raise CompileError("CHOOSE over empty extensional set")
+        return mk_int(best)
+    if mode and isinstance(sval, SymV) and sval.spec.kind == "iset":
+        # masked min/max over the int universe; value is unspecified when
+        # the set is empty (the spec guards emptiness, as TLC does lazily)
+        best = None
+        for i, m in enumerate(sval.spec.dom):
+            memb = sval.lanes[i]
+            mb = memb if isinstance(memb, bool) else _eq_lane(memb, 1)
+            if best is None:
+                best = _ite(mb, m, -10**6 if mode == "max" else 10**6)
+            else:
+                cand = _ite(mb, m, -10**6 if mode == "max" else 10**6)
+                best = jnp.maximum(best, cand) if mode == "max" \
+                    else jnp.minimum(best, cand)
+        return mk_int(best)
+    raise CompileError("CHOOSE over symbolic set (not a Min/Max pattern)")
+
+
+def _minmax_pattern(e: A.Choose) -> Optional[str]:
+    """Min(s): CHOOSE x \\in s : \\A y \\in s : x <= y (raft.tla:151-154)."""
+    p = e.pred
+    if not (isinstance(p, A.Quant) and p.kind == "A" and len(p.binders) == 1
+            and isinstance(p.body, A.OpApp)):
+        return None
+    op = p.body.name
+    if op in ("<=", "=<", "\\leq"):
+        return "min"
+    if op in (">=", "\\geq"):
+        return "max"
+    return None
+
+
+def _sym_opapp2(e: A.OpApp, fr: Frame):
+    name = e.name
+    kc = fr.kc
+    if e.path:
+        raise CompileError("instance paths not compilable yet")
+    if name == "/\\":
+        # lazy like TLC: a statically-false left guard protects the right
+        # (IF agreeIndexes /= {} /\ log[i][Max(agreeIndexes)]...,
+        # raft.tla:288-295); with a TRACED guard, an uncompilable right
+        # side is recovered by flagging overflow where it would be needed
+        a = as_bool(sym_eval2(e.args[0], fr), fr)
+        if a is False:
+            return mk_bool(False)
+        try:
+            b = as_bool(sym_eval2(e.args[1], fr), fr)
+        except CompileError:
+            if a is True:
+                raise
+            fr.flag_overflow(a)
+            return mk_bool(False)
+        return mk_bool(_land(a, b))
+    if name == "\\/":
+        a = as_bool(sym_eval2(e.args[0], fr), fr)
+        if a is True:
+            return mk_bool(True)
+        try:
+            b = as_bool(sym_eval2(e.args[1], fr), fr)
+        except CompileError:
+            if a is False:
+                raise
+            fr.flag_overflow(_lnot(a))
+            return mk_bool(a)
+        return mk_bool(_lor(a, b))
+    if name == "~":
+        return mk_bool(_lnot(as_bool(sym_eval2(e.args[0], fr), fr)))
+    if name == "=>":
+        a = as_bool(sym_eval2(e.args[0], fr), fr)
+        if a is False:
+            return mk_bool(True)
+        return mk_bool(_lor(_lnot(a),
+                            as_bool(sym_eval2(e.args[1], fr), fr)))
+    if name in ("<=>", "\\equiv"):
+        a = as_bool(sym_eval2(e.args[0], fr), fr)
+        b = as_bool(sym_eval2(e.args[1], fr), fr)
+        if isinstance(a, bool) and isinstance(b, bool):
+            return mk_bool(a == b)
+        return mk_bool(jnp.equal(a, b))
+    if name in ("=", "/=", "#"):
+        a = sym_eval2(e.args[0], fr)
+        b = sym_eval2(e.args[1], fr)
+        r = _generic_eq(a, b, fr)
+        return mk_bool(r if name == "=" else _lnot(r))
+    if name in ("\\in", "\\notin"):
+        x = sym_eval2(e.args[0], fr)
+        s = sym_eval2(e.args[1], fr)
+        r = _generic_in(x, s, fr)
+        return mk_bool(r if name == "\\in" else _lnot(r))
+    if name in _ARITH:
+        a = as_int_lane(sym_eval2(e.args[0], fr))
+        b = as_int_lane(sym_eval2(e.args[1], fr))
+        if isinstance(a, int) and isinstance(b, int):
+            return mk_int({"+": a + b, "-": a - b, "*": a * b,
+                           "\\div": a // b if b else 0,
+                           "%": a % b if b else 0,
+                           "^": a ** b}[name])
+        ops = {"+": jnp.add, "-": jnp.subtract, "*": jnp.multiply,
+               "\\div": jnp.floor_divide, "%": jnp.mod,
+               "^": jnp.power}
+        return mk_int(ops[name](a, b))
+    if name in _CMP:
+        a = as_int_lane(sym_eval2(e.args[0], fr))
+        b = as_int_lane(sym_eval2(e.args[1], fr))
+        if isinstance(a, int) and isinstance(b, int):
+            return mk_bool({"<": a < b, ">": a > b}.get(
+                name, a <= b if name in ("<=", "=<", "\\leq") else a >= b))
+        ops = {"<": jnp.less, ">": jnp.greater}
+        f = ops.get(name, jnp.less_equal if name in ("<=", "=<", "\\leq")
+                    else jnp.greater_equal)
+        return mk_bool(f(a, b))
+    if name == "-.":
+        a = as_int_lane(sym_eval2(e.args[0], fr))
+        return mk_int(-a if isinstance(a, int) else jnp.negative(a))
+    if name == "..":
+        a = sym_eval2(e.args[0], fr)
+        b = sym_eval2(e.args[1], fr)
+        al, bl = as_int_lane(a), as_int_lane(b)
+        if isinstance(al, int) and isinstance(bl, int):
+            return frozenset(range(al, bl + 1))
+        return interval_iset(al, bl, fr)
+    if name in ("\\cup", "\\union"):
+        return set_union(sym_eval2(e.args[0], fr),
+                         sym_eval2(e.args[1], fr), fr)
+    if name in ("\\cap", "\\intersect", "\\"):
+        a = sym_eval2(e.args[0], fr)
+        b = sym_eval2(e.args[1], fr)
+        if isinstance(a, frozenset) and isinstance(b, frozenset):
+            return a & b if name != "\\" else a - b
+        ma, mb = _to_mask_set(a, fr), _to_mask_set(b, fr)
+        ma, mb = unify(ma, mb, fr)
+        out = []
+        for x, y in zip(ma.lanes, mb.lanes):
+            xb = x if isinstance(x, bool) else _eq_lane(x, 1)
+            yb = y if isinstance(y, bool) else _eq_lane(y, 1)
+            r = _land(xb, yb) if name != "\\" else _land(xb, _lnot(yb))
+            out.append(_ite(r, 1, 0) if not isinstance(r, bool)
+                       else (1 if r else 0))
+        return SymV(ma.spec, out)
+    if name == "\\subseteq":
+        a = sym_eval2(e.args[0], fr)
+        b = sym_eval2(e.args[1], fr)
+        acc = True
+        for g, m in _elements(a, fr):
+            inn = _generic_in(m, b, fr)
+            acc = _land(acc, _lor(_lnot(g), inn))
+        return mk_bool(acc)
+    if name == "Cardinality":
+        s = sym_eval2(e.args[0], fr)
+        if isinstance(s, frozenset):
+            return mk_int(len(s))
+        n = 0
+        for g, _ in _elements(s, fr):
+            n = n + (_ite(g, 1, 0) if not isinstance(g, bool)
+                     else (1 if g else 0))
+        return mk_int(n)
+    if name == "SUBSET":
+        s = sym_eval2(e.args[0], fr)
+        if isinstance(s, frozenset):
+            out = []
+            ms = sorted(s, key=sort_key)
+            for r in range(len(ms) + 1):
+                for c in itertools.combinations(ms, r):
+                    out.append(frozenset(c))
+            return frozenset(out)
+        raise CompileError("SUBSET of symbolic set")
+    if name == "UNION":
+        s = sym_eval2(e.args[0], fr)
+        if isinstance(s, frozenset):
+            out = frozenset()
+            for m in s:
+                out = out | m
+            return out
+        raise CompileError("UNION of symbolic set")
+    if name == "DOMAIN":
+        f = sym_eval2(e.args[0], fr)
+        if isinstance(f, Fcn):
+            return f.domain()
+        if isinstance(f, SymV):
+            sp = f.spec
+            if sp.kind == "fcn":
+                return frozenset(sp.dom)
+            if sp.kind == "seq":
+                return interval_iset(mk_int(1), seq_len(f), fr)
+            if sp.kind == "kvtable":
+                return Elems([(g, k) for g, k, _ in kv_domain_slots(f)])
+            if sp.kind == "pfcn":
+                lanes = []
+                off = 0
+                for dk, es in zip(sp.dom, sp.elems):
+                    lanes.append(f.lanes[off])
+                    off += 1 + es.width
+                if all(isinstance(m, (str, ModelValue)) for m in sp.dom):
+                    return SymV(VS("set", dom=sp.dom), lanes)
+                return SymV(VS("iset", dom=sp.dom), lanes)
+        raise CompileError("DOMAIN of non-function")
+    if name == "Len":
+        return seq_len(_lift(sym_eval2(e.args[0], fr), fr))
+    if name == "Append":
+        return seq_append(_lift(sym_eval2(e.args[0], fr), fr),
+                          sym_eval2(e.args[1], fr), fr)
+    if name == "SubSeq":
+        return seq_subseq(_lift(sym_eval2(e.args[0], fr), fr),
+                          sym_eval2(e.args[1], fr),
+                          sym_eval2(e.args[2], fr), fr)
+    if name in ("\\o", "\\circ"):
+        return seq_concat(_lift(sym_eval2(e.args[0], fr), fr),
+                          _lift(sym_eval2(e.args[1], fr), fr), fr)
+    if name == "Head":
+        return sym_apply(_lift(sym_eval2(e.args[0], fr), fr), [mk_int(1)],
+                         fr)
+    if name == ":>":
+        k = _lift(sym_eval2(e.args[0], fr), fr)
+        v = _lift(sym_eval2(e.args[1], fr), fr)
+        return ("$single", k, v)
+    if name == "@@":
+        f = sym_eval2(e.args[0], fr)
+        g = sym_eval2(e.args[1], fr)
+        if isinstance(g, tuple) and g and g[0] == "$single":
+            f = _lift(f, fr)
+            if f.spec.kind == "kvtable":
+                return kv_merge_insert(f, g[1], g[2], fr)
+            if f.spec.kind == "pfcn":
+                def same(old):
+                    return g[2]
+                return sym_except(f, [("idx", [g[1]])], lambda old: g[2],
+                                  fr)
+        raise CompileError("@@ outside table-insert idiom")
+    if name == "Assert":
+        raise CompileError("Assert in expression position")
+    if name == "!sel":
+        base, num = e.args
+        if isinstance(base, A.Ident):
+            d = kc.model.defs.get(base.name)
+            if isinstance(d, OpClosure):
+                conjs = _flatten_conj(d.body)
+                if 1 <= num.val <= len(conjs):
+                    return sym_eval2(conjs[num.val - 1], fr)
+        raise CompileError("!sel not resolvable")
+    # user-defined operators
+    d = fr.bound.get(name)
+    if d is None:
+        d = kc.model.defs.get(name)
+    if isinstance(d, tuple) and d and d[0] == "$op":
+        od, captured = d[1], d[2]
+        args = [sym_eval2(a, fr) for a in e.args]
+        return sym_eval2(od.body, fr.with_bound(
+            {**captured, **dict(zip(od.params, args))}))
+    if isinstance(d, OpClosure):
+        args = [sym_eval2(a, fr) for a in e.args]
+        return sym_eval2(d.body, fr.with_bound(dict(zip(d.params, args))))
+    if d is not None and not e.args:
+        if isinstance(d, (SymV, frozenset, Fcn, Elems)):
+            return d
+        return _static_const(d, fr)
+    raise CompileError(f"cannot compile operator {name}")
+
+
+def _flatten_conj(e):
+    if isinstance(e, A.OpApp) and e.name == "/\\":
+        return _flatten_conj(e.args[0]) + _flatten_conj(e.args[1])
+    return [e]
+
+
+def _generic_eq(a, b, fr: Frame):
+    if isinstance(a, Elems) or isinstance(b, Elems):
+        raise CompileError("equality over extensional sets")
+    if not isinstance(a, SymV) and not isinstance(b, SymV):
+        try:
+            return tla_eq(a, b)
+        except EvalError as ex:
+            raise CompileError(str(ex))
+    if isinstance(a, frozenset) or isinstance(b, frozenset):
+        # set vs symbolic set: subset both ways
+        st = a if isinstance(a, frozenset) else b
+        sy = b if isinstance(a, frozenset) else a
+        if isinstance(sy, SymV) and sy.spec.kind in ("set", "iset"):
+            acc = True
+            for i, m in enumerate(sy.spec.dom):
+                memb = sy.lanes[i]
+                mb = memb if isinstance(memb, bool) else _eq_lane(memb, 1)
+                want = in_set(m, st)
+                acc = _land(acc, mb if want else _lnot(mb))
+            extra = st - frozenset(sy.spec.dom)
+            if extra:
+                return False
+            return acc
+        if isinstance(sy, SymV) and sy.spec.kind == "growset":
+            return sym_eq(sy, static_to_symv(st, fr.kc, sy.spec), fr)
+        raise CompileError("set equality with unsupported operand")
+    a = _lift(a, fr)
+    b = _lift(b, fr)
+    return sym_eq(a, b, fr)
+
+
+def _generic_in(x, s, fr: Frame):
+    if isinstance(s, Elems):
+        acc = False
+        for g, v in s.items:
+            acc = _lor(acc, _land(g, _generic_eq(x, v, fr)))
+        return acc
+    return sym_in(x, s, fr)
+
+
+# ---------------------------------------------------------------------------
+# layout + action compilation
+# ---------------------------------------------------------------------------
+
+class Layout2:
+    """vspec-based state layout (replaces compile.ground.StateLayout)."""
+
+    def __init__(self, vars: Tuple[str, ...], specs: Dict[str, VS],
+                 uni: EnumUniverse):
+        self.vars = vars
+        self.specs = specs
+        self.uni = uni
+        self.width = sum(specs[v].width for v in vars)
+        self.offsets = {}
+        off = 0
+        for v in vars:
+            self.offsets[v] = off
+            off += specs[v].width
+
+    def encode(self, state: Dict[str, Any]):
+        import numpy as np
+        out: List[int] = []
+        for v in self.vars:
+            vs_encode(state[v], self.specs[v], self.uni, out)
+        return np.asarray(out, dtype=np.int32)
+
+    def decode(self, row) -> Dict[str, Any]:
+        from .vspec import decode as vs_decode
+        st = {}
+        i = 0
+        for v in self.vars:
+            st[v], i = vs_decode(row, i, self.specs[v], self.uni)
+        return st
+
+
+def build_layout2(model: Model, sampled_states: List[Dict[str, Any]],
+                  bounds: Bounds) -> Layout2:
+    from .vspec import (apply_bounds, collect_enums_from_value, infer)
+    uni = EnumUniverse()
+    # enum universe: every sampled value + every string literal in the
+    # module AST + cfg model values (guards may compare against literals
+    # no sampled state contains)
+    for st in sampled_states:
+        for v in st.values():
+            collect_enums_from_value(v, uni)
+    for d in model.defs.values():
+        if not isinstance(d, OpClosure):
+            collect_enums_from_value(d, uni)
+    _collect_ast_strings(model, uni)
+    specs: Dict[str, VS] = {}
+    for var in model.vars:
+        sp = None
+        for st in sampled_states:
+            s2 = infer(st[var], uni)
+            sp = s2 if sp is None else vs_merge(sp, s2)
+        specs[var] = apply_bounds(sp, bounds)
+    return Layout2(tuple(model.vars), specs, uni)
+
+
+def _collect_ast_strings(model: Model, uni: EnumUniverse):
+    def walk(e):
+        if isinstance(e, A.Str):
+            uni.add(e.val)
+        for f in getattr(e, "__dataclass_fields__", {}):
+            v = getattr(e, f)
+            if isinstance(v, A.Node):
+                walk(v)
+            elif isinstance(v, tuple):
+                _walk_tuple(v)
+
+    def _walk_tuple(t):
+        for x in t:
+            if isinstance(x, A.Node):
+                walk(x)
+            elif isinstance(x, tuple):
+                _walk_tuple(x)
+
+    for d in model.defs.values():
+        if isinstance(d, OpClosure) and isinstance(d.body, A.Node):
+            walk(d.body)
+
+
+@dataclass
+class CompiledAction2:
+    label: str
+    fn: Callable  # (row[, slot]) -> (enabled, assert_ok, overflow, succ_row)
+    n_slots: int = 0  # >0: fn takes a traced slot index in [0, n_slots)
+
+
+def _has_slotv(ga) -> bool:
+    for item in ga.items:
+        _, bound_env = item
+        for v in bound_env.values():
+            if isinstance(v, tuple) and len(v) == 2 and v[0] == "$slotv":
+                return True
+    return False
+
+
+def compile_action2(kc: KernelCtx, ga) -> CompiledAction2:
+    layout = kc.layout
+    vars = layout.vars
+    slotted = _has_slotv(ga)
+
+    def fn(row, slot=None):
+        state = {}
+        off = 0
+        for v in vars:
+            sp = layout.specs[v]
+            state[v] = SymV(sp, [row[off + i] for i in range(sp.width)])
+            off += sp.width
+        primes: Dict[str, SymV] = {}
+        overflow = [False]
+        enabled = True
+        assert_ok = True
+
+        for item in ga.items:
+            if isinstance(item, tuple) and len(item) == 2 \
+                    and isinstance(item[0], A.Node):
+                expr, bound_env = item
+            else:
+                raise CompileError(f"bad grounded item {item!r}")
+            fr = Frame(kc, _lift_bound(bound_env, kc), state, primes,
+                       overflow)
+            # dynamic-\E slot binding guards (traced slot index)
+            slot_guards = []
+            bound2 = dict(fr.bound)
+            for nm, bv in list(bound2.items()):
+                if isinstance(bv, tuple) and len(bv) == 2 \
+                        and bv[0] == "$slotv":
+                    g, val = _slot_bind_traced(bv[1], slot, fr)
+                    slot_guards.append(g)
+                    bound2[nm] = val
+            if slot_guards:
+                fr = Frame(kc, bound2, state, primes, overflow)
+                for g in slot_guards:
+                    enabled = _land(enabled, g)
+
+            tgt = _prime_target2(expr, vars)
+            if tgt is not None:
+                var, rhs = tgt
+                try:
+                    val = _lift(sym_eval2(rhs, fr), fr)
+                    val = coerce(val, layout.specs[var], fr)
+                except CompileError:
+                    if enabled is True:
+                        raise
+                    # uncompilable only along paths the guards exclude:
+                    # abort (overflow) if the action is ever enabled
+                    fr.flag_overflow(enabled)
+                    val = SymV(layout.specs[var],
+                               [0] * layout.specs[var].width)
+                if var in primes:
+                    enabled = _land(enabled, sym_eq(primes[var], val, fr))
+                else:
+                    primes[var] = val
+                continue
+            if isinstance(expr, A.Unchanged):
+                _unchanged2(expr.expr, kc, state, primes, vars)
+                continue
+            if isinstance(expr, A.OpApp) and expr.name == "Assert":
+                cond = as_bool(sym_eval2(expr.args[0], fr), fr)
+                if cond is not True:
+                    bad = _land(enabled, _lnot(cond))
+                    assert_ok = _land(assert_ok, _lnot(bad))
+                continue
+            try:
+                g = as_bool(sym_eval2(expr, fr), fr)
+            except CompileError:
+                if enabled is True:
+                    raise
+                fr.flag_overflow(enabled)
+                g = False
+            enabled = _land(enabled, g)
+
+        missing = [v for v in vars if v not in primes]
+        if missing:
+            raise CompileError(f"action {ga.label} leaves {missing} "
+                               f"unassigned")
+        out: List = []
+        for v in vars:
+            out.extend(primes[v].lanes)
+        succ = jnp.stack([jnp.asarray(x, dtype=jnp.int32) for x in out])
+        en = enabled if _is_traced(enabled) else jnp.asarray(bool(enabled))
+        ak = assert_ok if _is_traced(assert_ok) \
+            else jnp.asarray(bool(assert_ok))
+        ov = overflow[0] if _is_traced(overflow[0]) \
+            else jnp.asarray(bool(overflow[0]))
+        # overflow only matters on taken transitions
+        ov = jnp.logical_and(en, ov)
+        return en, ak, ov, succ
+
+    if slotted:
+        return CompiledAction2(ga.label, fn, n_slots=kc.bounds.kv_cap)
+    return CompiledAction2(ga.label, lambda row: fn(row, None))
+
+
+def _lift_bound(bound_env: Dict[str, Any], kc: KernelCtx) -> Dict[str, Any]:
+    out = {}
+    for k, v in bound_env.items():
+        if isinstance(v, (frozenset, Fcn, InfiniteSet)) or \
+                (isinstance(v, tuple) and v and v[0] == "$slot"):
+            out[k] = v
+        elif isinstance(v, (int, bool, str, ModelValue)):
+            if isinstance(v, bool):
+                out[k] = SymV(BOOL, [v])
+            elif isinstance(v, int):
+                out[k] = SymV(INT, [v])
+            else:
+                out[k] = SymV(ENUM, [kc.uni.index(v)])
+        else:
+            out[k] = v
+    return out
+
+
+def _slot_bind_traced(setexpr: A.Node, slot, fr: Frame):
+    """Bind the slot-th element (traced index) of a dynamic set — a
+    select-chain over the table slots, so the trace stays O(capacity)
+    per ACTION FAMILY instead of per instance."""
+    sval = sym_eval2(setexpr, fr)
+    items = list(_elements(sval, fr))
+    if not items:
+        return False, None
+    guard = False
+    first = items[0][1]
+    if not isinstance(first, SymV):
+        first = _lift(first, fr)
+    lanes = list(first.lanes)
+    spec = first.spec
+    for i, (g, v) in enumerate(items):
+        sv = v if isinstance(v, SymV) else _lift(v, fr)
+        sv = coerce(sv, spec, fr)
+        hit = _eq_lane(slot, i)
+        guard = _lor(guard, _land(hit, g))
+        if i > 0:
+            lanes = _select_lanes(hit, sv.lanes, lanes)
+    return guard, SymV(spec, lanes)
+
+
+def _prime_target2(e: A.Node, vars):
+    if isinstance(e, A.OpApp) and e.name == "=" and \
+            isinstance(e.args[0], A.Prime) and \
+            isinstance(e.args[0].expr, A.Ident) and \
+            e.args[0].expr.name in vars:
+        return e.args[0].expr.name, e.args[1]
+    return None
+
+
+def _unchanged2(e: A.Node, kc: KernelCtx, state, primes, vars):
+    if isinstance(e, A.Ident):
+        if e.name in vars:
+            if e.name not in primes:
+                primes[e.name] = state[e.name]
+            return
+        d = kc.model.defs.get(e.name)
+        if isinstance(d, OpClosure) and not d.params:
+            _unchanged2(d.body, kc, state, primes, vars)
+            return
+        raise CompileError(f"UNCHANGED of non-variable {e.name}")
+    if isinstance(e, A.TupleExpr):
+        for x in e.items:
+            _unchanged2(x, kc, state, primes, vars)
+        return
+    raise CompileError(f"unsupported UNCHANGED {e!r}")
+
+
+def compile_predicate2(kc: KernelCtx, expr: A.Node) -> Callable:
+    layout = kc.layout
+
+    def fn(row):
+        state = {}
+        off = 0
+        for v in layout.vars:
+            sp = layout.specs[v]
+            state[v] = SymV(sp, [row[off + i] for i in range(sp.width)])
+            off += sp.width
+        fr = Frame(kc, {}, state, {}, [False])
+        r = as_bool(sym_eval2(expr, fr), fr)
+        return r if _is_traced(r) else jnp.asarray(bool(r))
+
+    return fn

@@ -1,11 +1,17 @@
 r"""Device-resident BFS engine (BACKEND=jax) — SURVEY.md §7.5.
 
 The hot loop reconstructed in SURVEY.md §3.2, as array programs: the frontier
-and the seen-set live on the accelerator as i32[cap, W] row matrices; one
-jitted level step expands every (state x grounded action) pair with vmap,
-masks disabled instances, and deduplicates EXACTLY by lexicographic
-multi-key sort (jax.lax.sort over the W state lanes) — no fingerprint
-collisions, unlike TLC's probabilistic hashing (testout2:261-264).
+and the seen-set live on the accelerator; one jitted level step expands every
+(state x grounded action) pair with vmap, masks disabled instances, and
+deduplicates by lexicographic multi-key sort (jax.lax.sort).
+
+Two dedup modes:
+  exact  (narrow layouts, W <= FP_THRESHOLD): sort keys are all W state
+         lanes — zero collision risk, stronger than TLC.
+  fp128  (wide layouts — raft's W is ~1-2k lanes): sort keys are four
+         independent 32-bit mixes of the row (a 128-bit fingerprint, vs
+         TLC's 64-bit, testout2:261-264); the collision probability is
+         reported in the result like TLC reports its estimate.
 
 Capacities are power-of-two buckets that grow on demand, so jit recompiles
 O(log N) times; all shapes inside a step are static (XLA/TPU requirement).
@@ -18,7 +24,6 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass
-from functools import partial
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -29,11 +34,17 @@ from jax import lax
 from ..sem.modules import Model
 from ..sem.enumerate import enumerate_init
 from ..engine.explore import CheckResult, Violation
-from ..compile.ground import (CompileError, StateLayout, build_layout,
-                              ground_actions)
-from ..compile.kernel import compile_action, compile_predicate
+from ..engine.simulate import sample_states
+from ..compile.vspec import Bounds, CompileError
+from ..compile.kernel2 import (KernelCtx, Layout2, build_layout2,
+                               compile_action2, compile_predicate2)
+from ..compile.ground import ground_actions
 
 SENTINEL = np.int32(2**31 - 1)
+FP_THRESHOLD = 48  # lanes; beyond this, dedup on 128-bit fingerprints
+
+_FP_MIX = [(0x9E3779B1, 0x85EBCA6B), (0xC2B2AE35, 0x27D4EB2F),
+           (0x165667B1, 0x9E3779B1), (0x85EBCA6B, 0xC2B2AE35)]
 
 
 def _pow2_at_least(n: int, lo: int = 256) -> int:
@@ -43,58 +54,123 @@ def _pow2_at_least(n: int, lo: int = 256) -> int:
     return c
 
 
+def fingerprint128(rows):
+    """rows [N, W] i32 -> [N, 4] i32 (four independent 32-bit mixes)."""
+    u = rows.astype(jnp.uint32)
+    out = []
+    for j, (m1, m2) in enumerate(_FP_MIX):
+        h = jnp.full(rows.shape[0], 2166136261 + j * 0x9E3779B1,
+                     jnp.uint32)
+        for i in range(rows.shape[1]):
+            h = (h ^ (u[:, i] * jnp.uint32(m1))) * jnp.uint32(m2)
+        h = h ^ (h >> 15)
+        h = h * jnp.uint32(0x2C1B3C6D)
+        h = h ^ (h >> 12)
+        out.append(h.astype(jnp.int32))
+    return jnp.stack(out, axis=1)
+
+
 class TpuExplorer:
     def __init__(self, model: Model, log: Callable[[str], None] = None,
                  max_states: Optional[int] = None, store_trace: bool = True,
-                 progress_every: float = 30.0):
+                 progress_every: float = 30.0,
+                 bounds: Optional[Bounds] = None,
+                 sample_cfg: Tuple[int, int, int] = (800, 40, 60)):
         self.model = model
         self.log = log or (lambda s: None)
         self.max_states = max_states
         self.store_trace = store_trace
         self.progress_every = progress_every
+        self.bounds = bounds or Bounds()
 
         base_ctx = model.ctx()
         self.init_states = enumerate_init(model.init, base_ctx, model.vars)
-        self.layout = build_layout(model, self.init_states)
-        self.actions = ground_actions(model)
-        self.compiled = [compile_action(model, self.layout, ga)
-                         for ga in self.actions]
-        self.inv_fns = [(nm, compile_predicate(model, self.layout, ex))
+        bfs_n, walks, depth = sample_cfg
+        sampled = sample_states(model, bfs_states=bfs_n, n_walks=walks,
+                                walk_depth=depth)
+        self.layout = build_layout2(model, sampled, self.bounds)
+        self.kc = KernelCtx(model, self.layout, self.bounds)
+        dyn = self.bounds.kv_cap if any(
+            s.kind == "kvtable" for s in self.layout.specs.values()) else 0
+        self.actions = ground_actions(model, dyn_slots=dyn)
+        self.compiled = [compile_action2(self.kc, ga) for ga in self.actions]
+        # flat instance list: slotted kernels contribute n_slots rows
+        self.labels_flat = []
+        for ca in self.compiled:
+            if ca.n_slots:
+                self.labels_flat.extend(
+                    [ca.label] * ca.n_slots)
+            else:
+                self.labels_flat.append(ca.label)
+        self.inv_fns = [(nm, compile_predicate2(self.kc, ex))
                         for nm, ex in model.invariants]
-        self.constraint_fns = [(nm, compile_predicate(model, self.layout, ex))
+        self.constraint_fns = [(nm, compile_predicate2(self.kc, ex))
                                for nm, ex in model.constraints]
         if model.action_constraints:
             raise CompileError("action constraints not compiled yet - "
                                "use the interp backend")
-        self.A = len(self.compiled)
+        self.A = len(self.labels_flat)
         self.W = self.layout.width
+        self.fp_mode = self.W > FP_THRESHOLD
+        # dedup key lanes: an explicit validity lane FIRST (0=valid row,
+        # 1=invalid) — validity must never be encoded in-band in hash
+        # output or state lanes, either could legitimately equal SENTINEL
+        self.K = (4 if self.fp_mode else self.W) + 1
         self._step_cache: Dict[Tuple[int, int], Callable] = {}
+
+    def _keys_of(self, rows, valid):
+        """Dedup key lanes: [validity, hash-or-state lanes]. Invalid rows
+        get validity=1 (sorting after all valid rows) and SENTINEL data."""
+        if self.fp_mode:
+            k = fingerprint128(rows)
+        else:
+            k = rows
+        k = jnp.where(valid[:, None], k, SENTINEL)
+        vlane = jnp.where(valid, 0, 1).astype(jnp.int32)
+        return jnp.concatenate([vlane[:, None], k], axis=1)
 
     # ---- jitted level step, compiled per (seen_cap, frontier_cap) ----
     def _get_step(self, SC: int, FC: int) -> Callable:
         key = (SC, FC)
         if key in self._step_cache:
             return self._step_cache[key]
-        A, W = self.A, self.W
+        A, W, K = self.A, self.W, self.K
         acts = self.compiled
         inv_fns = self.inv_fns
         con_fns = self.constraint_fns
+        keys_of = self._keys_of
 
         def expand(frontier):
-            ens, aoks, succs = [], [], []
+            ens, aoks, ovs, succs = [], [], [], []
             for ca in acts:
-                en, aok, succ = jax.vmap(ca.fn)(frontier)
-                ens.append(en)
-                aoks.append(aok)
-                succs.append(succ)
-            return (jnp.stack(ens), jnp.stack(aoks), jnp.stack(succs))
+                if ca.n_slots:
+                    # [S, F] grids: vmap over slots then frontier rows
+                    slots = jnp.arange(ca.n_slots, dtype=jnp.int32)
+                    en, aok, ov, succ = jax.vmap(
+                        jax.vmap(ca.fn, in_axes=(0, None)),
+                        in_axes=(None, 0))(frontier, slots)
+                    # shapes [S, F, ...] -> per-slot rows
+                    for si in range(ca.n_slots):
+                        ens.append(en[si])
+                        aoks.append(aok[si])
+                        ovs.append(ov[si])
+                        succs.append(succ[si])
+                else:
+                    en, aok, ov, succ = jax.vmap(ca.fn)(frontier)
+                    ens.append(en)
+                    aoks.append(aok)
+                    ovs.append(ov)
+                    succs.append(succ)
+            return (jnp.stack(ens), jnp.stack(aoks), jnp.stack(ovs),
+                    jnp.stack(succs))
 
         @jax.jit
-        def step(seen, frontier, fcount):
+        def step(seen_keys, frontier, fcount):
             fvalid = jnp.arange(FC) < fcount
-            en, aok, succ = expand(frontier)          # [A,FC] [A,FC] [A,FC,W]
+            en, aok, ov, succ = expand(frontier)
             valid = en & fvalid[None, :]
             assert_bad = (~aok) & fvalid[None, :]
+            overflow = ov & fvalid[None, :]
             dead = fvalid & ~jnp.any(en, axis=0)
             gen = jnp.sum(valid)
 
@@ -103,36 +179,43 @@ class TpuExplorer:
             cvalid = valid.reshape(C)
             prov = jnp.arange(C, dtype=jnp.int32)
             cand = jnp.where(cvalid[:, None], cand, SENTINEL)
+            ckeys = keys_of(cand, cvalid)
 
-            allr = jnp.concatenate([seen, cand])       # [SC+C, W]
+            # argsort on keys only, then gather payloads by permutation —
+            # a variadic sort carrying all W lanes compiles and runs far
+            # slower than sort(keys, index) + take
+            allk = jnp.concatenate([seen_keys, ckeys])       # [SC+C, K]
             flag = jnp.concatenate([
                 jnp.zeros(SC, jnp.int32), jnp.ones(C, jnp.int32)])
-            aprov = jnp.concatenate([
-                jnp.full(SC, -1, jnp.int32), prov])
-            ops = tuple(allr[:, i] for i in range(W)) + (flag, aprov)
-            sorted_ = lax.sort(ops, num_keys=W + 1, is_stable=True)
-            rows = jnp.stack(sorted_[:W], axis=1)
-            sflag, sprov = sorted_[W], sorted_[W + 1]
-            rvalid = rows[:, 0] != SENTINEL
+            idx0 = jnp.arange(SC + C, dtype=jnp.int32)
+            ops = tuple(allk[:, i] for i in range(K)) + (flag, idx0)
+            sorted_ = lax.sort(ops, num_keys=K + 1, is_stable=True)
+            skeys = jnp.stack(sorted_[:K], axis=1)
+            sflag = sorted_[K]
+            perm = sorted_[K + 1]
+            # candidate payload indices: position in cand (or -1 for seen)
+            cidx = perm - SC  # >=0 only for candidate entries
+            rvalid = skeys[:, 0] == 0
             neq_prev = jnp.concatenate([
                 jnp.array([True]),
-                jnp.any(rows[1:] != rows[:-1], axis=1)])
+                jnp.any(skeys[1:] != skeys[:-1], axis=1)])
             new = (sflag == 1) & rvalid & neq_prev
             new_count = jnp.sum(new)
 
-            # compact new rows (and their provenance) to the front, keeping
-            # lexicographic order (stable single-key sort)
-            ops2 = ((1 - new.astype(jnp.int32)),) + \
-                tuple(rows[:, i] for i in range(W)) + (sprov,)
+            # compact new entries to the front (stable, keeps key order)
+            ops2 = ((1 - new.astype(jnp.int32)), cidx)
             comp = lax.sort(ops2, num_keys=1, is_stable=True)
-            new_rows = jnp.stack(comp[1:W + 1], axis=1)[:C]
-            new_prov = comp[W + 1][:C]
+            new_cidx = comp[1][:C]
+            safe_cidx = jnp.clip(new_cidx, 0, C - 1)
+            new_rows = jnp.take(cand, safe_cidx, axis=0)
+            new_prov = jnp.take(prov, safe_cidx)
             nvalid = jnp.arange(C) < new_count
+            new_rows = jnp.where(nvalid[:, None], new_rows, SENTINEL)
 
-            # merged seen-set, compacted and still sorted
+            # merged seen keys, compacted and sorted
             keep = ((sflag == 0) & rvalid) | new
             ops3 = ((1 - keep.astype(jnp.int32)),) + \
-                tuple(rows[:, i] for i in range(W))
+                tuple(skeys[:, i] for i in range(K))
             comp3 = lax.sort(ops3, num_keys=1, is_stable=True)
             seen2 = jnp.stack(comp3[1:], axis=1)[:SC]
             seen_count2 = jnp.sum(keep)
@@ -150,19 +233,20 @@ class TpuExplorer:
                 inv_bad_idx = jnp.where(first, idx, inv_bad_idx)
                 inv_bad_which = jnp.where(first, wi, inv_bad_which)
                 inv_bad_any = inv_bad_any | any_
-            # constraints: violating states stay in seen but leave the search
+            # constraints: violating states stay in seen but leave search
             explore = nvalid
             for nm, f in con_fns:
                 explore = explore & jax.vmap(f)(new_rows)
             explore_count = jnp.sum(explore)
-            # push explored rows to the front for the next frontier
-            ops4 = ((1 - explore.astype(jnp.int32)),) + \
-                tuple(new_rows[:, i] for i in range(W)) + (new_prov,)
+            idx4 = jnp.arange(C, dtype=jnp.int32)
+            ops4 = ((1 - explore.astype(jnp.int32)), idx4)
             comp4 = lax.sort(ops4, num_keys=1, is_stable=True)
-            front_rows = jnp.stack(comp4[1:W + 1], axis=1)[:C]
-            front_prov = comp4[W + 1][:C]
+            perm4 = comp4[1]
+            front_rows = jnp.take(new_rows, perm4, axis=0)
+            front_prov = jnp.take(new_prov, perm4)
 
             return dict(gen=gen, dead=dead, assert_bad=assert_bad,
+                        overflow=jnp.any(overflow),
                         seen=seen2, seen_count=seen_count2,
                         new_rows=new_rows, new_prov=new_prov,
                         new_count=new_count,
@@ -179,19 +263,22 @@ class TpuExplorer:
         t0 = time.time()
         model = self.model
         layout = self.layout
-        W = self.W
+        W, K = self.W, self.K
         warnings = []
         if model.properties:
             names = ", ".join(n for n, _ in model.properties)
             warnings.append(
                 f"temporal properties NOT checked (unimplemented): {names}")
+        if self.fp_mode:
+            warnings.append(
+                "wide state (W={}): dedup on 128-bit fingerprints; "
+                "collision probability < n^2 * 2^-129".format(W))
 
-        # initial states (dedup on host; tiny)
         rows = {}
         for st in self.init_states:
             rows[layout.encode(st).tobytes()] = st
-        init_rows = np.stack([np.frombuffer(k, dtype=np.int32)
-                              for k in rows.keys()]) \
+        init_rows = np.stack([np.frombuffer(kk, dtype=np.int32)
+                              for kk in rows.keys()]) \
             if rows else np.zeros((0, W), np.int32)
         n_init = len(init_rows)
         generated = n_init
@@ -215,7 +302,6 @@ class TpuExplorer:
                    for nm, ex in model.constraints):
                 explored_init.append(i)
 
-        # capacities
         FC = _pow2_at_least(max(n_init, 1))
         SC = _pow2_at_least(4 * max(n_init, 1))
 
@@ -225,15 +311,19 @@ class TpuExplorer:
         frontier[:n_front] = front_init
         frontier = jnp.asarray(frontier)
         fcount = n_front
-        seen = np.full((SC, W), SENTINEL, np.int32)
+
+        init_keys = np.asarray(
+            self._keys_of(jnp.asarray(init_rows),
+                          jnp.ones(n_init, bool))) if n_init else \
+            np.zeros((0, K), np.int32)
+        seen = np.full((SC, K), SENTINEL, np.int32)
         if n_init:
-            order = np.lexsort(tuple(init_rows[:, i]
-                                     for i in reversed(range(W))))
-            seen[:n_init] = init_rows[order]
+            order = np.lexsort(tuple(init_keys[:, i]
+                                     for i in reversed(range(K))))
+            seen[:n_init] = init_keys[order]
         seen = jnp.asarray(seen)
         seen_count = n_init
 
-        # trace bookkeeping: per level (rows np, prov np, frontier_cap)
         trace_levels: List[Tuple[np.ndarray, Optional[np.ndarray], int]] = []
         trace_levels.append((np.asarray(init_rows), None, 0))
         frontier_maps: List[np.ndarray] = [np.asarray(explored_init,
@@ -242,28 +332,32 @@ class TpuExplorer:
         depth = 0
         last_progress = time.time()
         while fcount > 0:
-            # capacity management
             C = self.A * FC
             if seen_count + C > SC:
                 SC2 = _pow2_at_least(seen_count + C, SC)
-                pad = jnp.full((SC2 - SC, W), SENTINEL, jnp.int32)
+                pad = jnp.full((SC2 - SC, K), SENTINEL, jnp.int32)
                 seen = jnp.concatenate([seen, pad])
                 SC = SC2
             step = self._get_step(SC, FC)
             out = step(seen, frontier, fcount)
 
-            # violations first (device->host sync points)
+            if bool(out["overflow"]):
+                return self._mk_result(
+                    False, distinct, generated, depth, t0, warnings,
+                    Violation("error", "capacity overflow", [],
+                              "a container exceeded its lane capacity "
+                              "(raise --seq-cap/--grow-cap/--kv-cap); "
+                              "counts would no longer be exact"))
             if bool(jnp.any(out["assert_bad"])):
                 ab = np.asarray(out["assert_bad"])
                 a, f = np.unravel_index(np.argmax(ab), ab.shape)
                 trace = self._trace_to(trace_levels, frontier_maps,
                                        depth, int(f))
-                trace.append((None, self.actions[int(a)].label))
                 return self._mk_result(
                     False, distinct, generated, depth, t0, warnings,
                     Violation("assert", "Assert",
                               [x for x in trace if x[0] is not None],
-                              f"assertion in {self.actions[int(a)].label}"))
+                              f"assertion in {self.labels_flat[int(a)]}"))
             if model.check_deadlock and bool(jnp.any(out["dead"])):
                 f = int(jnp.argmax(out["dead"]))
                 trace = self._trace_to(trace_levels, frontier_maps,
@@ -295,9 +389,6 @@ class TpuExplorer:
 
             front_count = int(out["front_count"])
             if self.store_trace:
-                # map frontier positions back to new_rows positions: the
-                # frontier is the explore-compacted permutation of new rows;
-                # recover by matching provenance
                 fp = np.asarray(out["front_prov"][:max(front_count, 1)])
                 npv = np.asarray(out["new_prov"][:max(new_count, 1)])
                 pos = {int(p): i for i, p in enumerate(npv[:new_count])}
@@ -311,7 +402,6 @@ class TpuExplorer:
                 return self._mk_result(True, distinct, generated, depth, t0,
                                        warnings, None, truncated=True)
 
-            # next frontier
             if front_count > FC:
                 FC = _pow2_at_least(front_count, FC)
             nf = jnp.full((FC, W), SENTINEL, jnp.int32)
@@ -344,8 +434,6 @@ class TpuExplorer:
 
     def _trace_to(self, trace_levels, frontier_maps, level: int, idx: int,
                   from_new: bool = False) -> List[Tuple[Dict, str]]:
-        """Reconstruct the path to frontier index idx at `level` (or to
-        new-row index idx when from_new)."""
         if not self.store_trace:
             return []
         out = []
@@ -362,7 +450,7 @@ class TpuExplorer:
                 break
             p = int(prov[cur])
             a, f = p // par_FC, p % par_FC
-            out.append((st, self.actions[a].label))
+            out.append((st, self.labels_flat[a]))
             lvl -= 1
             cur = int(frontier_maps[lvl][f]) if lvl < len(frontier_maps) \
                 else f

@@ -1,0 +1,20 @@
+------------------------------- MODULE MCraft -------------------------------
+\* Model-checking shim for the reference raft spec
+\* (/root/reference/examples/raft.tla), following the corpus MC-module idiom
+\* (MCPaxos.tla etc., SURVEY.md §5 "config system"). raft ships no .cfg;
+\* BASELINE.json pins the benchmark model: Server={s1,s2,s3}, bounded log.
+\* Terms and log lengths are bounded by a CONSTRAINT exactly as TLC users do
+\* for raft (the spec's state space is otherwise infinite via Timeout).
+EXTENDS raft
+
+CONSTANTS MaxTerm, MaxLogLen
+
+StateConstraint ==
+    /\ \A i \in Server : currentTerm[i] <= MaxTerm
+    /\ \A i \in Server : Len(log[i]) <= MaxLogLen
+
+\* The safety properties raft.tla:500-507 tracks
+NoMoreThanOneLeader == ~MoreThanOneLeader
+
+NoLogDecrease == committedLogDecrease = FALSE
+=============================================================================
