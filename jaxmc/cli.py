@@ -87,9 +87,16 @@ def cmd_check(args) -> int:
     log = (lambda s: None) if args.quiet else print
     if args.backend == "interp":
         ex = Explorer(model, log=log, max_states=args.max_states,
-                      progress_every=args.progress_every)
+                      progress_every=args.progress_every,
+                      checkpoint_path=args.checkpoint,
+                      checkpoint_every=args.checkpoint_every,
+                      resume_from=args.resume)
         res = ex.run()
     else:
+        if args.checkpoint or args.resume:
+            print("error: --checkpoint/--resume are interp-backend only "
+                  "for now", file=sys.stderr)
+            return 2
         try:
             from .tpu.bfs import TpuExplorer
         except ImportError as e:
@@ -165,6 +172,12 @@ def main(argv=None) -> int:
                    help="jax backend: max message-table domain size")
     c.add_argument("--no-trace", action="store_true",
                    help="jax backend: skip trace bookkeeping (benchmarks)")
+    c.add_argument("--checkpoint", default=None,
+                   help="write periodic checkpoints to this file "
+                        "(TLC's states/ equivalent)")
+    c.add_argument("--checkpoint-every", type=float, default=600.0)
+    c.add_argument("--resume", default=None,
+                   help="resume an interp-backend run from a checkpoint")
     c.set_defaults(fn=cmd_check)
 
     i = sub.add_parser("info", help="parse a spec and print a summary")

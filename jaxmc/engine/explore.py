@@ -53,12 +53,18 @@ class Explorer:
     def __init__(self, model: Model, log: Callable[[str], None] = None,
                  max_states: Optional[int] = None,
                  progress_every: float = 30.0,
-                 trace_parents: bool = True):
+                 trace_parents: bool = True,
+                 checkpoint_path: Optional[str] = None,
+                 checkpoint_every: float = 600.0,
+                 resume_from: Optional[str] = None):
         self.model = model
         self.log = log or (lambda s: None)
         self.max_states = max_states
         self.progress_every = progress_every
         self.trace_parents = trace_parents
+        self.checkpoint_path = checkpoint_path
+        self.checkpoint_every = checkpoint_every
+        self.resume_from = resume_from
         self.prints: List[Any] = []
 
     def _ctx(self, state=None, primes=None):
@@ -111,6 +117,22 @@ class Explorer:
         depth_of: List[int] = []
         diameter = 0
         last_progress = time.time()
+        last_checkpoint = time.time()
+
+        def write_checkpoint():
+            # TLC-style periodic checkpoint (testout1:10; SURVEY.md §5):
+            # the full search state, resumable with --resume
+            import pickle
+            import os as _os
+            tmp = self.checkpoint_path + ".tmp"
+            with open(tmp, "wb") as fh:
+                pickle.dump(dict(module=model.module.name, vars=list(vars),
+                                 states=states, parents=parents,
+                                 labels=labels, depth_of=depth_of,
+                                 queue=list(queue), generated=generated,
+                                 diameter=diameter, prints=self.prints), fh)
+            _os.replace(tmp, self.checkpoint_path)
+            self.log(f"Checkpointing run to {self.checkpoint_path}")
 
         def add_state(st, parent, label, depth):
             nonlocal generated
@@ -126,11 +148,18 @@ class Explorer:
             depth_of.append(depth)
             return sid, True
 
+        from .refinement import build_refinement_checkers
+        refiners, live_only = build_refinement_checkers(model)
         warnings = []
-        if model.properties:
-            names = ", ".join(n for n, _ in model.properties)
+        if live_only:
             warnings.append(
-                f"temporal properties NOT checked (unimplemented): {names}")
+                "liveness properties NOT checked (unimplemented): "
+                + ", ".join(live_only))
+        for rc in refiners:
+            if rc.liveness_skipped:
+                warnings.append(
+                    f"property {rc.name}: refinement checked stepwise; its "
+                    f"fairness conjuncts are NOT checked")
 
         def result(ok, violation=None, truncated=False):
             return CheckResult(ok=ok, distinct=len(states),
@@ -139,9 +168,40 @@ class Explorer:
                                prints=self.prints, truncated=truncated,
                                warnings=warnings)
 
+        # ---- resume from a checkpoint ----
+        if self.resume_from:
+            import pickle
+            try:
+                with open(self.resume_from, "rb") as fh:
+                    ck = pickle.load(fh)
+                if not isinstance(ck, dict) or "states" not in ck:
+                    raise ValueError("not a jaxmc checkpoint")
+            except (pickle.UnpicklingError, ValueError, EOFError) as ex:
+                raise EvalError(
+                    f"cannot resume: {self.resume_from} is not a valid "
+                    f"jaxmc checkpoint ({ex})")
+            if ck.get("module") != model.module.name or \
+                    ck.get("vars") != list(vars):
+                raise EvalError(
+                    f"cannot resume: checkpoint is for module "
+                    f"{ck.get('module')!r} with variables "
+                    f"{ck.get('vars')}, not {model.module.name!r}")
+            self.prints.extend(ck.get("prints", []))
+            states.extend(ck["states"])
+            parents.extend(ck["parents"])
+            labels.extend(ck["labels"])
+            depth_of.extend(ck["depth_of"])
+            queue.extend(ck["queue"])
+            generated = ck["generated"]
+            diameter = ck["diameter"]
+            for i, st in enumerate(states):
+                seen[_state_key(st, vars)] = i
+            self.log(f"Resumed from {self.resume_from}: {len(states)} "
+                     f"distinct states, {len(queue)} on queue.")
+
         # ---- initial states ----
         try:
-            inits = enumerate_init(model.init, base_ctx, vars)
+            inits = [] if self.resume_from else                 enumerate_init(model.init, base_ctx, vars)
         except TLCAssertFailure as ex:
             return result(False, Violation("assert", "Init", [], str(ex.out)))
         init_count = 0
@@ -156,10 +216,19 @@ class Explorer:
                 return result(False, Violation(
                     "invariant", bad,
                     self._trace_to(sid, parents, states, labels)))
+            for rc in refiners:
+                if not rc.check_init(st):
+                    return result(False, Violation(
+                        "property", rc.name,
+                        self._trace_to(sid, parents, states, labels),
+                        f"initial state violates {rc.name}'s initial "
+                        f"predicate"))
             if self._satisfies_constraints(st):
                 queue.append(sid)
-        self.log(f"Finished computing initial states: {init_count} distinct "
-                 f"state{'s' if init_count != 1 else ''} generated.")
+        if not self.resume_from:
+            self.log(f"Finished computing initial states: {init_count} "
+                     f"distinct state{'s' if init_count != 1 else ''} "
+                     f"generated.")
 
         # ---- BFS ----
         while queue:
@@ -178,6 +247,18 @@ class Explorer:
                         continue
                     nid, new = add_state(succ, sid, label_str(label),
                                          depth + 1)
+                    for rc in refiners:
+                        if not rc.check_edge(st, succ):
+                            trace = self._trace_to(sid, parents, states,
+                                                   labels)
+                            trace.append((succ, label_str(label)))
+                            msg = (f"step is not a [{rc.name}-Next]_v "
+                                   f"step of the refined specification")
+                            if rc.last_error:
+                                msg += (f"; while evaluating the property: "
+                                        f"{rc.last_error}")
+                            return result(False, Violation(
+                                "property", rc.name, trace, msg))
                     if not new:
                         continue
                     bad = self._check_state_preds(succ)
@@ -189,6 +270,8 @@ class Explorer:
                         queue.append(nid)
                     if self.max_states and len(states) >= self.max_states:
                         self.log("-- state limit reached, search truncated")
+                        if self.checkpoint_path:
+                            write_checkpoint()
                         return result(True, truncated=True)
             except TLCAssertFailure as ex:
                 trace = self._trace_to(sid, parents, states, labels)
@@ -204,6 +287,10 @@ class Explorer:
                 self.log(f"Progress({depth}): {generated} states generated, "
                          f"{len(states)} distinct states found, "
                          f"{len(queue)} states left on queue.")
+            if self.checkpoint_path and \
+                    now - last_checkpoint >= self.checkpoint_every:
+                last_checkpoint = now
+                write_checkpoint()
 
         self.log(f"Model checking completed. No error has been found.")
         self.log(f"{generated} states generated, {len(states)} distinct "
@@ -217,6 +304,10 @@ def format_trace(violation: Violation) -> str:
     lines = []
     if violation.kind == "invariant":
         lines.append(f"Error: Invariant {violation.name} is violated.")
+    elif violation.kind == "property":
+        lines.append(f"Error: Property {violation.name} is violated"
+                     + (f" ({violation.message})." if violation.message
+                        else "."))
     elif violation.kind == "assert":
         lines.append(f"Error: Assertion failed: {violation.message}")
     elif violation.kind == "deadlock":

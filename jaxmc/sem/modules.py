@@ -216,10 +216,11 @@ def _split_spec(expr: A.Node, defs: Dict[str, Any]):
     from ..front.subst import contains_box
     init = None
     nxt = None
+    sub = None
     fair = []
 
     def walk(e):
-        nonlocal init, nxt
+        nonlocal init, nxt, sub
         if isinstance(e, A.OpApp) and e.name == "/\\":
             walk(e.args[0])
             walk(e.args[1])
@@ -230,6 +231,7 @@ def _split_spec(expr: A.Node, defs: Dict[str, Any]):
                 raise EvalError("specification has two [][Next]_vars "
                                 "conjuncts")
             nxt = e.args[0].action
+            sub = e.args[0].sub
             return
         if isinstance(e, (A.Fair, A.Quant)):
             fair.append(e)
@@ -249,7 +251,7 @@ def _split_spec(expr: A.Node, defs: Dict[str, Any]):
     if init is None or nxt is None:
         raise EvalError("could not extract Init and [][Next]_vars from "
                         "specification formula")
-    return init, nxt, fair
+    return init, nxt, sub, fair
 
 
 def bind_model_defs(module: LoadedModule, cfg: ModelConfig) -> Dict[str, Any]:
@@ -292,7 +294,7 @@ def bind_model(module: LoadedModule, cfg: ModelConfig) -> Model:
 
     if cfg.specification:
         spec_body = named(cfg.specification)
-        init, nxt, _fair = _split_spec(spec_body, defs)
+        init, nxt, _sub, _fair = _split_spec(spec_body, defs)
     else:
         if not cfg.init or not cfg.next:
             raise EvalError("cfg must give SPECIFICATION or INIT+NEXT")

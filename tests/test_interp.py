@@ -235,3 +235,72 @@ end algorithm *)
         r = run_spec(p, ModelConfig(specification="Spec"))
         os.unlink(p)
         assert r.ok
+
+
+class TestRefinement:
+    def test_paxos_voting_refinement_checked(self):
+        # MCPaxos.cfg PROPERTY VotingSpecBar == V!Spec — the Paxos -> Voting
+        # refinement (SURVEY.md §3.4) holds stepwise on every edge
+        d = os.path.join(REFERENCE, "examples/Paxos")
+        cfg = parse_cfg(open(os.path.join(d, "MCPaxos.cfg")).read())
+        r = run_spec(os.path.join(d, "MCPaxos.tla"), cfg)
+        assert r.ok
+        assert not any("VotingSpecBar" in w for w in r.warnings)
+
+    def test_hourclock2_equivalence_checked(self):
+        d = os.path.join(REFERENCE, "examples/SpecifyingSystems/HourClock")
+        cfg = parse_cfg(open(os.path.join(d, "HourClock2.cfg")).read())
+        r = run_spec(os.path.join(d, "HourClock2.tla"), cfg)
+        assert r.ok and not r.warnings
+
+    def test_non_refinement_detected(self):
+        import tempfile
+        src = """---- MODULE badhc ----
+EXTENDS Naturals
+VARIABLE hr
+HCini == hr \\in 1..12
+HCnxt == hr' = IF hr >= 11 THEN 1 ELSE hr + 2
+HC == HCini /\\ [][HCnxt]_hr
+Jump == hr' = IF hr = 12 THEN 1 ELSE hr + 1
+JumpSpec == HCini /\\ [][Jump]_hr
+====
+"""
+        with tempfile.NamedTemporaryFile("w", suffix=".tla",
+                                         delete=False) as f:
+            f.write(src)
+            p = f.name
+        cfg = ModelConfig(specification="HC", properties=["JumpSpec"],
+                          check_deadlock=False)
+        r = run_spec(p, cfg)
+        os.unlink(p)
+        assert not r.ok
+        assert r.violation.kind == "property"
+        assert r.violation.name == "JumpSpec"
+
+    def test_liveness_only_property_warned(self):
+        d = os.path.join(REFERENCE, "examples/SpecifyingSystems/TLC")
+        cfg = parse_cfg(open(os.path.join(d, "MCAlternatingBit.cfg")).read())
+        r = run_spec(os.path.join(d, "MCAlternatingBit.tla"), cfg)
+        assert r.ok
+        assert any("SentLeadsToRcvd" in w for w in r.warnings)
+        assert any("ABCSpec" in w and "stepwise" in w for w in r.warnings)
+
+
+class TestCheckpoint:
+    def test_checkpoint_resume_roundtrip(self):
+        # truncated run writes a checkpoint; resuming completes with the
+        # exact full-run counts (TLC's states/ dir contract, SURVEY.md §5)
+        import tempfile
+        spec = os.path.join(REFERENCE, "pcal_intro.tla")
+        cfg = parse_cfg(open(os.path.join(REFERENCE, "pcal_intro.cfg")).read())
+        ckpt = tempfile.mktemp(suffix=".ckpt")
+        m1 = Loader([]).load_path(spec)
+        r1 = Explorer(bind_model(m1, cfg), max_states=1500,
+                      checkpoint_path=ckpt, checkpoint_every=0.0).run()
+        assert r1.truncated and os.path.exists(ckpt)
+        m2 = Loader([]).load_path(spec)
+        r2 = Explorer(bind_model(m2, cfg), resume_from=ckpt).run()
+        os.unlink(ckpt)
+        assert r2.ok
+        assert r2.distinct == 3800
+        assert r2.generated == 5850
