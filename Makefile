@@ -23,4 +23,9 @@ test:
 bench:
 	$(PY) bench.py
 
-.PHONY: all check check-corpus test bench
+# build the native host fingerprint store (also built on demand at import)
+native:
+	mkdir -p native/build
+	g++ -O2 -shared -fPIC -std=c++17 native/fps_store.cc -o native/build/libjaxmc_fps.so
+
+.PHONY: all check check-corpus test bench native

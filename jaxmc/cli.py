@@ -110,6 +110,7 @@ def cmd_check(args) -> int:
             res = TpuExplorer(model, log=log, bounds=bounds,
                               store_trace=not args.no_trace,
                               progress_every=args.progress_every,
+                              host_seen=args.host_seen,
                               max_states=args.max_states).run()
         except CompileError as e:
             print(f"error: this spec is outside the jax backend's "
@@ -172,6 +173,10 @@ def main(argv=None) -> int:
                    help="jax backend: max message-table domain size")
     c.add_argument("--no-trace", action="store_true",
                    help="jax backend: skip trace bookkeeping (benchmarks)")
+    c.add_argument("--host-seen", action="store_true",
+                   help="jax backend: keep the seen-set in the native C++ "
+                        "fingerprint store (state spaces beyond device "
+                        "memory; usually faster)")
     c.add_argument("--checkpoint", default=None,
                    help="write periodic checkpoints to this file "
                         "(TLC's states/ equivalent)")

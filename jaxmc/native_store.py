@@ -1,0 +1,99 @@
+r"""ctypes binding for the native host fingerprint store (native/fps_store.cc).
+
+Builds the shared library on first use with g++ (pybind11 is not in the
+image; the C ABI + ctypes keeps the binding dependency-free). Falls back
+cleanly when no toolchain exists: callers must check is_available().
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SRC = os.path.join(_REPO, "native", "fps_store.cc")
+_SO = os.path.join(_REPO, "native", "build", "libjaxmc_fps.so")
+_lock = threading.Lock()
+_lib = None
+_build_err: Optional[str] = None
+
+
+def _load():
+    global _lib, _build_err
+    with _lock:
+        if _lib is not None or _build_err is not None:
+            return _lib
+        try:
+            if not os.path.exists(_SO) or \
+                    os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+                os.makedirs(os.path.dirname(_SO), exist_ok=True)
+                subprocess.run(
+                    ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                     _SRC, "-o", _SO],
+                    check=True, capture_output=True, text=True)
+            lib = ctypes.CDLL(_SO)
+            lib.jaxmc_fps_create.restype = ctypes.c_void_p
+            lib.jaxmc_fps_destroy.argtypes = [ctypes.c_void_p]
+            lib.jaxmc_fps_count.argtypes = [ctypes.c_void_p]
+            lib.jaxmc_fps_count.restype = ctypes.c_uint64
+            lib.jaxmc_fps_insert.argtypes = [
+                ctypes.c_void_p,
+                np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS"),
+                ctypes.c_uint64,
+                np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),
+            ]
+            lib.jaxmc_fps_insert.restype = ctypes.c_uint64
+            _lib = lib
+        except subprocess.CalledProcessError as ex:
+            _build_err = f"{ex}; stderr: {ex.stderr}"
+        except OSError as ex:
+            _build_err = str(ex)
+        return _lib
+
+
+def is_available() -> bool:
+    return _load() is not None
+
+
+def build_error() -> Optional[str]:
+    _load()
+    return _build_err
+
+
+class FingerprintStore:
+    """Sorted 128-bit fingerprint set in native memory."""
+
+    def __init__(self):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(f"native store unavailable: {_build_err}")
+        self._lib = lib
+        self._h = lib.jaxmc_fps_create()
+
+    def __del__(self):
+        if getattr(self, "_h", None):
+            self._lib.jaxmc_fps_destroy(self._h)
+            self._h = None
+
+    def __len__(self) -> int:
+        return int(self._lib.jaxmc_fps_count(self._h))
+
+    def insert(self, fps: np.ndarray) -> np.ndarray:
+        """fps: [N, 4] int32 fingerprints (as produced by
+        tpu.bfs.fingerprint128). Returns a bool mask of the rows that were
+        new (first in-batch occurrence of a previously-unseen fingerprint);
+        those rows are now members."""
+        fps = np.ascontiguousarray(fps, dtype=np.int32)
+        u = fps.view(np.uint32).astype(np.uint64)
+        hi = np.ascontiguousarray((u[:, 0] << np.uint64(32)) | u[:, 1])
+        lo = np.ascontiguousarray((u[:, 2] << np.uint64(32)) | u[:, 3])
+        out = np.zeros(len(fps), dtype=np.uint8)
+        self._lib.jaxmc_fps_insert(self._h, hi, lo,
+                                   np.uint64(len(fps)), out)
+        return out.astype(bool)

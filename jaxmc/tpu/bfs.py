@@ -75,13 +75,15 @@ class TpuExplorer:
                  max_states: Optional[int] = None, store_trace: bool = True,
                  progress_every: float = 30.0,
                  bounds: Optional[Bounds] = None,
-                 sample_cfg: Tuple[int, int, int] = (800, 40, 60)):
+                 sample_cfg: Tuple[int, int, int] = (800, 40, 60),
+                 host_seen: bool = False):
         self.model = model
         self.log = log or (lambda s: None)
         self.max_states = max_states
         self.store_trace = store_trace
         self.progress_every = progress_every
         self.bounds = bounds or Bounds()
+        self.host_seen = host_seen
 
         base_ctx = model.ctx()
         self.init_states = enumerate_init(model.init, base_ctx, model.vars)
@@ -117,6 +119,45 @@ class TpuExplorer:
         # output or state lanes, either could legitimately equal SENTINEL
         self.K = (4 if self.fp_mode else self.W) + 1
         self._step_cache: Dict[Tuple[int, int], Callable] = {}
+        self._hstep_cache: Dict[int, Callable] = {}
+        if host_seen:
+            from .. import native_store
+            if not native_store.is_available():
+                raise CompileError(f"host_seen requires the native store: "
+                                   f"{native_store.build_error()}")
+            if not self.fp_mode:
+                # narrow layouts also hash fine; host store is fp-based
+                self.fp_mode = True
+                self.K = 4 + 1
+
+    def _expand_fn(self):
+        """The (state x action) expansion closure shared by both step
+        builders; slotted kernels vmap over a traced slot index."""
+        acts = self.compiled
+
+        def expand(frontier):
+            ens, aoks, ovs, succs = [], [], [], []
+            for ca in acts:
+                if ca.n_slots:
+                    slots = jnp.arange(ca.n_slots, dtype=jnp.int32)
+                    en, aok, ov, succ = jax.vmap(
+                        jax.vmap(ca.fn, in_axes=(0, None)),
+                        in_axes=(None, 0))(frontier, slots)
+                    for si in range(ca.n_slots):
+                        ens.append(en[si])
+                        aoks.append(aok[si])
+                        ovs.append(ov[si])
+                        succs.append(succ[si])
+                else:
+                    en, aok, ov, succ = jax.vmap(ca.fn)(frontier)
+                    ens.append(en)
+                    aoks.append(aok)
+                    ovs.append(ov)
+                    succs.append(succ)
+            return (jnp.stack(ens), jnp.stack(aoks), jnp.stack(ovs),
+                    jnp.stack(succs))
+
+        return expand
 
     def _keys_of(self, rows, valid):
         """Dedup key lanes: [validity, hash-or-state lanes]. Invalid rows
@@ -135,34 +176,10 @@ class TpuExplorer:
         if key in self._step_cache:
             return self._step_cache[key]
         A, W, K = self.A, self.W, self.K
-        acts = self.compiled
         inv_fns = self.inv_fns
         con_fns = self.constraint_fns
         keys_of = self._keys_of
-
-        def expand(frontier):
-            ens, aoks, ovs, succs = [], [], [], []
-            for ca in acts:
-                if ca.n_slots:
-                    # [S, F] grids: vmap over slots then frontier rows
-                    slots = jnp.arange(ca.n_slots, dtype=jnp.int32)
-                    en, aok, ov, succ = jax.vmap(
-                        jax.vmap(ca.fn, in_axes=(0, None)),
-                        in_axes=(None, 0))(frontier, slots)
-                    # shapes [S, F, ...] -> per-slot rows
-                    for si in range(ca.n_slots):
-                        ens.append(en[si])
-                        aoks.append(aok[si])
-                        ovs.append(ov[si])
-                        succs.append(succ[si])
-                else:
-                    en, aok, ov, succ = jax.vmap(ca.fn)(frontier)
-                    ens.append(en)
-                    aoks.append(aok)
-                    ovs.append(ov)
-                    succs.append(succ)
-            return (jnp.stack(ens), jnp.stack(aoks), jnp.stack(ovs),
-                    jnp.stack(succs))
+        expand = self._expand_fn()
 
         @jax.jit
         def step(seen_keys, frontier, fcount):
@@ -258,8 +275,202 @@ class TpuExplorer:
         self._step_cache[key] = step
         return step
 
+    def _get_hstep(self, FC: int) -> Callable:
+        """Expand-only step for host_seen mode: the seen-set lives in the
+        native C++ fingerprint store (native/fps_store.cc) — the spill
+        layer of SURVEY.md §7.5 — so the device does expansion, hashing,
+        and predicate checks while membership runs on the host."""
+        if FC in self._hstep_cache:
+            return self._hstep_cache[FC]
+        A, W = self.A, self.W
+        inv_fns = self.inv_fns
+        con_fns = self.constraint_fns
+        keys_of = self._keys_of
+        expand = self._expand_fn()
+
+        @jax.jit
+        def hstep(frontier, fcount):
+            fvalid = jnp.arange(FC) < fcount
+            en, aok, ov, succ = expand(frontier)
+            valid = en & fvalid[None, :]
+            assert_bad = (~aok) & fvalid[None, :]
+            overflow = ov & fvalid[None, :]
+            dead = fvalid & ~jnp.any(en, axis=0)
+            gen = jnp.sum(valid)
+            C = A * FC
+            cand = succ.reshape(C, W)
+            cvalid = valid.reshape(C)
+            cand = jnp.where(cvalid[:, None], cand, SENTINEL)
+            keys = keys_of(cand, cvalid)
+            inv_ok = jnp.ones(C, bool)
+            for nm, f in inv_fns:
+                inv_ok = inv_ok & jax.vmap(f)(cand)
+            explore = jnp.ones(C, bool)
+            for nm, f in con_fns:
+                explore = explore & jax.vmap(f)(cand)
+            return dict(cand=cand, cvalid=cvalid, keys=keys, gen=gen,
+                        dead=dead, assert_bad=assert_bad,
+                        overflow=jnp.any(overflow), inv_ok=inv_ok,
+                        explore=explore)
+
+        self._hstep_cache[FC] = hstep
+        return hstep
+
+    def _run_host_seen(self) -> CheckResult:
+        from .. import native_store
+        t0 = time.time()
+        model = self.model
+        layout = self.layout
+        W = self.W
+        warnings = ["seen-set resident in the native host fingerprint "
+                    "store (host_seen); dedup on 128-bit fingerprints"]
+        if model.properties:
+            warnings.append(
+                "temporal properties NOT checked on the jax backend: "
+                + ", ".join(n for n, _ in model.properties))
+
+        rows = {}
+        for st in self.init_states:
+            rows[layout.encode(st).tobytes()] = st
+        init_rows = np.stack([np.frombuffer(kk, dtype=np.int32)
+                              for kk in rows.keys()]) \
+            if rows else np.zeros((0, W), np.int32)
+        n_init = len(init_rows)
+        generated = n_init
+        distinct = n_init
+        self.log(f"Finished computing initial states: {n_init} distinct "
+                 f"state{'s' if n_init != 1 else ''} generated.")
+
+        from ..sem.eval import eval_expr, _bool
+        explored_init = []
+        for i, row in enumerate(init_rows):
+            st = layout.decode(row)
+            ctx = model.ctx(state=st)
+            for nm, ex in model.invariants:
+                if not _bool(eval_expr(ex, ctx), f"invariant {nm}"):
+                    return self._mk_result(
+                        False, distinct, generated, 0, t0, warnings,
+                        Violation("invariant", nm,
+                                  [(st, "Initial predicate")]))
+            if all(_bool(eval_expr(ex, ctx), f"constraint {nm}")
+                   for nm, ex in model.constraints):
+                explored_init.append(i)
+
+        store = native_store.FingerprintStore()
+        init_keys = np.asarray(self._keys_of(
+            jnp.asarray(init_rows), jnp.ones(n_init, bool))) if n_init \
+            else np.zeros((0, self.K), np.int32)
+        store.insert(init_keys[:, 1:])  # drop the validity lane
+
+        FC = _pow2_at_least(max(len(explored_init), 1))
+        frontier = np.full((FC, W), SENTINEL, np.int32)
+        fr0 = init_rows[explored_init]
+        frontier[:len(fr0)] = fr0
+        frontier = jnp.asarray(frontier)
+        fcount = len(fr0)
+
+        trace_levels = [(np.asarray(init_rows), None, 0)]
+        frontier_maps = [np.asarray(explored_init, dtype=np.int64)]
+        depth = 0
+        last_progress = time.time()
+        while fcount > 0:
+            hstep = self._get_hstep(FC)
+            out = hstep(frontier, fcount)
+            if bool(out["overflow"]):
+                return self._mk_result(
+                    False, distinct, generated, depth, t0, warnings,
+                    Violation("error", "capacity overflow", [],
+                              "a container exceeded its lane capacity "
+                              "(raise --seq-cap/--grow-cap/--kv-cap)"))
+            if bool(jnp.any(out["assert_bad"])):
+                ab = np.asarray(out["assert_bad"])
+                a, f = np.unravel_index(np.argmax(ab), ab.shape)
+                trace = self._trace_to(trace_levels, frontier_maps, depth,
+                                       int(f))
+                return self._mk_result(
+                    False, distinct, generated, depth, t0, warnings,
+                    Violation("assert", "Assert",
+                              [x for x in trace if x[0] is not None],
+                              f"assertion in {self.labels_flat[int(a)]}"))
+            if model.check_deadlock and bool(jnp.any(out["dead"])):
+                f = int(jnp.argmax(out["dead"]))
+                trace = self._trace_to(trace_levels, frontier_maps, depth,
+                                       f)
+                return self._mk_result(
+                    False, distinct, generated, depth, t0, warnings,
+                    Violation("deadlock", "deadlock", trace))
+
+            generated += int(out["gen"])
+            cvalid = np.asarray(out["cvalid"])
+            keys = np.asarray(out["keys"])
+            inv_ok = np.asarray(out["inv_ok"])
+            explore = np.asarray(out["explore"])
+            valid_idx = np.nonzero(cvalid)[0]
+            new_mask = store.insert(keys[valid_idx][:, 1:])
+            new_idx = valid_idx[new_mask]
+            distinct += len(new_idx)
+
+            new_rows_dev = jnp.take(out["cand"], jnp.asarray(
+                new_idx, dtype=np.int32), axis=0) if len(new_idx) else None
+
+            if len(new_idx) and not inv_ok[new_idx].all():
+                badpos = int(np.nonzero(~inv_ok[new_idx])[0][0])
+                st = layout.decode(np.asarray(new_rows_dev[badpos]))
+                ctx = model.ctx(state=st)
+                nm = next((n for n, ex in model.invariants
+                           if not _bool(eval_expr(ex, ctx), n)),
+                          model.invariants[0][0] if model.invariants
+                          else "invariant")
+                if self.store_trace:
+                    rows_h = np.asarray(new_rows_dev)
+                    prov_h = new_idx.astype(np.int64)
+                    trace_levels.append((rows_h, prov_h, FC))
+                    trace = self._trace_to(trace_levels, frontier_maps,
+                                           depth + 1, badpos, from_new=True)
+                else:
+                    trace = [(st, "?")]
+                return self._mk_result(
+                    False, distinct, generated, depth + 1, t0, warnings,
+                    Violation("invariant", nm, trace))
+
+            explore_idx = new_idx[explore[new_idx]]
+            if self.store_trace:
+                rows_h = np.asarray(new_rows_dev) if len(new_idx) else \
+                    np.zeros((0, W), np.int32)
+                trace_levels.append((rows_h, new_idx.astype(np.int64), FC))
+                pos = {int(p): i for i, p in enumerate(new_idx)}
+                frontier_maps.append(np.asarray(
+                    [pos[int(p)] for p in explore_idx], dtype=np.int64))
+            depth += 1
+            if self.max_states and distinct >= self.max_states:
+                self.log("-- state limit reached, search truncated")
+                return self._mk_result(True, distinct, generated, depth,
+                                       t0, warnings, None, truncated=True)
+            fcount = len(explore_idx)
+            if fcount > FC:
+                FC = _pow2_at_least(fcount, FC)
+            nf = jnp.full((FC, W), SENTINEL, jnp.int32)
+            if fcount:
+                nf = nf.at[:fcount].set(
+                    jnp.take(out["cand"], jnp.asarray(explore_idx),
+                             axis=0))
+            frontier = nf
+            now = time.time()
+            if now - last_progress >= self.progress_every:
+                last_progress = now
+                self.log(f"Progress({depth}): {generated} generated, "
+                         f"{distinct} distinct, {fcount} on queue.")
+
+        self.log("Model checking completed. No error has been found.")
+        self.log(f"{generated} states generated, {distinct} distinct "
+                 f"states found, 0 states left on queue.")
+        return self._mk_result(True, distinct, generated, depth - 1, t0,
+                               warnings)
+
     # ---- host-side search loop ----
     def run(self) -> CheckResult:
+        if self.host_seen:
+            return self._run_host_seen()
         t0 = time.time()
         model = self.model
         layout = self.layout

@@ -1,0 +1,88 @@
+// jaxmc native host fingerprint store.
+//
+// The device BFS keeps its seen-set in accelerator memory; for state spaces
+// beyond HBM (SURVEY.md §7.5 "spill seen-set shards to host when full") the
+// 128-bit state fingerprints spill into this sorted store. Batch insert
+// with membership marking: O(batch log batch + |store|) per level via
+// sort + two-pointer merge, the classic external dedup used by explicit
+// state model checkers.
+//
+// C ABI only (bound via ctypes; pybind11 is not available in this image).
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+struct Fp {
+    uint64_t hi, lo;
+    bool operator<(const Fp& o) const {
+        return hi != o.hi ? hi < o.hi : lo < o.lo;
+    }
+    bool operator==(const Fp& o) const { return hi == o.hi && lo == o.lo; }
+};
+
+struct Store {
+    std::vector<Fp> base;  // sorted, unique
+};
+
+}  // namespace
+
+extern "C" {
+
+void* jaxmc_fps_create() { return new Store(); }
+
+void jaxmc_fps_destroy(void* p) { delete static_cast<Store*>(p); }
+
+uint64_t jaxmc_fps_count(void* p) {
+    return static_cast<Store*>(p)->base.size();
+}
+
+// Marks out_new[i] = 1 for fingerprints absent from the store (first
+// occurrence within the batch wins), inserts them, returns the number of
+// new fingerprints. hi/lo/out_new are length n.
+uint64_t jaxmc_fps_insert(void* p, const uint64_t* hi, const uint64_t* lo,
+                          uint64_t n, uint8_t* out_new) {
+    Store& st = *static_cast<Store*>(p);
+    std::memset(out_new, 0, n);
+
+    std::vector<uint64_t> order(n);
+    for (uint64_t i = 0; i < n; ++i) order[i] = i;
+    std::sort(order.begin(), order.end(), [&](uint64_t a, uint64_t b) {
+        Fp fa{hi[a], lo[a]}, fb{hi[b], lo[b]};
+        if (fa == fb) return a < b;  // stable: first occurrence first
+        return fa < fb;
+    });
+
+    std::vector<Fp> merged;
+    merged.reserve(st.base.size() + n);
+    uint64_t new_count = 0;
+    size_t bi = 0;
+    bool have_prev = false;
+    Fp prev{0, 0};
+    for (uint64_t k = 0; k < n; ++k) {
+        uint64_t idx = order[k];
+        Fp f{hi[idx], lo[idx]};
+        if (have_prev && f == prev) continue;  // duplicate within batch
+        // advance base, copying smaller entries
+        while (bi < st.base.size() && st.base[bi] < f)
+            merged.push_back(st.base[bi++]);
+        if (bi < st.base.size() && st.base[bi] == f) {
+            prev = f;
+            have_prev = true;
+            continue;  // already known
+        }
+        out_new[idx] = 1;
+        ++new_count;
+        merged.push_back(f);
+        prev = f;
+        have_prev = true;
+    }
+    while (bi < st.base.size()) merged.push_back(st.base[bi++]);
+    st.base.swap(merged);
+    return new_count;
+}
+
+}  // extern "C"

@@ -135,3 +135,27 @@ class TestGraftEntry:
         sys.path.insert(0, os.path.dirname(SPECS))
         import __graft_entry__ as g
         g.dryrun_multichip(8)
+
+
+class TestHostSeen:
+    def test_host_seen_exact_counts(self):
+        from jaxmc import native_store
+        if not native_store.is_available():
+            import pytest
+            pytest.skip("no native toolchain")
+        from jaxmc.tpu.bfs import TpuExplorer
+        cfg = parse_cfg(open(os.path.join(REFERENCE, "pcal_intro.cfg")).read())
+        model = load(os.path.join(REFERENCE, "pcal_intro.tla"), cfg)
+        r = TpuExplorer(model, host_seen=True).run()
+        assert r.ok and r.distinct == 3800 and r.generated == 5850
+
+    def test_host_seen_finds_violation_with_trace(self):
+        from jaxmc import native_store
+        if not native_store.is_available():
+            import pytest
+            pytest.skip("no native toolchain")
+        from jaxmc.tpu.bfs import TpuExplorer
+        model = load(os.path.join(SPECS, "pcal_intro_buggy.tla"))
+        r = TpuExplorer(model, host_seen=True).run()
+        assert not r.ok and r.violation.kind == "assert"
+        assert len(r.violation.trace) >= 2

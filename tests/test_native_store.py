@@ -1,0 +1,38 @@
+"""Native fingerprint store: build, membership semantics, scale."""
+
+import numpy as np
+import pytest
+
+from jaxmc import native_store
+
+
+pytestmark = pytest.mark.skipif(not native_store.is_available(),
+                                reason=f"no toolchain: "
+                                       f"{native_store.build_error()}")
+
+
+def test_insert_semantics():
+    st = native_store.FingerprintStore()
+    a = np.arange(20, dtype=np.int32).reshape(5, 4)
+    new = st.insert(a)
+    assert new.all() and len(st) == 5
+    # re-insert: nothing new
+    assert not st.insert(a).any()
+    # batch with in-batch duplicates and one known row
+    b = np.vstack([a[2], a[2] + 100, a[2] + 100, a[0]]).astype(np.int32)
+    new = st.insert(b)
+    assert list(new) == [False, True, False, False]
+    assert len(st) == 6
+
+
+def test_scale_and_order_independence():
+    st = native_store.FingerprintStore()
+    rng = np.random.RandomState(0)
+    fps = rng.randint(-2**31, 2**31 - 1, size=(50000, 4)).astype(np.int32)
+    n1 = st.insert(fps).sum()
+    st2 = native_store.FingerprintStore()
+    perm = rng.permutation(len(fps))
+    n2 = st2.insert(fps[perm]).sum()
+    assert n1 == n2 == len(st) == len(st2)
+    # everything known now, in any order
+    assert not st.insert(fps[perm][:1000]).any()
