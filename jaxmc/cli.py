@@ -1,6 +1,7 @@
 r"""jaxmc command-line interface.
 
     python -m jaxmc check SPEC.tla [--cfg F.cfg] [--backend interp|jax]
+    python -m jaxmc simulate SPEC.tla [--walks N --depth N --coverage]
     python -m jaxmc info SPEC.tla
 
 Mirrors the reference's `make test` contract (tlc *tla, Makefile:6-7): check a
@@ -134,6 +135,25 @@ def cmd_check(args) -> int:
     return 1
 
 
+def cmd_simulate(args) -> int:
+    """TLC's -simulate mode: random behaviors, invariants checked along
+    the way (engine/simulate.py)."""
+    from .engine.simulate import random_walks
+    from .engine.explore import format_trace
+
+    model = _load_model(args.spec, args.cfg, no_deadlock=True,
+                        includes=args.include)
+    v = random_walks(model, n_walks=args.walks, depth=args.depth,
+                     seed=args.seed, check_invariants=True,
+                     coverage_guided=args.coverage)
+    if v is None:
+        print(f"{args.walks} behaviors of length <= {args.depth} simulated. "
+              f"No error has been found.")
+        return 0
+    print(format_trace(v))
+    return 1
+
+
 def cmd_info(args) -> int:
     from .sem.modules import Loader
     from .front import tla_ast as A
@@ -184,6 +204,19 @@ def main(argv=None) -> int:
     c.add_argument("--resume", default=None,
                    help="resume an interp-backend run from a checkpoint")
     c.set_defaults(fn=cmd_check)
+
+    m = sub.add_parser("simulate",
+                       help="check invariants along random behaviors "
+                            "(TLC -simulate)")
+    m.add_argument("spec")
+    m.add_argument("--cfg", default=None)
+    m.add_argument("-I", "--include", action="append", default=[])
+    m.add_argument("--walks", type=int, default=100)
+    m.add_argument("--depth", type=int, default=100)
+    m.add_argument("--seed", type=int, default=0)
+    m.add_argument("--coverage", action="store_true",
+                   help="bias toward rarely-taken action families")
+    m.set_defaults(fn=cmd_simulate)
 
     i = sub.add_parser("info", help="parse a spec and print a summary")
     i.add_argument("spec")

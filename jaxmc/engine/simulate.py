@@ -14,6 +14,7 @@ from collections import deque
 from typing import Dict, List, Optional, Tuple
 
 from ..sem.eval import TLCAssertFailure, eval_expr, _bool
+from ..sem.values import EvalError
 from ..sem.enumerate import enumerate_init, enumerate_next, label_str
 from ..sem.modules import Model
 from .explore import CheckResult, Violation
@@ -34,7 +35,14 @@ def random_walks(model: Model, n_walks: int, depth: int,
     ctx = model.ctx()
     inits = enumerate_init(model.init, ctx, model.vars)
     if not inits:
-        return None
+        raise EvalError("no initial states satisfy the initial predicate")
+    if check_invariants:
+        for st in inits:
+            ictx = model.ctx(state=st)
+            for nm, expr in model.invariants:
+                if not _bool(eval_expr(expr, ictx), f"invariant {nm}"):
+                    return Violation("invariant", nm,
+                                     [(st, "Initial predicate")])
     label_counts: Dict[str, int] = {}
     for w in range(n_walks):
         st = rng.choice(inits)

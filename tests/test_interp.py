@@ -304,3 +304,24 @@ class TestCheckpoint:
         assert r2.ok
         assert r2.distinct == 3800
         assert r2.generated == 5850
+
+
+class TestSimulate:
+    def test_simulate_finds_assert(self):
+        from jaxmc.engine.simulate import random_walks
+        model = bind_model(
+            Loader([]).load_path(os.path.join(SPECS, "pcal_intro_buggy.tla")),
+            ModelConfig(specification="Spec"))
+        v = random_walks(model, n_walks=80, depth=12, seed=3,
+                         check_invariants=True)
+        assert v is not None and v.kind == "assert"
+
+    def test_simulate_clean_spec_passes(self):
+        from jaxmc.engine.simulate import random_walks
+        cfg = parse_cfg(open(os.path.join(REFERENCE, "pcal_intro.cfg")).read())
+        model = bind_model(
+            Loader([]).load_path(os.path.join(REFERENCE, "pcal_intro.tla")),
+            cfg)
+        v = random_walks(model, n_walks=25, depth=15, seed=1,
+                         check_invariants=True)
+        assert v is None
