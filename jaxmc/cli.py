@@ -141,11 +141,12 @@ def cmd_simulate(args) -> int:
     from .engine.simulate import random_walks
     from .engine.explore import format_trace
 
-    model = _load_model(args.spec, args.cfg, no_deadlock=True,
+    model = _load_model(args.spec, args.cfg, no_deadlock=args.no_deadlock,
                         includes=args.include)
     v = random_walks(model, n_walks=args.walks, depth=args.depth,
                      seed=args.seed, check_invariants=True,
-                     coverage_guided=args.coverage)
+                     coverage_guided=args.coverage,
+                     check_deadlock=model.check_deadlock)
     if v is None:
         print(f"{args.walks} behaviors of length <= {args.depth} simulated. "
               f"No error has been found.")
@@ -216,6 +217,8 @@ def main(argv=None) -> int:
     m.add_argument("--seed", type=int, default=0)
     m.add_argument("--coverage", action="store_true",
                    help="bias toward rarely-taken action families")
+    m.add_argument("--no-deadlock", action="store_true",
+                   help="disable deadlock reporting")
     m.set_defaults(fn=cmd_simulate)
 
     i = sub.add_parser("info", help="parse a spec and print a summary")

@@ -14,7 +14,7 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-from ..sem.values import EvalError, fmt, sort_key
+from ..sem.values import EvalError, Fcn, ModelValue, fmt, sort_key
 from ..sem.eval import TLCAssertFailure, eval_expr, _bool
 from ..sem.enumerate import enumerate_init, enumerate_next, label_str
 from ..sem.modules import Model
@@ -47,6 +47,72 @@ class CheckResult:
 
 def _state_key(state: Dict[str, Any], vars: Tuple[str, ...]):
     return tuple(state[v] for v in vars)
+
+
+def _apply_perm(v, pd):
+    """Apply a model-value permutation (dict ModelValue->ModelValue) to a
+    value tree."""
+    if isinstance(v, ModelValue):
+        return pd.get(v, v)
+    if isinstance(v, frozenset):
+        return frozenset(_apply_perm(x, pd) for x in v)
+    if isinstance(v, Fcn):
+        return Fcn({_apply_perm(k, pd): _apply_perm(x, pd)
+                    for k, x in v.d.items()})
+    from ..sem.values import FcnSetV
+    if isinstance(v, FcnSetV):
+        return frozenset(_apply_perm(x, pd) for x in v.materialize())
+    return v
+
+
+def make_canonicalizer(model: Model):
+    """cfg SYMMETRY (TLC.tla:13-14 Permutations): canonicalize each state
+    to the least representative under the declared permutation set, the
+    standard symmetry reduction (SURVEY.md §5). Returns None when no
+    symmetry is declared or every permutation is the identity."""
+    if model.symmetry is None:
+        return None
+    from ..sem.values import enumerate_set
+    base = []
+    val = eval_expr(model.symmetry, model.ctx())
+    for p in enumerate_set(val):
+        if isinstance(p, Fcn):
+            base.append(dict(p.d))
+    # close under composition: TLC canonicalizes over the GROUP the
+    # declared set generates — Permutations(A) \cup Permutations(B) alone
+    # misses the combined A+B permutations and under-reduces
+    def key_of(pd):
+        return tuple(sorted((id(k), id(v)) for k, v in pd.items()))
+
+    group = {key_of(pd): pd for pd in base}
+    frontier = list(base)
+    while frontier:
+        nxt = []
+        for a in frontier:
+            for b in base:
+                comp = {k: b.get(a.get(k, k), a.get(k, k))
+                        for k in set(a) | set(b)}
+                kk = key_of(comp)
+                if kk not in group:
+                    group[kk] = comp
+                    nxt.append(comp)
+        frontier = nxt
+    perms = [pd for pd in group.values()
+             if any(k is not v for k, v in pd.items())]
+    if not perms:
+        return None
+
+    def canon(state: Dict[str, Any]) -> Dict[str, Any]:
+        best = state
+        best_key = sort_key(tuple(state[v] for v in model.vars))
+        for pd in perms:
+            cand = {v: _apply_perm(state[v], pd) for v in model.vars}
+            k = sort_key(tuple(cand[v] for v in model.vars))
+            if k < best_key:
+                best, best_key = cand, k
+        return best
+
+    return canon
 
 
 class Explorer:
@@ -134,9 +200,13 @@ class Explorer:
             _os.replace(tmp, self.checkpoint_path)
             self.log(f"Checkpointing run to {self.checkpoint_path}")
 
+        canon = make_canonicalizer(model)
+
         def add_state(st, parent, label, depth):
+            # dedup on the symmetry-canonical key but store the state as
+            # reached, so counterexample traces remain genuine behaviors
             nonlocal generated
-            key = _state_key(st, vars)
+            key = _state_key(canon(st) if canon is not None else st, vars)
             sid = seen.get(key)
             if sid is not None:
                 return sid, False

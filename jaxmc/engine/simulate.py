@@ -23,7 +23,8 @@ from .explore import CheckResult, Violation
 def random_walks(model: Model, n_walks: int, depth: int,
                  seed: int = 0, collect=None,
                  check_invariants: bool = False,
-                 coverage_guided: bool = False):
+                 coverage_guided: bool = False,
+                 check_deadlock: bool = False):
     """Run random behaviors; returns a Violation or None. collect(state)
     is called on every visited state when given.
 
@@ -55,6 +56,8 @@ def random_walks(model: Model, n_walks: int, depth: int,
             except TLCAssertFailure as ex:
                 return Violation("assert", "Assert", trace, str(ex.out))
             if not succs:
+                if check_deadlock:
+                    return Violation("deadlock", "deadlock", trace)
                 break
             if coverage_guided:
                 # weight by action-family novelty (label name sans args)

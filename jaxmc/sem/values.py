@@ -250,6 +250,9 @@ def sort_key(v):
         return (6, v.kind)
     if t is FcnSetV:
         return sort_key(v.materialize())
+    if t is tuple:
+        # engine-level state tuples (symmetry canonicalization)
+        return tuple(sort_key(x) for x in v)
     raise EvalError(f"unorderable value {v!r}")
 
 

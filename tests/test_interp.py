@@ -7,7 +7,7 @@ import os
 import pytest
 
 from jaxmc.front.parser import parse_expr_text
-from jaxmc.front.cfg import ModelConfig, parse_cfg
+from jaxmc.front.cfg import CfgModelValue, ModelConfig, parse_cfg
 from jaxmc.sem.values import Fcn, ModelValue, fmt, mk_seq
 from jaxmc.sem.eval import Ctx, eval_expr
 from jaxmc.sem.modules import Loader, bind_model, BASE_IDENTS
@@ -325,3 +325,44 @@ class TestSimulate:
         v = random_walks(model, n_walks=25, depth=15, seed=1,
                          check_invariants=True)
         assert v is None
+
+
+class TestSymmetry:
+    SYMM = """---- MODULE symm ----
+EXTENDS Naturals, FiniteSets, TLC
+CONSTANTS Proc
+VARIABLE x
+Init == x = [p \\in Proc |-> 0]
+Bump(p) == x[p] < 2 /\\ x' = [x EXCEPT ![p] = x[p] + 1]
+Next == \\E p \\in Proc : Bump(p)
+Sym == Permutations(Proc)
+====
+"""
+
+    def _model(self, symmetry):
+        import tempfile
+        with tempfile.NamedTemporaryFile("w", suffix=".tla",
+                                         delete=False) as f:
+            f.write(self.SYMM)
+            p = f.name
+        cfg = ModelConfig(init="Init", next="Next", check_deadlock=False,
+                          symmetry=symmetry)
+        cfg.constants["Proc"] = frozenset(
+            {CfgModelValue("p1"), CfgModelValue("p2")})
+        m = bind_model(Loader([]).load_path(p), cfg)
+        os.unlink(p)
+        return m
+
+    def test_symmetry_collapses_orbit(self):
+        # 3x3 counter grid collapses to unordered pairs under p1<->p2
+        r_full = Explorer(self._model(None)).run()
+        r_sym = Explorer(self._model("Sym")).run()
+        assert r_full.distinct == 9
+        assert r_sym.distinct == 6
+
+    def test_mcpaxos_symmetry_cfg_unchanged(self):
+        # MCPaxos's SYMMETRY over singleton sets is the identity
+        d = os.path.join(REFERENCE, "examples/Paxos")
+        cfg = parse_cfg(open(os.path.join(d, "MCPaxos.cfg")).read())
+        r = run_spec(os.path.join(d, "MCPaxos.tla"), cfg)
+        assert r.ok and r.distinct == 25
