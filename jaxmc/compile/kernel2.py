@@ -27,6 +27,8 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+import numpy as np
+
 from ..front import tla_ast as A
 from ..sem.values import (EvalError, Fcn, InfiniteSet, ModelValue, fmt,
                           in_set, mk_seq, sort_key, tla_eq)
@@ -45,18 +47,62 @@ def _is_traced(v) -> bool:
 
 
 class SymV:
+    """A symbolic value: vspec shape + its encoded lanes as ONE i32 array
+    (np.ndarray when fully static, a traced jax array otherwise). Array
+    lanes keep the jaxpr O(expression size): slices, splices, equality and
+    selects are single XLA ops over the whole block instead of per-lane
+    scalar graphs."""
     __slots__ = ("spec", "lanes")
 
-    def __init__(self, spec: VS, lanes: List):
+    def __init__(self, spec: VS, lanes):
         self.spec = spec
+        if isinstance(lanes, (list, tuple)):
+            lanes = _cat([_as_lane_arr(x) for x in lanes]) if lanes \
+                else np.zeros(0, np.int32)
         self.lanes = lanes
 
     @property
     def static(self) -> bool:
-        return all(not _is_traced(x) for x in self.lanes)
+        return isinstance(self.lanes, np.ndarray)
 
     def __repr__(self):
         return f"SymV({self.spec.kind}, {len(self.lanes)} lanes)"
+
+
+def _as_lane_arr(x):
+    """One lane (scalar int/bool, traced scalar, or an array) as a 1-D
+    lane array segment."""
+    if isinstance(x, np.ndarray):
+        return x.astype(np.int32) if x.ndim else x.reshape(1).astype(np.int32)
+    if _is_traced(x):
+        if x.ndim == 0:
+            x = jnp.reshape(x, (1,))
+        if x.dtype == jnp.bool_:
+            x = x.astype(jnp.int32)
+        return x.astype(jnp.int32)
+    if isinstance(x, bool):
+        return np.asarray([1 if x else 0], np.int32)
+    return np.asarray([x], np.int32)
+
+
+def _cat(segs):
+    """Concatenate lane segments; stays numpy when all static."""
+    segs = [sg for sg in segs if len(sg)]
+    if not segs:
+        return np.zeros(0, np.int32)
+    if len(segs) == 1:
+        return segs[0]
+    if all(isinstance(sg, np.ndarray) for sg in segs):
+        return np.concatenate(segs)
+    return jnp.concatenate([jnp.asarray(sg) for sg in segs])
+
+
+def _zeros(n):
+    return np.zeros(n, np.int32)
+
+
+def _fill(n, v):
+    return np.full(n, v, np.int32)
 
 
 def _ite(c, a, b):
@@ -139,7 +185,7 @@ def static_to_symv(v, kc: KernelCtx, spec: Optional[VS] = None) -> SymV:
         spec = apply_bounds(spec, kc.bounds)
     out: List[int] = []
     vs_encode(v, spec, kc.uni, out)
-    return SymV(spec, out)
+    return SymV(spec, np.asarray(out, np.int32))
 
 
 def coerce(v: SymV, spec: VS, fr: Frame) -> SymV:
@@ -149,98 +195,102 @@ def coerce(v: SymV, spec: VS, fr: Frame) -> SymV:
     return SymV(spec, _coerce_lanes(v.spec, spec, v.lanes, fr))
 
 
-def _coerce_lanes(src: VS, dst: VS, lanes: List, fr: Frame) -> List:
+def _coerce_lanes(src: VS, dst: VS, lanes, fr: Frame):
+    """Re-encode a lane array from spec src to spec dst (array in/out)."""
     if src == dst:
-        return list(lanes)
+        return lanes
     uni = fr.kc.uni
     sk, dk = src.kind, dst.kind
     if sk == "justempty":
         if dk == "seq":
-            return [0] + [0] * (dst.cap * dst.elem.width)
+            return _zeros(dst.width)
         if dk == "kvtable":
-            return [0] + [SENTINEL_LANE] * (dst.cap * (dst.elem.width +
-                                                       dst.val.width))
+            return _cat([_zeros(1), _fill(dst.width - 1, SENTINEL_LANE)])
         if dk == "pfcn":
-            out = []
-            for e in dst.elems:
-                out.append(0)
-                out.extend([0] * e.width)
-            return out
+            return _zeros(dst.width)
         if dk == "fcn":
-            # an always-empty value flowing into a non-empty-domain layout
-            # slot: impossible at runtime unless the layout under-sampled —
-            # flag overflow so an enabled action taking this path aborts
-            # the run instead of producing wrong lanes
-            fr.flag_overflow(True)
-            return [0] * dst.width
+            fr.flag_overflow(len(dst.dom) > 0)
+            return _zeros(dst.width)
         raise CompileError(f"cannot coerce empty function to {dk}")
+    if dk == "justempty":
+        # storing into an only-ever-empty layout slot: exact as long as the
+        # value is empty at runtime; otherwise the overflow flag aborts
+        if sk in ("seq", "kvtable"):
+            fr.flag_overflow(_lnot(_eq_lane(lanes[0], 0)))
+            return _zeros(0)
+        if sk == "pfcn":
+            off = 0
+            for kk, es in zip(src.dom, src.elems):
+                fr.flag_overflow(_eq_lane(lanes[off], 1))
+                off += 1 + es.width
+            return _zeros(0)
+        if sk == "fcn":
+            fr.flag_overflow(len(src.dom) > 0)
+            return _zeros(0)
     if sk == "emptyset" or (sk == "set" and not src.dom):
         if dk == "set":
-            return [0] * len(dst.dom)
+            return _zeros(len(dst.dom))
         if dk == "growset":
-            return [0] + [SENTINEL_LANE] * (dst.cap * dst.elem.width)
+            return _cat([_zeros(1), _fill(dst.width - 1, SENTINEL_LANE)])
         if dk == "iset":
-            return [0] * len(dst.dom)
+            return _zeros(len(dst.dom))
         raise CompileError(f"cannot coerce empty set to {dk}")
     if sk == dk == "seq":
         if dst.cap < src.cap:
             raise CompileError("sequence coercion would shrink capacity")
-        out = [lanes[0]]
+        segs = [lanes[0:1]]
         for i in range(src.cap):
-            out.extend(_coerce_lanes(src.elem, dst.elem,
-                                     lanes[1 + i * src.elem.width:
-                                           1 + (i + 1) * src.elem.width], fr))
-        out.extend([0] * ((dst.cap - src.cap) * dst.elem.width))
-        return out
+            segs.append(_coerce_lanes(
+                src.elem, dst.elem,
+                lanes[1 + i * src.elem.width:
+                      1 + (i + 1) * src.elem.width], fr))
+        segs.append(_zeros((dst.cap - src.cap) * dst.elem.width))
+        return _cat(segs)
     if sk == dk == "set":
-        if src.dom == dst.dom:
-            return list(lanes)
         pos = {m: i for i, m in enumerate(src.dom)}
-        out = []
-        for m in dst.dom:
-            out.append(lanes[pos[m]] if m in pos else 0)
-        extra = set(src.dom) - set(dst.dom)
-        if extra:
-            raise CompileError(f"set coercion drops members {extra}")
-        return out
-    if sk == dk == "iset":
+        if set(src.dom) - set(dst.dom):
+            raise CompileError("set coercion drops members")
+        segs = [lanes[pos[m]:pos[m] + 1] if m in pos else _zeros(1)
+                for m in dst.dom]
+        return _cat(segs)
+    if sk == dk == "iset" or (sk == "set" and dk == "iset"):
         pos = {m: i for i, m in enumerate(src.dom)}
-        out = []
-        for m in dst.dom:
-            out.append(lanes[pos[m]] if m in pos else 0)
         if set(src.dom) - set(dst.dom):
             raise CompileError("iset coercion drops members")
-        return out
+        segs = [lanes[pos[m]:pos[m] + 1] if m in pos else _zeros(1)
+                for m in dst.dom]
+        return _cat(segs)
     if sk == dk == "growset":
-        if dst.cap < src.cap or src.elem != dst.elem:
-            if src.elem != dst.elem:
-                raise CompileError("growset element coercion unsupported")
+        if src.elem != dst.elem:
+            raise CompileError("growset element coercion unsupported")
+        if dst.cap < src.cap:
             raise CompileError("growset coercion would shrink capacity")
-        out = [lanes[0]]
-        out.extend(lanes[1:])
-        out.extend([SENTINEL_LANE] * ((dst.cap - src.cap) * dst.elem.width))
-        return out
+        return _cat([lanes,
+                     _fill((dst.cap - src.cap) * dst.elem.width,
+                           SENTINEL_LANE)])
     if sk == dk == "kvtable":
         if src.elem != dst.elem or src.val != dst.val:
             raise CompileError("kvtable element coercion unsupported")
         if dst.cap < src.cap:
             raise CompileError("kvtable coercion would shrink capacity")
-        out = list(lanes)
-        out.extend([SENTINEL_LANE] *
-                   ((dst.cap - src.cap) * (dst.elem.width + dst.val.width)))
-        return out
+        pad = dst.elem.width + dst.val.width
+        return _cat([lanes, _fill((dst.cap - src.cap) * pad,
+                                  SENTINEL_LANE)])
     if sk == "fcn" and dk == "union":
         names = tuple(k for k in src.dom)
         for tag, (vnames, vfields) in enumerate(dst.variants):
             if vnames == names:
-                out = [tag]
+                segs = [np.asarray([tag], np.int32)]
                 off = 0
+                w = 1
                 for (kk, es), fs in zip(zip(src.dom, src.elems), vfields):
-                    out.extend(_coerce_lanes(es, fs,
-                                             lanes[off:off + es.width], fr))
+                    seg = _coerce_lanes(es, fs,
+                                        lanes[off:off + es.width], fr)
+                    segs.append(seg)
                     off += es.width
-                out.extend([0] * (dst.width - len(out)))
-                return out
+                    w += fs.width
+                segs.append(_zeros(dst.width - w))
+                return _cat(segs)
         raise CompileError(f"record {names} not a variant of the union")
     if sk == "fcn" and dk == "pfcn":
         srcmap = {}
@@ -248,32 +298,31 @@ def _coerce_lanes(src: VS, dst: VS, lanes: List, fr: Frame) -> List:
         for kk, es in zip(src.dom, src.elems):
             srcmap[kk] = (es, lanes[off:off + es.width])
             off += es.width
-        out = []
+        if set(srcmap) - set(dst.dom):
+            raise CompileError("pfcn coercion drops keys")
+        segs = []
         for kk, es in zip(dst.dom, dst.elems):
             if kk in srcmap:
                 ses, sl = srcmap[kk]
-                out.append(1)
-                out.extend(_coerce_lanes(ses, es, sl, fr))
+                segs.append(np.asarray([1], np.int32))
+                segs.append(_coerce_lanes(ses, es, sl, fr))
             else:
-                out.append(0)
-                out.extend([0] * es.width)
-        if set(srcmap) - set(dst.dom):
-            raise CompileError("pfcn coercion drops keys")
-        return out
+                segs.append(_zeros(1 + es.width))
+        return _cat(segs)
     if sk == "fcn" and dk == "seq":
         if not all(isinstance(k, int) for k in src.dom):
             raise CompileError("cannot coerce non-int function to sequence")
         n = len(src.dom)
-        out = [n]
-        off = 0
-        for kk, es in zip(src.dom, src.elems):
-            out.extend(_coerce_lanes(es, dst.elem,
-                                     lanes[off:off + es.width], fr))
-            off += es.width
         if n > dst.cap:
             raise CompileError("sequence literal exceeds capacity")
-        out.extend([0] * ((dst.cap - n) * dst.elem.width))
-        return out
+        segs = [np.asarray([n], np.int32)]
+        off = 0
+        for kk, es in zip(src.dom, src.elems):
+            segs.append(_coerce_lanes(es, dst.elem,
+                                      lanes[off:off + es.width], fr))
+            off += es.width
+        segs.append(_zeros((dst.cap - n) * dst.elem.width))
+        return _cat(segs)
     if sk == "fcn" and dk == "kvtable":
         rows = []
         off = 0
@@ -287,23 +336,23 @@ def _coerce_lanes(src: VS, dst: VS, lanes: List, fr: Frame) -> List:
         rows.sort(key=lambda r: r[0])
         if len(rows) > dst.cap:
             raise CompileError("table literal exceeds capacity")
-        out = [len(rows)]
+        segs = [np.asarray([len(rows)], np.int32)]
         for kb, vl in rows:
-            out.extend(kb)
-            out.extend(vl)
+            segs.append(np.asarray(kb, np.int32))
+            segs.append(vl)
         pad = dst.elem.width + dst.val.width
-        out.extend([SENTINEL_LANE] * ((dst.cap - len(rows)) * pad))
-        return out
+        segs.append(_fill((dst.cap - len(rows)) * pad, SENTINEL_LANE))
+        return _cat(segs)
     if sk == "fcn" and dk == "fcn":
         if tuple(src.dom) != tuple(dst.dom):
             raise CompileError("function domains differ in coercion")
-        out = []
+        segs = []
         off = 0
         for (kk, ses), des in zip(zip(src.dom, src.elems), dst.elems):
-            out.extend(_coerce_lanes(ses, des,
-                                     lanes[off:off + ses.width], fr))
+            segs.append(_coerce_lanes(ses, des,
+                                      lanes[off:off + ses.width], fr))
             off += ses.width
-        return out
+        return _cat(segs)
     if sk == "pfcn" and dk == "fcn":
         # sound when every dst key is present; absent keys flag overflow
         srcmap = {}
@@ -311,59 +360,32 @@ def _coerce_lanes(src: VS, dst: VS, lanes: List, fr: Frame) -> List:
         for kk, es in zip(src.dom, src.elems):
             srcmap[kk] = (lanes[off], es, lanes[off + 1:off + 1 + es.width])
             off += 1 + es.width
-        out = []
+        segs = []
         for kk, es in zip(dst.dom, dst.elems):
             if kk not in srcmap:
                 raise CompileError("pfcn->fcn coercion missing key")
             pres, ses, sl = srcmap[kk]
             fr.flag_overflow(_eq_lane(pres, 0))
-            out.extend(_coerce_lanes(ses, es, sl, fr))
-        return out
-    if dk == "justempty":
-        # storing into an only-ever-empty layout slot: exact as long as the
-        # value is empty at runtime; otherwise the overflow flag aborts the
-        # run with a clear error (deepen sampling / raise caps)
-        if sk == "seq":
-            fr.flag_overflow(_lnot(_eq_lane(lanes[0], 0)))
-            return []
-        if sk == "kvtable":
-            fr.flag_overflow(_lnot(_eq_lane(lanes[0], 0)))
-            return []
-        if sk == "pfcn":
-            off = 0
-            for kk, es in zip(src.dom, src.elems):
-                fr.flag_overflow(_eq_lane(lanes[off], 1))
-                off += 1 + es.width
-            return []
-        if sk == "fcn":
-            fr.flag_overflow(len(src.dom) > 0)
-            return []
+            segs.append(_coerce_lanes(ses, es, sl, fr))
+        return _cat(segs)
     if sk == "pfcn" and dk == "pfcn":
         srcmap = {}
         off = 0
         for kk, es in zip(src.dom, src.elems):
-            srcmap[kk] = (lanes[off], es, lanes[off + 1:off + 1 + es.width])
+            srcmap[kk] = (lanes[off:off + 1], es,
+                          lanes[off + 1:off + 1 + es.width])
             off += 1 + es.width
-        out = []
+        segs = []
         for kk, es in zip(dst.dom, dst.elems):
             if kk in srcmap:
                 pres, ses, sl = srcmap[kk]
-                out.append(pres)
-                out.extend(_coerce_lanes(ses, es, sl, fr))
+                segs.append(pres)
+                segs.append(_coerce_lanes(ses, es, sl, fr))
             else:
-                out.append(0)
-                out.extend([0] * es.width)
-        return out
+                segs.append(_zeros(1 + es.width))
+        return _cat(segs)
     if sk == "iset" and dk == "set":
         raise CompileError("cannot view integer set as enum set")
-    if sk == "set" and dk == "iset":
-        pos = {m: i for i, m in enumerate(src.dom)}
-        out = []
-        for m in dst.dom:
-            out.append(lanes[pos[m]] if m in pos else 0)
-        if set(src.dom) - set(dst.dom):
-            raise CompileError("iset coercion drops members")
-        return out
     raise CompileError(f"cannot coerce {sk} to {dk}")
 
 
@@ -378,24 +400,22 @@ def unify(a: SymV, b: SymV, fr: Frame) -> Tuple[SymV, SymV]:
 
 def sym_eq(a: SymV, b: SymV, fr: Frame):
     a, b = unify(a, b, fr)
-    acc = True
-    for x, y in zip(a.lanes, b.lanes):
-        acc = _land(acc, _eq_lane(x, y))
-    return acc
+    if a.static and b.static:
+        return bool(np.array_equal(a.lanes, b.lanes))
+    if len(a.lanes) == 0:
+        return True
+    return jnp.all(jnp.asarray(a.lanes) == jnp.asarray(b.lanes))
 
 
-def lanes_lex_lt(a: List, b: List):
-    """Lexicographic a < b over equal-length lane lists."""
-    assert len(a) == len(b)
-    lt = False
-    eq = True
-    for x, y in zip(a, b):
-        xlt = x < y if (not _is_traced(x) and not _is_traced(y)) \
-            else jnp.less(x, y)
-        xeq = _eq_lane(x, y)
-        lt = _lor(lt, _land(eq, xlt))
-        eq = _land(eq, xeq)
-    return lt
+def _rows_lex_lt(rows, x):
+    """Vectorized lexicographic rows[i] < x over a [n, w] matrix: decided
+    at each row's first differing lane. w == 0 rows compare equal."""
+    if rows.shape[1] == 0:
+        return jnp.zeros(rows.shape[0], bool)
+    neq = rows != x[None, :]
+    first = jnp.argmax(neq, axis=1)
+    srow = jnp.take_along_axis(rows, first[:, None], axis=1)[:, 0]
+    return jnp.where(jnp.any(neq, axis=1), srow < x[first], False)
 
 
 # ---------------------------------------------------------------------------
@@ -409,11 +429,11 @@ def as_bool(v, fr: Frame):
         if v.spec.kind != "bool":
             raise CompileError(f"expected boolean, got {v.spec.kind}")
         x = v.lanes[0]
-        if isinstance(x, int):
+        if v.static:
             return bool(x)
-        return x != 0 if x.dtype != jnp.bool_ else x
+        return x != 0
     if _is_traced(v):
-        return v
+        return v if v.dtype == jnp.bool_ else v != 0
     raise CompileError(f"expected boolean, got {v!r}")
 
 
@@ -421,18 +441,19 @@ def as_int_lane(v):
     if isinstance(v, SymV):
         if v.spec.kind != "int":
             raise CompileError(f"expected integer, got {v.spec.kind}")
-        return v.lanes[0]
+        x = v.lanes[0]
+        return int(x) if v.static else x
     if isinstance(v, bool):
         raise CompileError("boolean used as integer")
     if isinstance(v, int) or _is_traced(v):
         return v
+    if isinstance(v, np.integer):
+        return int(v)
     raise CompileError(f"expected integer, got {v!r}")
 
 
 def mk_bool(x) -> SymV:
-    if _is_traced(x) and x.dtype != jnp.bool_:
-        x = x != 0
-    return SymV(BOOL, [x if not isinstance(x, bool) else x])
+    return SymV(BOOL, [x])
 
 
 def mk_int(x) -> SymV:
@@ -450,13 +471,28 @@ def _lift(v, fr: Frame) -> SymV:
     return static_to_symv(v, fr.kc)
 
 
-def _seq_elem(v: SymV, i: int) -> List:
+def _seq_elem(v: SymV, i: int):
     ew = v.spec.elem.width
     return v.lanes[1 + i * ew: 1 + (i + 1) * ew]
 
 
-def _select_lanes(cond, a: List, b: List) -> List:
-    return [_ite(cond, x, y) for x, y in zip(a, b)]
+def _slots_matrix(lanes, off, cap, w):
+    """View lanes[off : off+cap*w] as a [cap, w] matrix (one reshape)."""
+    seg = lanes[off:off + cap * w]
+    if isinstance(seg, np.ndarray):
+        return seg.reshape(cap, w)
+    return jnp.reshape(seg, (cap, w))
+
+
+def _select_lanes(cond, a, b):
+    """Lane-block select: one XLA where over the whole segment."""
+    if isinstance(cond, bool):
+        return a if cond else b
+    a = a if not isinstance(a, (list, tuple)) else \
+        _cat([_as_lane_arr(x) for x in a])
+    b = b if not isinstance(b, (list, tuple)) else \
+        _cat([_as_lane_arr(x) for x in b])
+    return jnp.where(cond, a, b)
 
 
 def sym_apply(f, args: List, fr: Frame) -> Any:
@@ -521,31 +557,26 @@ def sym_apply(f, args: List, fr: Frame) -> Any:
             off += 1 + es.width
         return SymV(espec, acc)
     if sp.kind == "seq":
-        idx = as_int_lane(key if not isinstance(key, SymV) else key)
-        if isinstance(key, SymV):
-            idx = as_int_lane(key)
+        idx = as_int_lane(key)
         if isinstance(idx, int):
             if not 1 <= idx <= sp.cap:
                 raise CompileError(f"static sequence index {idx} out of "
                                    f"capacity {sp.cap}")
             return SymV(sp.elem, _seq_elem(f, idx - 1))
-        acc = _seq_elem(f, 0)
-        for i in range(1, sp.cap):
-            acc = _select_lanes(jnp.equal(idx, i + 1), _seq_elem(f, i), acc)
-        return SymV(sp.elem, acc)
+        elems = jnp.asarray(_slots_matrix(f.lanes, 1, sp.cap,
+                                          sp.elem.width))
+        safe = jnp.clip(idx - 1, 0, sp.cap - 1)
+        return SymV(sp.elem, elems[safe])
     if sp.kind == "kvtable":
-        # msgs[m]: match key lanes per slot
+        # msgs[m]: one vectorized key match + select
         kw, vw = sp.elem.width, sp.val.width
         kv = coerce(key if isinstance(key, SymV) else _lift(key, fr),
                     sp.elem, fr)
-        acc = [0] * vw
-        for s in range(sp.cap):
-            base = 1 + s * (kw + vw)
-            cond = True
-            for x, y in zip(kv.lanes, f.lanes[base:base + kw]):
-                cond = _land(cond, _eq_lane(x, y))
-            acc = _select_lanes(cond, f.lanes[base + kw:base + kw + vw], acc)
-        return SymV(sp.val, acc)
+        rows = jnp.asarray(_slots_matrix(f.lanes, 1, sp.cap, kw + vw))
+        match = jnp.all(rows[:, :kw] ==
+                        jnp.asarray(_as_seg(kv.lanes, kw))[None, :], axis=1)
+        sel = jnp.where(match[:, None], rows[:, kw:], 0)
+        return SymV(sp.val, jnp.sum(sel, axis=0).astype(jnp.int32))
     if sp.kind == "union":
         raise CompileError("cannot apply a record value")
     if sp.kind == "justempty":
@@ -651,16 +682,12 @@ def sym_in(x, s, fr: Frame):
         return acc
     if sp.kind == "growset":
         xe = coerce(_lift(x, fr), sp.elem, fr)
-        acc = False
         ew = sp.elem.width
-        for slot in range(sp.cap):
-            base = 1 + slot * ew
-            used = _lt_lane(slot, sv.lanes[0])
-            same = True
-            for a, b in zip(xe.lanes, sv.lanes[base:base + ew]):
-                same = _land(same, _eq_lane(a, b))
-            acc = _lor(acc, _land(used, same))
-        return acc
+        slots = _slots_matrix(sv.lanes, 1, sp.cap, ew)
+        used = jnp.arange(sp.cap) < sv.lanes[0]
+        hits = jnp.all(jnp.asarray(slots) == jnp.asarray(xe.lanes)[None, :],
+                       axis=1) & used
+        return jnp.any(hits)
     raise CompileError(f"membership in {sp.kind} not supported")
 
 
@@ -703,42 +730,34 @@ def set_elements(s, fr: Frame):
 
 
 def grow_insert(s: SymV, x: SymV, fr: Frame) -> SymV:
-    """s \\cup {x} on a growset — sorted insertion, canonical."""
+    """s \\cup {x} on a growset — sorted insertion, canonical, vectorized
+    over the slot matrix."""
     sp = s.spec
     xe = coerce(x, sp.elem, fr)
     ew = sp.elem.width
-    present = sym_in(xe, s, fr)
     cnt = s.lanes[0]
-    # position where x belongs: number of used elements lex-< x
-    pos = 0
-    slots = []
-    for slot in range(sp.cap):
-        base = 1 + slot * ew
-        slots.append(s.lanes[base:base + ew])
-    for slot in range(sp.cap):
-        used = _lt_lane(slot, cnt)
-        lt = lanes_lex_lt(slots[slot], xe.lanes)
-        inc = _land(used, lt)
-        pos = pos + (_ite(inc, 1, 0) if not isinstance(inc, bool)
-                     else (1 if inc else 0))
-    new_lanes = [None] * len(s.lanes)
-    newcnt = _ite(present, cnt, cnt + 1 if isinstance(cnt, int)
-                  else cnt + 1)
-    new_lanes[0] = newcnt
-    fr.flag_overflow(_land(_lnot(present), _ge_lane(cnt, sp.cap)))
-    for slot in range(sp.cap):
-        base = 1 + slot * ew
-        # if inserting at pos: slots < pos keep; slot == pos takes x;
-        # slots > pos shift from slot-1
-        is_before = _lt_lane(slot, pos)
-        is_at = _eq_lane(slot, pos)
-        keep = slots[slot]
-        shifted = slots[slot - 1] if slot > 0 else [0] * ew
-        ins = _select_lanes(is_before, keep,
-                            _select_lanes(is_at, xe.lanes, shifted))
-        out = _select_lanes(present, keep, ins)
-        new_lanes[base:base + ew] = out
-    return SymV(sp, new_lanes)
+    if ew == 0:
+        # zero-width elements (a growset of always-empty values) are all
+        # indistinguishable: the set is {} or a singleton
+        newcnt = jnp.maximum(jnp.asarray(cnt), 1)
+        return SymV(sp, jnp.reshape(newcnt, (1,)).astype(jnp.int32))
+    slots = jnp.asarray(_slots_matrix(s.lanes, 1, sp.cap, ew))
+    xl = jnp.asarray(xe.lanes)
+    used = jnp.arange(sp.cap) < cnt
+    present = jnp.any(jnp.all(slots == xl[None, :], axis=1) & used)
+    lt = _rows_lex_lt(slots, xl)
+    pos = jnp.sum(used & lt)
+    fr.flag_overflow(jnp.logical_and(jnp.logical_not(present),
+                                     _ge_lane(cnt, sp.cap)))
+    idx = jnp.arange(sp.cap)
+    prev = jnp.concatenate([jnp.zeros((1, ew), jnp.int32), slots[:-1]])
+    ins = jnp.where((idx < pos)[:, None], slots,
+                    jnp.where((idx == pos)[:, None], xl[None, :], prev))
+    out_slots = jnp.where(present, slots, ins)
+    newcnt = jnp.where(present, cnt, cnt + 1)
+    lanes = jnp.concatenate([jnp.reshape(newcnt, (1,)).astype(jnp.int32),
+                             out_slots.reshape(-1)])
+    return SymV(sp, lanes)
 
 
 def _ge_lane(a, b):
@@ -818,11 +837,8 @@ def interval_iset(lo, hi, fr: Frame) -> SymV:
     hi_l = as_int_lane(hi)
     cap = fr.kc.iset_cap
     uni_members = tuple(range(0, cap + 2))
-    lanes = []
-    for m in uni_members:
-        memb = _land(_ge_lane(m, lo_l), _ge_lane(hi_l, m))
-        lanes.append(_ite(memb, 1, 0) if not isinstance(memb, bool)
-                     else (1 if memb else 0))
+    ms = jnp.arange(0, cap + 2)
+    lanes = ((ms >= lo_l) & (ms <= hi_l)).astype(jnp.int32)
     # overflow if the interval reaches beyond the universe
     fr.flag_overflow(_land(_ge_lane(hi_l, cap + 2),
                            _ge_lane(hi_l, lo_l)))
@@ -846,23 +862,29 @@ def seq_append(v: SymV, x, fr: Frame) -> SymV:
         xe = _lift(x, fr)
         from .vspec import apply_bounds
         sp = apply_bounds(VS("seq", cap=1, elem=xe.spec), fr.kc.bounds)
-        v = SymV(sp, [0] + [0] * (sp.cap * sp.elem.width))
+        v = SymV(sp, _zeros(sp.width))
     sp = v.spec
     xe = coerce(_lift(x, fr), sp.elem, fr)
+    if v.static and xe.static:
+        # static fast path: fold on python values so constants stay static
+        from ..sem.values import mk_seq as _mk_seq
+        sv = _decode_static(v, fr)
+        xv = _decode_static(xe, fr)
+        return static_to_symv(_mk_seq(sv.as_list() + [xv]), fr.kc)
+    ew = sp.elem.width
     n = v.lanes[0]
     fr.flag_overflow(_ge_lane(n, sp.cap))
-    lanes = [n + 1 if isinstance(n, int) else n + 1]
-    for i in range(sp.cap):
-        at = _eq_lane(n, i)
-        lanes.extend(_select_lanes(at, xe.lanes, _seq_elem(v, i)))
+    elems = jnp.asarray(_slots_matrix(v.lanes, 1, sp.cap, ew))
+    at = (jnp.arange(sp.cap) == n)[:, None]
+    out = jnp.where(at, jnp.asarray(xe.lanes)[None, :], elems)
+    lanes = jnp.concatenate([
+        jnp.reshape(n + 1, (1,)).astype(jnp.int32), out.reshape(-1)])
     return SymV(sp, lanes)
 
 
 def seq_subseq(v: SymV, m, n, fr: Frame) -> SymV:
-    """SubSeq(v, m, n) with traced bounds; empty when m > n."""
+    """SubSeq(v, m, n) with traced bounds; empty when m > n. One gather."""
     if v.spec.kind == "justempty":
-        # SubSeq of an always-empty sequence: empty unless m <= n, which
-        # would be out of domain — flag it
         ml, nl = as_int_lane(m), as_int_lane(n)
         fr.flag_overflow(_ge_lane(nl, ml))
         return v
@@ -870,18 +892,14 @@ def seq_subseq(v: SymV, m, n, fr: Frame) -> SymV:
     ml = as_int_lane(m)
     nl = as_int_lane(n)
     ew = sp.elem.width
-    outlen_raw = nl - ml + 1
-    neg = _lt_lane(outlen_raw, 0)
-    outlen = _ite(neg, 0, outlen_raw)
-    lanes = [outlen]
-    for i in range(sp.cap):
-        # out[i] = v[m - 1 + i]  when i < outlen, else zeros
-        src = ml + i  # 1-based source index
-        elem = [0] * ew
-        for j in range(sp.cap):
-            elem = _select_lanes(_eq_lane(src, j + 1), _seq_elem(v, j), elem)
-        inrange = _lt_lane(i, outlen)
-        lanes.extend(_select_lanes(inrange, elem, [0] * ew))
+    outlen = jnp.maximum(nl - ml + 1, 0)
+    elems = jnp.asarray(_slots_matrix(v.lanes, 1, sp.cap, ew))
+    src = ml - 1 + jnp.arange(sp.cap)          # 0-based source indices
+    gathered = jnp.take(elems, jnp.clip(src, 0, sp.cap - 1), axis=0)
+    keep = (jnp.arange(sp.cap) < outlen)[:, None]
+    out = jnp.where(keep, gathered, 0)
+    lanes = jnp.concatenate([
+        jnp.reshape(outlen, (1,)).astype(jnp.int32), out.reshape(-1)])
     return SymV(sp, lanes)
 
 
@@ -899,18 +917,36 @@ def seq_concat(a: SymV, b: SymV, fr: Frame) -> SymV:
     na, nb = a.lanes[0], b.lanes[0]
     total = na + nb
     fr.flag_overflow(_ge_lane(total, sp.cap + 1))
-    lanes = [total]
-    for i in range(sp.cap):
-        from_a = _lt_lane(i, na)
-        bsrc = i - na  # 0-based into b
-        belem = [0] * ew
-        for j in range(sp.cap):
-            belem = _select_lanes(_eq_lane(bsrc, j), _seq_elem(b, j), belem)
-        lanes.extend(_select_lanes(from_a, _seq_elem(a, i), belem))
+    ea = jnp.asarray(_slots_matrix(a.lanes, 1, sp.cap, ew))
+    eb = jnp.asarray(_slots_matrix(b.lanes, 1, sp.cap, ew))
+    idx = jnp.arange(sp.cap)
+    bsrc = jnp.clip(idx - na, 0, sp.cap - 1)
+    from_b = jnp.take(eb, bsrc, axis=0)
+    out = jnp.where((idx < na)[:, None], ea, from_b)
+    out = jnp.where((idx < total)[:, None], out, 0)
+    lanes = jnp.concatenate([
+        jnp.reshape(total, (1,)).astype(jnp.int32), out.reshape(-1)])
     return SymV(sp, lanes)
 
 
 # ---- EXCEPT ----
+
+def _splice(lanes, off, width, new_seg):
+    """lanes with [off:off+width] replaced by new_seg (3 segments, O(1) ops)."""
+    return _cat([lanes[:off], _as_seg(new_seg, width), lanes[off + width:]])
+
+
+def _as_seg(x, width):
+    if isinstance(x, (list, tuple)):
+        return _cat([_as_lane_arr(i) for i in x])
+    if _is_traced(x) and x.ndim == 0:
+        return jnp.reshape(x, (1,))
+    if isinstance(x, np.ndarray) and x.ndim == 0:
+        return x.reshape(1)
+    if isinstance(x, (int, bool)):
+        return _as_lane_arr(x)
+    return x
+
 
 def sym_except(f: SymV, path, rhs_eval, fr: Frame) -> SymV:
     """[f EXCEPT !path = rhs]; rhs_eval(old: SymV) -> value."""
@@ -937,84 +973,89 @@ def sym_except(f: SymV, path, rhs_eval, fr: Frame) -> SymV:
                     old = SymV(es, f.lanes[off:off + es.width])
                     new = _apply_rest(old, path[1:], rhs_eval, fr)
                     new = coerce(_lift(new, fr), es, fr)
-                    lanes = list(f.lanes)
-                    lanes[off:off + es.width] = new.lanes
-                    return SymV(sp, lanes)
+                    return SymV(sp, _splice(f.lanes, off, es.width,
+                                            new.lanes))
                 off += es.width
             raise CompileError(f"EXCEPT key {key!r} outside domain")
-        # symbolic key over homogeneous fcn
-        lanes = list(f.lanes)
+        # symbolic key over (usually homogeneous) fcn: guarded per-key
+        # segments, concatenated once
+        segs = []
         off = 0
         for dk, es in zip(sp.dom, sp.elems):
-            cond = as_bool(sym_eq(keysym, static_to_symv(dk, fr.kc), fr), fr)
+            cond = as_bool(mk_bool(sym_eq(
+                keysym, static_to_symv(dk, fr.kc), fr)), fr)
             old = SymV(es, f.lanes[off:off + es.width])
-            new = coerce(_lift(_apply_rest(old, path[1:], rhs_eval, fr), fr),
-                         es, fr)
-            lanes[off:off + es.width] = _select_lanes(
-                cond, new.lanes, f.lanes[off:off + es.width])
+            new = coerce(_lift(_apply_rest(old, path[1:], rhs_eval, fr),
+                               fr), es, fr)
+            segs.append(_as_seg(_select_lanes(
+                cond, new.lanes, f.lanes[off:off + es.width]), es.width))
             off += es.width
-        return SymV(sp, lanes)
+        return SymV(sp, _cat(segs))
     if sp.kind == "seq":
         kv = arg[0] if kind == "idx" else arg
-        idx = as_int_lane(kv if not isinstance(kv, SymV) else kv)
-        if isinstance(kv, SymV):
-            idx = as_int_lane(kv)
+        idx = as_int_lane(kv)
         ew = sp.elem.width
-        lanes = list(f.lanes)
-        for i in range(sp.cap):
-            cond = _eq_lane(idx, i + 1)
-            old = SymV(sp.elem, _seq_elem(f, i))
-            new = coerce(_lift(_apply_rest(old, path[1:], rhs_eval, fr), fr),
-                         sp.elem, fr)
-            base = 1 + i * ew
-            lanes[base:base + ew] = _select_lanes(cond, new.lanes,
-                                                  f.lanes[base:base + ew])
+        # old element: one gather; new: one masked scatter over the matrix
+        elems = jnp.asarray(_slots_matrix(f.lanes, 1, sp.cap, ew))
+        safe = jnp.clip(idx - 1, 0, sp.cap - 1)
+        old = SymV(sp.elem, elems[safe])
+        new = coerce(_lift(_apply_rest(old, path[1:], rhs_eval, fr), fr),
+                     sp.elem, fr)
+        at = (jnp.arange(sp.cap) == (idx - 1))[:, None]
+        out = jnp.where(at, jnp.asarray(_as_seg(new.lanes, ew))[None, :],
+                        elems)
+        lanes = jnp.concatenate([jnp.reshape(f.lanes[0], (1,)).astype(
+            jnp.int32), out.reshape(-1)])
         return SymV(sp, lanes)
     if sp.kind == "kvtable":
         kv = arg[0] if kind == "idx" else arg
         kl = coerce(_lift(kv, fr), sp.elem, fr)
         kw, vw = sp.elem.width, sp.val.width
-        lanes = list(f.lanes)
-        for s in range(sp.cap):
-            base = 1 + s * (kw + vw)
-            cond = True
-            for x, y in zip(kl.lanes, f.lanes[base:base + kw]):
-                cond = _land(cond, _eq_lane(x, y))
-            old = SymV(sp.val, f.lanes[base + kw:base + kw + vw])
-            new = coerce(_lift(_apply_rest(old, path[1:], rhs_eval, fr), fr),
-                         sp.val, fr)
-            lanes[base + kw:base + kw + vw] = _select_lanes(
-                cond, new.lanes, f.lanes[base + kw:base + kw + vw])
+        rows = jnp.asarray(_slots_matrix(f.lanes, 1, sp.cap, kw + vw))
+        match = jnp.all(rows[:, :kw] == jnp.asarray(kl.lanes)[None, :],
+                        axis=1)
+        # old value: the matching row's value lanes (or zeros)
+        mpos = jnp.argmax(match)
+        old = SymV(sp.val, rows[mpos, kw:])
+        new = coerce(_lift(_apply_rest(old, path[1:], rhs_eval, fr), fr),
+                     sp.val, fr)
+        newvals = jnp.where(match[:, None],
+                            jnp.asarray(_as_seg(new.lanes, vw))[None, :],
+                            rows[:, kw:])
+        out = jnp.concatenate([rows[:, :kw], newvals], axis=1)
+        lanes = jnp.concatenate([jnp.reshape(f.lanes[0], (1,)).astype(
+            jnp.int32), out.reshape(-1)])
         return SymV(sp, lanes)
     if sp.kind == "pfcn":
         kv = arg[0] if kind == "idx" else arg
         if isinstance(kv, SymV) and not kv.static and kind == "idx":
-            # traced key (voterLog[i] @@ (j :> ...) with slot-bound j):
-            # guarded update across the key universe
-            lanes = list(f.lanes)
+            # traced key: guarded per-key segments, concatenated once
+            segs = []
             off = 0
             for dk, es in zip(sp.dom, sp.elems):
                 cond = as_bool(mk_bool(sym_eq(
                     kv, static_to_symv(dk, fr.kc), fr)), fr)
                 old = SymV(es, f.lanes[off + 1:off + 1 + es.width])
-                new = coerce(_lift(_apply_rest(old, path[1:], rhs_eval, fr),
-                                   fr), es, fr)
-                lanes[off] = _ite(cond, 1, f.lanes[off])
-                lanes[off + 1:off + 1 + es.width] = _select_lanes(
-                    cond, new.lanes, f.lanes[off + 1:off + 1 + es.width])
+                new = coerce(_lift(_apply_rest(old, path[1:], rhs_eval,
+                                               fr), fr), es, fr)
+                pres = _ite(cond, 1, f.lanes[off])
+                sel = _select_lanes(cond, new.lanes,
+                                    f.lanes[off + 1:off + 1 + es.width])
+                segs.append(_as_lane_arr(pres))
+                segs.append(_as_seg(sel, es.width))
                 off += 1 + es.width
-            return SymV(sp, lanes)
+            return SymV(sp, _cat(segs))
         key = _static_key_value(kv, fr) if kind == "idx" else arg
         off = 0
         for dk, es in zip(sp.dom, sp.elems):
             if _keys_equal(dk, key):
                 old = SymV(es, f.lanes[off + 1:off + 1 + es.width])
-                new = coerce(_lift(_apply_rest(old, path[1:], rhs_eval, fr),
-                                   fr), es, fr)
-                lanes = list(f.lanes)
-                lanes[off] = 1
-                lanes[off + 1:off + 1 + es.width] = new.lanes
-                return SymV(sp, lanes)
+                new = coerce(_lift(_apply_rest(old, path[1:], rhs_eval,
+                                               fr), fr), es, fr)
+                return SymV(sp, _splice(
+                    f.lanes, off, 1 + es.width,
+                    _cat([np.asarray([1], np.int32),
+                          _as_seg(new.lanes, es.width)])))
             off += 1 + es.width
         raise CompileError(f"EXCEPT key {key!r} outside pfcn universe")
     raise CompileError(f"EXCEPT on {sp.kind}")
@@ -1028,44 +1069,33 @@ def _apply_rest(old: SymV, rest, rhs_eval, fr: Frame):
 
 def kv_merge_insert(f: SymV, key: SymV, val: SymV, fr: Frame) -> SymV:
     """f @@ (key :> val): insert if key absent (f wins on overlap),
-    keeping the table sorted by key lanes."""
+    keeping the table sorted by key lanes — vectorized."""
     sp = f.spec
     kl = coerce(key, sp.elem, fr)
     vl = coerce(val, sp.val, fr)
     kw, vw = sp.elem.width, sp.val.width
     cnt = f.lanes[0]
-    present = False
-    keys = []
-    rows = []
-    for s in range(sp.cap):
-        base = 1 + s * (kw + vw)
-        krow = f.lanes[base:base + kw]
-        keys.append(krow)
-        rows.append(f.lanes[base:base + kw + vw])
-        used = _lt_lane(s, cnt)
-        same = True
-        for x, y in zip(kl.lanes, krow):
-            same = _land(same, _eq_lane(x, y))
-        present = _lor(present, _land(used, same))
-    pos = 0
-    for s in range(sp.cap):
-        used = _lt_lane(s, cnt)
-        lt = lanes_lex_lt(keys[s], kl.lanes)
-        inc = _land(used, lt)
-        pos = pos + (_ite(inc, 1, 0) if not isinstance(inc, bool)
-                     else (1 if inc else 0))
-    fr.flag_overflow(_land(_lnot(present), _ge_lane(cnt, sp.cap)))
-    newrow = list(kl.lanes) + list(vl.lanes)
-    lanes = [None] * len(f.lanes)
-    lanes[0] = _ite(present, cnt, cnt + 1)
-    for s in range(sp.cap):
-        base = 1 + s * (kw + vw)
-        before = _lt_lane(s, pos)
-        at = _eq_lane(s, pos)
-        shifted = rows[s - 1] if s > 0 else [0] * (kw + vw)
-        ins = _select_lanes(before, rows[s],
-                            _select_lanes(at, newrow, shifted))
-        lanes[base:base + kw + vw] = _select_lanes(present, rows[s], ins)
+    rows = jnp.asarray(_slots_matrix(f.lanes, 1, sp.cap, kw + vw))
+    keys = rows[:, :kw]
+    xl = jnp.asarray(_as_seg(kl.lanes, kw))
+    used = jnp.arange(sp.cap) < cnt
+    if kw == 0:
+        present = cnt > 0 if isinstance(cnt, int) else jnp.asarray(cnt) > 0
+    else:
+        present = jnp.any(jnp.all(keys == xl[None, :], axis=1) & used)
+    lt = _rows_lex_lt(keys, xl)
+    pos = jnp.sum(used & lt)
+    fr.flag_overflow(jnp.logical_and(jnp.logical_not(present),
+                                     _ge_lane(cnt, sp.cap)))
+    newrow = jnp.concatenate([xl, jnp.asarray(_as_seg(vl.lanes, vw))])
+    idx = jnp.arange(sp.cap)
+    prev = jnp.concatenate([jnp.zeros((1, kw + vw), jnp.int32), rows[:-1]])
+    ins = jnp.where((idx < pos)[:, None], rows,
+                    jnp.where((idx == pos)[:, None], newrow[None, :], prev))
+    out = jnp.where(present, rows, ins)
+    newcnt = jnp.where(present, cnt, cnt + 1)
+    lanes = jnp.concatenate([jnp.reshape(newcnt, (1,)).astype(jnp.int32),
+                             out.reshape(-1)])
     return SymV(sp, lanes)
 
 
@@ -1921,7 +1951,7 @@ def compile_action2(kc: KernelCtx, ga) -> CompiledAction2:
         off = 0
         for v in vars:
             sp = layout.specs[v]
-            state[v] = SymV(sp, [row[off + i] for i in range(sp.width)])
+            state[v] = SymV(sp, row[off:off + sp.width])
             off += sp.width
         primes: Dict[str, SymV] = {}
         overflow = [False]
@@ -1991,10 +2021,9 @@ def compile_action2(kc: KernelCtx, ga) -> CompiledAction2:
         if missing:
             raise CompileError(f"action {ga.label} leaves {missing} "
                                f"unassigned")
-        out: List = []
-        for v in vars:
-            out.extend(primes[v].lanes)
-        succ = jnp.stack([jnp.asarray(x, dtype=jnp.int32) for x in out])
+        succ = jnp.concatenate(
+            [jnp.asarray(primes[v].lanes, dtype=jnp.int32)
+             for v in vars])
         en = enabled if _is_traced(enabled) else jnp.asarray(bool(enabled))
         ak = assert_ok if _is_traced(assert_ok) \
             else jnp.asarray(bool(assert_ok))
@@ -2035,20 +2064,22 @@ def _slot_bind_traced(setexpr: A.Node, slot, fr: Frame):
     items = list(_elements(sval, fr))
     if not items:
         return False, None
-    guard = False
     first = items[0][1]
     if not isinstance(first, SymV):
         first = _lift(first, fr)
-    lanes = list(first.lanes)
     spec = first.spec
-    for i, (g, v) in enumerate(items):
+    mat = []
+    guards = []
+    for g, v in items:
         sv = v if isinstance(v, SymV) else _lift(v, fr)
-        sv = coerce(sv, spec, fr)
-        hit = _eq_lane(slot, i)
-        guard = _lor(guard, _land(hit, g))
-        if i > 0:
-            lanes = _select_lanes(hit, sv.lanes, lanes)
-    return guard, SymV(spec, lanes)
+        mat.append(jnp.asarray(coerce(sv, spec, fr).lanes))
+        gb = g if not isinstance(g, bool) else jnp.asarray(g)
+        guards.append(gb)
+    mat = jnp.stack(mat)                       # [n_items, w]
+    gs = jnp.stack([jnp.asarray(g) for g in guards])
+    safe = jnp.clip(slot, 0, len(items) - 1)
+    guard = jnp.where(slot < len(items), gs[safe], False)
+    return guard, SymV(spec, mat[safe])
 
 
 def _prime_target2(e: A.Node, vars):
@@ -2086,7 +2117,7 @@ def compile_predicate2(kc: KernelCtx, expr: A.Node) -> Callable:
         off = 0
         for v in layout.vars:
             sp = layout.specs[v]
-            state[v] = SymV(sp, [row[off + i] for i in range(sp.width)])
+            state[v] = SymV(sp, row[off:off + sp.width])
             off += sp.width
         fr = Frame(kc, {}, state, {}, [False])
         r = as_bool(sym_eval2(expr, fr), fr)

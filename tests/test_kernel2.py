@@ -26,18 +26,26 @@ def state_key(st, vars):
 
 
 def kernel_successors(ex, st):
-    """Successor states via the compiled kernels for one concrete state."""
+    """Successor states via the compiled kernels for one concrete state
+    (slotted kernels evaluated per slot index; kernels jitted once,
+    cached on the action object so recycled ids cannot alias)."""
     import jax
     row = ex.layout.encode(st)
     out = set()
     overflow = False
     for ca in ex.compiled:
-        en, aok, ov, succ = ca.fn(row)
-        if bool(ov):
-            overflow = True
-        if bool(en):
-            dec = ex.layout.decode(np.asarray(succ))
-            out.add(state_key(dec, ex.layout.vars))
+        jf = getattr(ca, "_jitted", None)
+        if jf is None:
+            jf = jax.jit(ca.fn)
+            ca._jitted = jf
+        slots = range(ca.n_slots) if ca.n_slots else [None]
+        for k in slots:
+            en, aok, ov, succ = (jf(row, k) if k is not None else jf(row))
+            if bool(ov):
+                overflow = True
+            if bool(en):
+                dec = ex.layout.decode(np.asarray(succ))
+                out.add(state_key(dec, ex.layout.vars))
     return out, overflow
 
 
