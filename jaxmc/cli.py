@@ -111,7 +111,7 @@ def cmd_check(args) -> int:
             res = TpuExplorer(model, log=log, bounds=bounds,
                               store_trace=not args.no_trace,
                               progress_every=args.progress_every,
-                              host_seen=args.host_seen,
+                              host_seen=args.host_seen, chunk=args.chunk,
                               max_states=args.max_states).run()
         except CompileError as e:
             print(f"error: this spec is outside the jax backend's "
@@ -198,6 +198,9 @@ def main(argv=None) -> int:
                    help="jax backend: keep the seen-set in the native C++ "
                         "fingerprint store (state spaces beyond device "
                         "memory; usually faster)")
+    c.add_argument("--chunk", type=int, default=2048,
+                   help="jax backend: frontier rows expanded per kernel "
+                        "call (bounds device memory; host-seen mode)")
     c.add_argument("--checkpoint", default=None,
                    help="write periodic checkpoints to this file "
                         "(TLC's states/ equivalent)")

@@ -76,7 +76,7 @@ class TpuExplorer:
                  progress_every: float = 30.0,
                  bounds: Optional[Bounds] = None,
                  sample_cfg: Tuple[int, int, int] = (800, 40, 60),
-                 host_seen: bool = False):
+                 host_seen: bool = False, chunk: int = 2048):
         self.model = model
         self.log = log or (lambda s: None)
         self.max_states = max_states
@@ -84,6 +84,7 @@ class TpuExplorer:
         self.progress_every = progress_every
         self.bounds = bounds or Bounds()
         self.host_seen = host_seen
+        self.chunk = chunk
 
         base_ctx = model.ctx()
         self.init_states = enumerate_init(model.init, base_ctx, model.vars)
@@ -362,104 +363,124 @@ class TpuExplorer:
             else np.zeros((0, self.K), np.int32)
         store.insert(init_keys[:, 1:])  # drop the validity lane
 
-        FC = _pow2_at_least(max(len(explored_init), 1))
-        frontier = np.full((FC, W), SENTINEL, np.int32)
-        fr0 = init_rows[explored_init]
-        frontier[:len(fr0)] = fr0
-        frontier = jnp.asarray(frontier)
-        fcount = len(fr0)
+        # the frontier lives host-side as a dense row matrix; each level is
+        # processed in fixed-size chunks so the [A, chunk, W] expand tensor
+        # is memory-bounded and the jit compiles for ONE shape
+        CH = _pow2_at_least(self.chunk, lo=64)
+        frontier_np = np.ascontiguousarray(init_rows[explored_init])
 
         trace_levels = [(np.asarray(init_rows), None, 0)]
         frontier_maps = [np.asarray(explored_init, dtype=np.int64)]
         depth = 0
         last_progress = time.time()
-        while fcount > 0:
-            hstep = self._get_hstep(FC)
-            out = hstep(frontier, fcount)
-            if bool(out["overflow"]):
-                return self._mk_result(
-                    False, distinct, generated, depth, t0, warnings,
-                    Violation("error", "capacity overflow", [],
-                              "a container exceeded its lane capacity "
-                              "(raise --seq-cap/--grow-cap/--kv-cap)"))
-            if bool(jnp.any(out["assert_bad"])):
-                ab = np.asarray(out["assert_bad"])
-                a, f = np.unravel_index(np.argmax(ab), ab.shape)
-                trace = self._trace_to(trace_levels, frontier_maps, depth,
-                                       int(f))
-                return self._mk_result(
-                    False, distinct, generated, depth, t0, warnings,
-                    Violation("assert", "Assert",
-                              [x for x in trace if x[0] is not None],
-                              f"assertion in {self.labels_flat[int(a)]}"))
-            if model.check_deadlock and bool(jnp.any(out["dead"])):
-                f = int(jnp.argmax(out["dead"]))
-                trace = self._trace_to(trace_levels, frontier_maps, depth,
-                                       f)
-                return self._mk_result(
-                    False, distinct, generated, depth, t0, warnings,
-                    Violation("deadlock", "deadlock", trace))
+        hstep = self._get_hstep(CH)
+        while len(frontier_np) > 0:
+            L = len(frontier_np)
+            lvl_new_rows: List[np.ndarray] = []
+            lvl_new_prov: List[np.ndarray] = []
+            lvl_explore: List[np.ndarray] = []
+            inv_hit = None
+            for base in range(0, L, CH):
+                cn = min(CH, L - base)
+                buf = np.full((CH, W), SENTINEL, np.int32)
+                buf[:cn] = frontier_np[base:base + cn]
+                out = hstep(jnp.asarray(buf), cn)
+                if bool(out["overflow"]):
+                    return self._mk_result(
+                        False, distinct, generated, depth, t0, warnings,
+                        Violation("error", "capacity overflow", [],
+                                  "a container exceeded its lane capacity "
+                                  "(raise --seq-cap/--grow-cap/--kv-cap)"))
+                if bool(jnp.any(out["assert_bad"])):
+                    ab = np.asarray(out["assert_bad"])
+                    ai, f = np.unravel_index(np.argmax(ab), ab.shape)
+                    trace = self._trace_to(trace_levels, frontier_maps,
+                                           depth, base + int(f))
+                    return self._mk_result(
+                        False, distinct, generated, depth, t0, warnings,
+                        Violation("assert", "Assert",
+                                  [x for x in trace if x[0] is not None],
+                                  f"assertion in "
+                                  f"{self.labels_flat[int(ai)]}"))
+                if model.check_deadlock and bool(jnp.any(out["dead"])):
+                    f = int(jnp.argmax(out["dead"]))
+                    trace = self._trace_to(trace_levels, frontier_maps,
+                                           depth, base + f)
+                    return self._mk_result(
+                        False, distinct, generated, depth, t0, warnings,
+                        Violation("deadlock", "deadlock", trace))
 
-            generated += int(out["gen"])
-            cvalid = np.asarray(out["cvalid"])
-            keys = np.asarray(out["keys"])
-            inv_ok = np.asarray(out["inv_ok"])
-            explore = np.asarray(out["explore"])
-            valid_idx = np.nonzero(cvalid)[0]
-            new_mask = store.insert(keys[valid_idx][:, 1:])
-            new_idx = valid_idx[new_mask]
-            distinct += len(new_idx)
+                generated += int(out["gen"])
+                cvalid = np.asarray(out["cvalid"])
+                keys = np.asarray(out["keys"])
+                inv_ok = np.asarray(out["inv_ok"])
+                explore = np.asarray(out["explore"])
+                valid_idx = np.nonzero(cvalid)[0]
+                new_mask = store.insert(keys[valid_idx][:, 1:])
+                new_idx = valid_idx[new_mask]
+                distinct += len(new_idx)
+                if not len(new_idx):
+                    continue
+                rows_np = np.asarray(jnp.take(
+                    out["cand"], jnp.asarray(new_idx, dtype=np.int32),
+                    axis=0))
+                # global provenance: action a, parent base+f within the
+                # level's full frontier of length L (cand index = a*CH + f)
+                a_ids = new_idx // CH
+                f_ids = new_idx % CH
+                prov_global = a_ids * L + (base + f_ids)
+                if inv_hit is None and not inv_ok[new_idx].all():
+                    off = sum(len(r) for r in lvl_new_rows)
+                    badpos = int(np.nonzero(~inv_ok[new_idx])[0][0])
+                    inv_hit = off + badpos
+                lvl_new_rows.append(rows_np)
+                lvl_new_prov.append(prov_global.astype(np.int64))
+                lvl_explore.append(explore[new_idx])
+                if inv_hit is not None:
+                    # the violation is already in hand: skip the rest of
+                    # the level's chunks
+                    break
 
-            new_rows_dev = jnp.take(out["cand"], jnp.asarray(
-                new_idx, dtype=np.int32), axis=0) if len(new_idx) else None
+            new_rows_np = np.concatenate(lvl_new_rows) if lvl_new_rows \
+                else np.zeros((0, W), np.int32)
+            new_prov_np = np.concatenate(lvl_new_prov) if lvl_new_prov \
+                else np.zeros(0, np.int64)
+            explore_mask = np.concatenate(lvl_explore) if lvl_explore \
+                else np.zeros(0, bool)
 
-            if len(new_idx) and not inv_ok[new_idx].all():
-                badpos = int(np.nonzero(~inv_ok[new_idx])[0][0])
-                st = layout.decode(np.asarray(new_rows_dev[badpos]))
+            if self.store_trace:
+                trace_levels.append((new_rows_np, new_prov_np, L))
+            if inv_hit is not None:
+                st = layout.decode(new_rows_np[inv_hit])
                 ctx = model.ctx(state=st)
                 nm = next((n for n, ex in model.invariants
                            if not _bool(eval_expr(ex, ctx), n)),
                           model.invariants[0][0] if model.invariants
                           else "invariant")
-                if self.store_trace:
-                    rows_h = np.asarray(new_rows_dev)
-                    prov_h = new_idx.astype(np.int64)
-                    trace_levels.append((rows_h, prov_h, FC))
-                    trace = self._trace_to(trace_levels, frontier_maps,
-                                           depth + 1, badpos, from_new=True)
-                else:
-                    trace = [(st, "?")]
+                trace = self._trace_to(trace_levels, frontier_maps,
+                                       depth + 1, inv_hit,
+                                       from_new=True) \
+                    if self.store_trace else [(st, "?")]
                 return self._mk_result(
                     False, distinct, generated, depth + 1, t0, warnings,
                     Violation("invariant", nm, trace))
 
-            explore_idx = new_idx[explore[new_idx]]
+            sel = np.nonzero(explore_mask)[0]
             if self.store_trace:
-                rows_h = np.asarray(new_rows_dev) if len(new_idx) else \
-                    np.zeros((0, W), np.int32)
-                trace_levels.append((rows_h, new_idx.astype(np.int64), FC))
-                pos = {int(p): i for i, p in enumerate(new_idx)}
-                frontier_maps.append(np.asarray(
-                    [pos[int(p)] for p in explore_idx], dtype=np.int64))
+                frontier_maps.append(sel.astype(np.int64))
             depth += 1
             if self.max_states and distinct >= self.max_states:
                 self.log("-- state limit reached, search truncated")
                 return self._mk_result(True, distinct, generated, depth,
                                        t0, warnings, None, truncated=True)
-            fcount = len(explore_idx)
-            if fcount > FC:
-                FC = _pow2_at_least(fcount, FC)
-            nf = jnp.full((FC, W), SENTINEL, jnp.int32)
-            if fcount:
-                nf = nf.at[:fcount].set(
-                    jnp.take(out["cand"], jnp.asarray(explore_idx),
-                             axis=0))
-            frontier = nf
+            frontier_np = new_rows_np[sel]
+
             now = time.time()
             if now - last_progress >= self.progress_every:
                 last_progress = now
                 self.log(f"Progress({depth}): {generated} generated, "
-                         f"{distinct} distinct, {fcount} on queue.")
+                         f"{distinct} distinct, {len(frontier_np)} on "
+                         f"queue.")
 
         self.log("Model checking completed. No error has been found.")
         self.log(f"{generated} states generated, {distinct} distinct "
