@@ -114,7 +114,12 @@ def _ite(c, a, b):
     return jnp.where(c, a, b)
 
 
+def _npbool(x):
+    return bool(x) if isinstance(x, np.bool_) else x
+
+
 def _land(a, b):
+    a, b = _npbool(a), _npbool(b)
     if a is True:
         return b
     if b is True:
@@ -125,6 +130,7 @@ def _land(a, b):
 
 
 def _lor(a, b):
+    a, b = _npbool(a), _npbool(b)
     if a is False:
         return b
     if b is False:
@@ -135,6 +141,7 @@ def _lor(a, b):
 
 
 def _lnot(a):
+    a = _npbool(a)
     return (not a) if isinstance(a, bool) else jnp.logical_not(a)
 
 
@@ -159,20 +166,37 @@ class KernelCtx:
 
 class Frame:
     """Per-expression evaluation frame."""
-    __slots__ = ("kc", "bound", "state", "primes", "overflow")
+    __slots__ = ("kc", "bound", "state", "primes", "overflow", "strict",
+                 "guard")
 
-    def __init__(self, kc: KernelCtx, bound, state, primes, overflow):
+    def __init__(self, kc: KernelCtx, bound, state, primes, overflow,
+                 strict=False, guard=True):
         self.kc = kc
         self.bound = bound      # name -> SymV | static python value
         self.state = state      # var -> SymV
         self.primes = primes    # var -> SymV
         self.overflow = overflow  # list with one traced/py bool cell
+        # strict frames (compiled predicates) may not use overflow-guarded
+        # recovery: a wrong False from an invariant would be a spurious
+        # violation, a wrong True a missed one — fail the compile instead
+        self.strict = strict
+        # liveness of the current evaluation context: bodies evaluated for
+        # dead quantifier/set members (mask false) must not abort the run
+        self.guard = guard
 
     def with_bound(self, extra):
         return Frame(self.kc, {**self.bound, **extra}, self.state,
-                     self.primes, self.overflow)
+                     self.primes, self.overflow, self.strict, self.guard)
+
+    def with_guard(self, g):
+        return Frame(self.kc, self.bound, self.state, self.primes,
+                     self.overflow, self.strict, _land(self.guard, g))
 
     def flag_overflow(self, cond):
+        cond = _land(self.guard, _npbool(cond))
+        if self.strict and cond is not False:
+            raise CompileError("uncompilable subterm in a predicate "
+                               "(no overflow recovery in invariants)")
         self.overflow[0] = _lor(self.overflow[0], cond)
 
 
@@ -237,14 +261,18 @@ def _coerce_lanes(src: VS, dst: VS, lanes, fr: Frame):
         raise CompileError(f"cannot coerce empty set to {dk}")
     if sk == dk == "seq":
         if dst.cap < src.cap:
-            raise CompileError("sequence coercion would shrink capacity")
+            # shrinking is sound when the runtime length fits; otherwise
+            # the overflow flag aborts the run (universe-sized constructor
+            # results coerce into tighter layout slots)
+            fr.flag_overflow(_ge_lane(lanes[0], dst.cap + 1))
         segs = [lanes[0:1]]
-        for i in range(src.cap):
+        for i in range(min(src.cap, dst.cap)):
             segs.append(_coerce_lanes(
                 src.elem, dst.elem,
                 lanes[1 + i * src.elem.width:
                       1 + (i + 1) * src.elem.width], fr))
-        segs.append(_zeros((dst.cap - src.cap) * dst.elem.width))
+        if dst.cap > src.cap:
+            segs.append(_zeros((dst.cap - src.cap) * dst.elem.width))
         return _cat(segs)
     if sk == dk == "set":
         pos = {m: i for i, m in enumerate(src.dom)}
@@ -587,18 +615,24 @@ def sym_apply(f, args: List, fr: Frame) -> Any:
 def _static_key_value(key, fr: Frame):
     if isinstance(key, SymV):
         if key.spec.kind == "int":
-            return key.lanes[0]
+            return int(key.lanes[0])
         if key.spec.kind == "enum":
-            return fr.kc.uni.value(key.lanes[0])
+            return fr.kc.uni.value(int(key.lanes[0]))
         if key.spec.kind == "bool":
             return bool(key.lanes[0])
         raise CompileError(f"unsupported static key kind {key.spec.kind}")
+    if isinstance(key, np.integer):
+        return int(key)
     return key
 
 
 def _keys_equal(a, b) -> bool:
     if isinstance(a, ModelValue) or isinstance(b, ModelValue):
         return a is b
+    if isinstance(a, np.integer):
+        a = int(a)
+    if isinstance(b, np.integer):
+        b = int(b)
     if type(a) is not type(b) and not (isinstance(a, int)
                                        and isinstance(b, int)):
         return False
@@ -653,14 +687,43 @@ def _set_of(v, fr: Frame):
 def sym_in(x, s, fr: Frame):
     kind, sv = _set_of(s, fr)
     if kind == "inf":
-        # membership in Nat/Int/Seq(S): type-level, true for well-shaped
-        # compiled values of the right kind
+        if not isinstance(x, SymV):
+            # static value against an infinite set: the interpreter rule
+            return in_set(x, sv)
+        # membership in Nat/Int/Seq(S): type-level for compiled values
         if isinstance(x, SymV):
             if sv.kind == "Nat":
                 return jnp.greater_equal(as_int_lane(x), 0) \
                     if _is_traced(as_int_lane(x)) else as_int_lane(x) >= 0
             if sv.kind == "Int":
                 return True
+            if sv.kind == "Seq":
+                # q \in Seq(S): every used element in S (TypeInvariant,
+                # InnerFIFO.tla) — vacuous beyond the length
+                if x.spec.kind == "justempty":
+                    return True
+                if x.spec.kind == "seq":
+                    acc = True
+                    n = x.lanes[0]
+                    for i in range(x.spec.cap):
+                        el = SymV(x.spec.elem, _seq_elem(x, i))
+                        inn = _generic_in(el, sv.param, fr)
+                        unused = _ge_lane(i, n)
+                        acc = _land(acc, _lor(unused, inn))
+                    return acc
+                if x.spec.kind == "fcn" and all(
+                        isinstance(k, int) for k in x.spec.dom) and \
+                        tuple(x.spec.dom) == tuple(
+                            range(1, len(x.spec.dom) + 1)):
+                    # heterogeneous tuple encoded as int-keyed record
+                    acc = True
+                    off = 0
+                    for kk, es in zip(x.spec.dom, x.spec.elems):
+                        el = SymV(es, x.lanes[off:off + es.width])
+                        acc = _land(acc, _generic_in(el, sv.param, fr))
+                        off += es.width
+                    return acc
+                return False
         raise CompileError(f"membership in {sv!r} not compilable")
     if kind == "static":
         if not isinstance(x, SymV) or x.static:
@@ -1252,7 +1315,8 @@ def sym_eval2(e: A.Node, fr: Frame):
         acc = True if e.kind == "A" else False
         for b in _binder_combos(e.binders, fr):
             guard, bound = b
-            v = as_bool(sym_eval2(e.body, fr.with_bound(bound)), fr)
+            v = as_bool(sym_eval2(
+                e.body, fr.with_bound(bound).with_guard(guard)), fr)
             if e.kind == "A":
                 acc = _land(acc, _lor(_lnot(guard), v))
             else:
@@ -1271,6 +1335,27 @@ def sym_eval2(e: A.Node, fr: Frame):
             else:
                 raise CompileError("unsupported LET body in compiled expr")
         return sym_eval2(e.body, fr.with_bound(defs))
+    if t is A.RecordSet:
+        # [a: S, b: T] — static record sets materialize like SUBSET
+        from ..sem.values import mk_record
+        fields = []
+        for k, sexpr in e.fields:
+            sval = sym_eval2(sexpr, fr)
+            if not isinstance(sval, frozenset):
+                raise CompileError("record set over symbolic field set")
+            fields.append((k, sorted(sval, key=sort_key)))
+        out = []
+        for combo in itertools.product(*[vs for _, vs in fields]):
+            out.append(mk_record({k: v for (k, _), v
+                                  in zip(fields, combo)}))
+        return frozenset(out)
+    if t is A.FnSet:
+        dom = sym_eval2(e.dom, fr)
+        rng = sym_eval2(e.rng, fr)
+        if isinstance(dom, frozenset) and isinstance(rng, frozenset):
+            from ..sem.values import FcnSetV
+            return frozenset(FcnSetV(dom, rng).materialize())
+        raise CompileError("function set over symbolic operands")
     if t is A.Unchanged:
         raise CompileError("UNCHANGED in expression position")
     raise CompileError(f"cannot compile {t.__name__}")
@@ -1290,12 +1375,22 @@ def _static_const(d, fr: Frame):
 def _tuple_symv(items, fr: Frame) -> SymV:
     espec = None
     lifted = []
+    hetero = False
     for x in items:
         sv = _lift(x, fr)
         lifted.append(sv)
-        espec = sv.spec if espec is None else vs_merge(espec, sv.spec)
-    if espec is None:
+        try:
+            espec = sv.spec if espec is None else vs_merge(espec, sv.spec)
+        except CompileError:
+            hetero = True
+    if espec is None and not hetero:
         return SymV(VS("justempty"), [])
+    if hetero:
+        # heterogeneous tuple: fixed int-keyed record
+        return SymV(VS("fcn", dom=tuple(range(1, len(lifted) + 1)),
+                       elems=tuple(sv.spec for sv in lifted)),
+                    _cat([_as_seg(sv.lanes, sv.spec.width)
+                          for sv in lifted]))
     from .vspec import apply_bounds
     espec = apply_bounds(espec, fr.kc.bounds)
     n = len(lifted)
@@ -1402,7 +1497,18 @@ def _sym_fndef(e: A.FnDef, fr: Frame) -> SymV:
             g = sval.lanes[idx]
             gb = g if isinstance(g, bool) else _eq_lane(g, 1)
             b = {pat: mk_int(m)}
-            v = _lift(sym_eval2(e.body, fr.with_bound(b)), fr)
+            try:
+                v = _lift(sym_eval2(e.body,
+                                    fr.with_bound(b).with_guard(gb)), fr)
+            except CompileError:
+                # body uncompilable for this universe member (q[j+1] past
+                # the sequence capacity for dead j): zeros, and abort the
+                # run if the member is ever actually in the set
+                fr.flag_overflow(gb)
+                if vals:
+                    v = SymV(vals[0][1].spec, _zeros(vals[0][1].spec.width))
+                else:
+                    continue
             vals.append((gb, v))
             length = length + (_ite(gb, 1, 0) if not isinstance(gb, bool)
                                else (1 if gb else 0))
@@ -1478,7 +1584,7 @@ def _sym_setfilter(e: A.SetFilter, fr: Frame):
 def _sym_setmap(e: A.SetMap, fr: Frame):
     out = []
     for guard, bound in _binder_combos(e.binders, fr):
-        v = sym_eval2(e.expr, fr.with_bound(bound))
+        v = sym_eval2(e.expr, fr.with_bound(bound).with_guard(guard))
         out.append((guard, v))
     if all(g is True for g, _ in out):
         conc = _try_concrete([v for _, v in out], fr)
@@ -1750,6 +1856,14 @@ def _sym_opapp2(e: A.OpApp, fr: Frame):
     if name == "Head":
         return sym_apply(_lift(sym_eval2(e.args[0], fr), fr), [mk_int(1)],
                          fr)
+    if name == "Tail":
+        v = _lift(sym_eval2(e.args[0], fr), fr)
+        if v.spec.kind != "seq":
+            raise CompileError("Tail of non-sequence")
+        # the interpreter raises on Tail(<<>>); a reachable empty-Tail is
+        # a spec error, so the overflow flag aborts equivalently
+        fr.flag_overflow(_eq_lane(v.lanes[0], 0))
+        return seq_subseq(v, mk_int(2), seq_len(v), fr)
     if name == ":>":
         k = _lift(sym_eval2(e.args[0], fr), fr)
         v = _lift(sym_eval2(e.args[1], fr), fr)
@@ -1767,6 +1881,21 @@ def _sym_opapp2(e: A.OpApp, fr: Frame):
                 return sym_except(f, [("idx", [g[1]])], lambda old: g[2],
                                   fr)
         raise CompileError("@@ outside table-insert idiom")
+    if name in ("\\X", "\\times"):
+        args = [sym_eval2(a, fr) for a in e.args]
+        if all(isinstance(a, frozenset) for a in args):
+            from ..sem.values import mk_seq as _mkseq
+            out = []
+            for combo in itertools.product(
+                    *[sorted(a, key=sort_key) for a in args]):
+                out.append(_mkseq(list(combo)))
+            return frozenset(out)
+        raise CompileError("cartesian product over symbolic sets")
+    if name == "Seq":
+        sv = sym_eval2(e.args[0], fr)
+        if isinstance(sv, frozenset):
+            return InfiniteSet("Seq", sv)
+        raise CompileError("Seq over symbolic set")
     if name == "Assert":
         raise CompileError("Assert in expression position")
     if name == "!sel":
@@ -2062,6 +2191,12 @@ def _slot_bind_traced(setexpr: A.Node, slot, fr: Frame):
     per ACTION FAMILY instead of per instance."""
     sval = sym_eval2(setexpr, fr)
     items = list(_elements(sval, fr))
+    if len(items) > fr.kc.bounds.kv_cap:
+        # more potential elements than engine slot instances: transitions
+        # would be silently dropped — reject the compile instead
+        raise CompileError(
+            f"dynamic \\E set has {len(items)} potential elements but "
+            f"only {fr.kc.bounds.kv_cap} slots (raise --kv-cap)")
     if not items:
         return False, None
     first = items[0][1]
@@ -2119,7 +2254,7 @@ def compile_predicate2(kc: KernelCtx, expr: A.Node) -> Callable:
             sp = layout.specs[v]
             state[v] = SymV(sp, row[off:off + sp.width])
             off += sp.width
-        fr = Frame(kc, {}, state, {}, [False])
+        fr = Frame(kc, {}, state, {}, [False], strict=True)
         r = as_bool(sym_eval2(expr, fr), fr)
         return r if _is_traced(r) else jnp.asarray(bool(r))
 

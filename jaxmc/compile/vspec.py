@@ -141,11 +141,16 @@ def infer(v, uni: EnumUniverse) -> VS:
         keys = sorted(v.d.keys(), key=sort_key)
         if all(isinstance(k, int) and not isinstance(k, bool) for k in keys) \
                 and keys == list(range(1, len(keys) + 1)):
-            elem = None
-            for k in keys:
-                s = infer(v.d[k], uni)
-                elem = s if elem is None else merge(elem, s)
-            return VS("seq", cap=len(keys), elem=elem)
+            try:
+                elem = None
+                for k in keys:
+                    s = infer(v.d[k], uni)
+                    elem = s if elem is None else merge(elem, s)
+                return VS("seq", cap=len(keys), elem=elem)
+            except CompileError:
+                # heterogeneous tuple (<<data, bit>> pairs in
+                # AlternatingBit): a fixed int-keyed record, not a sequence
+                pass
         for k in keys:
             if isinstance(k, (str, ModelValue)):
                 uni.add(k)

@@ -93,9 +93,11 @@ class TpuExplorer:
                                 walk_depth=depth)
         self.layout = build_layout2(model, sampled, self.bounds)
         self.kc = KernelCtx(model, self.layout, self.bounds)
-        dyn = self.bounds.kv_cap if any(
-            s.kind == "kvtable" for s in self.layout.specs.values()) else 0
-        self.actions = ground_actions(model, dyn_slots=dyn)
+        # dynamic \E expansion applies to message tables AND to
+        # state-dependent intervals (\E i \in 1..Len(q), AlternatingBit's
+        # Lose); slots beyond the actual element count are mask-disabled
+        self.actions = ground_actions(model,
+                                      dyn_slots=self.bounds.kv_cap)
         self.compiled = [compile_action2(self.kc, ga) for ga in self.actions]
         # flat instance list: slotted kernels contribute n_slots rows
         self.labels_flat = []

@@ -159,3 +159,29 @@ class TestHostSeen:
         r = TpuExplorer(model, host_seen=True).run()
         assert not r.ok and r.violation.kind == "assert"
         assert len(r.violation.trace) >= 2
+
+
+class TestCorpusOnDevice:
+    # seq-heavy corpus models must reproduce the interpreter's exact
+    # counts on the device backend (tuple messages, Tail, Lose's dynamic
+    # sequence surgery, record-set TypeInvariants)
+    CASES = [
+        ("examples/SpecifyingSystems/FIFO/MCInnerFIFO.tla", 5808, 9660),
+        ("examples/SpecifyingSystems/TLC/MCAlternatingBit.tla", 428, 1392),
+    ]
+
+    @pytest.mark.parametrize("rel,distinct,generated", CASES,
+                             ids=[c[0].split("/")[-1] for c in CASES])
+    def test_corpus_model_exact(self, rel, distinct, generated):
+        from jaxmc import native_store
+        if not native_store.is_available():
+            pytest.skip("no native toolchain")
+        from jaxmc.tpu.bfs import TpuExplorer
+        spec = os.path.join(REFERENCE, rel)
+        cfg = parse_cfg(open(spec[:-4] + ".cfg", encoding="utf-8",
+                             errors="replace").read())
+        model = load(spec, cfg)
+        r = TpuExplorer(model, host_seen=True, store_trace=False).run()
+        assert r.ok
+        assert r.distinct == distinct
+        assert r.generated == generated
