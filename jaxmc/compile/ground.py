@@ -30,9 +30,9 @@ from ..sem.eval import Ctx, OpClosure, eval_expr, iter_binders, bind_pattern
 from ..sem.modules import Model
 
 
-class CompileError(Exception):
-    """Raised when a construct cannot be compiled to the TPU path; callers
-    fall back to the interpreter (SURVEY.md §7.2)."""
+# ONE CompileError class for the whole compile package — ground and
+# vspec/kernel2 raise interchangeably and callers catch one type
+from .vspec import CompileError  # noqa: F401  (re-export)
 
 
 # ---------------- enum universe ----------------
@@ -318,6 +318,14 @@ def ground_actions(model: Model, max_actions: int = 4096,
                 if dyn_slots > 0 and len(e.binders) == 1 \
                         and len(e.binders[0][0]) == 1 \
                         and isinstance(e.binders[0][0][0], str):
+                    if any(isinstance(bv, tuple) and len(bv) == 2
+                           and bv[0] == "$slotv" for bv in bound.values()):
+                        # two dynamic binders would share the one traced
+                        # slot index and only explore diagonal pairs —
+                        # reject rather than silently drop transitions
+                        raise CompileError(
+                            "nested dynamic \\E binders not supported "
+                            "(one slot axis per action)")
                     # one vectorized instance: the kernel binds the slot
                     # element by a traced slot index and the engine vmaps
                     # over slots (keeps trace size O(1) in table capacity)

@@ -2061,19 +2061,32 @@ class CompiledAction2:
     n_slots: int = 0  # >0: fn takes a traced slot index in [0, n_slots)
 
 
-def _has_slotv(ga) -> bool:
+def _slotv_markers(ga) -> set:
+    """Identities of the distinct $slotv binder markers in a grounded
+    action (a binder's marker tuple is shared by reference across items)."""
+    markers = set()
     for item in ga.items:
         _, bound_env = item
         for v in bound_env.values():
             if isinstance(v, tuple) and len(v) == 2 and v[0] == "$slotv":
-                return True
-    return False
+                markers.add(id(v))
+    return markers
 
 
 def compile_action2(kc: KernelCtx, ga) -> CompiledAction2:
     layout = kc.layout
     vars = layout.vars
-    slotted = _has_slotv(ga)
+    markers = _slotv_markers(ga)
+    if len(markers) > 1:
+        # every $slotv resolves through the ONE traced slot index, so two
+        # distinct dynamic binders (nested or /\-conjoined sibling \E)
+        # would only explore equal-index pairs — reject rather than
+        # silently drop off-diagonal transitions (ground.py catches the
+        # nested form early; this catches the rest)
+        raise CompileError(
+            f"action {ga.label}: multiple dynamic \\E binders not "
+            f"supported (one slot axis per action)")
+    slotted = bool(markers)
 
     def fn(row, slot=None):
         state = {}

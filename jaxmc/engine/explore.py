@@ -185,9 +185,13 @@ class Explorer:
         last_progress = time.time()
         last_checkpoint = time.time()
 
-        def write_checkpoint():
+        def write_checkpoint(queue_head=(), generated_at=None,
+                             prints_at=None):
             # TLC-style periodic checkpoint (testout1:10; SURVEY.md §5):
-            # the full search state, resumable with --resume
+            # the full search state, resumable with --resume. A state whose
+            # expansion is in flight is re-queued at the head with
+            # `generated` rolled back to its pop, so resume re-expands it
+            # exactly once and full-run counts stay exact
             import pickle
             import os as _os
             tmp = self.checkpoint_path + ".tmp"
@@ -195,8 +199,13 @@ class Explorer:
                 pickle.dump(dict(module=model.module.name, vars=list(vars),
                                  states=states, parents=parents,
                                  labels=labels, depth_of=depth_of,
-                                 queue=list(queue), generated=generated,
-                                 diameter=diameter, prints=self.prints), fh)
+                                 queue=list(queue_head) + list(queue),
+                                 generated=generated if generated_at is None
+                                 else generated_at,
+                                 diameter=diameter,
+                                 seen_keys=list(seen.keys()),
+                                 prints=self.prints if prints_at is None
+                                 else self.prints[:prints_at]), fh)
             _os.replace(tmp, self.checkpoint_path)
             self.log(f"Checkpointing run to {self.checkpoint_path}")
 
@@ -264,8 +273,17 @@ class Explorer:
             queue.extend(ck["queue"])
             generated = ck["generated"]
             diameter = ck["diameter"]
-            for i, st in enumerate(states):
-                seen[_state_key(st, vars)] = i
+            # dedup keys must be symmetry-canonical, matching add_state.
+            # seen_keys stores them directly (in state-index order) so
+            # resume is a linear dict fill, not n re-canonicalizations
+            keys = ck.get("seen_keys")
+            if keys is not None and len(keys) == len(states):
+                for i, k in enumerate(keys):
+                    seen[k] = i
+            else:
+                for i, st in enumerate(states):
+                    seen[_state_key(canon(st) if canon is not None else st,
+                                    vars)] = i
             self.log(f"Resumed from {self.resume_from}: {len(states)} "
                      f"distinct states, {len(queue)} on queue.")
 
@@ -307,6 +325,8 @@ class Explorer:
             depth = depth_of[sid]
             diameter = max(diameter, depth)
             succ_count = 0
+            gen_at_pop = generated
+            prints_at_pop = len(self.prints)
             try:
                 for succ, label in enumerate_next(model.next, base_ctx, vars,
                                                   st):
@@ -341,7 +361,9 @@ class Explorer:
                     if self.max_states and len(states) >= self.max_states:
                         self.log("-- state limit reached, search truncated")
                         if self.checkpoint_path:
-                            write_checkpoint()
+                            write_checkpoint(queue_head=[sid],
+                                             generated_at=gen_at_pop,
+                                             prints_at=prints_at_pop)
                         return result(True, truncated=True)
             except TLCAssertFailure as ex:
                 trace = self._trace_to(sid, parents, states, labels)

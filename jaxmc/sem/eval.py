@@ -114,6 +114,10 @@ class RecFcn(Fcn):
             self._forced = True
             self._hash = None
 
+    def _materialized_items(self):
+        self._force_all()
+        return self._d.items()
+
     def domain(self):
         return frozenset(self._dom_list)
 

@@ -39,6 +39,11 @@ class ModelValue:
             cls._interned[name] = mv
         return mv
 
+    def __reduce__(self):
+        # re-intern on unpickle (checkpoint/resume); default pickling
+        # would call __new__ with no args and break identity equality
+        return (ModelValue, (self.name,))
+
     def __repr__(self):
         return self.name
 
@@ -99,6 +104,17 @@ class Fcn:
         if self._hash is None:
             self._hash = hash(frozenset(self._d.items()))
         return self._hash
+
+    def __reduce__(self):
+        # never pickle the cached hash: str/frozenset hashes are
+        # per-process (PYTHONHASHSEED), so a checkpointed hash is wrong
+        # in the resuming process and set/dict membership silently breaks.
+        # Rebuilding via __init__ also forces lazy subclasses (RecFcn)
+        # to a plain materialized Fcn, whose closures cannot pickle
+        return (Fcn, (list(self._materialized_items()),))
+
+    def _materialized_items(self):
+        return self._d.items()
 
     def __repr__(self):
         return fmt(self)

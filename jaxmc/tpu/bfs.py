@@ -43,6 +43,10 @@ from ..compile.ground import ground_actions
 SENTINEL = np.int32(2**31 - 1)
 FP_THRESHOLD = 48  # lanes; beyond this, dedup on 128-bit fingerprints
 
+SYMMETRY_WARNING = (
+    "cfg SYMMETRY NOT applied on the jax backend: counts are "
+    "unreduced and will exceed the interp/TLC reduced counts")
+
 _FP_MIX = [(0x9E3779B1, 0x85EBCA6B), (0xC2B2AE35, 0x27D4EB2F),
            (0x165667B1, 0x9E3779B1), (0x85EBCA6B, 0xC2B2AE35)]
 
@@ -331,6 +335,8 @@ class TpuExplorer:
             warnings.append(
                 "temporal properties NOT checked on the jax backend: "
                 + ", ".join(n for n, _ in model.properties))
+        if model.symmetry is not None:
+            warnings.append(SYMMETRY_WARNING)
 
         rows = {}
         for st in self.init_states:
@@ -503,6 +509,8 @@ class TpuExplorer:
             names = ", ".join(n for n, _ in model.properties)
             warnings.append(
                 f"temporal properties NOT checked (unimplemented): {names}")
+        if model.symmetry is not None:
+            warnings.append(SYMMETRY_WARNING)
         if self.fp_mode:
             warnings.append(
                 "wide state (W={}): dedup on 128-bit fingerprints; "

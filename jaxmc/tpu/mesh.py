@@ -33,7 +33,7 @@ from ..sem.enumerate import enumerate_init
 from ..engine.explore import CheckResult, Violation
 from ..compile.ground import CompileError, build_layout, ground_actions
 from ..compile.kernel import compile_action, compile_predicate
-from .bfs import SENTINEL, _pow2_at_least
+from .bfs import SENTINEL, SYMMETRY_WARNING, _pow2_at_least
 
 
 def _row_hash(rows, xp=jnp):
@@ -195,6 +195,8 @@ class MeshExplorer:
         if model.properties:
             warnings.append("temporal properties NOT checked (unimplemented)"
                             f": {', '.join(n for n, _ in model.properties)}")
+        if model.symmetry is not None:
+            warnings.append(SYMMETRY_WARNING)
 
         # encode + host-dedup init states, distribute by owner hash
         rows = {}

@@ -305,6 +305,52 @@ class TestCheckpoint:
         assert r2.distinct == 3800
         assert r2.generated == 5850
 
+    def test_checkpoint_resume_with_symmetry(self, tmp_path):
+        # the resumed seen-set must be rebuilt with symmetry-canonical
+        # keys, or known states get re-added after resume (inflated counts)
+        spec = tmp_path / "symm.tla"
+        spec.write_text(TestSymmetry.SYMM)
+        ckpt = str(tmp_path / "symm.ckpt")
+
+        def model():
+            cfg = ModelConfig(init="Init", next="Next", check_deadlock=False,
+                              symmetry="Sym")
+            cfg.constants["Proc"] = frozenset(
+                {CfgModelValue("p1"), CfgModelValue("p2")})
+            return bind_model(Loader([]).load_path(str(spec)), cfg)
+
+        r1 = Explorer(model(), max_states=3, checkpoint_path=ckpt,
+                      checkpoint_every=0.0).run()
+        assert r1.truncated and os.path.exists(ckpt)
+        r2 = Explorer(model(), resume_from=ckpt).run()
+        assert r2.ok
+        assert r2.distinct == 6   # == the unresumed symmetric run
+
+    def test_checkpoint_resume_cross_process(self, tmp_path):
+        # checkpoints must survive a process boundary: str/frozenset hashes
+        # are per-process, so pickled values must not carry cached hashes,
+        # and interned ModelValues must re-intern (MCPaxos states hold both)
+        import subprocess
+        import sys
+        ckpt = str(tmp_path / "mcpaxos.ckpt")
+        d = os.path.join(REFERENCE, "examples/Paxos")
+        base = [sys.executable, "-m", "jaxmc", "check",
+                os.path.join(d, "MCPaxos.tla"),
+                "--cfg", os.path.join(d, "MCPaxos.cfg")]
+        env = {**os.environ, "JAX_PLATFORMS": "cpu",
+               "PYTHONPATH": os.path.dirname(os.path.dirname(
+                   os.path.abspath(__file__)))}
+        r1 = subprocess.run(base + ["--max-states", "10", "--checkpoint",
+                                    ckpt, "--checkpoint-every", "0"],
+                            capture_output=True, text=True, env=env)
+        assert "TRUNCATED" in r1.stdout, r1.stdout + r1.stderr
+        r2 = subprocess.run(base + ["--resume", ckpt],
+                            capture_output=True, text=True, env=env)
+        # exact full-run counts (the pinned unresumed run: 82/25)
+        assert "82 states generated, 25 distinct" in r2.stdout, \
+            r2.stdout + r2.stderr
+        assert "No error has been found" in r2.stdout
+
 
 class TestSimulate:
     def test_simulate_finds_assert(self):

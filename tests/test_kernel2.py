@@ -98,3 +98,47 @@ def test_kernel_matches_interp_raft_tiny():
         ks, ov = kernel_successors(ex, st)
         assert not ov, "capacity overflow on sampled state"
         assert ks == interp_successors(model, st)
+
+
+def test_nested_dynamic_exists_rejected(tmp_path):
+    # two dynamic \E binders would share the one traced slot index and
+    # silently explore only diagonal (i == j) pairs — the compiler must
+    # reject instead (exactness contract: compile exactly or not at all)
+    from jaxmc.compile.ground import CompileError
+    from jaxmc.tpu.bfs import TpuExplorer
+    spec = tmp_path / "nested_dyn.tla"
+    spec.write_text(r"""---- MODULE nested_dyn ----
+EXTENDS Naturals, Sequences
+VARIABLE q
+Init == q = <<1, 2>>
+Next == \E i \in 1..Len(q) : \E j \in 1..Len(q) :
+          q' = [q EXCEPT ![i] = ((q[j] + 1) % 3)]
+====
+""")
+    model = bind_model(Loader([]).load_path(str(spec)),
+                       ModelConfig(init="Init", next="Next",
+                                   check_deadlock=False))
+    with pytest.raises(CompileError, match="nested dynamic"):
+        TpuExplorer(model, store_trace=False)
+
+
+def test_sibling_dynamic_exists_rejected(tmp_path):
+    # /\-conjoined sibling dynamic \E binders also land in one grounded
+    # action with distinct $slotv markers — same diagonal-only hazard as
+    # the nested form, caught at action-compile time
+    from jaxmc.compile.ground import CompileError
+    from jaxmc.tpu.bfs import TpuExplorer
+    spec = tmp_path / "sibling_dyn.tla"
+    spec.write_text(r"""---- MODULE sibling_dyn ----
+EXTENDS Naturals, Sequences
+VARIABLE q
+Init == q = <<1, 2>>
+Next == (\E i \in 1..Len(q) : q[i] < 9)
+        /\ (\E j \in 1..Len(q) : q' = [q EXCEPT ![j] = ((q[j] + 1) % 3)])
+====
+""")
+    model = bind_model(Loader([]).load_path(str(spec)),
+                       ModelConfig(init="Init", next="Next",
+                                   check_deadlock=False))
+    with pytest.raises(CompileError, match="dynamic"):
+        TpuExplorer(model, store_trace=False)
