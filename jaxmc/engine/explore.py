@@ -203,7 +203,7 @@ class Explorer:
                                  generated=generated if generated_at is None
                                  else generated_at,
                                  diameter=diameter,
-                                 seen_keys=list(seen.keys()),
+                                 seen_items=list(seen.items()),
                                  prints=self.prints if prints_at is None
                                  else self.prints[:prints_at]), fh)
             _os.replace(tmp, self.checkpoint_path)
@@ -211,14 +211,22 @@ class Explorer:
 
         canon = make_canonicalizer(model)
 
+        VIOL = -1  # seen-value for constraint-violating states: TLC (1.57,
+        # testout2:265 — 195 distinct) discards them entirely: fingerprinted
+        # so they are not re-processed, but never counted as distinct,
+        # never invariant-checked, never explored (Specifying Systems §14)
+
         def add_state(st, parent, label, depth):
-            # dedup on the symmetry-canonical key but store the state as
-            # reached, so counterexample traces remain genuine behaviors
-            nonlocal generated
+            """Returns (sid | None, new). sid None = discarded by
+            CONSTRAINT; new is True the first time any state (kept or
+            discarded) is seen."""
             key = _state_key(canon(st) if canon is not None else st, vars)
             sid = seen.get(key)
             if sid is not None:
-                return sid, False
+                return (None if sid == VIOL else sid), False
+            if not self._satisfies_constraints(st):
+                seen[key] = VIOL
+                return None, True
             sid = len(states)
             seen[key] = sid
             states.append(st)
@@ -274,16 +282,17 @@ class Explorer:
             generated = ck["generated"]
             diameter = ck["diameter"]
             # dedup keys must be symmetry-canonical, matching add_state.
-            # seen_keys stores them directly (in state-index order) so
-            # resume is a linear dict fill, not n re-canonicalizations
-            keys = ck.get("seen_keys")
-            if keys is not None and len(keys) == len(states):
-                for i, k in enumerate(keys):
-                    seen[k] = i
-            else:
-                for i, st in enumerate(states):
-                    seen[_state_key(canon(st) if canon is not None else st,
-                                    vars)] = i
+            # seen_items stores (key, sid-or-VIOL) directly so resume is a
+            # linear dict fill — no re-canonicalization, and discarded
+            # (constraint-violating) fingerprints survive the checkpoint.
+            # Checkpoints without seen_items predate this format (their
+            # pickled values also carry stale per-process hashes) — reject
+            items = ck.get("seen_items")
+            if items is None:
+                raise EvalError(
+                    f"cannot resume: {self.resume_from} was written by an "
+                    f"incompatible jaxmc version (no seen_items)")
+            seen.update(items)
             self.log(f"Resumed from {self.resume_from}: {len(states)} "
                      f"distinct states, {len(queue)} on queue.")
 
@@ -297,8 +306,10 @@ class Explorer:
             sid, new = add_state(st, None, "Initial predicate", 0)
             if not new:
                 continue
-            init_count += 1
             generated += 1
+            if sid is None:
+                continue  # discarded by CONSTRAINT
+            init_count += 1
             bad = self._check_state_preds(st)
             if bad is not None:
                 return result(False, Violation(
@@ -311,8 +322,7 @@ class Explorer:
                         self._trace_to(sid, parents, states, labels),
                         f"initial state violates {rc.name}'s initial "
                         f"predicate"))
-            if self._satisfies_constraints(st):
-                queue.append(sid)
+            queue.append(sid)
         if not self.resume_from:
             self.log(f"Finished computing initial states: {init_count} "
                      f"distinct state{'s' if init_count != 1 else ''} "
@@ -337,6 +347,8 @@ class Explorer:
                         continue
                     nid, new = add_state(succ, sid, label_str(label),
                                          depth + 1)
+                    if nid is None:
+                        continue  # discarded by CONSTRAINT (not checked)
                     for rc in refiners:
                         if not rc.check_edge(st, succ):
                             trace = self._trace_to(sid, parents, states,
@@ -356,8 +368,7 @@ class Explorer:
                         return result(False, Violation(
                             "invariant", bad,
                             self._trace_to(nid, parents, states, labels)))
-                    if self._satisfies_constraints(succ):
-                        queue.append(nid)
+                    queue.append(nid)
                     if self.max_states and len(states) >= self.max_states:
                         self.log("-- state limit reached, search truncated")
                         if self.checkpoint_path:

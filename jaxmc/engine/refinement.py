@@ -101,7 +101,7 @@ class RefinementChecker:
             ctx = inst.enter(ctx, [])
             # keep outer state/primes visible through the chain
             ctx = Ctx(ctx.defs, ctx.bound, state, primes, self.model.vars,
-                      ctx.on_print)
+                      ctx.on_print, ctx.memo)
         return ctx
 
     def check_init(self, state: Dict[str, Any]) -> bool:

@@ -39,9 +39,9 @@ class Walker:
     def _ctx(self, base: Ctx, partial: Dict[str, Any]) -> Ctx:
         if self.mode == "init":
             return Ctx(base.defs, base.bound, partial, None, self.var_order,
-                       base.on_print)
+                       base.on_print, base.memo)
         return Ctx(base.defs, base.bound, self.state, partial, self.var_order,
-                   base.on_print)
+                   base.on_print, base.memo)
 
     def _target(self, e: A.Node, ctx: Ctx) -> Optional[str]:
         """Variable name if e is an assignable occurrence in this mode."""
@@ -133,7 +133,7 @@ class Walker:
                 inner = ctx
                 if target.defs is not None:
                     inner = Ctx(target.defs, ctx.bound, ctx.state, ctx.primes,
-                                ctx.vars, ctx.on_print)
+                                ctx.vars, ctx.on_print, ctx.memo)
                 inner = inner.with_bound(
                     {**target.bound, **dict(zip(target.params, args))})
                 new_label = label
@@ -150,7 +150,7 @@ class Walker:
                 inner = ctx
                 if target.defs is not None:
                     inner = Ctx(target.defs, ctx.bound, ctx.state, ctx.primes,
-                                ctx.vars, ctx.on_print)
+                                ctx.vars, ctx.on_print, ctx.memo)
                 if target.bound:
                     inner = inner.with_bound(target.bound)
                 new_label = label
@@ -238,7 +238,7 @@ class Walker:
                 inner = ctx
                 if target.defs is not None:
                     inner = Ctx(target.defs, ctx.bound, ctx.state, ctx.primes,
-                                ctx.vars, ctx.on_print)
+                                ctx.vars, ctx.on_print, ctx.memo)
                 return self._unchanged(target.body, inner, partial)
             raise EvalError(f"UNCHANGED of non-variable {e.name}")
         if isinstance(e, A.TupleExpr):

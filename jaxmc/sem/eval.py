@@ -36,6 +36,9 @@ class OpClosure:
     body: A.Node
     bound: Dict[str, Any] = field(default_factory=dict)
     defs: Optional[Dict[str, Any]] = None  # module defs snapshot (instances)
+    # True only for module-level definitions built once per loaded module
+    # (Loader.build) — the closures eligible for memoization (sem/memo.py)
+    stable: bool = field(default=False, compare=False)
 
 
 @dataclass
@@ -48,25 +51,27 @@ class BuiltinOp:
 
 class Ctx:
     """Evaluation context: definition table, bound variables, state."""
-    __slots__ = ("defs", "bound", "state", "primes", "vars", "on_print")
+    __slots__ = ("defs", "bound", "state", "primes", "vars", "on_print",
+                 "memo")
 
     def __init__(self, defs, bound=None, state=None, primes=None, vars=(),
-                 on_print=None):
+                 on_print=None, memo=None):
         self.defs = defs          # name -> OpClosure | BuiltinOp | value
         self.bound = bound or {}  # name -> value (quantifier/param bindings)
         self.state = state        # name -> value, None outside behaviors
         self.primes = primes      # name -> value (partial during enumeration)
         self.vars = vars          # declared VARIABLE names
         self.on_print = on_print  # callback for TLC Print
+        self.memo = memo          # per-model MemoStore (sem/memo.py) or None
 
     def with_bound(self, extra: Dict[str, Any]) -> "Ctx":
         c = Ctx(self.defs, {**self.bound, **extra}, self.state, self.primes,
-                self.vars, self.on_print)
+                self.vars, self.on_print, self.memo)
         return c
 
     def with_defs(self, extra: Dict[str, Any]) -> "Ctx":
         c = Ctx({**self.defs, **extra}, self.bound, self.state, self.primes,
-                self.vars, self.on_print)
+                self.vars, self.on_print, self.memo)
         return c
 
 
@@ -226,13 +231,28 @@ def _resolve(name: str, ctx: Ctx):
     raise EvalError(f"unknown identifier {name}")
 
 
+_MISS = object()
+
+
 def _force(v, ctx, name=""):
     """Resolve a definition reference to a value (apply zero-arg closures)."""
     if isinstance(v, OpClosure):
         if v.params:
             return v  # operator value (can be passed higher-order)
+        store = ctx.memo
+        if store is not None and v.stable and not v.bound:
+            from .memo import memo_key  # late import (module cycle)
+            key = memo_key(store, v, ctx.defs, ctx)
+            if key is not None:
+                hit = store.vals.get(key, _MISS)
+                if hit is not _MISS:
+                    return hit
+                val = eval_expr(v.body, ctx)
+                store.put(key, val)
+                return val
         inner = ctx if v.defs is None else Ctx(v.defs, ctx.bound, ctx.state,
-                                               ctx.primes, ctx.vars, ctx.on_print)
+                                               ctx.primes, ctx.vars,
+                                               ctx.on_print, ctx.memo)
         if v.bound:
             inner = inner.with_bound(v.bound)
         if isinstance(v.body, A.FnConstrDef):
@@ -270,7 +290,8 @@ def _ev_prime(e, ctx):
         # prime distributes over state expressions; evaluate in primed context
         if ctx.primes is None:
             raise EvalError("primed expression outside an action")
-        sub = Ctx(ctx.defs, ctx.bound, ctx.primes, None, ctx.vars, ctx.on_print)
+        sub = Ctx(ctx.defs, ctx.bound, ctx.primes, None, ctx.vars,
+                  ctx.on_print, ctx.memo)
         return eval_expr(e.expr, sub)
     name = e.expr.name
     if ctx.primes is None:
@@ -281,7 +302,8 @@ def _ev_prime(e, ctx):
         return ctx.primes[name]
     # primed DEFINITION (opId', InnerSerial.tla:6): evaluate its body with
     # the primed state as the state
-    sub = Ctx(ctx.defs, ctx.bound, ctx.primes, None, ctx.vars, ctx.on_print)
+    sub = Ctx(ctx.defs, ctx.bound, ctx.primes, None, ctx.vars, ctx.on_print,
+              ctx.memo)
     return eval_expr(e.expr, sub)
 
 
@@ -292,9 +314,21 @@ def apply_op(opv, args: List[Any], ctx: Ctx):
         if len(opv.params) != len(args):
             raise EvalError(f"{opv.name} expects {len(opv.params)} args, "
                             f"got {len(args)}")
+        store = ctx.memo
+        if store is not None and opv.stable and not opv.bound and args:
+            from .memo import memo_key  # late import (module cycle)
+            key = memo_key(store, opv, ctx.defs, ctx, tuple(args))
+            if key is not None:
+                hit = store.vals.get(key, _MISS)
+                if hit is not _MISS:
+                    return hit
+                inner = ctx.with_bound(dict(zip(opv.params, args)))
+                val = eval_expr(opv.body, inner)
+                store.put(key, val)
+                return val
         base = ctx if opv.defs is None else Ctx(opv.defs, ctx.bound, ctx.state,
                                                 ctx.primes, ctx.vars,
-                                                ctx.on_print)
+                                                ctx.on_print, ctx.memo)
         inner = base.with_bound({**opv.bound, **dict(zip(opv.params, args))})
         return eval_expr(opv.body, inner)
     raise EvalError(f"value {fmt(opv)} is not an operator")

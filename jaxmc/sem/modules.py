@@ -81,7 +81,7 @@ class InstanceNamespace:
         for p, v in zip(self.params, argvals):
             defs[p] = v
         return Ctx(defs, outer.bound, outer.state, outer.primes, outer.vars,
-                   outer.on_print)
+                   outer.on_print, outer.memo)
 
     def __repr__(self):
         return f"<instance of {self.module.name}>"
@@ -149,9 +149,10 @@ class Loader:
             elif isinstance(u, A.Variables):
                 m.variables.extend(u.names)
             elif isinstance(u, A.OpDef):
-                defs[u.name] = OpClosure(u.name, u.params, u.body)
+                defs[u.name] = OpClosure(u.name, u.params, u.body,
+                                         stable=True)
             elif isinstance(u, A.FnConstrDef):
-                defs[u.name] = OpClosure(u.name, (), u)
+                defs[u.name] = OpClosure(u.name, (), u, stable=True)
             elif isinstance(u, A.InstanceDef):
                 if u.name is None:
                     if u.module in NATIVE_MODULES:
@@ -193,9 +194,16 @@ class Model:
     vars: Tuple[str, ...]
     defs: Dict[str, Any]
     check_deadlock: bool = True
+    _memo: Any = field(default=None, repr=False, compare=False)
 
     def ctx(self, state=None, primes=None, on_print=None) -> Ctx:
-        return Ctx(self.defs, {}, state, primes, self.vars, on_print)
+        # one MemoStore per model: operator results are keyed by dependency
+        # values, and constants differ between models (sem/memo.py)
+        if self._memo is None:
+            from .memo import MemoStore
+            self._memo = MemoStore(self.defs)
+        return Ctx(self.defs, {}, state, primes, self.vars, on_print,
+                   self._memo)
 
 
 def _cfg_value(v):

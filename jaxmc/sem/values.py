@@ -57,12 +57,13 @@ class ModelValue:
 class Fcn:
     """Immutable TLA+ function. Sequences are functions with domain 1..n,
     records functions with string domain — all compare uniformly."""
-    __slots__ = ("_d", "_hash")
+    __slots__ = ("_d", "_hash", "_sk")
 
     def __init__(self, mapping: Iterable):
         d = dict(mapping)
         self._d = d
         self._hash = None
+        self._sk = None  # cached sort_key (never pickled — see __reduce__)
 
     @property
     def d(self) -> dict:
@@ -231,12 +232,25 @@ def in_set(v, s) -> bool:
     raise EvalError(f"\\in applied to non-set {fmt(s)}")
 
 
+_ENUM_CACHE: Dict[frozenset, List[Any]] = {}
+_ENUM_CACHE_CAP = 1 << 16
+
+
 def enumerate_set(s) -> List[Any]:
-    """Deterministically ordered elements; raises on infinite sets."""
+    """Deterministically ordered elements; raises on infinite sets.
+
+    Results for frozensets are cached (values are immutable and equal sets
+    enumerate identically) — callers must NOT mutate the returned list."""
     if isinstance(s, FcnSetV):
         return sorted(s.materialize(), key=sort_key)
     if isinstance(s, frozenset):
-        return sorted(s, key=sort_key)
+        hit = _ENUM_CACHE.get(s)
+        if hit is None:
+            if len(_ENUM_CACHE) >= _ENUM_CACHE_CAP:
+                _ENUM_CACHE.clear()
+            hit = sorted(s, key=sort_key)
+            _ENUM_CACHE[s] = hit
+        return hit
     if isinstance(s, InfiniteSet):
         raise EvalError(f"cannot enumerate infinite set {s!r}")
     raise EvalError(f"expected a set, got {fmt(s)}")
@@ -257,11 +271,15 @@ def sort_key(v):
     if t is ModelValue:
         return (3, v.name)
     if t is frozenset:
-        return (4, len(v), tuple(sort_key(x) for x in sorted(v, key=sort_key)))
+        return (4, len(v), tuple(sort_key(x) for x in enumerate_set(v)))
     if t is Fcn:
-        items = sorted(v.d.items(), key=lambda kv: sort_key(kv[0]))
-        return (5, len(items),
-                tuple((sort_key(k), sort_key(x)) for k, x in items))
+        sk = v._sk
+        if sk is None:
+            items = sorted(v.d.items(), key=lambda kv: sort_key(kv[0]))
+            sk = (5, len(items),
+                  tuple((sort_key(k), sort_key(x)) for k, x in items))
+            v._sk = sk
+        return sk
     if t is InfiniteSet:
         return (6, v.kind)
     if t is FcnSetV:

@@ -244,20 +244,10 @@ class TpuExplorer:
             seen2 = jnp.stack(comp3[1:], axis=1)[:SC]
             seen_count2 = jnp.sum(keep)
 
-            # invariants over the new distinct states
-            inv_bad_any = jnp.asarray(False)
-            inv_bad_idx = jnp.asarray(0, jnp.int32)
-            inv_bad_which = jnp.asarray(-1, jnp.int32)
-            for wi, (nm, f) in enumerate(inv_fns):
-                ok = jax.vmap(f)(new_rows)
-                bad = nvalid & ~ok
-                any_ = jnp.any(bad)
-                idx = jnp.argmax(bad)
-                first = jnp.logical_and(any_, ~inv_bad_any)
-                inv_bad_idx = jnp.where(first, idx, inv_bad_idx)
-                inv_bad_which = jnp.where(first, wi, inv_bad_which)
-                inv_bad_any = inv_bad_any | any_
-            # constraints: violating states stay in seen but leave search
+            # constraints FIRST: violating states are fingerprinted (they
+            # are in seen2 above) but discarded — never counted distinct,
+            # never invariant-checked, never explored. TLC semantics,
+            # pinned by the golden run (testout2:265, 195 distinct)
             explore = nvalid
             for nm, f in con_fns:
                 explore = explore & jax.vmap(f)(new_rows)
@@ -268,12 +258,25 @@ class TpuExplorer:
             perm4 = comp4[1]
             front_rows = jnp.take(new_rows, perm4, axis=0)
             front_prov = jnp.take(new_prov, perm4)
+            frontvalid = jnp.arange(C) < explore_count
+
+            # invariants over the kept (explored) states only
+            inv_bad_any = jnp.asarray(False)
+            inv_bad_idx = jnp.asarray(0, jnp.int32)
+            inv_bad_which = jnp.asarray(-1, jnp.int32)
+            for wi, (nm, f) in enumerate(inv_fns):
+                ok = jax.vmap(f)(front_rows)
+                bad = frontvalid & ~ok
+                any_ = jnp.any(bad)
+                idx = jnp.argmax(bad)
+                first = jnp.logical_and(any_, ~inv_bad_any)
+                inv_bad_idx = jnp.where(first, idx, inv_bad_idx)
+                inv_bad_which = jnp.where(first, wi, inv_bad_which)
+                inv_bad_any = inv_bad_any | any_
 
             return dict(gen=gen, dead=dead, assert_bad=assert_bad,
                         overflow=jnp.any(overflow),
                         seen=seen2, seen_count=seen_count2,
-                        new_rows=new_rows, new_prov=new_prov,
-                        new_count=new_count,
                         front_rows=front_rows, front_prov=front_prov,
                         front_count=explore_count,
                         inv_bad_any=inv_bad_any, inv_bad_idx=inv_bad_idx,
@@ -346,24 +349,27 @@ class TpuExplorer:
             if rows else np.zeros((0, W), np.int32)
         n_init = len(init_rows)
         generated = n_init
-        distinct = n_init
-        self.log(f"Finished computing initial states: {n_init} distinct "
-                 f"state{'s' if n_init != 1 else ''} generated.")
 
+        # constraint-violating init states are fingerprinted but discarded:
+        # not distinct, not invariant-checked, not explored (TLC semantics)
         from ..sem.eval import eval_expr, _bool
         explored_init = []
         for i, row in enumerate(init_rows):
             st = layout.decode(row)
             ctx = model.ctx(state=st)
+            if not all(_bool(eval_expr(ex, ctx), f"constraint {nm}")
+                       for nm, ex in model.constraints):
+                continue
             for nm, ex in model.invariants:
                 if not _bool(eval_expr(ex, ctx), f"invariant {nm}"):
                     return self._mk_result(
-                        False, distinct, generated, 0, t0, warnings,
-                        Violation("invariant", nm,
-                                  [(st, "Initial predicate")]))
-            if all(_bool(eval_expr(ex, ctx), f"constraint {nm}")
-                   for nm, ex in model.constraints):
-                explored_init.append(i)
+                        False, len(explored_init) + 1, generated, 0, t0,
+                        warnings, Violation("invariant", nm,
+                                            [(st, "Initial predicate")]))
+            explored_init.append(i)
+        distinct = len(explored_init)
+        self.log(f"Finished computing initial states: {distinct} distinct "
+                 f"state{'s' if distinct != 1 else ''} generated.")
 
         store = native_store.FingerprintStore()
         init_keys = np.asarray(self._keys_of(
@@ -426,7 +432,10 @@ class TpuExplorer:
                 valid_idx = np.nonzero(cvalid)[0]
                 new_mask = store.insert(keys[valid_idx][:, 1:])
                 new_idx = valid_idx[new_mask]
-                distinct += len(new_idx)
+                # discarded (constraint-violating) states are in the store
+                # (fingerprinted) but never counted distinct, checked, or
+                # explored — TLC semantics (testout2:265)
+                distinct += int(explore[new_idx].sum())
                 if not len(new_idx):
                     continue
                 rows_np = np.asarray(jnp.take(
@@ -437,9 +446,10 @@ class TpuExplorer:
                 a_ids = new_idx // CH
                 f_ids = new_idx % CH
                 prov_global = a_ids * L + (base + f_ids)
-                if inv_hit is None and not inv_ok[new_idx].all():
+                bad_mask = (~inv_ok[new_idx]) & explore[new_idx]
+                if inv_hit is None and bad_mask.any():
                     off = sum(len(r) for r in lvl_new_rows)
-                    badpos = int(np.nonzero(~inv_ok[new_idx])[0][0])
+                    badpos = int(np.nonzero(bad_mask)[0][0])
                     inv_hit = off + badpos
                 lvl_new_rows.append(rows_np)
                 lvl_new_prov.append(prov_global.astype(np.int64))
@@ -524,25 +534,28 @@ class TpuExplorer:
             if rows else np.zeros((0, W), np.int32)
         n_init = len(init_rows)
         generated = n_init
-        distinct = n_init
-        self.log(f"Finished computing initial states: {n_init} distinct "
-                 f"state{'s' if n_init != 1 else ''} generated.")
 
-        # invariants + constraints on init states (host-side interpreter)
+        # constraints + invariants on init states (host-side interpreter);
+        # constraint-violating inits are fingerprinted but discarded: not
+        # distinct, not invariant-checked, not explored (TLC semantics)
         from ..sem.eval import eval_expr, _bool
         explored_init = []
         for i, row in enumerate(init_rows):
             st = layout.decode(row)
             ctx = model.ctx(state=st)
+            if not all(_bool(eval_expr(ex, ctx), f"constraint {nm}")
+                       for nm, ex in model.constraints):
+                continue
             for nm, ex in model.invariants:
                 if not _bool(eval_expr(ex, ctx), f"invariant {nm}"):
                     return self._mk_result(
-                        False, distinct, generated, 0, t0, warnings,
-                        Violation("invariant", nm,
-                                  [(st, "Initial predicate")]))
-            if all(_bool(eval_expr(ex, ctx), f"constraint {nm}")
-                   for nm, ex in model.constraints):
-                explored_init.append(i)
+                        False, len(explored_init) + 1, generated, 0, t0,
+                        warnings, Violation("invariant", nm,
+                                            [(st, "Initial predicate")]))
+            explored_init.append(i)
+        distinct = len(explored_init)
+        self.log(f"Finished computing initial states: {distinct} distinct "
+                 f"state{'s' if distinct != 1 else ''} generated.")
 
         FC = _pow2_at_least(max(n_init, 1))
         SC = _pow2_at_least(4 * max(n_init, 1))
@@ -608,17 +621,21 @@ class TpuExplorer:
                     False, distinct, generated, depth, t0, warnings,
                     Violation("deadlock", "deadlock", trace))
 
-            new_count = int(out["new_count"])
+            front_count = int(out["front_count"])
             generated += int(out["gen"])
-            distinct += new_count
+            distinct += front_count  # kept states only (discards excluded)
             seen = out["seen"]
             seen_count = int(out["seen_count"])
 
             if self.store_trace:
-                new_rows_h = np.asarray(out["new_rows"][:max(new_count, 1)])
-                new_prov_h = np.asarray(out["new_prov"][:max(new_count, 1)])
+                # trace levels hold the kept states; every kept state is
+                # explored, so the frontier map is the identity
+                fr_h = np.asarray(out["front_rows"][:max(front_count, 1)])
+                fp_h = np.asarray(out["front_prov"][:max(front_count, 1)])
                 trace_levels.append(
-                    (new_rows_h[:new_count], new_prov_h[:new_count], FC))
+                    (fr_h[:front_count], fp_h[:front_count], FC))
+                frontier_maps.append(
+                    np.arange(front_count, dtype=np.int64))
             if bool(out["inv_bad_any"]):
                 idx = int(out["inv_bad_idx"])
                 which = int(out["inv_bad_which"])
@@ -628,15 +645,6 @@ class TpuExplorer:
                 return self._mk_result(
                     False, distinct, generated, depth + 1, t0, warnings,
                     Violation("invariant", nm, trace))
-
-            front_count = int(out["front_count"])
-            if self.store_trace:
-                fp = np.asarray(out["front_prov"][:max(front_count, 1)])
-                npv = np.asarray(out["new_prov"][:max(new_count, 1)])
-                pos = {int(p): i for i, p in enumerate(npv[:new_count])}
-                frontier_maps.append(
-                    np.asarray([pos[int(p)] for p in fp[:front_count]],
-                               dtype=np.int64))
             depth += 1
 
             if self.max_states and distinct >= self.max_states:

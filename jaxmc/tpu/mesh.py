@@ -147,10 +147,10 @@ class MeshExplorer:
             seen2 = jnp.stack(comp3[1:], axis=1)[:SC]
             seen_count2 = jnp.sum(keep)
 
-            inv_bad = jnp.asarray(False)
+            # constraints FIRST: violating states stay fingerprinted in the
+            # seen shard but are discarded — not distinct, not checked, not
+            # explored (TLC semantics, testout2:265)
             nvalid = jnp.arange(new_rows.shape[0]) < new_count
-            for nm, f in inv_fns:
-                inv_bad = inv_bad | jnp.any(nvalid & ~jax.vmap(f)(new_rows))
             explore = nvalid
             for nm, f in con_fns:
                 explore = explore & jax.vmap(f)(new_rows)
@@ -159,10 +159,15 @@ class MeshExplorer:
             comp4 = lax.sort(ops4, num_keys=1, is_stable=True)
             front_rows = jnp.stack(comp4[1:], axis=1)[:max(G, 1)]
             front_count = jnp.sum(explore)
+            frontvalid = jnp.arange(front_rows.shape[0]) < front_count
+            inv_bad = jnp.asarray(False)
+            for nm, f in inv_fns:
+                inv_bad = inv_bad | jnp.any(frontvalid &
+                                            ~jax.vmap(f)(front_rows))
 
             # global reductions over ICI
             tot_gen = lax.psum(gen_local, "d")
-            tot_new = lax.psum(new_count, "d")
+            tot_new = lax.psum(front_count, "d")
             any_dead = lax.psum(dead_local.astype(jnp.int32), "d") > 0
             any_assert = lax.psum(assert_bad.astype(jnp.int32), "d") > 0
             any_inv = lax.psum(inv_bad.astype(jnp.int32), "d") > 0
@@ -207,25 +212,28 @@ class MeshExplorer:
             else np.zeros((0, W), np.int32)
         n_init = len(init_rows)
         generated = n_init
-        distinct = n_init
-        self.log(f"Finished computing initial states: {n_init} distinct "
-                 f"state{'s' if n_init != 1 else ''} generated.")
 
-        # invariants + constraints on init states (host-side interpreter)
+        # constraints + invariants on init states (host-side interpreter);
+        # constraint-violating inits are fingerprinted but discarded: not
+        # distinct, not invariant-checked, not explored (TLC semantics)
         from ..sem.eval import eval_expr, _bool
         explored_mask = np.ones(n_init, bool)
         for i, row in enumerate(init_rows):
             st = layout.decode(row)
             ctx = model.ctx(state=st)
-            for nm, ex2 in model.invariants:
-                if not _bool(eval_expr(ex2, ctx), f"invariant {nm}"):
-                    return self._mk(False, distinct, generated, 0, t0,
-                                    warnings, Violation(
-                                        "invariant", nm,
-                                        [(st, "Initial predicate")]))
             if not all(_bool(eval_expr(ex2, ctx), f"constraint {nm}")
                        for nm, ex2 in model.constraints):
                 explored_mask[i] = False
+                continue
+            for nm, ex2 in model.invariants:
+                if not _bool(eval_expr(ex2, ctx), f"invariant {nm}"):
+                    return self._mk(False, int(explored_mask[:i + 1].sum()),
+                                    generated, 0, t0, warnings, Violation(
+                                        "invariant", nm,
+                                        [(st, "Initial predicate")]))
+        distinct = int(explored_mask.sum())
+        self.log(f"Finished computing initial states: {distinct} distinct "
+                 f"state{'s' if distinct != 1 else ''} generated.")
 
         owner = (_row_hash(init_rows, xp=np) % np.uint32(D)).astype(np.int64)
 

@@ -1,0 +1,15 @@
+---------------------------- MODULE MCraftMicro ----------------------------
+\* A raft model small enough to run to COMPLETION on every backend — the
+\* whole-run count-equality fixture (BASELINE.json "identical reachable-state
+\* count"). Extends the MCraft shim (itself extending the reference raft,
+\* /root/reference/examples/raft.tla) with a bound on the message-bag domain:
+\* raft's WithMessage (raft.tla:117-121) grows DOMAIN messages without bound
+\* even at MaxTerm=2/MaxLogLen=1, which is why MCraft_tiny never finishes.
+\* Bounding the domain cardinality is the standard TLC trick for making the
+\* bag finite (same idiom as qConstraint, MCInnerFIFO.cfg).
+EXTENDS MCraft, FiniteSets
+
+CONSTANT MaxMsgDomain
+
+MsgConstraint == Cardinality(DOMAIN messages) <= MaxMsgDomain
+=============================================================================
