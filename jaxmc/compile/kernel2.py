@@ -2061,16 +2061,44 @@ class CompiledAction2:
     n_slots: int = 0  # >0: fn takes a traced slot index in [0, n_slots)
 
 
-def _slotv_markers(ga) -> set:
-    """Identities of the distinct $slotv binder markers in a grounded
-    action (a binder's marker tuple is shared by reference across items)."""
-    markers = set()
+def _slotv_markers(ga) -> dict:
+    """The distinct $slotv binder markers in a grounded action, keyed by
+    identity (a binder's marker tuple is shared by reference across items),
+    each mapped to one bound_env it appears in (for slot-count probing)."""
+    markers = {}
     for item in ga.items:
         _, bound_env = item
         for v in bound_env.values():
             if isinstance(v, tuple) and len(v) == 2 and v[0] == "$slotv":
-                markers.add(id(v))
+                markers[id(v)] = (v, bound_env)
     return markers
+
+
+def _probe_slot_count(kc: KernelCtx, sexpr: A.Node, bound_env) -> int:
+    """Structural slot count of a dynamic \\E set: trace the set expression
+    abstractly (jax.eval_shape, no compile) and count its element slots —
+    the same enumeration _slot_bind_traced performs inside the kernel, so
+    the count is exact per action instead of the global kv_cap ceiling."""
+    layout = kc.layout
+    clean = {k: v for k, v in bound_env.items()
+             if not (isinstance(v, tuple) and len(v) == 2
+                     and v[0] == "$slotv")}
+    holder = {}
+
+    def probe(row):
+        state = {}
+        off = 0
+        for v in layout.vars:
+            sp = layout.specs[v]
+            state[v] = SymV(sp, row[off:off + sp.width])
+            off += sp.width
+        fr = Frame(kc, _lift_bound(clean, kc), state, {}, [False])
+        sval = sym_eval2(sexpr, fr)
+        holder["n"] = len(list(_elements(sval, fr)))
+        return jnp.zeros(())
+
+    jax.eval_shape(probe, jax.ShapeDtypeStruct((layout.width,), jnp.int32))
+    return holder["n"]
 
 
 def compile_action2(kc: KernelCtx, ga) -> CompiledAction2:
@@ -2087,6 +2115,20 @@ def compile_action2(kc: KernelCtx, ga) -> CompiledAction2:
             f"action {ga.label}: multiple dynamic \\E binders not "
             f"supported (one slot axis per action)")
     slotted = bool(markers)
+    n_slots = 0
+    if slotted:
+        (marker, benv), = markers.values()
+        try:
+            n_slots = _probe_slot_count(kc, marker[1], benv)
+        except Exception as ex:
+            # an unsized slot axis could silently drop transitions —
+            # reject (interp backend still checks the model)
+            raise CompileError(
+                f"action {ga.label}: cannot size the dynamic \\E slot "
+                f"axis ({ex})") from ex
+        if n_slots == 0:
+            # structurally empty dynamic set: the action can never fire
+            n_slots = 1  # keep one (always-disabled) instance
 
     def fn(row, slot=None):
         state = {}
@@ -2176,7 +2218,7 @@ def compile_action2(kc: KernelCtx, ga) -> CompiledAction2:
         return en, ak, ov, succ
 
     if slotted:
-        return CompiledAction2(ga.label, fn, n_slots=kc.bounds.kv_cap)
+        return CompiledAction2(ga.label, fn, n_slots=n_slots)
     return CompiledAction2(ga.label, lambda row: fn(row, None))
 
 
@@ -2204,12 +2246,8 @@ def _slot_bind_traced(setexpr: A.Node, slot, fr: Frame):
     per ACTION FAMILY instead of per instance."""
     sval = sym_eval2(setexpr, fr)
     items = list(_elements(sval, fr))
-    if len(items) > fr.kc.bounds.kv_cap:
-        # more potential elements than engine slot instances: transitions
-        # would be silently dropped — reject the compile instead
-        raise CompileError(
-            f"dynamic \\E set has {len(items)} potential elements but "
-            f"only {fr.kc.bounds.kv_cap} slots (raise --kv-cap)")
+    # n_slots is probed per action from this same enumeration
+    # (_probe_slot_count), so every potential element has a slot instance
     if not items:
         return False, None
     first = items[0][1]

@@ -48,9 +48,14 @@ SENTINEL_LANE = 2**31 - 1
 
 @dataclass
 class Bounds:
-    seq_cap: int = 4        # max Len of any sequence value
-    grow_cap: int = 32      # max cardinality of growing sets
-    kv_cap: int = 32        # max message-bag domain size
+    """Capacity FLOORS for the lane encodings. A container's capacity is
+    max(floor, observed_max * margin) — observed over constraint-satisfying
+    sampled states; the floors exist to be raised when sampling
+    under-observes a model (the runtime overflow guard aborts exactly if a
+    search outgrows the inferred caps, naming the flag to raise)."""
+    seq_cap: int = 4        # sequence length floor
+    grow_cap: int = 4       # growing-set cardinality floor
+    kv_cap: int = 4         # message-table domain floor
     observed_margin: int = 2  # caps at least observed_max * margin
 
 

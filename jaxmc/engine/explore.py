@@ -153,11 +153,8 @@ class Explorer:
         return True
 
     def _satisfies_constraints(self, state) -> bool:
-        ctx = self._ctx(state=state)
-        for name, expr in self.model.constraints:
-            if not _bool(eval_expr(expr, ctx), f"constraint {name}"):
-                return False
-        return True
+        from ..sem.modules import satisfies_constraints
+        return satisfies_constraints(self.model, state)
 
     def _trace_to(self, sid, parents, states, labels) -> List[Tuple[Dict, str]]:
         out = []

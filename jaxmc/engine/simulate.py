@@ -86,9 +86,24 @@ def sample_states(model: Model, bfs_states: int = 1500,
                   n_walks: int = 60, walk_depth: int = 60,
                   seed: int = 0) -> List[Dict]:
     """States for layout inference: BFS prefix (covers the breadth of early
-    actions) + random walks (cover depth: leaders, full logs, elections)."""
+    actions) + random walks (cover depth: leaders, full logs, elections).
+
+    Constraint-violating states are excluded: the checker discards them
+    (TLC semantics), so including them would size container capacities for
+    a space the search never explores — on raft, sampling without the cfg
+    CONSTRAINT grows the message table to the full potential message
+    universe and the compiled kernels with it. The encoder's overflow
+    guard still aborts exactly if a real run outgrows the inferred caps
+    (one frontier step can exceed the constrained envelope; the sizing
+    margin covers it)."""
+    from ..sem.modules import satisfies_constraints
     ctx = model.ctx()
-    states = enumerate_init(model.init, ctx, model.vars)
+
+    def in_bounds(st):
+        return satisfies_constraints(model, st)
+
+    states = [st for st in enumerate_init(model.init, ctx, model.vars)
+              if in_bounds(st)]
     out = list(states)
 
     def key(s):
@@ -102,7 +117,7 @@ def sample_states(model: Model, bfs_states: int = 1500,
             succs = enumerate_next(model.next, ctx, model.vars, st)
             for succ, _ in succs:
                 k = key(succ)
-                if k not in seen:
+                if k not in seen and in_bounds(succ):
                     seen.add(k)
                     out.append(succ)
                     q.append(succ)
@@ -119,17 +134,21 @@ def sample_states(model: Model, bfs_states: int = 1500,
 
     def collect(st):
         k = key(st)
-        if k not in seen:
+        if k not in seen and in_bounds(st):
             seen.add(k)
             out.append(st)
 
-    starts = list(enumerate_init(model.init, ctx, model.vars))
+    starts = states
+    if not starts:
+        return out  # no constraint-satisfying init: nothing to walk
     for w in range(n_walks):
         pool = starts + novel_starts
         st = rng.choice(pool)
         for _ in range(walk_depth):
             try:
-                succs = list(enumerate_next(model.next, ctx, model.vars, st))
+                succs = [sl for sl in
+                         enumerate_next(model.next, ctx, model.vars, st)
+                         if in_bounds(sl[0])]
             except TLCAssertFailure:
                 break
             if not succs:

@@ -240,7 +240,8 @@ def _force(v, ctx, name=""):
         if v.params:
             return v  # operator value (can be passed higher-order)
         store = ctx.memo
-        if store is not None and v.stable and not v.bound:
+        if store is not None and v.stable and not v.bound \
+                and v.defs is None:
             from .memo import memo_key  # late import (module cycle)
             key = memo_key(store, v, ctx.defs, ctx)
             if key is not None:
@@ -315,7 +316,8 @@ def apply_op(opv, args: List[Any], ctx: Ctx):
             raise EvalError(f"{opv.name} expects {len(opv.params)} args, "
                             f"got {len(args)}")
         store = ctx.memo
-        if store is not None and opv.stable and not opv.bound and args:
+        if store is not None and opv.stable and not opv.bound and args \
+                and opv.defs is None:
             from .memo import memo_key  # late import (module cycle)
             key = memo_key(store, opv, ctx.defs, ctx, tuple(args))
             if key is not None:

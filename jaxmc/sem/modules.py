@@ -206,6 +206,18 @@ class Model:
                    self._memo)
 
 
+def satisfies_constraints(model: "Model", state) -> bool:
+    """Does `state` satisfy every cfg CONSTRAINT? The ONE implementation —
+    the engine, the device backends, and layout sampling must agree on
+    which states the search keeps (TLC discard semantics)."""
+    from .eval import _bool
+    ctx = model.ctx(state=state)
+    for name, expr in model.constraints:
+        if not _bool(eval_expr(expr, ctx), f"constraint {name}"):
+            return False
+    return True
+
+
 def _cfg_value(v):
     if isinstance(v, CfgModelValue):
         return ModelValue(v.name)

@@ -51,6 +51,27 @@ _FP_MIX = [(0x9E3779B1, 0x85EBCA6B), (0xC2B2AE35, 0x27D4EB2F),
            (0x165667B1, 0x9E3779B1), (0x85EBCA6B, 0xC2B2AE35)]
 
 
+def filter_init_states(model, layout, init_rows):
+    """Apply TLC's CONSTRAINT-discard semantics to encoded init rows:
+    returns (explored_indices, (invariant_name, state) | None). Violating
+    inits are fingerprinted by the caller but never counted distinct,
+    invariant-checked, or explored; invariants run on kept inits only
+    (host-side interpreter — init sets are small)."""
+    from ..sem.modules import satisfies_constraints
+    from ..sem.eval import eval_expr, _bool
+    explored = []
+    for i, row in enumerate(init_rows):
+        st = layout.decode(row)
+        if not satisfies_constraints(model, st):
+            continue
+        ctx = model.ctx(state=st)
+        for nm, ex in model.invariants:
+            if not _bool(eval_expr(ex, ctx), f"invariant {nm}"):
+                return explored, (nm, st)
+        explored.append(i)
+    return explored, None
+
+
 def _pow2_at_least(n: int, lo: int = 256) -> int:
     c = lo
     while c < n:
@@ -350,23 +371,13 @@ class TpuExplorer:
         n_init = len(init_rows)
         generated = n_init
 
-        # constraint-violating init states are fingerprinted but discarded:
-        # not distinct, not invariant-checked, not explored (TLC semantics)
-        from ..sem.eval import eval_expr, _bool
-        explored_init = []
-        for i, row in enumerate(init_rows):
-            st = layout.decode(row)
-            ctx = model.ctx(state=st)
-            if not all(_bool(eval_expr(ex, ctx), f"constraint {nm}")
-                       for nm, ex in model.constraints):
-                continue
-            for nm, ex in model.invariants:
-                if not _bool(eval_expr(ex, ctx), f"invariant {nm}"):
-                    return self._mk_result(
-                        False, len(explored_init) + 1, generated, 0, t0,
-                        warnings, Violation("invariant", nm,
-                                            [(st, "Initial predicate")]))
-            explored_init.append(i)
+        explored_init, init_viol = filter_init_states(model, layout,
+                                                      init_rows)
+        if init_viol is not None:
+            nm, st = init_viol
+            return self._mk_result(
+                False, len(explored_init) + 1, generated, 0, t0, warnings,
+                Violation("invariant", nm, [(st, "Initial predicate")]))
         distinct = len(explored_init)
         self.log(f"Finished computing initial states: {distinct} distinct "
                  f"state{'s' if distinct != 1 else ''} generated.")
@@ -535,24 +546,13 @@ class TpuExplorer:
         n_init = len(init_rows)
         generated = n_init
 
-        # constraints + invariants on init states (host-side interpreter);
-        # constraint-violating inits are fingerprinted but discarded: not
-        # distinct, not invariant-checked, not explored (TLC semantics)
-        from ..sem.eval import eval_expr, _bool
-        explored_init = []
-        for i, row in enumerate(init_rows):
-            st = layout.decode(row)
-            ctx = model.ctx(state=st)
-            if not all(_bool(eval_expr(ex, ctx), f"constraint {nm}")
-                       for nm, ex in model.constraints):
-                continue
-            for nm, ex in model.invariants:
-                if not _bool(eval_expr(ex, ctx), f"invariant {nm}"):
-                    return self._mk_result(
-                        False, len(explored_init) + 1, generated, 0, t0,
-                        warnings, Violation("invariant", nm,
-                                            [(st, "Initial predicate")]))
-            explored_init.append(i)
+        explored_init, init_viol = filter_init_states(model, layout,
+                                                      init_rows)
+        if init_viol is not None:
+            nm, st = init_viol
+            return self._mk_result(
+                False, len(explored_init) + 1, generated, 0, t0, warnings,
+                Violation("invariant", nm, [(st, "Initial predicate")]))
         distinct = len(explored_init)
         self.log(f"Finished computing initial states: {distinct} distinct "
                  f"state{'s' if distinct != 1 else ''} generated.")

@@ -33,7 +33,8 @@ from ..sem.enumerate import enumerate_init
 from ..engine.explore import CheckResult, Violation
 from ..compile.ground import CompileError, build_layout, ground_actions
 from ..compile.kernel import compile_action, compile_predicate
-from .bfs import SENTINEL, SYMMETRY_WARNING, _pow2_at_least
+from .bfs import (SENTINEL, SYMMETRY_WARNING, _pow2_at_least,
+                  filter_init_states)
 
 
 def _row_hash(rows, xp=jnp):
@@ -213,24 +214,16 @@ class MeshExplorer:
         n_init = len(init_rows)
         generated = n_init
 
-        # constraints + invariants on init states (host-side interpreter);
-        # constraint-violating inits are fingerprinted but discarded: not
-        # distinct, not invariant-checked, not explored (TLC semantics)
-        from ..sem.eval import eval_expr, _bool
-        explored_mask = np.ones(n_init, bool)
-        for i, row in enumerate(init_rows):
-            st = layout.decode(row)
-            ctx = model.ctx(state=st)
-            if not all(_bool(eval_expr(ex2, ctx), f"constraint {nm}")
-                       for nm, ex2 in model.constraints):
-                explored_mask[i] = False
-                continue
-            for nm, ex2 in model.invariants:
-                if not _bool(eval_expr(ex2, ctx), f"invariant {nm}"):
-                    return self._mk(False, int(explored_mask[:i + 1].sum()),
-                                    generated, 0, t0, warnings, Violation(
-                                        "invariant", nm,
-                                        [(st, "Initial predicate")]))
+        explored_init, init_viol = filter_init_states(model, layout,
+                                                      init_rows)
+        if init_viol is not None:
+            nm, st = init_viol
+            return self._mk(False, len(explored_init) + 1, generated, 0,
+                            t0, warnings, Violation(
+                                "invariant", nm,
+                                [(st, "Initial predicate")]))
+        explored_mask = np.zeros(n_init, bool)
+        explored_mask[explored_init] = True
         distinct = int(explored_mask.sum())
         self.log(f"Finished computing initial states: {distinct} distinct "
                  f"state{'s' if distinct != 1 else ''} generated.")

@@ -14,6 +14,7 @@ import pytest
 from jaxmc.front.cfg import ModelConfig, parse_cfg
 from jaxmc.sem.modules import Loader, bind_model
 from jaxmc.sem.enumerate import enumerate_init, enumerate_next
+from jaxmc.engine.explore import Explorer
 
 from conftest import REFERENCE
 
@@ -142,3 +143,42 @@ Next == (\E i \in 1..Len(q) : q[i] < 9)
                                    check_deadlock=False))
     with pytest.raises(CompileError, match="dynamic"):
         TpuExplorer(model, store_trace=False)
+
+
+def _load_micro():
+    ldr = Loader([os.path.join(REFERENCE, "examples"), SPECS])
+    return bind_model(
+        ldr.load_path(os.path.join(SPECS, "MCraftMicro.tla")),
+        parse_cfg(open(os.path.join(SPECS, "MCraft_micro.cfg")).read()))
+
+
+def test_raft_micro_differential_default():
+    # default-selected fast slice of the raft kernel-vs-interp
+    # differential (the full sweep on MCraft_tiny is slow-marked above)
+    from jaxmc.tpu.bfs import TpuExplorer
+    from jaxmc.engine.simulate import sample_states
+    model = _load_micro()
+    ex = TpuExplorer(model, store_trace=False)
+    states = sample_states(model, bfs_states=30, n_walks=4, walk_depth=20)
+    assert len(states) >= 12
+    for st in states[:12]:
+        ks, ov = kernel_successors(ex, st)
+        assert not ov, "capacity overflow on sampled state"
+        assert ks == interp_successors(model, st)
+
+
+def test_raft_micro_whole_run_equivalence():
+    # the BASELINE.json contract at a scale that COMPLETES: identical
+    # generated/distinct counts from the interpreter and the jax backend
+    # on a raft model (MCraftMicro bounds raft.tla's message-bag domain so
+    # the search is finite; reference hot path raft.tla:482-493)
+    from jaxmc.tpu.bfs import TpuExplorer
+    from jaxmc import native_store
+    ri = Explorer(_load_micro()).run()
+    assert ri.ok
+    assert (ri.generated, ri.distinct) == (6185, 694)
+    rj = TpuExplorer(_load_micro(), store_trace=False,
+                     host_seen=native_store.is_available(),
+                     chunk=256).run()
+    assert rj.ok
+    assert (rj.generated, rj.distinct) == (6185, 694)
