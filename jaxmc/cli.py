@@ -171,6 +171,7 @@ def cmd_info(args) -> int:
 
 
 def main(argv=None) -> int:
+    from .compile.vspec import Bounds  # no jax dependency
     ap = argparse.ArgumentParser(prog="jaxmc")
     sub = ap.add_subparsers(dest="cmd", required=True)
 
@@ -186,12 +187,14 @@ def main(argv=None) -> int:
                    help="disable deadlock checking")
     c.add_argument("--quiet", action="store_true")
     c.add_argument("--progress-every", type=float, default=30.0)
-    c.add_argument("--seq-cap", type=int, default=4,
-                   help="jax backend: max sequence length lanes")
-    c.add_argument("--grow-cap", type=int, default=32,
-                   help="jax backend: max growing-set cardinality")
-    c.add_argument("--kv-cap", type=int, default=32,
-                   help="jax backend: max message-table domain size")
+    c.add_argument("--seq-cap", type=int, default=Bounds.seq_cap,
+                   help="jax backend: sequence-length capacity FLOOR "
+                        "(actual cap = max(floor, observed * margin); "
+                        "raise if a run aborts with capacity overflow)")
+    c.add_argument("--grow-cap", type=int, default=Bounds.grow_cap,
+                   help="jax backend: growing-set capacity floor")
+    c.add_argument("--kv-cap", type=int, default=Bounds.kv_cap,
+                   help="jax backend: message-table domain capacity floor")
     c.add_argument("--no-trace", action="store_true",
                    help="jax backend: skip trace bookkeeping (benchmarks)")
     c.add_argument("--host-seen", action="store_true",

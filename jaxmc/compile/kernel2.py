@@ -2156,7 +2156,7 @@ def compile_action2(kc: KernelCtx, ga) -> CompiledAction2:
             for nm, bv in list(bound2.items()):
                 if isinstance(bv, tuple) and len(bv) == 2 \
                         and bv[0] == "$slotv":
-                    g, val = _slot_bind_traced(bv[1], slot, fr)
+                    g, val = _slot_bind_traced(bv[1], slot, fr, n_slots)
                     slot_guards.append(g)
                     bound2[nm] = val
             if slot_guards:
@@ -2240,14 +2240,19 @@ def _lift_bound(bound_env: Dict[str, Any], kc: KernelCtx) -> Dict[str, Any]:
     return out
 
 
-def _slot_bind_traced(setexpr: A.Node, slot, fr: Frame):
+def _slot_bind_traced(setexpr: A.Node, slot, fr: Frame, n_slots: int):
     """Bind the slot-th element (traced index) of a dynamic set — a
     select-chain over the table slots, so the trace stays O(capacity)
     per ACTION FAMILY instead of per instance."""
     sval = sym_eval2(setexpr, fr)
     items = list(_elements(sval, fr))
-    # n_slots is probed per action from this same enumeration
-    # (_probe_slot_count), so every potential element has a slot instance
+    if len(items) > n_slots:
+        # the engine only vmaps n_slots slot indices (probed by
+        # _probe_slot_count from this same enumeration) — a divergence
+        # here would silently drop the elements beyond the probe
+        raise CompileError(
+            f"dynamic \\E set has {len(items)} potential elements but "
+            f"the probed slot axis has {n_slots}")
     if not items:
         return False, None
     first = items[0][1]

@@ -201,6 +201,7 @@ class Explorer:
                                  else generated_at,
                                  diameter=diameter,
                                  seen_items=list(seen.items()),
+                                 edges=edges if collect_edges else None,
                                  prints=self.prints if prints_at is None
                                  else self.prints[:prints_at]), fh)
             _os.replace(tmp, self.checkpoint_path)
@@ -235,17 +236,37 @@ class Explorer:
         from .refinement import build_refinement_checkers
         refiners, live_only = build_refinement_checkers(model)
         warnings = []
-        if live_only:
-            warnings.append(
-                "liveness properties NOT checked (unimplemented): "
-                + ", ".join(live_only))
         for rc in refiners:
             if rc.liveness_skipped:
                 warnings.append(
                     f"property {rc.name}: refinement checked stepwise; its "
                     f"fairness conjuncts are NOT checked")
+        # temporal obligations are checked over the behavior graph after
+        # the search completes (engine/liveness.py) — collect the full
+        # edge log only when some property needs it
+        from .liveness import classify_property, UnsupportedProperty
+        refined_names = {rc.name for rc in refiners}
+        live_obligations = []
+        unsupported = []
+        for pnm, pexpr in model.properties:
+            try:
+                live_obligations.extend(
+                    classify_property(model, pnm, pexpr, {}))
+            except (UnsupportedProperty, EvalError):
+                if pnm not in refined_names:
+                    unsupported.append(pnm)
+        if unsupported:
+            warnings.append(
+                "temporal properties NOT checked (unsupported form): "
+                + ", ".join(unsupported))
+        collect_edges = bool(live_obligations)
+        edges: List[Tuple[int, int]] = []
 
         def result(ok, violation=None, truncated=False):
+            if truncated and live_obligations:
+                warnings.append("temporal properties NOT checked: the "
+                                "search was truncated (behavior graph "
+                                "incomplete)")
             return CheckResult(ok=ok, distinct=len(states),
                                generated=generated, diameter=diameter,
                                violation=violation, wall_s=time.time() - t0,
@@ -290,6 +311,16 @@ class Explorer:
                     f"cannot resume: {self.resume_from} was written by an "
                     f"incompatible jaxmc version (no seen_items)")
             seen.update(items)
+            if collect_edges:
+                # liveness needs the FULL edge log; a checkpoint written
+                # without one cannot support temporal checking
+                ck_edges = ck.get("edges")
+                if ck_edges is None:
+                    raise EvalError(
+                        "cannot resume with temporal properties: the "
+                        "checkpoint has no edge log (it was written "
+                        "without PROPERTY obligations)")
+                edges.extend(ck_edges)
             self.log(f"Resumed from {self.resume_from}: {len(states)} "
                      f"distinct states, {len(queue)} on queue.")
 
@@ -346,6 +377,8 @@ class Explorer:
                                          depth + 1)
                     if nid is None:
                         continue  # discarded by CONSTRAINT (not checked)
+                    if collect_edges:
+                        edges.append((sid, nid))
                     for rc in refiners:
                         if not rc.check_edge(st, succ):
                             trace = self._trace_to(sid, parents, states,
@@ -391,6 +424,17 @@ class Explorer:
                     now - last_checkpoint >= self.checkpoint_every:
                 last_checkpoint = now
                 write_checkpoint()
+
+        # ---- temporal properties over the completed behavior graph ----
+        if live_obligations:
+            from .liveness import LivenessChecker
+            lc = LivenessChecker(model, states, edges, parents, labels)
+            bad, live_warns = lc.check(live_obligations)
+            warnings.extend(live_warns)
+            if bad is not None:
+                pname, trace, msg = bad
+                return result(False, Violation("property", pname, trace,
+                                               msg))
 
         self.log(f"Model checking completed. No error has been found.")
         self.log(f"{generated} states generated, {len(states)} distinct "

@@ -102,15 +102,17 @@ def sample_states(model: Model, bfs_states: int = 1500,
     def in_bounds(st):
         return satisfies_constraints(model, st)
 
-    states = [st for st in enumerate_init(model.init, ctx, model.vars)
-              if in_bounds(st)]
-    out = list(states)
+    inits = enumerate_init(model.init, ctx, model.vars)
+    states = [st for st in inits if in_bounds(st)]
+    # ALL inits are sampled (discarded ones are still fingerprinted, so
+    # the layout must encode them); only kept inits seed the expansion
+    out = list(inits)
 
     def key(s):
         return tuple(sorted((k, repr(v)) for k, v in s.items()))
 
     seen = {key(s) for s in out}
-    q = deque(out)
+    q = deque(states)
     while q and len(out) < bfs_states:
         st = q.popleft()
         try:

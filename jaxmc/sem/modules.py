@@ -194,6 +194,9 @@ class Model:
     vars: Tuple[str, ...]
     defs: Dict[str, Any]
     check_deadlock: bool = True
+    # fairness conjuncts of the SPECIFICATION formula (WF/SF, possibly
+    # quantified or behind named ops) — consumed by engine/liveness.py
+    fairness: List[A.Node] = field(default_factory=list)
     _memo: Any = field(default=None, repr=False, compare=False)
 
     def ctx(self, state=None, primes=None, on_print=None) -> Ctx:
@@ -312,9 +315,10 @@ def bind_model(module: LoadedModule, cfg: ModelConfig) -> Model:
             return d.body
         raise EvalError(f"cfg name {nm} does not name a definition")
 
+    fair: List[A.Node] = []
     if cfg.specification:
         spec_body = named(cfg.specification)
-        init, nxt, _sub, _fair = _split_spec(spec_body, defs)
+        init, nxt, _sub, fair = _split_spec(spec_body, defs)
     else:
         if not cfg.init or not cfg.next:
             raise EvalError("cfg must give SPECIFICATION or INIT+NEXT")
@@ -331,4 +335,5 @@ def bind_model(module: LoadedModule, cfg: ModelConfig) -> Model:
                  invariants=invariants, constraints=constraints,
                  action_constraints=action_constraints,
                  properties=properties, symmetry=symmetry, vars=vars,
-                 defs=defs, check_deadlock=cfg.check_deadlock)
+                 defs=defs, check_deadlock=cfg.check_deadlock,
+                 fairness=fair)

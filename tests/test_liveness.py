@@ -1,0 +1,140 @@
+r"""Behavior-graph liveness checking (engine/liveness.py).
+
+Targets the corpus's temporal-property obligations (VERDICT round-1
+Missing #1): the Liveness-chapter properties, MCAlternatingBit's leads-to,
+RealTime's expected-to-fail property, and MCInnerSerial's AlwaysResponds —
+each with a fairness-free negative control proving the checks are not
+vacuous.
+"""
+
+import os
+
+from jaxmc.front.cfg import parse_cfg
+from jaxmc.sem.modules import Loader, bind_model
+from jaxmc.engine.explore import Explorer
+
+from conftest import REFERENCE
+
+SS = os.path.join(REFERENCE, "examples/SpecifyingSystems")
+
+
+def run(spec_path, cfg_text=None, cfg_path=None):
+    cfg = parse_cfg(cfg_text if cfg_text is not None
+                    else open(cfg_path).read())
+    m = Loader([os.path.dirname(spec_path)]).load_path(spec_path)
+    return Explorer(bind_model(m, cfg)).run()
+
+
+class TestLiveHourClock:
+    SPEC = os.path.join(SS, "Liveness/LiveHourClock.tla")
+
+    def test_all_properties_hold_under_fairness(self):
+        # PROPERTIES AlwaysTick AllTimes TypeInvariance
+        # (LiveHourClock.cfg) — []<><<A>>_v, \A-quantified []<>, and []P
+        r = run(self.SPEC, cfg_path=os.path.join(
+            SS, "Liveness/LiveHourClock.cfg"))
+        assert r.ok
+        assert not any("NOT checked" in w for w in r.warnings)
+
+    def test_alwaystick_violated_without_fairness(self):
+        # HC alone permits infinite stuttering: []<><<HCnxt>>_hr fails
+        r = run(self.SPEC, "SPECIFICATION HC\nPROPERTIES AlwaysTick\n")
+        assert not r.ok
+        assert r.violation.kind == "property"
+        assert "AlwaysTick" in r.violation.name
+
+    def test_alltimes_violated_without_fairness(self):
+        r = run(self.SPEC, "SPECIFICATION HC\nPROPERTIES AllTimes\n")
+        assert not r.ok
+        assert "AllTimes" in r.violation.name
+
+
+class TestAlternatingBit:
+    SPEC = os.path.join(SS, "TLC/MCAlternatingBit.tla")
+    NOFAIR = """INIT ABInit
+NEXT ABNext
+CONSTANTS
+  Data = {d1, d2}
+  msgQLen = 2
+  ackQLen = 2
+CONSTRAINT SeqConstraint
+PROPERTIES SentLeadsToRcvd
+CHECK_DEADLOCK FALSE
+"""
+
+    def test_sent_leadsto_rcvd_holds_under_wf_sf(self):
+        # ABSpec's fairness is WF(ReSndMsg) /\ WF(SndAck) /\ SF(RcvMsg)
+        # /\ SF(RcvAck) (AlternatingBit.tla:72-75) — the ~> property needs
+        # all of it
+        r = run(self.SPEC, cfg_path=os.path.join(
+            SS, "TLC/MCAlternatingBit.cfg"))
+        assert r.ok
+        assert not any("SentLeadsToRcvd" in w for w in r.warnings)
+
+    def test_violated_without_fairness(self):
+        r = run(self.SPEC, self.NOFAIR)
+        assert not r.ok
+        assert "SentLeadsToRcvd" in r.violation.name
+
+
+class TestRealTimeHourClock:
+    def test_error_temporal_found_violated(self):
+        # the cfg's PROPERTY ErrorTemporal ([]((now # 4) => <>[](now # 4)),
+        # MCRealTimeHourClock.tla:43) is expected to FAIL — finding the
+        # violation is the pass criterion
+        r = run(os.path.join(SS, "RealTime/MCRealTimeHourClock.tla"),
+                cfg_path=os.path.join(SS,
+                                      "RealTime/MCRealTimeHourClock.cfg"))
+        assert not r.ok
+        assert r.violation.kind == "property"
+        assert "ErrorTemporal" in r.violation.name
+        assert r.distinct == 216 and r.generated == 696
+
+
+class TestInnerSerial:
+    SPEC = os.path.join(SS, "AdvancedExamples/MCInnerSerial.tla")
+    NOFAIR = """INIT Init
+NEXT Next
+CONSTANTS
+  Reg = {r1}
+  Adr = {a1}
+  Val = {v1, v2}
+  Proc = {p1, p2}
+  InitMem <- MCInitMem
+  InitWr = InitWr
+  Done = Done
+  MaxQLen = 1
+  Nat <- MCNat
+CONSTRAINT Constraint
+PROPERTY AlwaysResponds
+CHECK_DEADLOCK FALSE
+"""
+
+    def test_always_responds_violated_without_fairness(self):
+        # the quantified ~> property needs InnerSerial's WF conjuncts
+        # (InnerSerial.tla:109-119); without them a pending request can
+        # stutter forever. (The fairness-ful positive run is the golden
+        # testout2 model — covered by test_innerserial_matches_golden_
+        # testout2, which now also checks AlwaysResponds.)
+        r = run(self.SPEC, self.NOFAIR)
+        assert not r.ok
+        assert "AlwaysResponds" in r.violation.name
+
+
+class TestCheckpointedLiveness:
+    def test_resume_preserves_edge_log(self, tmp_path):
+        # liveness after --resume must see pre-checkpoint edges: the
+        # fairness-free SentLeadsToRcvd violation must still be found
+        # when the search ran in two halves
+        spec = os.path.join(SS, "TLC/MCAlternatingBit.tla")
+        cfg_text = TestAlternatingBit.NOFAIR
+        ckpt = str(tmp_path / "ab.ckpt")
+        m1 = Loader([os.path.dirname(spec)]).load_path(spec)
+        r1 = Explorer(bind_model(m1, parse_cfg(cfg_text)), max_states=50,
+                      checkpoint_path=ckpt, checkpoint_every=0.0).run()
+        assert r1.truncated
+        m2 = Loader([os.path.dirname(spec)]).load_path(spec)
+        r2 = Explorer(bind_model(m2, parse_cfg(cfg_text)),
+                      resume_from=ckpt).run()
+        assert not r2.ok
+        assert "SentLeadsToRcvd" in r2.violation.name
