@@ -12,10 +12,12 @@ all: test
 check:
 	$(PY) -m jaxmc check $(SPEC) --backend $(BACKEND)
 
-# check every checkable spec the way `tlc *tla` does
+# check every checkable spec+cfg with its EXPECTED verdict, the way the
+# reference's `make test` runs `tlc *tla` (includes expected-violation
+# models); SLOW=--slow adds the multi-minute ones
+SLOW ?=
 check-corpus:
-	$(PY) -m jaxmc check /root/reference/pcal_intro.tla --backend $(BACKEND)
-	$(PY) -m jaxmc check /root/reference/atomic_add.tla --backend $(BACKEND)
+	$(PY) -m jaxmc sweep --backend $(BACKEND) $(SLOW)
 
 test:
 	$(PY) -m pytest tests/ -q

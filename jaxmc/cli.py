@@ -155,6 +155,12 @@ def cmd_simulate(args) -> int:
     return 1
 
 
+def cmd_sweep(args) -> int:
+    from .corpus import sweep
+    return 1 if sweep(backend=args.backend,
+                      include_slow=args.slow) else 0
+
+
 def cmd_info(args) -> int:
     from .sem.modules import Loader
     from .front import tla_ast as A
@@ -230,6 +236,15 @@ def main(argv=None) -> int:
     i = sub.add_parser("info", help="parse a spec and print a summary")
     i.add_argument("spec")
     i.set_defaults(fn=cmd_info)
+
+    s = sub.add_parser("sweep",
+                       help="check the WHOLE corpus with expected "
+                            "verdicts (the reference's `tlc *tla`)")
+    s.add_argument("--backend", choices=("interp", "jax"),
+                   default="interp")
+    s.add_argument("--slow", action="store_true",
+                   help="include the multi-minute models")
+    s.set_defaults(fn=cmd_sweep)
 
     args = ap.parse_args(argv)
     try:

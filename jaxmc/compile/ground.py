@@ -1,27 +1,17 @@
-r"""Model grounder: fixed-width integer state encodings + static action
-grounding (SURVEY.md §7.3).
-
-With cfg constants bound, every state variable gets a fixed-width i32
-encoding: ints and booleans one lane each, strings/model values as indices
-into a global enum universe, functions with a fixed finite domain as one
-encoded block per domain element, sets over a small static universe as 0/1
-membership lanes. The layout is derived from the initial states (structure
-must be Next-stable — the cross-check tests validate this against the
-interpreter).
+r"""Static action grounding (SURVEY.md §7.3).
 
 Action grounding statically expands the Next disjunction: operator expansion,
 \/ splits, and \E over constant domains become a finite list of
 GroundedActions, each a conjunct list evaluated by the kernel compiler
-(compile/kernel.py). This is the raft.tla:482-493 shape: ~10 action families
-x parameter instantiations (SURVEY.md §3.3).
+(compile/kernel2.py; state encodings live in compile/vspec.py). This is the
+raft.tla:482-493 shape: ~10 action families x parameter instantiations
+(SURVEY.md §3.3).
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
-
-import numpy as np
 
 from ..front import tla_ast as A
 from ..sem.values import (EvalError, Fcn, InfiniteSet, ModelValue,
@@ -33,236 +23,6 @@ from ..sem.modules import Model
 # ONE CompileError class for the whole compile package — ground and
 # vspec/kernel2 raise interchangeably and callers catch one type
 from .vspec import CompileError  # noqa: F401  (re-export)
-
-
-# ---------------- enum universe ----------------
-
-class EnumUniverse:
-    """Global index space for strings and model values appearing in the
-    model (pc labels, role names, message types, Nil, ...)."""
-
-    def __init__(self):
-        self.to_idx: Dict[Any, int] = {}
-        self.values: List[Any] = []
-
-    def add(self, v):
-        if v not in self.to_idx:
-            self.to_idx[v] = len(self.values)
-            self.values.append(v)
-
-    def index(self, v) -> int:
-        try:
-            return self.to_idx[v]
-        except KeyError:
-            raise CompileError(f"value {fmt(v)} not in enum universe")
-
-    def value(self, i: int):
-        return self.values[i]
-
-    def __len__(self):
-        return len(self.values)
-
-
-def collect_enums(model: Model) -> EnumUniverse:
-    uni = EnumUniverse()
-
-    def walk_ast(e):
-        if isinstance(e, A.Str):
-            uni.add(e.val)
-        for f in getattr(e, "__dataclass_fields__", {}):
-            v = getattr(e, f)
-            if isinstance(v, A.Node):
-                walk_ast(v)
-            elif isinstance(v, tuple):
-                for x in _flat(v):
-                    if isinstance(x, A.Node):
-                        walk_ast(x)
-
-    def _flat(t):
-        for x in t:
-            if isinstance(x, tuple):
-                yield from _flat(x)
-            else:
-                yield x
-
-    def walk_value(v):
-        if isinstance(v, ModelValue):
-            uni.add(v)
-        elif isinstance(v, str):
-            uni.add(v)
-        elif isinstance(v, frozenset):
-            for x in v:
-                walk_value(x)
-        elif isinstance(v, Fcn):
-            for k, x in v.d.items():
-                walk_value(k)
-                walk_value(x)
-
-    for d in model.defs.values():
-        if isinstance(d, OpClosure):
-            if isinstance(d.body, A.Node):
-                walk_ast(d.body)
-        else:
-            walk_value(d)
-    for u in model.module.ast.units:
-        if isinstance(u, (A.OpDef,)):
-            walk_ast(u.body)
-    return uni
-
-
-# ---------------- value specs ----------------
-
-@dataclass(frozen=True)
-class Spec_:
-    kind: str                     # 'int' | 'bool' | 'enum' | 'fcn' | 'set'
-    dom: Tuple = ()               # fcn: ordered domain keys; set: universe
-    elems: Tuple = ()             # fcn: per-key element spec
-
-    @property
-    def width(self) -> int:
-        if self.kind in ("int", "bool", "enum"):
-            return 1
-        if self.kind == "fcn":
-            return sum(e.width for e in self.elems)
-        if self.kind == "set":
-            return len(self.dom)
-        raise AssertionError(self.kind)
-
-
-def infer_spec(v, uni: EnumUniverse) -> Spec_:
-    if isinstance(v, bool):
-        return Spec_("bool")
-    if isinstance(v, int):
-        return Spec_("int")
-    if isinstance(v, (str, ModelValue)):
-        uni.add(v)
-        return Spec_("enum")
-    if isinstance(v, Fcn):
-        keys = sorted(v.d.keys(), key=sort_key)
-        for k in keys:
-            if isinstance(k, (str, ModelValue)):
-                uni.add(k)
-        elems = tuple(infer_spec(v.d[k], uni) for k in keys)
-        return Spec_("fcn", tuple(keys), elems)
-    if isinstance(v, frozenset):
-        # set over a universe discovered from observed members; engine
-        # validates closure at encode time
-        members = tuple(sorted(v, key=sort_key))
-        for m in members:
-            if isinstance(m, (str, ModelValue)):
-                uni.add(m)
-        return Spec_("set", members)
-    raise CompileError(f"cannot derive fixed-width encoding for {fmt(v)}")
-
-
-def merge_spec(a: Spec_, b: Spec_) -> Spec_:
-    if a.kind != b.kind:
-        raise CompileError(f"unstable value structure: {a.kind} vs {b.kind}")
-    if a.kind == "fcn":
-        if a.dom != b.dom:
-            raise CompileError("function domains differ across states")
-        return Spec_("fcn", a.dom,
-                     tuple(merge_spec(x, y) for x, y in zip(a.elems, b.elems)))
-    if a.kind == "set":
-        if a.dom == b.dom:
-            return a
-        merged = tuple(sorted(set(a.dom) | set(b.dom), key=sort_key))
-        return Spec_("set", merged)
-    return a
-
-
-def encode_value(v, spec: Spec_, uni: EnumUniverse, out: List[int]):
-    if spec.kind == "int":
-        if isinstance(v, bool) or not isinstance(v, int):
-            raise CompileError(f"expected int, got {fmt(v)}")
-        out.append(v)
-    elif spec.kind == "bool":
-        if not isinstance(v, bool):
-            raise CompileError(f"expected bool, got {fmt(v)}")
-        out.append(1 if v else 0)
-    elif spec.kind == "enum":
-        out.append(uni.index(v))
-    elif spec.kind == "fcn":
-        if not isinstance(v, Fcn):
-            raise CompileError(f"expected function, got {fmt(v)}")
-        if len(v.d) != len(spec.dom):
-            raise CompileError("function domain changed")
-        for k, es in zip(spec.dom, spec.elems):
-            encode_value(v.apply(k), es, uni, out)
-    elif spec.kind == "set":
-        if not isinstance(v, frozenset):
-            raise CompileError(f"expected set, got {fmt(v)}")
-        for m in spec.dom:
-            out.append(1 if m in v else 0)
-        extra = v - frozenset(spec.dom)
-        if extra:
-            raise CompileError(f"set value outside universe: {fmt(extra)}")
-    else:
-        raise AssertionError(spec.kind)
-
-
-def decode_value(row, i: int, spec: Spec_, uni: EnumUniverse):
-    if spec.kind == "int":
-        return int(row[i]), i + 1
-    if spec.kind == "bool":
-        return bool(row[i]), i + 1
-    if spec.kind == "enum":
-        return uni.value(int(row[i])), i + 1
-    if spec.kind == "fcn":
-        d = {}
-        for k, es in zip(spec.dom, spec.elems):
-            d[k], i = decode_value(row, i, es, uni)
-        return Fcn(d), i
-    if spec.kind == "set":
-        members = []
-        for m in spec.dom:
-            if int(row[i]):
-                members.append(m)
-            i += 1
-        return frozenset(members), i
-    raise AssertionError(spec.kind)
-
-
-@dataclass
-class StateLayout:
-    vars: Tuple[str, ...]
-    specs: Dict[str, Spec_]
-    uni: EnumUniverse
-    width: int = 0
-
-    def __post_init__(self):
-        self.width = sum(self.specs[v].width for v in self.vars)
-        self.offsets = {}
-        off = 0
-        for v in self.vars:
-            self.offsets[v] = off
-            off += self.specs[v].width
-
-    def encode(self, state: Dict[str, Any]) -> np.ndarray:
-        out: List[int] = []
-        for v in self.vars:
-            encode_value(state[v], self.specs[v], self.uni, out)
-        return np.asarray(out, dtype=np.int32)
-
-    def decode(self, row) -> Dict[str, Any]:
-        st = {}
-        i = 0
-        for v in self.vars:
-            st[v], i = decode_value(row, i, self.specs[v], self.uni)
-        return st
-
-
-def build_layout(model: Model, init_states: List[Dict[str, Any]]) -> StateLayout:
-    if not init_states:
-        raise CompileError("no initial states to derive a layout from")
-    uni = collect_enums(model)
-    specs: Dict[str, Spec_] = {}
-    for v in model.vars:
-        sp = infer_spec(init_states[0][v], uni)
-        for st in init_states[1:]:
-            sp = merge_spec(sp, infer_spec(st[v], uni))
-        specs[v] = sp
-    return StateLayout(tuple(model.vars), specs, uni)
 
 
 # ---------------- static action grounding ----------------

@@ -1,0 +1,208 @@
+r"""The corpus sweep: `jaxmc sweep` = the reference's `make test` contract
+(`tlc *tla`, /root/reference/Makefile:6-7) — check every checkable
+spec+cfg with its EXPECTED verdict, including the models whose defining
+property is an expected violation. One manifest drives both the sweep and
+the pytest pins (tests/test_corpus.py parametrizes over it).
+
+Verdicts: "ok" (clean pass), "assumes" (ASSUME-calculator module, no
+behavior spec), or "violation:<kind>" where kind is the Violation.kind the
+checker must report (invariant/property/assert/deadlock).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+REFERENCE = os.environ.get("JAXMC_REFERENCE", "/root/reference")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SS = "examples/SpecifyingSystems"
+
+
+@dataclass
+class Case:
+    spec: str                      # path, relative to root
+    root: str = "ref"              # "ref" (reference) | "repo"
+    cfg: Optional[str] = None      # defaults to spec with .cfg
+    expect: str = "ok"             # ok | assumes | violation:<kind>
+    distinct: Optional[int] = None
+    generated: Optional[int] = None
+    no_deadlock: bool = False
+    includes: Tuple[str, ...] = ()  # extra -I dirs, relative to root kind
+    slow: bool = False             # excluded from the default sweep/pins
+
+    def spec_path(self) -> str:
+        base = REFERENCE if self.root == "ref" else REPO
+        return os.path.join(base, self.spec)
+
+    def cfg_path(self) -> Optional[str]:
+        if self.cfg == "":
+            return None
+        if self.cfg is not None:
+            base = REFERENCE if self.root == "ref" else REPO
+            return os.path.join(base, self.cfg)
+        p = self.spec_path()[:-4] + ".cfg"
+        return p if os.path.exists(p) else None
+
+    def include_dirs(self) -> List[str]:
+        out = []
+        for inc in self.includes:
+            if inc.startswith("repo:"):
+                out.append(os.path.join(REPO, inc[5:]))
+            else:
+                out.append(os.path.join(REFERENCE, inc))
+        return out
+
+
+# Every reference cfg (all 21) plus the repo's MC shims. Counts are the
+# TLC-semantics pins (CONSTRAINT-violating states are discarded, matching
+# the golden testout2 run; see tests/test_corpus.py).
+CASES: List[Case] = [
+    # -- top level + tutorial variants
+    Case("pcal_intro.tla", distinct=3800, generated=5850),
+    Case("specs/pcal_intro_buggy.tla", root="repo", cfg="",
+         expect="violation:assert"),
+    Case("atomic_add.tla", cfg="", distinct=5, generated=7,
+         no_deadlock=True),
+    # -- Paxos chain
+    Case("examples/Paxos/MCConsensus.tla", distinct=4, generated=7,
+         no_deadlock=True),
+    Case("examples/Paxos/MCVoting.tla", distinct=77, generated=406,
+         no_deadlock=True),
+    Case("examples/Paxos/MCPaxos.tla", distinct=25, generated=82),
+    # -- Specifying Systems chapters
+    Case(f"{SS}/SimpleMath/SimpleMath.tla", expect="assumes"),
+    Case(f"{SS}/HourClock/HourClock.tla", distinct=12, generated=24),
+    Case(f"{SS}/HourClock/HourClock2.tla", distinct=12, generated=24),
+    Case(f"{SS}/AsynchronousInterface/AsynchInterface.tla",
+         distinct=12, generated=30),
+    Case(f"{SS}/AsynchronousInterface/Channel.tla",
+         distinct=12, generated=30),
+    Case(f"{SS}/AsynchronousInterface/PrintValues.tla", expect="assumes"),
+    Case(f"{SS}/FIFO/MCInnerFIFO.tla", distinct=3864, generated=9660),
+    Case(f"{SS}/CachingMemory/MCInternalMemory.tla",
+         distinct=4408, generated=21400),
+    Case(f"{SS}/CachingMemory/MCWriteThroughCache.tla",
+         distinct=5196, generated=28170),
+    Case(f"{SS}/Liveness/LiveHourClock.tla", distinct=12, generated=24),
+    Case(f"{SS}/Liveness/MCLiveInternalMemory.tla",
+         distinct=4408, generated=21400),
+    Case(f"{SS}/Liveness/MCLiveWriteThroughCache.tla",
+         distinct=5196, generated=28170),
+    # ErrorTemporal is EXPECTED to fail (MCRealTimeHourClock.tla:43)
+    Case(f"{SS}/RealTime/MCRealTimeHourClock.tla",
+         expect="violation:property", distinct=216, generated=696),
+    Case(f"{SS}/TLC/ABCorrectness.tla", distinct=20, generated=36),
+    Case(f"{SS}/TLC/MCAlternatingBit.tla", distinct=240, generated=1392),
+    Case(f"{SS}/AdvancedExamples/MCInnerSequential.tla",
+         distinct=3528, generated=24368),
+    # the golden testout2 model (6181/195, diameter 5 — TLC 1.57: 22h)
+    Case(f"{SS}/AdvancedExamples/MCInnerSerial.tla",
+         distinct=195, generated=6181),
+    # -- repo MC shims for the cfg-less reference specs
+    Case("specs/transfer_scaled.tla", root="repo",
+         cfg="specs/transfer_scaled.cfg",
+         distinct=153701, generated=311153, slow=True),
+    Case("specs/MCraftMicro.tla", root="repo",
+         cfg="specs/MCraft_micro.cfg", includes=("examples",),
+         distinct=694, generated=6185),
+    Case("specs/MCraftMicro.tla", root="repo",
+         cfg="specs/MCraft_3s_bench.cfg", includes=("examples",),
+         distinct=76654, generated=1138651, slow=True),
+    Case("specs/MCtextbookSI.tla", root="repo",
+         cfg="specs/MCtextbookSI_small.cfg", includes=("examples",),
+         distinct=569, generated=945),
+    # SI is EXPECTED non-serializable (textbookSnapshotIsolation.tla:91-96)
+    Case("specs/MCtextbookSI.tla", root="repo",
+         cfg="specs/MCtextbookSI_skew.cfg", includes=("examples",),
+         expect="violation:invariant", slow=True),
+    Case("specs/MCserializableSI.tla", root="repo",
+         cfg="specs/MCserializableSI_small.cfg", includes=("examples",),
+         distinct=569, generated=945),
+]
+
+
+def run_case(case: Case, backend: str = "interp"):
+    """Returns (passed: bool, detail: str, result|None)."""
+    from .front.cfg import ModelConfig, parse_cfg
+    from .sem.modules import Loader, bind_model
+    from .engine.explore import Explorer
+
+    spec = case.spec_path()
+    cfgp = case.cfg_path()
+    cfg = parse_cfg(open(cfgp).read()) if cfgp else ModelConfig(
+        specification="Spec")
+    if case.no_deadlock:
+        cfg.check_deadlock = False
+    ldr = Loader([os.path.dirname(spec)] + case.include_dirs())
+    mod = ldr.load_path(spec)
+
+    if case.expect == "assumes":
+        from .sem.eval import eval_expr, _bool, Ctx
+        from .sem.modules import bind_model_defs
+        defs = bind_model_defs(mod, cfg)
+        ctx = Ctx(defs)
+        n = 0
+        for a in mod.assumes:
+            if not _bool(eval_expr(a.expr, ctx), "ASSUME"):
+                return False, "ASSUME violated", None
+            n += 1
+        return True, f"{n} assumptions checked", None
+
+    model = bind_model(mod, cfg)
+    if backend == "jax":
+        from .tpu.bfs import TpuExplorer
+        from .compile.vspec import CompileError
+        from . import native_store
+        try:
+            r = TpuExplorer(model, store_trace=False,
+                            host_seen=native_store.is_available()).run()
+        except CompileError as ex:
+            return True, f"SKIP (outside jax subset: {ex})", None
+    else:
+        r = Explorer(model).run()
+
+    if case.expect == "ok":
+        if not r.ok:
+            return False, f"unexpected {r.violation.kind} violation " \
+                          f"({r.violation.name})", r
+    else:
+        kind = case.expect.split(":", 1)[1]
+        if r.ok or r.violation.kind != kind:
+            return False, f"expected a {kind} violation, got " \
+                          f"{'ok' if r.ok else r.violation.kind}", r
+    if case.distinct is not None and r.distinct != case.distinct:
+        return False, f"distinct {r.distinct} != pinned {case.distinct}", r
+    if case.generated is not None and r.generated != case.generated:
+        return False, f"generated {r.generated} != " \
+                      f"pinned {case.generated}", r
+    return True, f"{r.generated} generated / {r.distinct} distinct " \
+                 f"({case.expect})", r
+
+
+def sweep(backend: str = "interp", include_slow: bool = False,
+          log=print) -> int:
+    """Check the whole corpus; returns the number of failures."""
+    failures = 0
+    t0 = time.time()
+    n = 0
+    for case in CASES:
+        if case.slow and not include_slow:
+            continue
+        n += 1
+        name = case.cfg or case.spec
+        t1 = time.time()
+        try:
+            ok, detail, _ = run_case(case, backend)
+        except Exception as ex:  # a crash is a failure, not an abort
+            ok, detail = False, f"CRASH {type(ex).__name__}: {ex}"
+        status = "ok  " if ok else "FAIL"
+        log(f"[{status}] {name:62s} {detail} "
+            f"({time.time() - t1:.1f}s)")
+        if not ok:
+            failures += 1
+    log(f"{n} corpus models checked, {failures} failures "
+        f"({time.time() - t0:.1f}s, backend={backend})")
+    return failures

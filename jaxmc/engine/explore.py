@@ -259,7 +259,9 @@ class Explorer:
             warnings.append(
                 "temporal properties NOT checked (unsupported form): "
                 + ", ".join(unsupported))
-        collect_edges = bool(live_obligations)
+        # 'always' obligations only iterate states — don't pay for the
+        # edge log (RAM + checkpoint size) unless some obligation needs it
+        collect_edges = any(ob.kind != "always" for ob in live_obligations)
         edges: List[Tuple[int, int]] = []
 
         def result(ok, violation=None, truncated=False):

@@ -244,7 +244,9 @@ class LivenessChecker:
             self._state_key[self._key(st)] = i
 
     def _key(self, st):
-        return tuple(repr(st[v]) for v in self.model.vars)
+        # value-equality key (NOT repr: repr of equal frozensets is
+        # insertion-order dependent) — all TLA values are hashable
+        return tuple(st[v] for v in self.model.vars)
 
     # ---- fairness action evaluation ----
 

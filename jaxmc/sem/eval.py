@@ -605,9 +605,29 @@ def _ev_quant(e: A.Quant, ctx: Ctx):
     return False
 
 
+_FRESH_CHOOSE: Dict[A.Node, ModelValue] = {}
+
+
 def _ev_choose(e: A.Choose, ctx: Ctx):
     if e.set is None:
-        raise EvalError("unbounded CHOOSE not supported")
+        # TLC's special case: CHOOSE x : x \notin S evaluates to an
+        # arbitrary value outside S — a fresh model value, deterministic
+        # per CHOOSE expression (textbookSnapshotIsolation.tla:32 NoLock,
+        # InnerSerial.tla:9 InitWr). Anything else unbounded is rejected,
+        # as in TLC.
+        if isinstance(e.pred, A.OpApp) and e.pred.name == "\\notin" \
+                and isinstance(e.pred.args[0], A.Ident) \
+                and isinstance(e.var, str) \
+                and e.pred.args[0].name == e.var:
+            mv = _FRESH_CHOOSE.get(e)
+            if mv is None:
+                import hashlib
+                tag = hashlib.md5(repr(e).encode()).hexdigest()[:8]
+                mv = ModelValue(f"$fresh_{tag}")
+                _FRESH_CHOOSE[e] = mv
+            return mv
+        raise EvalError("unbounded CHOOSE not supported (except the "
+                        "CHOOSE x : x \\notin S fresh-value idiom)")
     s = eval_expr(e.set, ctx)
     for v in enumerate_set(s):
         b = bind_pattern(e.var, v)

@@ -224,6 +224,11 @@ def analyze_closure(clo, defs: Dict[str, Any], vars) -> Optional[
             return
         if isinstance(e, A.Let):
             loc = set(local)
+            # LET RECURSIVE declarations put names in scope before their
+            # definitions (textbookSnapshotIsolation.tla:647)
+            for d in e.defs:
+                if isinstance(d, A.RecursiveDecl):
+                    loc |= {nm for nm, _arity in d.names}
             for d in e.defs:
                 if isinstance(d, A.OpDef):
                     walk(d.body, loc | set(d.params), prime_mode)
@@ -235,6 +240,8 @@ def analyze_closure(clo, defs: Dict[str, Any], vars) -> Optional[
                         loc2 |= set(_pat_names(pats))
                     walk(d.body, loc2 | {d.name}, prime_mode)
                     loc.add(d.name)
+                elif isinstance(d, A.RecursiveDecl):
+                    pass
                 else:
                     raise _Uncacheable("unsupported LET unit")
             walk(e.body, loc, prime_mode)
@@ -281,16 +288,24 @@ def memo_key(store: MemoStore, clo, defs, ctx, args=()) -> Optional[tuple]:
     if an is None:
         return None
     sdeps, pdeps = an
+    # type names ride along because Python conflates True==1/False==0 in
+    # tuple equality — TLA+ treats them as different values (sem/values.py
+    # _enum_key has the same guard). Nested conflation inside containers
+    # remains the documented True/1 deviation.
     parts = [id(clo)]
-    parts.extend(args)
+    for a in args:
+        parts.append(type(a).__name__)
+        parts.append(a)
     st, pr = ctx.state, ctx.primes
     for v in sdeps:
         if st is None or v not in st:
             return None
+        parts.append(type(st[v]).__name__)
         parts.append(st[v])
     for v in pdeps:
         if pr is None or v not in pr:
             return None
+        parts.append(type(pr[v]).__name__)
         parts.append(pr[v])
     key = tuple(parts)
     try:

@@ -232,8 +232,19 @@ def in_set(v, s) -> bool:
     raise EvalError(f"\\in applied to non-set {fmt(s)}")
 
 
-_ENUM_CACHE: Dict[frozenset, List[Any]] = {}
+_ENUM_CACHE: Dict[Any, List[Any]] = {}
 _ENUM_CACHE_CAP = 1 << 16
+
+
+def _enum_key(s: frozenset):
+    # Python conflates True==1 / False==0, so {0, 1} and {FALSE, TRUE}
+    # are EQUAL frozensets — TLA+ distinguishes them (sort_key ranks bool
+    # before int). Tag the key with the exact bool subset: two Python-
+    # equal sets can only differ in which of 0/1 are booleans, and the
+    # bool subset pins that down ({0, TRUE} vs {1, FALSE} get distinct
+    # keys), so the cache never serves ints as booleans or vice versa.
+    bools = frozenset(x for x in s if type(x) is bool)
+    return (s, bools)
 
 
 def enumerate_set(s) -> List[Any]:
@@ -244,12 +255,13 @@ def enumerate_set(s) -> List[Any]:
     if isinstance(s, FcnSetV):
         return sorted(s.materialize(), key=sort_key)
     if isinstance(s, frozenset):
-        hit = _ENUM_CACHE.get(s)
+        key = _enum_key(s)
+        hit = _ENUM_CACHE.get(key)
         if hit is None:
             if len(_ENUM_CACHE) >= _ENUM_CACHE_CAP:
                 _ENUM_CACHE.clear()
             hit = sorted(s, key=sort_key)
-            _ENUM_CACHE[s] = hit
+            _ENUM_CACHE[key] = hit
         return hit
     if isinstance(s, InfiniteSet):
         raise EvalError(f"cannot enumerate infinite set {s!r}")

@@ -139,6 +139,11 @@ class TpuExplorer:
         if model.action_constraints:
             raise CompileError("action constraints not compiled yet - "
                                "use the interp backend")
+        # refinement PROPERTYs check stepwise on the host over the
+        # streamed candidate edges — same verdicts as the interp backend
+        from ..engine.refinement import build_refinement_checkers
+        self.refiners, self.unrefined = build_refinement_checkers(model)
+        self._ref_pair_cache: set = set()
         self.A = len(self.labels_flat)
         self.W = self.layout.width
         self.fp_mode = self.W > FP_THRESHOLD
@@ -187,6 +192,72 @@ class TpuExplorer:
 
         return expand
 
+    def _temporal_warnings(self) -> List[str]:
+        out = []
+        if self.unrefined:
+            out.append(
+                "temporal properties NOT checked on the jax backend "
+                "(run --backend interp for liveness): "
+                + ", ".join(self.unrefined))
+        for rc in self.refiners:
+            if rc.liveness_skipped:
+                out.append(
+                    f"property {rc.name}: refinement checked stepwise; "
+                    f"its fairness conjuncts are NOT checked")
+        return out
+
+    def _refine_init(self, init_rows, explored_init):
+        """check_init on kept init states; (rc_name, state) | None."""
+        if not self.refiners:
+            return None
+        for i in explored_init:
+            st = self.layout.decode(init_rows[i])
+            for rc in self.refiners:
+                if not rc.check_init(st):
+                    return rc.name, st
+        return None
+
+    def _refine_edges(self, frontier_rows, cand, cvalid, explore, FC):
+        """Stepwise refinement over this level's kept candidate edges
+        (decode on host, same check the interp engine runs). Returns
+        (action_idx, frontier_idx, succ_state, checker) or None.
+        Duplicate (parent, succ) pairs are checked once per run."""
+        if not self.refiners:
+            return None
+        idxs = np.nonzero(np.asarray(cvalid) & np.asarray(explore))[0]
+        if not len(idxs):
+            return None
+        cand = np.asarray(cand)
+        frontier_rows = np.asarray(frontier_rows)
+        parents: Dict[int, Any] = {}
+        if len(self._ref_pair_cache) > (1 << 20):
+            self._ref_pair_cache.clear()
+        for c in idxs:
+            f = int(c % FC)
+            a = int(c // FC)
+            key = (frontier_rows[f].tobytes(), cand[c].tobytes())
+            if key in self._ref_pair_cache:
+                continue
+            self._ref_pair_cache.add(key)
+            pst = parents.get(f)
+            if pst is None:
+                pst = self.layout.decode(frontier_rows[f])
+                parents[f] = pst
+            sst = self.layout.decode(cand[c])
+            for rc in self.refiners:
+                if not rc.check_edge(pst, sst):
+                    return a, f, sst, rc
+        return None
+
+    def _refine_violation(self, rc, sst, a, trace):
+        msg = (f"step is not a [{rc.name}-Next]_v step of the refined "
+               f"specification")
+        if rc.last_error:
+            msg += f"; while evaluating the property: {rc.last_error}"
+        trace = [x for x in trace if x[0] is not None]
+        trace.append((sst, self.labels_flat[a]))
+        return Violation("property", rc.name, trace, msg)
+
     def _keys_of(self, rows, valid):
         """Dedup key lanes: [validity, hash-or-state lanes]. Invalid rows
         get validity=1 (sorting after all valid rows) and SENTINEL data."""
@@ -208,6 +279,8 @@ class TpuExplorer:
         con_fns = self.constraint_fns
         keys_of = self._keys_of
         expand = self._expand_fn()
+        need_edges = bool(self.refiners)  # stream candidates for stepwise
+        # refinement on the host (verdict parity with the interp backend)
 
         @jax.jit
         def step(seen_keys, frontier, fcount):
@@ -295,13 +368,21 @@ class TpuExplorer:
                 inv_bad_which = jnp.where(first, wi, inv_bad_which)
                 inv_bad_any = inv_bad_any | any_
 
-            return dict(gen=gen, dead=dead, assert_bad=assert_bad,
-                        overflow=jnp.any(overflow),
-                        seen=seen2, seen_count=seen_count2,
-                        front_rows=front_rows, front_prov=front_prov,
-                        front_count=explore_count,
-                        inv_bad_any=inv_bad_any, inv_bad_idx=inv_bad_idx,
-                        inv_bad_which=inv_bad_which)
+            out = dict(gen=gen, dead=dead, assert_bad=assert_bad,
+                       overflow=jnp.any(overflow),
+                       seen=seen2, seen_count=seen_count2,
+                       front_rows=front_rows, front_prov=front_prov,
+                       front_count=explore_count,
+                       inv_bad_any=inv_bad_any, inv_bad_idx=inv_bad_idx,
+                       inv_bad_which=inv_bad_which)
+            if need_edges:
+                exp_all = cvalid
+                for nm, f in con_fns:
+                    exp_all = exp_all & jax.vmap(f)(cand)
+                out["cand"] = cand
+                out["cvalid"] = cvalid
+                out["explore_all"] = exp_all
+            return out
 
         self._step_cache[key] = step
         return step
@@ -355,10 +436,7 @@ class TpuExplorer:
         W = self.W
         warnings = ["seen-set resident in the native host fingerprint "
                     "store (host_seen); dedup on 128-bit fingerprints"]
-        if model.properties:
-            warnings.append(
-                "temporal properties NOT checked on the jax backend: "
-                + ", ".join(n for n, _ in model.properties))
+        warnings.extend(self._temporal_warnings())
         if model.symmetry is not None:
             warnings.append(SYMMETRY_WARNING)
 
@@ -378,6 +456,14 @@ class TpuExplorer:
             return self._mk_result(
                 False, len(explored_init) + 1, generated, 0, t0, warnings,
                 Violation("invariant", nm, [(st, "Initial predicate")]))
+        rv = self._refine_init(init_rows, explored_init)
+        if rv is not None:
+            nm, st = rv
+            return self._mk_result(
+                False, len(explored_init), generated, 0, t0, warnings,
+                Violation("property", nm, [(st, "Initial predicate")],
+                          f"initial state violates {nm}'s initial "
+                          f"predicate"))
         distinct = len(explored_init)
         self.log(f"Finished computing initial states: {distinct} distinct "
                  f"state{'s' if distinct != 1 else ''} generated.")
@@ -440,6 +526,15 @@ class TpuExplorer:
                 keys = np.asarray(out["keys"])
                 inv_ok = np.asarray(out["inv_ok"])
                 explore = np.asarray(out["explore"])
+                rviol = self._refine_edges(buf, out["cand"], cvalid,
+                                           explore, CH)
+                if rviol is not None:
+                    a, f, sst, rc = rviol
+                    trace = self._trace_to(trace_levels, frontier_maps,
+                                           depth, base + f)
+                    return self._mk_result(
+                        False, distinct, generated, depth, t0, warnings,
+                        self._refine_violation(rc, sst, a, trace))
                 valid_idx = np.nonzero(cvalid)[0]
                 new_mask = store.insert(keys[valid_idx][:, 1:])
                 new_idx = valid_idx[new_mask]
@@ -526,10 +621,7 @@ class TpuExplorer:
         layout = self.layout
         W, K = self.W, self.K
         warnings = []
-        if model.properties:
-            names = ", ".join(n for n, _ in model.properties)
-            warnings.append(
-                f"temporal properties NOT checked (unimplemented): {names}")
+        warnings.extend(self._temporal_warnings())
         if model.symmetry is not None:
             warnings.append(SYMMETRY_WARNING)
         if self.fp_mode:
@@ -553,6 +645,14 @@ class TpuExplorer:
             return self._mk_result(
                 False, len(explored_init) + 1, generated, 0, t0, warnings,
                 Violation("invariant", nm, [(st, "Initial predicate")]))
+        rv = self._refine_init(init_rows, explored_init)
+        if rv is not None:
+            nm, st = rv
+            return self._mk_result(
+                False, len(explored_init), generated, 0, t0, warnings,
+                Violation("property", nm, [(st, "Initial predicate")],
+                          f"initial state violates {nm}'s initial "
+                          f"predicate"))
         distinct = len(explored_init)
         self.log(f"Finished computing initial states: {distinct} distinct "
                  f"state{'s' if distinct != 1 else ''} generated.")
@@ -620,6 +720,18 @@ class TpuExplorer:
                 return self._mk_result(
                     False, distinct, generated, depth, t0, warnings,
                     Violation("deadlock", "deadlock", trace))
+
+            if self.refiners:
+                rviol = self._refine_edges(frontier, out["cand"],
+                                           out["cvalid"],
+                                           out["explore_all"], FC)
+                if rviol is not None:
+                    a, f, sst, rc = rviol
+                    trace = self._trace_to(trace_levels, frontier_maps,
+                                           depth, f)
+                    return self._mk_result(
+                        False, distinct, generated, depth, t0, warnings,
+                        self._refine_violation(rc, sst, a, trace))
 
             front_count = int(out["front_count"])
             generated += int(out["gen"])

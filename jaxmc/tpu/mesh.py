@@ -1,26 +1,32 @@
 r"""Multi-chip BFS over a jax.sharding.Mesh (SURVEY.md §2.3, §5).
 
 Frontier data-parallelism + fingerprint-space sharding: each device owns
-(a) a shard of the frontier (expanded locally with the same compiled action
-kernels as the single-chip path) and (b) a hash range of the seen-set.
-Per level, every device expands its frontier shard, the candidate successors
-are all_gather'd over the ICI axis, and each device keeps exactly the rows
-whose row-hash lands in its range — the structural analogue of
-ring-partitioned attention state for a model checker (SURVEY.md §5
-"long-context" row). Dedup within a shard is the same exact lexicographic
-sort as tpu/bfs.py; totals are psum'd.
+(a) a shard of the frontier, expanded with the SAME compiled kernels as
+the single-chip path (compile/kernel2.py — wide layouts, slotted dynamic
+\E, capacity buckets), and (b) a hash range of the seen-set, held as
+128-bit fingerprints with an explicit validity lane (never in-band
+sentinels — a valid state's lane can legitimately equal SENTINEL).
+Per level, every device expands its frontier shard, the candidate rows and
+their fingerprint keys are all_gather'd over the ICI axis, and each device
+keeps exactly the rows whose fingerprint lands in its range — the
+structural analogue of ring-partitioned attention state for a model
+checker (SURVEY.md §5 "long-context" row). Dedup within a shard is the
+same validity-lane-first lexicographic key sort as tpu/bfs.py; totals are
+psum'd. CONSTRAINT-discarded states are fingerprinted but never counted,
+checked, or explored (TLC semantics).
 
 The driver validates this path with N virtual CPU devices via
 __graft_entry__.dryrun_multichip (no multi-chip hardware needed).
 Collective-efficiency upgrades (hash-routed ppermute/all_to_all instead of
 all_gather) are planned once profiling on real multi-chip hardware exists.
+Counterexample traces and refinement PROPERTYs are single-chip features
+for now — the mesh reports their absence in warnings.
 """
 
 from __future__ import annotations
 
 import time
-from functools import partial
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Callable, Dict, Optional, Tuple
 
 import numpy as np
 import jax
@@ -29,138 +35,128 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..sem.modules import Model
-from ..sem.enumerate import enumerate_init
 from ..engine.explore import CheckResult, Violation
-from ..compile.ground import CompileError, build_layout, ground_actions
-from ..compile.kernel import compile_action, compile_predicate
-from .bfs import (SENTINEL, SYMMETRY_WARNING, _pow2_at_least,
-                  filter_init_states)
+from .bfs import (SENTINEL, SYMMETRY_WARNING, TpuExplorer, _pow2_at_least,
+                  filter_init_states, fingerprint128)
 
 
-def _row_hash(rows, xp=jnp):
-    """Deterministic FNV-1a row hash for owner routing (uint32 lanes).
-    xp=jnp on device, xp=np for host-side init-state routing — ONE
-    implementation so the two can never diverge."""
-    h = xp.full(rows.shape[:-1], 2166136261, xp.uint32)
-    for i in range(rows.shape[-1]):
-        h = (h ^ rows[..., i].astype(xp.uint32)) * xp.uint32(16777619)
-    return h
+class MeshExplorer(TpuExplorer):
+    """BFS with the frontier and seen-set sharded across a device mesh.
 
-
-class MeshExplorer:
-    """BFS with the frontier and seen-set sharded across a device mesh."""
+    Shares TpuExplorer's whole compile pipeline (layout sampling, slotted
+    kernels, compiled invariants/constraints); only the search loop is
+    mesh-sharded. Dedup is always on 128-bit fingerprints (the key layout
+    the seen shards store)."""
 
     def __init__(self, model: Model, mesh: Optional[Mesh] = None,
                  log: Callable[[str], None] = None,
                  max_states: Optional[int] = None,
-                 progress_every: float = 30.0):
-        self.model = model
-        self.log = log or (lambda s: None)
-        self.max_states = max_states
-        self.progress_every = progress_every
+                 progress_every: float = 30.0, **kw):
+        super().__init__(model, log=log, max_states=max_states,
+                         progress_every=progress_every,
+                         store_trace=False, **kw)
         if mesh is None:
             mesh = Mesh(np.array(jax.devices()), ("d",))
         self.mesh = mesh
         self.D = mesh.devices.size
+        # seen shards store fingerprint keys: force fp mode on any width
+        self.fp_mode = True
+        self.K = 4 + 1
+        self._mesh_step_cache: Dict[Tuple[int, int], Callable] = {}
 
-        base_ctx = model.ctx()
-        self.init_states = enumerate_init(model.init, base_ctx, model.vars)
-        self.layout = build_layout(model, self.init_states)
-        self.actions = ground_actions(model)
-        self.compiled = [compile_action(model, self.layout, ga)
-                         for ga in self.actions]
-        self.inv_fns = [(nm, compile_predicate(model, self.layout, ex))
-                        for nm, ex in model.invariants]
-        self.con_fns = [(nm, compile_predicate(model, self.layout, ex))
-                        for nm, ex in model.constraints]
-        if model.action_constraints:
-            raise CompileError("action constraints not compiled yet")
-        self.A = len(self.compiled)
-        self.W = self.layout.width
-        self._step_cache: Dict[Tuple[int, int], Callable] = {}
-
-    def _get_step(self, SC: int, FC: int) -> Callable:
-        """Per-device seen capacity SC, per-device frontier capacity FC."""
+    # ---- the sharded level step ----
+    def _get_mesh_step(self, SC: int, FC: int) -> Callable:
         key = (SC, FC)
-        if key in self._step_cache:
-            return self._step_cache[key]
-        A, W, D = self.A, self.W, self.D
-        acts = self.compiled
+        if key in self._mesh_step_cache:
+            return self._mesh_step_cache[key]
+        A, W, K, D = self.A, self.W, self.K, self.D
         inv_fns = self.inv_fns
-        con_fns = self.con_fns
+        con_fns = self.constraint_fns
+        keys_of = self._keys_of
+        expand = self._expand_fn()
+        C = A * FC
+        G = D * C
 
-        def device_step(seen, frontier, fcount):
-            # per-device blocks: seen [SC,W], frontier [FC,W], fcount [1]
-            seen = seen.reshape(SC, W)
+        def device_step(seen_keys, frontier, fcount):
+            # per-device blocks: seen_keys [SC,K], frontier [FC,W], [1]
+            seen_keys = seen_keys.reshape(SC, K)
             frontier = frontier.reshape(FC, W)
             me = lax.axis_index("d")
             fvalid = jnp.arange(FC) < fcount[0]
-            ens, aoks, succs = [], [], []
-            for ca in acts:
-                en, aok, succ = jax.vmap(ca.fn)(frontier)
-                ens.append(en)
-                aoks.append(aok)
-                succs.append(succ)
-            en = jnp.stack(ens)
-            aok = jnp.stack(aoks)
-            succ = jnp.stack(succs)
+            en, aok, ov, succ = expand(frontier)
             valid = en & fvalid[None, :]
             assert_bad = jnp.any((~aok) & fvalid[None, :])
+            overflow = jnp.any(ov & fvalid[None, :])
             dead_local = jnp.any(fvalid & ~jnp.any(en, axis=0))
             gen_local = jnp.sum(valid)
 
-            C = A * FC
-            cand = jnp.where(valid.reshape(C)[:, None],
-                             succ.reshape(C, W), SENTINEL)
-            # ICI exchange: gather all candidates, keep my hash range
-            allc = lax.all_gather(cand, "d", tiled=True)     # [D*C, W]
-            owner = (_row_hash(allc) % jnp.uint32(D)).astype(jnp.int32)
-            mine = (owner == me) & (allc[:, 0] != SENTINEL)
-            allc = jnp.where(mine[:, None], allc, SENTINEL)
+            cand = succ.reshape(C, W)
+            cvalid = valid.reshape(C)
+            cand = jnp.where(cvalid[:, None], cand, SENTINEL)
+            ckeys = keys_of(cand, cvalid)                 # [C, K]
 
-            # exact dedup against my seen shard
-            G = D * C
-            rows_all = jnp.concatenate([seen, allc])
+            # ICI exchange: gather all candidates + keys, keep my range
+            gcand = lax.all_gather(cand, "d", tiled=True)    # [G, W]
+            gkeys = lax.all_gather(ckeys, "d", tiled=True)   # [G, K]
+            gvalid = gkeys[:, 0] == 0     # explicit validity lane
+            owner = (gkeys[:, 1].astype(jnp.uint32)
+                     % jnp.uint32(D)).astype(jnp.int32)
+            mine = gvalid & (owner == me)
+            # foreign/invalid rows: validity lane 1 (sorts last), data
+            # lanes sentinel so equal keys cannot straddle the mask
+            gkeys = jnp.where(mine[:, None], gkeys,
+                              jnp.concatenate([jnp.ones(1, jnp.int32),
+                                               jnp.full(K - 1, SENTINEL,
+                                                        jnp.int32)]))
+
+            # merge-dedup against my seen shard (key sort; seen first at
+            # equal keys via the flag tiebreaker)
+            allk = jnp.concatenate([seen_keys, gkeys])    # [SC+G, K]
             flag = jnp.concatenate([jnp.zeros(SC, jnp.int32),
                                     jnp.ones(G, jnp.int32)])
-            ops = tuple(rows_all[:, i] for i in range(W)) + (flag,)
-            sorted_ = lax.sort(ops, num_keys=W + 1, is_stable=True)
-            rows = jnp.stack(sorted_[:W], axis=1)
-            sflag = sorted_[W]
-            rvalid = rows[:, 0] != SENTINEL
+            idx0 = jnp.arange(SC + G, dtype=jnp.int32)
+            ops = tuple(allk[:, i] for i in range(K)) + (flag, idx0)
+            sorted_ = lax.sort(ops, num_keys=K + 1, is_stable=True)
+            skeys = jnp.stack(sorted_[:K], axis=1)
+            sflag = sorted_[K]
+            perm = sorted_[K + 1]
+            cidx = perm - SC              # candidate position (<0: seen)
+            rvalid = skeys[:, 0] == 0
             neq_prev = jnp.concatenate([
-                jnp.array([True]), jnp.any(rows[1:] != rows[:-1], axis=1)])
+                jnp.array([True]),
+                jnp.any(skeys[1:] != skeys[:-1], axis=1)])
             new = (sflag == 1) & rvalid & neq_prev
             new_count = jnp.sum(new)
 
-            # hash skew can route up to G new rows to one device, so the
-            # compacted buffers are G-sized — truncating to C would silently
-            # drop states
-            ops2 = ((1 - new.astype(jnp.int32)),) + \
-                tuple(rows[:, i] for i in range(W))
+            # compact the new rows (gather payload by sorted position)
+            ops2 = ((1 - new.astype(jnp.int32)), cidx)
             comp = lax.sort(ops2, num_keys=1, is_stable=True)
-            new_rows = jnp.stack(comp[1:], axis=1)[:max(G, 1)]
+            new_cidx = comp[1][:G]
+            safe = jnp.clip(new_cidx, 0, G - 1)
+            new_rows = jnp.take(gcand, safe, axis=0)
+            nvalid = jnp.arange(G) < new_count
+            new_rows = jnp.where(nvalid[:, None], new_rows, SENTINEL)
 
+            # merged seen keys, compacted (keeps key order)
             keep = ((sflag == 0) & rvalid) | new
             ops3 = ((1 - keep.astype(jnp.int32)),) + \
-                tuple(rows[:, i] for i in range(W))
+                tuple(skeys[:, i] for i in range(K))
             comp3 = lax.sort(ops3, num_keys=1, is_stable=True)
             seen2 = jnp.stack(comp3[1:], axis=1)[:SC]
             seen_count2 = jnp.sum(keep)
 
-            # constraints FIRST: violating states stay fingerprinted in the
-            # seen shard but are discarded — not distinct, not checked, not
-            # explored (TLC semantics, testout2:265)
-            nvalid = jnp.arange(new_rows.shape[0]) < new_count
+            # constraints FIRST: violating states stay fingerprinted in
+            # the seen shard but are discarded — not distinct, not
+            # checked, not explored (TLC semantics, testout2:265)
             explore = nvalid
             for nm, f in con_fns:
                 explore = explore & jax.vmap(f)(new_rows)
-            ops4 = ((1 - explore.astype(jnp.int32)),) + \
-                tuple(new_rows[:, i] for i in range(W))
+            idx4 = jnp.arange(G, dtype=jnp.int32)
+            ops4 = ((1 - explore.astype(jnp.int32)), idx4)
             comp4 = lax.sort(ops4, num_keys=1, is_stable=True)
-            front_rows = jnp.stack(comp4[1:], axis=1)[:max(G, 1)]
+            front_rows = jnp.take(new_rows, comp4[1], axis=0)
             front_count = jnp.sum(explore)
-            frontvalid = jnp.arange(front_rows.shape[0]) < front_count
+            frontvalid = jnp.arange(G) < front_count
             inv_bad = jnp.asarray(False)
             for nm, f in inv_fns:
                 inv_bad = inv_bad | jnp.any(frontvalid &
@@ -171,14 +167,16 @@ class MeshExplorer:
             tot_new = lax.psum(front_count, "d")
             any_dead = lax.psum(dead_local.astype(jnp.int32), "d") > 0
             any_assert = lax.psum(assert_bad.astype(jnp.int32), "d") > 0
+            any_ovf = lax.psum(overflow.astype(jnp.int32), "d") > 0
             any_inv = lax.psum(inv_bad.astype(jnp.int32), "d") > 0
             tot_front = lax.psum(front_count, "d")
 
-            return (seen2.reshape(1, SC, W), seen_count2.reshape(1),
-                    front_rows.reshape(1, -1, W), front_count.reshape(1),
+            return (seen2.reshape(1, SC, K), seen_count2.reshape(1),
+                    front_rows.reshape(1, G, W), front_count.reshape(1),
                     tot_gen.reshape(1), tot_new.reshape(1),
                     any_dead.reshape(1), any_assert.reshape(1),
-                    any_inv.reshape(1), tot_front.reshape(1))
+                    any_ovf.reshape(1), any_inv.reshape(1),
+                    tot_front.reshape(1))
 
         try:
             from jax import shard_map
@@ -187,24 +185,37 @@ class MeshExplorer:
         step = jax.jit(shard_map(
             device_step, mesh=self.mesh,
             in_specs=(P("d"), P("d"), P("d")),
-            out_specs=(P("d"), P("d"), P("d"), P("d"), P("d"), P("d"),
-                       P("d"), P("d"), P("d"), P("d"))))
-        self._step_cache[key] = step
+            out_specs=tuple([P("d")] * 11)))
+        self._mesh_step_cache[key] = step
         return step
+
+    def _owner_of(self, rows: np.ndarray) -> np.ndarray:
+        """Host-side owner routing — the SAME fingerprint the device keys
+        use (lane 1 of _keys_of == fingerprint128 word 0), so host and
+        device can never disagree on ownership."""
+        if not len(rows):
+            return np.zeros(0, np.int64)
+        fp = np.asarray(fingerprint128(jnp.asarray(rows)))
+        return (fp[:, 0].astype(np.uint32) % np.uint32(self.D)) \
+            .astype(np.int64)
 
     def run(self) -> CheckResult:
         t0 = time.time()
         model = self.model
         layout = self.layout
-        D, W = self.D, self.W
-        warnings = []
-        if model.properties:
-            warnings.append("temporal properties NOT checked (unimplemented)"
-                            f": {', '.join(n for n, _ in model.properties)}")
+        D, W, K = self.D, self.W, self.K
+        warnings = ["mesh backend: dedup on 128-bit fingerprints; "
+                    "collision probability < n^2 * 2^-129; no "
+                    "counterexample traces yet"]
+        warnings.extend(self._temporal_warnings())
+        if self.refiners:
+            warnings.append(
+                "refinement properties NOT checked on the mesh backend "
+                "(single-chip --backend jax checks them): "
+                + ", ".join(rc.name for rc in self.refiners))
         if model.symmetry is not None:
             warnings.append(SYMMETRY_WARNING)
 
-        # encode + host-dedup init states, distribute by owner hash
         rows = {}
         for st in self.init_states:
             rows[layout.encode(st).tobytes()] = None
@@ -228,8 +239,7 @@ class MeshExplorer:
         self.log(f"Finished computing initial states: {distinct} distinct "
                  f"state{'s' if distinct != 1 else ''} generated.")
 
-        owner = (_row_hash(init_rows, xp=np) % np.uint32(D)).astype(np.int64)
-
+        owner = self._owner_of(init_rows)
         per_dev = [init_rows[(owner == d) & explored_mask]
                    for d in range(D)]
         seen_per_dev = [init_rows[owner == d] for d in range(D)]
@@ -238,16 +248,19 @@ class MeshExplorer:
         SC = _pow2_at_least(4 * FC, lo=256)
 
         frontier = np.full((D, FC, W), SENTINEL, np.int32)
-        seen = np.full((D, SC, W), SENTINEL, np.int32)
+        seen = np.full((D, SC, K), SENTINEL, np.int32)
+        seen[:, :, 0] = 1  # empty slots: validity lane 1
         fcount = np.zeros((D,), np.int32)
         for d in range(D):
             p = per_dev[d]
             frontier[d, :len(p)] = p
             sp = seen_per_dev[d]
             if len(sp):
-                order = np.lexsort(tuple(sp[:, i]
-                                         for i in reversed(range(W))))
-                seen[d, :len(sp)] = sp[order]
+                k = np.asarray(self._keys_of(
+                    jnp.asarray(sp), jnp.ones(len(sp), bool)))
+                order = np.lexsort(tuple(k[:, i]
+                                         for i in reversed(range(K))))
+                seen[d, :len(sp)] = k[order]
             fcount[d] = len(p)
         frontier = jnp.asarray(frontier)
         seen = jnp.asarray(seen)
@@ -258,17 +271,26 @@ class MeshExplorer:
         last_progress = time.time()
         while int(np.sum(np.asarray(fcount))) > 0:
             C = self.A * FC
-            if int(seen_counts.max(initial=0)) + D * C > SC:
-                SC2 = _pow2_at_least(int(seen_counts.max(initial=0)) + D * C,
-                                     SC)
-                pad = jnp.full((D, SC2 - SC, W), SENTINEL, jnp.int32)
-                seen = jnp.concatenate([seen, pad], axis=1)
+            need = int(seen_counts.max(initial=0)) + D * C
+            if need > SC:
+                SC2 = _pow2_at_least(need, SC)
+                pad = np.full((D, SC2 - SC, K), SENTINEL, np.int32)
+                pad[:, :, 0] = 1
+                seen = jnp.concatenate([seen, jnp.asarray(pad)], axis=1)
                 SC = SC2
-            step = self._get_step(SC, FC)
+            step = self._get_mesh_step(SC, FC)
             (seen, seen_cnt, front_rows, front_cnt, tot_gen, tot_new,
-             any_dead, any_assert, any_inv, tot_front) = step(
+             any_dead, any_assert, any_ovf, any_inv, tot_front) = step(
                 seen, frontier, fcount)
 
+            if bool(np.asarray(any_ovf)[0]):
+                return self._mk(False, distinct, generated, depth, t0,
+                                warnings, Violation(
+                                    "error", "capacity overflow", [],
+                                    "a container exceeded its lane "
+                                    "capacity (raise --seq-cap/--grow-cap/"
+                                    "--kv-cap); counts would no longer "
+                                    "be exact"))
             if model.check_deadlock and bool(np.asarray(any_dead)[0]):
                 return self._mk(False, distinct, generated, depth, t0,
                                 warnings, Violation(
@@ -283,8 +305,7 @@ class MeshExplorer:
                                     "no trace reconstruction yet)"))
 
             generated += int(np.asarray(tot_gen)[0])
-            new_total = int(np.asarray(tot_new)[0])
-            distinct += new_total
+            distinct += int(np.asarray(tot_new)[0])
             seen_counts = np.asarray(seen_cnt).astype(np.int64)
 
             if bool(np.asarray(any_inv)[0]):
@@ -299,7 +320,8 @@ class MeshExplorer:
                 return self._mk(True, distinct, generated, depth, t0,
                                 warnings, truncated=True)
 
-            # next frontier: per-device new rows, capacity = max new count
+            # next frontier: per-device kept rows; capacity grows to the
+            # max shard (hash skew can route up to G rows to one device)
             fcount = front_cnt
             max_front = int(np.asarray(front_cnt).max(initial=0))
             if max_front > FC:
@@ -320,8 +342,8 @@ class MeshExplorer:
                          f"{int(np.asarray(tot_front)[0])} on queue.")
 
         self.log("Model checking completed. No error has been found.")
-        self.log(f"{generated} states generated, {distinct} distinct states "
-                 f"found, 0 states left on queue.")
+        self.log(f"{generated} states generated, {distinct} distinct "
+                 f"states found, 0 states left on queue.")
         return self._mk(True, distinct, generated, depth - 1, t0, warnings)
 
     def _mk(self, ok, distinct, generated, diameter, t0, warnings,

@@ -1,0 +1,14 @@
+----------------------------- MODULE MCserializableSI -----------------------
+\* Model-checking shim for Cahill's serializable-snapshot-isolation spec
+\* (/root/reference/examples/serializableSnapshotIsolation.tla), encoding
+\* the spec's documented Toolbox model (:43-96). Unlike textbook SI, here
+\* BOTH serializability formulations must HOLD (:75-79) — SSI is the
+\* algorithm PostgreSQL ships.
+EXTENDS serializableSnapshotIsolation
+
+MCWellFormed == WellFormedTransactionsInHistory(history)
+
+MCCahillSerializable == CahillSerializable(history)
+
+MCBernsteinSerializable == BernsteinSerializable(history)
+=============================================================================

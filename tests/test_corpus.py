@@ -27,57 +27,23 @@ def run(rel, no_deadlock=False, max_states=None):
     return Explorer(bind_model(m, cfg), max_states=max_states).run()
 
 
-# (spec, no_deadlock, expect_ok, distinct, generated)
+# One manifest drives both this test and `jaxmc sweep` (make check-corpus):
+# jaxmc/corpus.py pins every checkable spec+cfg with its expected verdict.
 # distinct counts only CONSTRAINT-satisfying states: TLC fingerprints a
-# violating state but discards it (never distinct/checked/explored) —
+# violating state but discards it (never distinct/checked/explored) --
 # semantics pinned by the golden run (testout2:265: 195 distinct, matched
 # exactly by test_innerserial_matches_golden_testout2)
-CASES = [
-    ("pcal_intro.tla", False, True, 3800, 5850),
-    ("examples/Paxos/MCPaxos.tla", False, True, 25, 82),
-    ("examples/Paxos/MCConsensus.tla", True, True, 4, 7),
-    # MCVoting.cfg declares SYMMETRY: counts are symmetry-reduced
-    ("examples/Paxos/MCVoting.tla", True, True, 77, 406),
-    ("examples/SpecifyingSystems/HourClock/HourClock.tla",
-     False, True, 12, 24),
-    ("examples/SpecifyingSystems/HourClock/HourClock2.tla",
-     False, True, 12, 24),
-    ("examples/SpecifyingSystems/AsynchronousInterface/AsynchInterface.tla",
-     False, True, 12, 30),
-    ("examples/SpecifyingSystems/AsynchronousInterface/Channel.tla",
-     False, True, 12, 30),
-    ("examples/SpecifyingSystems/FIFO/MCInnerFIFO.tla",
-     False, True, 3864, 9660),
-    ("examples/SpecifyingSystems/CachingMemory/MCInternalMemory.tla",
-     False, True, 4408, 21400),
-    ("examples/SpecifyingSystems/CachingMemory/MCWriteThroughCache.tla",
-     False, True, 5196, 28170),
-    ("examples/SpecifyingSystems/Liveness/LiveHourClock.tla",
-     False, True, 12, 24),
-    ("examples/SpecifyingSystems/Liveness/MCLiveInternalMemory.tla",
-     False, True, 4408, 21400),
-    ("examples/SpecifyingSystems/Liveness/MCLiveWriteThroughCache.tla",
-     False, True, 5196, 28170),
-    # ErrorTemporal is EXPECTED to fail (the cfg checks a property the
-    # spec violates, MCRealTimeHourClock.tla:43) — TLC finds it too
-    ("examples/SpecifyingSystems/RealTime/MCRealTimeHourClock.tla",
-     False, False, 216, 696),
-    ("examples/SpecifyingSystems/TLC/ABCorrectness.tla",
-     False, True, 20, 36),
-    ("examples/SpecifyingSystems/TLC/MCAlternatingBit.tla",
-     False, True, 240, 1392),
-    ("examples/SpecifyingSystems/AdvancedExamples/MCInnerSequential.tla",
-     False, True, 3528, 24368),
-]
+from jaxmc.corpus import CASES, run_case
+
+FAST = [c for c in CASES if not c.slow]
 
 
-@pytest.mark.parametrize("rel,no_dl,ok,distinct,generated",
-                         CASES, ids=[c[0].split("/")[-1] for c in CASES])
-def test_corpus_spec(rel, no_dl, ok, distinct, generated):
-    r = run(rel, no_deadlock=no_dl)
-    assert r.ok == ok, (r.violation.kind if r.violation else None)
-    assert r.distinct == distinct
-    assert r.generated == generated
+@pytest.mark.parametrize(
+    "case", FAST,
+    ids=[(c.cfg or c.spec).split("/")[-1] for c in FAST])
+def test_corpus_case(case):
+    ok, detail, _r = run_case(case)
+    assert ok, detail
 
 
 def test_innerserial_matches_golden_testout2():

@@ -33,11 +33,12 @@ def pcal_model():
 
 class TestLayout:
     def test_roundtrip(self, pcal_model):
-        from jaxmc.compile.ground import build_layout
+        from jaxmc.compile.vspec import Bounds
+        from jaxmc.compile.kernel2 import build_layout2
         from jaxmc.sem.enumerate import enumerate_init
         inits = enumerate_init(pcal_model.init, pcal_model.ctx(),
                                pcal_model.vars)
-        lay = build_layout(pcal_model, inits)
+        lay = build_layout2(pcal_model, inits, Bounds())
         for st in inits[:10]:
             row = lay.encode(st)
             back = lay.decode(row)
@@ -185,3 +186,81 @@ class TestCorpusOnDevice:
         assert r.ok
         assert r.distinct == distinct
         assert r.generated == generated
+
+
+class TestRefinementOnDevice:
+    # refinement PROPERTYs check stepwise on the jax backend too (host-
+    # side over the streamed candidate edges) — verdict parity with interp
+
+    def test_hourclock2_equivalence_checked(self):
+        from jaxmc.tpu.bfs import TpuExplorer
+        d = os.path.join(REFERENCE, "examples/SpecifyingSystems/HourClock")
+        cfg = parse_cfg(open(os.path.join(d, "HourClock2.cfg")).read())
+        model = load(os.path.join(d, "HourClock2.tla"), cfg)
+        r = TpuExplorer(model).run()
+        assert r.ok
+        assert r.distinct == 12 and r.generated == 24
+        assert not any("HC2" in w for w in r.warnings)
+
+    def test_alternating_bit_abcspec_checked(self):
+        from jaxmc.tpu.bfs import TpuExplorer
+        d = os.path.join(REFERENCE, "examples/SpecifyingSystems/TLC")
+        cfg = parse_cfg(open(os.path.join(d, "MCAlternatingBit.cfg")).read())
+        model = load(os.path.join(d, "MCAlternatingBit.tla"), cfg)
+        r = TpuExplorer(model).run()
+        assert r.ok
+        assert r.distinct == 240 and r.generated == 1392
+        assert any("ABCSpec" in w and "stepwise" in w for w in r.warnings)
+
+    def test_non_refinement_detected(self, tmp_path):
+        from jaxmc.tpu.bfs import TpuExplorer
+        spec = tmp_path / "badhc.tla"
+        spec.write_text("""---- MODULE badhc ----
+EXTENDS Naturals
+VARIABLE hr
+HCini == hr \\in 1..12
+HCnxt == hr' = IF hr >= 11 THEN 1 ELSE hr + 2
+HC == HCini /\\ [][HCnxt]_hr
+Jump == hr' = IF hr = 12 THEN 1 ELSE hr + 1
+JumpSpec == HCini /\\ [][Jump]_hr
+====
+""")
+        cfg = ModelConfig(specification="HC", properties=["JumpSpec"],
+                          check_deadlock=False)
+        model = load(str(spec), cfg)
+        r = TpuExplorer(model).run()
+        assert not r.ok
+        assert r.violation.kind == "property"
+        assert r.violation.name == "JumpSpec"
+        # the trace ends with the non-refining step
+        assert len(r.violation.trace) >= 2
+
+
+@pytest.mark.slow
+def test_mesh_raft_micro_counts():
+    # the flagship wide-state workload shards: MCraftMicro on an 8-device
+    # mesh matches the interp/single-chip counts exactly
+    import jax
+    from jaxmc.tpu.mesh import MeshExplorer
+    assert len(jax.devices()) >= 8
+    ldr = Loader([os.path.join(REFERENCE, "examples"), SPECS])
+    model = bind_model(
+        ldr.load_path(os.path.join(SPECS, "MCraftMicro.tla")),
+        parse_cfg(open(os.path.join(SPECS, "MCraft_micro.cfg")).read()))
+    r = MeshExplorer(model).run()
+    assert r.ok
+    assert r.distinct == 694 and r.generated == 6185
+
+
+def test_mesh_innerfifo_counts():
+    # mesh-vs-interp equality on a corpus model with constraints and a
+    # canonically-sorted container (the fp128-key dedup path)
+    import jax
+    from jaxmc.tpu.mesh import MeshExplorer
+    assert len(jax.devices()) >= 8
+    d = os.path.join(REFERENCE, "examples/SpecifyingSystems/FIFO")
+    cfg = parse_cfg(open(os.path.join(d, "MCInnerFIFO.cfg")).read())
+    model = load(os.path.join(d, "MCInnerFIFO.tla"), cfg)
+    r = MeshExplorer(model).run()
+    assert r.ok
+    assert r.distinct == 3864 and r.generated == 9660

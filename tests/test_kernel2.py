@@ -182,3 +182,25 @@ def test_raft_micro_whole_run_equivalence():
                      chunk=256).run()
     assert rj.ok
     assert (rj.generated, rj.distinct) == (6185, 694)
+
+
+@pytest.mark.slow
+def test_raft_3s_bench_whole_run_equivalence():
+    # backend count-equivalence on the BENCHMARK model itself (bench.py's
+    # workload): ~3.5min interp + ~6min jax on CPU
+    from jaxmc.tpu.bfs import TpuExplorer
+    from jaxmc import native_store
+
+    def load_bench():
+        ldr = Loader([os.path.join(REFERENCE, "examples"), SPECS])
+        return bind_model(
+            ldr.load_path(os.path.join(SPECS, "MCraftMicro.tla")),
+            parse_cfg(open(os.path.join(SPECS,
+                                        "MCraft_3s_bench.cfg")).read()))
+    ri = Explorer(load_bench()).run()
+    assert ri.ok
+    assert (ri.generated, ri.distinct) == (1138651, 76654)
+    rj = TpuExplorer(load_bench(), store_trace=False,
+                     host_seen=native_store.is_available()).run()
+    assert rj.ok
+    assert (rj.generated, rj.distinct) == (1138651, 76654)
