@@ -244,24 +244,15 @@ class Explorer:
         # temporal obligations are checked over the behavior graph after
         # the search completes (engine/liveness.py) — collect the full
         # edge log only when some property needs it
-        from .liveness import classify_property, UnsupportedProperty
-        refined_names = {rc.name for rc in refiners}
-        live_obligations = []
-        unsupported = []
-        for pnm, pexpr in model.properties:
-            try:
-                live_obligations.extend(
-                    classify_property(model, pnm, pexpr, {}))
-            except (UnsupportedProperty, EvalError):
-                if pnm not in refined_names:
-                    unsupported.append(pnm)
+        from .liveness import collect_obligations
+        # 'always' obligations only iterate states — don't pay for the
+        # edge log (RAM + checkpoint size) unless some obligation needs it
+        live_obligations, unsupported, collect_edges = \
+            collect_obligations(model, {rc.name for rc in refiners})
         if unsupported:
             warnings.append(
                 "temporal properties NOT checked (unsupported form): "
                 + ", ".join(unsupported))
-        # 'always' obligations only iterate states — don't pay for the
-        # edge log (RAM + checkpoint size) unless some obligation needs it
-        collect_edges = any(ob.kind != "always" for ob in live_obligations)
         edges: List[Tuple[int, int]] = []
 
         def result(ok, violation=None, truncated=False):

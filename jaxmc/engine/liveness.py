@@ -141,6 +141,26 @@ def classify_property(model: Model, prop_name: str, expr: A.Node,
     raise UnsupportedProperty(f"unsupported temporal form")
 
 
+def collect_obligations(model: Model, refined_names: Set[str]
+                        ) -> Tuple[List[Obligation], List[str], bool]:
+    """Classify every cfg PROPERTY into temporal obligations — the shared
+    policy of the interp and jax backends (verdict/warning parity).
+    Returns (obligations, unsupported_names, collect_edges):
+    unsupported_names excludes properties a refinement checker already
+    covers; collect_edges is True iff some obligation needs the edge log
+    (everything except bare '[]P')."""
+    obligations: List[Obligation] = []
+    unsupported: List[str] = []
+    for pnm, pexpr in model.properties:
+        try:
+            obligations.extend(classify_property(model, pnm, pexpr, {}))
+        except (UnsupportedProperty, EvalError):
+            if pnm not in refined_names:
+                unsupported.append(pnm)
+    collect_edges = any(ob.kind != "always" for ob in obligations)
+    return obligations, unsupported, collect_edges
+
+
 def _contains_temporal(e: A.Node, model: Model, depth=0) -> bool:
     if depth > 40:
         return True

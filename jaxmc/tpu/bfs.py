@@ -95,6 +95,68 @@ def fingerprint128(rows):
     return jnp.stack(out, axis=1)
 
 
+class _LiveGraph:
+    """Host-side behavior-graph accumulator for device runs.
+
+    Mirrors the interp engine's bookkeeping (engine/explore.py): kept
+    states get dense ids in discovery order; edges record every
+    (parent, kept-successor) step including re-visits of already-seen
+    states; parents/labels form the BFS tree for trace reconstruction.
+    Constraint-discarded successors never enter the graph — the same
+    mask that keeps them off the device frontier keeps them out here."""
+
+    def __init__(self, labels_flat: List[str], collect_edges: bool):
+        self.labels_flat = labels_flat
+        self.collect_edges = collect_edges
+        self.rows: List[np.ndarray] = []
+        self.sid_by_key: Dict[bytes, int] = {}
+        self.parents: List[Optional[int]] = []
+        self.labels: List[str] = []
+        self.edges: List[Tuple[int, int]] = []
+
+    def add_inits(self, init_rows, explored_idx) -> np.ndarray:
+        sids = []
+        for i in explored_idx:
+            row = np.array(init_rows[i], copy=True)
+            sid = len(self.rows)
+            self.rows.append(row)
+            self.sid_by_key[row.tobytes()] = sid
+            self.parents.append(None)
+            self.labels.append("Initial predicate")
+            sids.append(sid)
+        return np.asarray(sids, dtype=np.int64)
+
+    def add_level(self, new_rows, new_prov, par_div: int,
+                  frontier_sids: np.ndarray) -> np.ndarray:
+        """Register this level's kept rows; prov = action*par_div + f."""
+        sids = []
+        for i in range(len(new_rows)):
+            row = np.array(new_rows[i], copy=True)
+            sid = len(self.rows)
+            self.rows.append(row)
+            self.sid_by_key[row.tobytes()] = sid
+            p = int(new_prov[i])
+            a, f = p // par_div, p % par_div
+            self.parents.append(int(frontier_sids[f]))
+            self.labels.append(self.labels_flat[a])
+            sids.append(sid)
+        return np.asarray(sids, dtype=np.int64)
+
+    def add_edges(self, rows: np.ndarray, parent_f: np.ndarray,
+                  frontier_sids: np.ndarray) -> None:
+        """Record edges (frontier_sids[parent_f[i]] -> sid of rows[i]) for
+        pre-masked kept candidates; call after add_level so same-level
+        successors resolve."""
+        if not self.collect_edges:
+            return
+        for i in range(len(rows)):
+            t = self.sid_by_key.get(rows[i].tobytes())
+            if t is None:
+                continue  # fp-collision shadow; counts already report it
+            self.edges.append(
+                (int(frontier_sids[int(parent_f[i])]), t))
+
+
 class TpuExplorer:
     def __init__(self, model: Model, log: Callable[[str], None] = None,
                  max_states: Optional[int] = None, store_trace: bool = True,
@@ -144,6 +206,13 @@ class TpuExplorer:
         from ..engine.refinement import build_refinement_checkers
         self.refiners, self.unrefined = build_refinement_checkers(model)
         self._ref_pair_cache: set = set()
+        # temporal (liveness) obligations check over the behavior graph
+        # after the search completes, exactly like the interp backend:
+        # kept states/edges stream to the host during the run and feed
+        # engine/liveness.py — same classifier, same checker, same verdict
+        from ..engine.liveness import collect_obligations
+        self.live_obligations, self.live_unsupported, self.collect_edges = \
+            collect_obligations(model, {rc.name for rc in self.refiners})
         self.A = len(self.labels_flat)
         self.W = self.layout.width
         self.fp_mode = self.W > FP_THRESHOLD
@@ -194,17 +263,32 @@ class TpuExplorer:
 
     def _temporal_warnings(self) -> List[str]:
         out = []
-        if self.unrefined:
+        if self.live_unsupported:
             out.append(
-                "temporal properties NOT checked on the jax backend "
-                "(run --backend interp for liveness): "
-                + ", ".join(self.unrefined))
+                "temporal properties NOT checked (unsupported form): "
+                + ", ".join(self.live_unsupported))
         for rc in self.refiners:
             if rc.liveness_skipped:
                 out.append(
                     f"property {rc.name}: refinement checked stepwise; "
                     f"its fairness conjuncts are NOT checked")
         return out
+
+    def _check_live(self, graph, warnings) -> Optional[Violation]:
+        """Run the temporal obligations over the accumulated behavior
+        graph (end of a completed search)."""
+        if not self.live_obligations:
+            return None
+        from ..engine.liveness import LivenessChecker
+        states = [self.layout.decode(r) for r in graph.rows]
+        lc = LivenessChecker(self.model, states, graph.edges,
+                             graph.parents, graph.labels)
+        bad, live_warns = lc.check(self.live_obligations)
+        warnings.extend(live_warns)
+        if bad is None:
+            return None
+        pname, trace, msg = bad
+        return Violation("property", pname, trace, msg)
 
     def _refine_init(self, init_rows, explored_init):
         """check_init on kept init states; (rc_name, state) | None."""
@@ -279,8 +363,9 @@ class TpuExplorer:
         con_fns = self.constraint_fns
         keys_of = self._keys_of
         expand = self._expand_fn()
-        need_edges = bool(self.refiners)  # stream candidates for stepwise
-        # refinement on the host (verdict parity with the interp backend)
+        # stream candidates for stepwise refinement and/or the liveness
+        # behavior graph on the host (verdict parity with the interp)
+        need_edges = bool(self.refiners) or self.collect_edges
 
         @jax.jit
         def step(seen_keys, frontier, fcount):
@@ -480,6 +565,11 @@ class TpuExplorer:
         CH = _pow2_at_least(self.chunk, lo=64)
         frontier_np = np.ascontiguousarray(init_rows[explored_init])
 
+        graph = _LiveGraph(self.labels_flat, self.collect_edges) \
+            if self.live_obligations else None
+        frontier_sids = graph.add_inits(init_rows, explored_init) \
+            if graph is not None else None
+
         trace_levels = [(np.asarray(init_rows), None, 0)]
         frontier_maps = [np.asarray(explored_init, dtype=np.int64)]
         depth = 0
@@ -490,6 +580,7 @@ class TpuExplorer:
             lvl_new_rows: List[np.ndarray] = []
             lvl_new_prov: List[np.ndarray] = []
             lvl_explore: List[np.ndarray] = []
+            lvl_edges: List[Tuple[np.ndarray, np.ndarray]] = []
             inv_hit = None
             for base in range(0, L, CH):
                 cn = min(CH, L - base)
@@ -535,6 +626,16 @@ class TpuExplorer:
                     return self._mk_result(
                         False, distinct, generated, depth, t0, warnings,
                         self._refine_violation(rc, sst, a, trace))
+                if graph is not None and graph.collect_edges:
+                    # keep only the masked kept-candidate rows (the full
+                    # [A*CH, W] tensor per chunk would hold the whole
+                    # level expansion in host RAM)
+                    eidx = np.nonzero(cvalid & explore)[0]
+                    erows = np.asarray(jnp.take(
+                        out["cand"], jnp.asarray(eidx, dtype=jnp.int32),
+                        axis=0)) if len(eidx) \
+                        else np.zeros((0, W), np.int32)
+                    lvl_edges.append((erows, base + eidx % CH))
                 valid_idx = np.nonzero(cvalid)[0]
                 new_mask = store.insert(keys[valid_idx][:, 1:])
                 new_idx = valid_idx[new_mask]
@@ -590,6 +691,13 @@ class TpuExplorer:
                     Violation("invariant", nm, trace))
 
             sel = np.nonzero(explore_mask)[0]
+            if graph is not None:
+                new_sids = graph.add_level(new_rows_np[sel],
+                                           new_prov_np[sel], L,
+                                           frontier_sids)
+                for erows, eparents in lvl_edges:
+                    graph.add_edges(erows, eparents, frontier_sids)
+                frontier_sids = new_sids
             if self.store_trace:
                 frontier_maps.append(sel.astype(np.int64))
             depth += 1
@@ -606,6 +714,11 @@ class TpuExplorer:
                          f"{distinct} distinct, {len(frontier_np)} on "
                          f"queue.")
 
+        if graph is not None:
+            viol = self._check_live(graph, warnings)
+            if viol is not None:
+                return self._mk_result(False, distinct, generated,
+                                       depth - 1, t0, warnings, viol)
         self.log("Model checking completed. No error has been found.")
         self.log(f"{generated} states generated, {distinct} distinct "
                  f"states found, 0 states left on queue.")
@@ -656,6 +769,11 @@ class TpuExplorer:
         distinct = len(explored_init)
         self.log(f"Finished computing initial states: {distinct} distinct "
                  f"state{'s' if distinct != 1 else ''} generated.")
+
+        graph = _LiveGraph(self.labels_flat, self.collect_edges) \
+            if self.live_obligations else None
+        frontier_sids = graph.add_inits(init_rows, explored_init) \
+            if graph is not None else None
 
         FC = _pow2_at_least(max(n_init, 1))
         SC = _pow2_at_least(4 * max(n_init, 1))
@@ -739,6 +857,23 @@ class TpuExplorer:
             seen = out["seen"]
             seen_count = int(out["seen_count"])
 
+            if graph is not None:
+                new_sids = graph.add_level(
+                    np.asarray(out["front_rows"][:front_count]),
+                    np.asarray(out["front_prov"][:front_count]),
+                    FC, frontier_sids)
+                if graph.collect_edges:
+                    # the step emits cand/explore_all iff need_edges —
+                    # which collect_edges implies
+                    mask = np.asarray(out["cvalid"]) & np.asarray(
+                        out["explore_all"])
+                    idx = np.nonzero(mask)[0]
+                    rows = np.asarray(jnp.take(
+                        out["cand"], jnp.asarray(idx, dtype=jnp.int32),
+                        axis=0)) if len(idx) else np.zeros((0, W), np.int32)
+                    graph.add_edges(rows, idx % FC, frontier_sids)
+                frontier_sids = new_sids
+
             if self.store_trace:
                 # trace levels hold the kept states; every kept state is
                 # explored, so the frontier map is the identity
@@ -779,6 +914,11 @@ class TpuExplorer:
                          f"{distinct} distinct states found, "
                          f"{fcount} states left on queue.")
 
+        if graph is not None:
+            viol = self._check_live(graph, warnings)
+            if viol is not None:
+                return self._mk_result(False, distinct, generated,
+                                       depth - 1, t0, warnings, viol)
         self.log("Model checking completed. No error has been found.")
         self.log(f"{generated} states generated, {distinct} distinct states "
                  f"found, 0 states left on queue.")
@@ -789,6 +929,10 @@ class TpuExplorer:
 
     def _mk_result(self, ok, distinct, generated, diameter, t0, warnings,
                    violation=None, truncated=False) -> CheckResult:
+        if truncated and self.live_obligations:
+            warnings.append("temporal properties NOT checked: the "
+                            "search was truncated (behavior graph "
+                            "incomplete)")
         return CheckResult(ok=ok, distinct=distinct, generated=generated,
                            diameter=max(diameter, 0), violation=violation,
                            wall_s=time.time() - t0, truncated=truncated,

@@ -208,6 +208,12 @@ class MeshExplorer(TpuExplorer):
                     "collision probability < n^2 * 2^-129; no "
                     "counterexample traces yet"]
         warnings.extend(self._temporal_warnings())
+        if self.live_obligations:
+            warnings.append(
+                "temporal properties NOT checked on the mesh backend "
+                "(single-chip --backend jax checks them): "
+                + ", ".join(sorted({ob.prop_name
+                                    for ob in self.live_obligations})))
         if self.refiners:
             warnings.append(
                 "refinement properties NOT checked on the mesh backend "
