@@ -99,12 +99,15 @@ def cmd_check(args) -> int:
                   "for now", file=sys.stderr)
             return 2
         try:
+            if getattr(args, "platform", None):
+                import jax
+                jax.config.update("jax_platforms", args.platform)
             from .tpu.bfs import TpuExplorer
         except ImportError as e:
             print(f"error: the jax backend is not available in this build "
                   f"({e})", file=sys.stderr)
             return 2
-        from .compile.vspec import Bounds, CompileError
+        from .compile.vspec import Bounds, CompileError, ModeError
         bounds = Bounds(seq_cap=args.seq_cap, grow_cap=args.grow_cap,
                         kv_cap=args.kv_cap)
         try:
@@ -112,7 +115,11 @@ def cmd_check(args) -> int:
                               store_trace=not args.no_trace,
                               progress_every=args.progress_every,
                               host_seen=args.host_seen, chunk=args.chunk,
+                              resident=args.resident,
                               max_states=args.max_states).run()
+        except ModeError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
         except CompileError as e:
             print(f"error: this spec is outside the jax backend's "
                   f"compilable subset ({e}); re-run with "
@@ -188,6 +195,13 @@ def main(argv=None) -> int:
                    help="extra module search directories (MC shims "
                         "extending reference specs)")
     c.add_argument("--backend", choices=["interp", "jax"], default="interp")
+    c.add_argument("--platform", default=os.environ.get("JAXMC_PLATFORM"),
+                   help="pin the jax platform (e.g. 'cpu', 'tpu') before "
+                        "device init - 'cpu' keeps --backend jax usable "
+                        "when the accelerator plugin would hang on a dead "
+                        "link (env: JAXMC_PLATFORM; plugin registration "
+                        "ignores JAX_PLATFORMS, so this uses "
+                        "jax.config.update)")
     c.add_argument("--max-states", type=int, default=None)
     c.add_argument("--no-deadlock", action="store_true",
                    help="disable deadlock checking")
@@ -210,6 +224,11 @@ def main(argv=None) -> int:
     c.add_argument("--chunk", type=int, default=2048,
                    help="jax backend: frontier rows expanded per kernel "
                         "call (bounds device memory; host-seen mode)")
+    c.add_argument("--resident", action="store_true",
+                   help="jax backend: run the WHOLE search device-side "
+                        "(frontier, fingerprint set, level loop in one "
+                        "jitted while_loop) - fastest over a high-latency "
+                        "device link; no traces, no temporal properties")
     c.add_argument("--checkpoint", default=None,
                    help="write periodic checkpoints to this file "
                         "(TLC's states/ equivalent)")

@@ -43,6 +43,13 @@ class CompileError(Exception):
     fall back to the interpreter (SURVEY.md §7.2)."""
 
 
+class ModeError(CompileError):
+    """An unsupported option/mode combination (e.g. --resident with
+    --host-seen, or resident mode on a model with temporal properties) —
+    the fix is different flags, not a different backend, so the CLI must
+    not advise 'this spec is outside the compilable subset'."""
+
+
 SENTINEL_LANE = 2**31 - 1
 
 

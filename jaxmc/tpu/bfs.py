@@ -35,13 +35,26 @@ from ..sem.modules import Model
 from ..sem.enumerate import enumerate_init
 from ..engine.explore import CheckResult, Violation
 from ..engine.simulate import sample_states
-from ..compile.vspec import Bounds, CompileError
+from ..compile.vspec import Bounds, CompileError, ModeError
 from ..compile.kernel2 import (KernelCtx, Layout2, build_layout2,
                                compile_action2, compile_predicate2)
 from ..compile.ground import ground_actions
 
 SENTINEL = np.int32(2**31 - 1)
 FP_THRESHOLD = 48  # lanes; beyond this, dedup on 128-bit fingerprints
+
+# resident-mode status codes (one summary scalar per dispatched batch)
+ST_CONTINUE = 0     # level budget exhausted, search not finished
+ST_DONE = 1         # frontier empty: search complete
+ST_INV = 2          # invariant violated (aux: which, row)
+ST_DEADLOCK = 3     # deadlocked state (aux: row)
+ST_ASSERT = 4       # Assert failed inside an enabled action (aux: row)
+ST_TRUNC = 5        # max_states reached
+ST_OVF_SEEN = 6     # seen-set capacity: grow SC, redo level
+ST_OVF_FRONT = 7    # frontier capacity: grow FCap, redo level
+ST_OVF_ACC = 8      # level-accumulator capacity: grow AccCap, redo level
+ST_OVF_VC = 9       # per-chunk valid-candidate capacity: grow VC, redo level
+ST_OVF_LANES = 10   # a container outgrew its lane capacity: hard abort
 
 SYMMETRY_WARNING = (
     "cfg SYMMETRY NOT applied on the jax backend: counts are "
@@ -93,6 +106,42 @@ def fingerprint128(rows):
         h = h ^ (h >> 12)
         out.append(h.astype(jnp.int32))
     return jnp.stack(out, axis=1)
+
+
+def _lower_bound(table, count, queries, cap):
+    """Vectorized lexicographic lower bound: for each query row (i32
+    words, signed order) the first index in table[0:count] whose row is
+    not less than the query. table [cap, w]: sorted valid prefix of
+    length count (traced). Fixed-trip binary search — compiles to plain
+    gathers/selects (no sort comparators), safe inside while loops.
+
+    The log2(cap) search steps MUST be a lax loop, not a Python unroll:
+    unrolled, XLA's fusion pass duplicates the whole dependent
+    gather/compare chain into every consumer (measured: 1 700+ copies of
+    the [cap,w] gather in the optimized HLO, turning a ms-scale level
+    step into minutes)."""
+    n = queries.shape[0]
+    iters = max(1, int(np.ceil(np.log2(max(cap, 2)))) + 1)
+
+    def step(_, lohi):
+        lo, hi = lohi
+        mid = (lo + hi) // 2
+        row = jnp.take(table, jnp.clip(mid, 0, cap - 1), axis=0)
+        lt = jnp.zeros(n, bool)
+        gt = jnp.zeros(n, bool)
+        for j in range(table.shape[1]):
+            undec = ~(lt | gt)
+            lt = lt | (undec & (row[:, j] < queries[:, j]))
+            gt = gt | (undec & (row[:, j] > queries[:, j]))
+        go = lo < hi
+        lo = jnp.where(go & lt, mid + 1, lo)
+        hi = jnp.where(go & ~lt, mid, hi)
+        return lo, hi
+
+    lo0 = jnp.zeros(n, jnp.int32)
+    hi0 = jnp.broadcast_to(jnp.asarray(count, jnp.int32), (n,))
+    lo, _ = lax.fori_loop(0, iters, step, (lo0, hi0))
+    return lo
 
 
 class _LiveGraph:
@@ -163,7 +212,8 @@ class TpuExplorer:
                  progress_every: float = 30.0,
                  bounds: Optional[Bounds] = None,
                  sample_cfg: Tuple[int, int, int] = (800, 40, 60),
-                 host_seen: bool = False, chunk: int = 2048):
+                 host_seen: bool = False, chunk: int = 2048,
+                 resident: bool = False):
         self.model = model
         self.log = log or (lambda s: None)
         self.max_states = max_states
@@ -172,6 +222,7 @@ class TpuExplorer:
         self.bounds = bounds or Bounds()
         self.host_seen = host_seen
         self.chunk = chunk
+        self.resident = resident
 
         base_ctx = model.ctx()
         self.init_states = enumerate_init(model.init, base_ctx, model.vars)
@@ -222,6 +273,34 @@ class TpuExplorer:
         self.K = (4 if self.fp_mode else self.W) + 1
         self._step_cache: Dict[Tuple[int, int], Callable] = {}
         self._hstep_cache: Dict[int, Callable] = {}
+        self._res_cache: Dict[Tuple[int, ...], Callable] = {}
+        # capacities learned by previous resident runs on this instance:
+        # a warm-up run trains them so the timed run never overflows
+        # (and therefore never recompiles)
+        self._res_caps: Optional[Dict[str, int]] = None
+        if resident:
+            if host_seen:
+                raise ModeError(
+                    "resident and host_seen are mutually exclusive: "
+                    "resident keeps the seen-set on device, host_seen "
+                    "keeps it in the native host store")
+            if self.refiners:
+                raise ModeError(
+                    "resident mode cannot check refinement PROPERTYs "
+                    "(stepwise host checking needs the edge stream) - "
+                    "use the level/host_seen device modes")
+            if self.live_obligations:
+                raise ModeError(
+                    "resident mode cannot check temporal properties "
+                    "(the behavior graph stays on device) - use the "
+                    "level/host_seen device modes")
+            self.store_trace = False
+            # resident dedup keys are always 128-bit fingerprints: the
+            # rank-merge binary search and the LSD key sorts are built
+            # for a fixed 4-word key
+            if not self.fp_mode:
+                self.fp_mode = True
+                self.K = 4 + 1
         if host_seen:
             from .. import native_store
             if not native_store.is_available():
@@ -513,6 +592,473 @@ class TpuExplorer:
         self._hstep_cache[FC] = hstep
         return hstep
 
+    # ---- resident mode: the whole BFS inside one jitted while_loop ----
+    #
+    # Motivation (measured): the axon tunnel to the TPU has ~160ms
+    # round-trip latency and ~20MB/s effective host<->device bandwidth, so
+    # any per-chunk (or even per-level) host participation dominates wall
+    # time. Here the seen-set (fingerprint keys), the frontier, and the
+    # level loop itself are all device-resident inside lax.while_loop; the
+    # host sees one small summary vector per MAXLVL-level batch. Capacity
+    # overflows roll back to the last completed level (the carry keeps the
+    # pre-level state) and report a grow-and-redo status, so counts stay
+    # exact across regrowth.
+
+    def _get_resident_run(self, SC, FCap, AccCap, VC, CH, MAXLVL):
+        key = (SC, FCap, AccCap, VC, CH, MAXLVL)
+        if key in self._res_cache:
+            return self._res_cache[key]
+        A, W, K = self.A, self.W, self.K
+        C = A * CH
+        inv_fns = self.inv_fns
+        con_fns = self.constraint_fns
+        keys_of = self._keys_of
+        expand = self._expand_fn()
+        check_deadlock = self.model.check_deadlock
+        assert FCap % CH == 0
+
+        def level(seen, seen_count, frontier, fcount):
+            nchunks = (fcount + CH - 1) // CH
+
+            def chunk_body(carry):
+                (ci, acc_keys, acc_rows, acc_n, gen, stat,
+                 bad_row) = carry
+                base = ci * CH
+                chunk = lax.dynamic_slice(frontier, (base, 0), (CH, W))
+                fvalid = (jnp.arange(CH) + base) < fcount
+                en, aok, ov, succ = expand(chunk)
+                valid = en & fvalid[None, :]
+                gen = gen + jnp.sum(valid, dtype=jnp.int32)
+
+                # lane-capacity overflow inside an enabled action: abort
+                ovf_lanes = jnp.any(ov & fvalid[None, :])
+                # Assert(FALSE) inside an enabled action
+                abad = (~aok) & fvalid[None, :]
+                assert_any = jnp.any(abad)
+                a_f = jnp.argmax(abad.reshape(-1)) % CH
+                # deadlock: a frontier state with no enabled action at all
+                dead = fvalid & ~jnp.any(en, axis=0)
+                dead_any = check_deadlock & jnp.any(dead)
+                d_f = jnp.argmax(dead)
+
+                cand = succ.reshape(C, W)
+                cvalid = valid.reshape(C)
+                vcnt = jnp.sum(cvalid, dtype=jnp.int32)
+                # compact valid candidates to a VC-bounded block before
+                # hashing: ~95% of the dense (state x action) grid is
+                # disabled, so hashing only the survivors is the win
+                ops = ((1 - cvalid.astype(jnp.int32)),
+                       jnp.arange(C, dtype=jnp.int32))
+                comp = lax.sort(ops, num_keys=1, is_stable=True)
+                cidx = comp[1][:VC]
+                rows_c = jnp.take(cand, jnp.clip(cidx, 0, C - 1), axis=0)
+                vmask = jnp.arange(VC) < vcnt
+                rows_c = jnp.where(vmask[:, None], rows_c, SENTINEL)
+                keys_c = keys_of(rows_c, vmask)
+
+                # append the block at acc_n (clamped; overflow redoes the
+                # level so clobbered rows never count)
+                off = jnp.clip(acc_n, 0, AccCap - VC)
+                acc_keys = lax.dynamic_update_slice(acc_keys, keys_c,
+                                                    (off, 0))
+                acc_rows = lax.dynamic_update_slice(acc_rows, rows_c,
+                                                    (off, 0))
+                acc_n = acc_n + vcnt
+
+                stat = jnp.where(
+                    stat != ST_CONTINUE, stat,
+                    jnp.where(
+                        ovf_lanes, ST_OVF_LANES,
+                        jnp.where(
+                            vcnt > VC, ST_OVF_VC,
+                            jnp.where(acc_n + VC > AccCap, ST_OVF_ACC,
+                                      ST_CONTINUE))))
+                # stat is still CONTINUE iff no earlier chunk reported
+                # anything, so this is the first detection
+                first_bad = (stat == ST_CONTINUE) & \
+                    (assert_any | dead_any)
+                bad_f = jnp.where(assert_any, a_f, d_f)
+                brow = lax.dynamic_slice(frontier,
+                                         (base + bad_f.astype(jnp.int32), 0),
+                                         (1, W))[0]
+                bad_row = jnp.where(first_bad, brow, bad_row)
+                stat = jnp.where(
+                    (stat == ST_CONTINUE) & assert_any, ST_ASSERT,
+                    jnp.where((stat == ST_CONTINUE) & dead_any,
+                              ST_DEADLOCK, stat))
+                return (ci + 1, acc_keys, acc_rows, acc_n, gen, stat,
+                        bad_row)
+
+            def chunk_cond(carry):
+                # stop at the FIRST non-continue status: carrying on after
+                # an assert/deadlock would skip the accumulator-overflow
+                # checks (they only arm while stat == CONTINUE) and let
+                # clamped writes clobber earlier candidate blocks
+                ci, _, _, _, _, stat, _ = carry
+                return (ci < nchunks) & (stat == ST_CONTINUE)
+
+            acc_keys0 = jnp.full((AccCap, K), SENTINEL, jnp.int32)
+            acc_rows0 = jnp.full((AccCap, W), SENTINEL, jnp.int32)
+            bad_row0 = jnp.full((W,), SENTINEL, jnp.int32)
+            (_, acc_keys, acc_rows, acc_n, gen, stat, bad_row) = \
+                lax.while_loop(chunk_cond, chunk_body,
+                               (jnp.int32(0), acc_keys0, acc_rows0,
+                                jnp.int32(0), jnp.int32(0),
+                                jnp.int32(ST_CONTINUE), bad_row0))
+
+            # conservative seen-capacity check BEFORE the merge: every
+            # accumulated candidate could be new
+            stat = jnp.where((stat == ST_CONTINUE) &
+                             (seen_count + acc_n > SC), ST_OVF_SEEN, stat)
+
+            # ---- merge-dedup the level's candidates against seen ----
+            # Multi-key lax.sort comparators explode XLA compile time
+            # inside while loops, so: (a) the candidate block is sorted
+            # by chained STABLE single-key passes (LSD radix over the
+            # key words), and (b) the seen-set is never re-sorted — new
+            # keys are merged by rank (two vectorized binary searches +
+            # scatters), which also touches O(new) not O(seen) per level.
+            sidx = jnp.arange(AccCap, dtype=jnp.int32)
+            cols = [acc_keys[:, j] for j in range(K)] + [sidx]
+            for kj in range(K - 1, -1, -1):  # least-significant first
+                rest = [c for i, c in enumerate(cols) if i != kj]
+                res = lax.sort(tuple([cols[kj]] + rest), num_keys=1,
+                               is_stable=True)
+                out_rest = list(res[1:])
+                cols = [res[0] if i == kj else out_rest.pop(0)
+                        for i in range(len(cols))]
+            skeys = jnp.stack(cols[:K], axis=1)
+            sidx_s = cols[K]
+            svalid = skeys[:, 0] == 0
+            neq_prev = jnp.concatenate([
+                jnp.array([True]),
+                jnp.any(skeys[1:] != skeys[:-1], axis=1)])
+
+            words = skeys[:, 1:]
+            seen_words = seen[:, 1:]
+            lb = _lower_bound(seen_words, seen_count, words, SC)
+            at_lb = jnp.take(seen_words, jnp.clip(lb, 0, SC - 1), axis=0)
+            found = (lb < seen_count) & jnp.all(at_lb == words, axis=1)
+            new = svalid & ~found & neq_prev
+            new_count = jnp.sum(new, dtype=jnp.int32)
+
+            # compact the new keys to the front (stable: key order kept)
+            flag2 = (1 - new.astype(jnp.int32))
+            res2 = lax.sort((flag2, cols[1], cols[2], cols[3], cols[4],
+                             sidx_s, lb), num_keys=1, is_stable=True)
+            nk_words = jnp.stack(res2[1:5], axis=1)
+            nk_sidx = res2[5]
+            nk_lb = res2[6]
+            nvalid = jnp.arange(AccCap) < new_count
+            new_rows = jnp.take(acc_rows,
+                                jnp.clip(nk_sidx, 0, AccCap - 1), axis=0)
+            new_rows = jnp.where(nvalid[:, None], new_rows, SENTINEL)
+
+            # rank merge into seen2: pos(new j) = lb_seen + j,
+            # pos(seen i) = i + lb_new(seen i) — a bijection since new
+            # keys are distinct from seen keys
+            ranks = _lower_bound(nk_words, new_count, seen_words, AccCap)
+            valid_seen_rows = jnp.arange(SC) < seen_count
+            pos_s = jnp.where(valid_seen_rows,
+                              jnp.arange(SC, dtype=jnp.int32) + ranks,
+                              SC)
+            seen2 = jnp.full((SC, K), SENTINEL, jnp.int32)
+            seen2 = seen2.at[pos_s].set(seen, mode="drop",
+                                        unique_indices=True)
+            nk_full = jnp.concatenate(
+                [jnp.zeros((AccCap, 1), jnp.int32), nk_words], axis=1)
+            pos_n = jnp.where(nvalid, nk_lb + sidx, SC)
+            seen2 = seen2.at[pos_n].set(nk_full, mode="drop",
+                                        unique_indices=True)
+            seen_count2 = seen_count + new_count
+
+            # constraints: violating states stay fingerprinted in seen2
+            # but are discarded (not distinct / checked / explored)
+            explore = nvalid
+            for nm, f in con_fns:
+                explore = explore & jax.vmap(f)(new_rows)
+            explore_count = jnp.sum(explore, dtype=jnp.int32)
+            stat = jnp.where((stat == ST_CONTINUE) &
+                             (explore_count > FCap), ST_OVF_FRONT, stat)
+
+            idx4 = jnp.arange(AccCap, dtype=jnp.int32)
+            ops4 = ((1 - explore.astype(jnp.int32)), idx4)
+            comp4 = lax.sort(ops4, num_keys=1, is_stable=True)
+            fidx = comp4[1][:FCap]
+            front_rows = jnp.take(new_rows,
+                                  jnp.clip(fidx, 0, AccCap - 1), axis=0)
+            frontvalid = jnp.arange(FCap) < explore_count
+            front_rows = jnp.where(frontvalid[:, None], front_rows,
+                                   SENTINEL)
+
+            inv_bad_any = jnp.asarray(False)
+            inv_bad_idx = jnp.asarray(0, jnp.int32)
+            inv_bad_which = jnp.asarray(-1, jnp.int32)
+            for wi, (nm, f) in enumerate(inv_fns):
+                ok = jax.vmap(f)(front_rows)
+                bad = frontvalid & ~ok
+                any_ = jnp.any(bad)
+                idx = jnp.argmax(bad).astype(jnp.int32)
+                first = jnp.logical_and(any_, ~inv_bad_any)
+                inv_bad_idx = jnp.where(first, idx, inv_bad_idx)
+                inv_bad_which = jnp.where(first, wi, inv_bad_which)
+                inv_bad_any = inv_bad_any | any_
+            inv_row = lax.dynamic_slice(front_rows, (inv_bad_idx, 0),
+                                        (1, W))[0]
+            bad_row = jnp.where(inv_bad_any & (stat == ST_CONTINUE),
+                                inv_row, bad_row)
+            stat = jnp.where((stat == ST_CONTINUE) & inv_bad_any,
+                             ST_INV, stat)
+
+            return (seen2, seen_count2, front_rows, explore_count, gen,
+                    explore_count, stat, inv_bad_which, bad_row)
+
+        def run(seen, seen_count, frontier, fcount, distinct,
+                gen_lo, gen_hi, depth, max_states):
+            def cond(carry):
+                (_, _, _, _, _, _, _, _, lvls, stat, _, _) = carry
+                return (stat == ST_CONTINUE) & (lvls < MAXLVL)
+
+            def body(carry):
+                (seen, seen_count, frontier, fcount, distinct,
+                 gen_lo, gen_hi, depth, lvls, stat, which, brow) = carry
+                (seen2, seen_count2, front2, fcount2, gen_l, kept,
+                 lstat, lwhich, lbrow) = level(seen, seen_count,
+                                               frontier, fcount)
+                ovf = (lstat == ST_OVF_SEEN) | (lstat == ST_OVF_FRONT) | \
+                    (lstat == ST_OVF_ACC) | (lstat == ST_OVF_VC) | \
+                    (lstat == ST_OVF_LANES)
+                # overflow rolls the whole level back (growable caps are
+                # redone after growth; lane overflow aborts with the
+                # last completed level's exact counts)
+                seen2 = jnp.where(ovf, seen, seen2)
+                seen_count2 = jnp.where(ovf, seen_count, seen_count2)
+                front2 = jnp.where(ovf, frontier, front2)
+                fcount2 = jnp.where(ovf, fcount, fcount2)
+                distinct2 = jnp.where(ovf, distinct, distinct + kept)
+                lo = (gen_lo.astype(jnp.uint32) +
+                      gen_l.astype(jnp.uint32))
+                wrapped = lo < gen_lo.astype(jnp.uint32)
+                gen_lo2 = jnp.where(ovf, gen_lo, lo.astype(jnp.int32))
+                gen_hi2 = jnp.where(ovf, gen_hi,
+                                    gen_hi + wrapped.astype(jnp.int32))
+                # deadlock/assert states belong to the CURRENT frontier
+                # (depth d), unlike invariant violations which live in
+                # the newly found level (d+1) — don't advance depth for
+                # them, matching the interp/level/host_seen backends
+                keep_depth = ovf | (lstat == ST_DEADLOCK) | \
+                    (lstat == ST_ASSERT)
+                depth2 = jnp.where(keep_depth, depth, depth + 1)
+                stat2 = jnp.where(
+                    lstat != ST_CONTINUE, lstat,
+                    jnp.where(fcount2 == 0, ST_DONE,
+                              jnp.where((max_states > 0) &
+                                        (distinct2 >= max_states),
+                                        ST_TRUNC, ST_CONTINUE)))
+                return (seen2, seen_count2, front2, fcount2, distinct2,
+                        gen_lo2, gen_hi2, depth2, lvls + 1, stat2,
+                        jnp.where(lstat == ST_INV, lwhich, which), lbrow)
+
+            carry0 = (seen, seen_count, frontier, fcount, distinct,
+                      gen_lo, gen_hi, depth, jnp.int32(0),
+                      jnp.int32(ST_CONTINUE), jnp.int32(-1),
+                      jnp.full((W,), SENTINEL, jnp.int32))
+            (seen, seen_count, frontier, fcount, distinct, gen_lo,
+             gen_hi, depth, _, stat, which, brow) = lax.while_loop(
+                cond, body, carry0)
+            summary = jnp.stack([stat, seen_count, fcount, distinct,
+                                 gen_lo, gen_hi, depth, which])
+            return seen, frontier, summary, brow
+
+        jitted = jax.jit(run, static_argnames=())
+        self._res_cache[key] = jitted
+        return jitted
+
+    def _prepare_init(self, t0, warnings):
+        """Shared init-state preparation for every device search mode:
+        encode + dedup the enumerated init states, run the init-state
+        invariant/refinement checks, log the TLC-format init line.
+
+        Returns (init_rows, explored_init, n_init, err): err is a
+        ready-to-return CheckResult when an initial state violates an
+        invariant or a refinement's initial predicate, else None."""
+        layout = self.layout
+        rows = {}
+        for st in self.init_states:
+            rows[layout.encode(st).tobytes()] = st
+        init_rows = np.stack([np.frombuffer(kk, dtype=np.int32)
+                              for kk in rows.keys()]) \
+            if rows else np.zeros((0, self.W), np.int32)
+        n_init = len(init_rows)
+        explored_init, init_viol = filter_init_states(self.model, layout,
+                                                      init_rows)
+        if init_viol is not None:
+            nm, st = init_viol
+            return init_rows, explored_init, n_init, self._mk_result(
+                False, len(explored_init) + 1, n_init, 0, t0, warnings,
+                Violation("invariant", nm, [(st, "Initial predicate")]))
+        rv = self._refine_init(init_rows, explored_init)
+        if rv is not None:
+            nm, st = rv
+            return init_rows, explored_init, n_init, self._mk_result(
+                False, len(explored_init), n_init, 0, t0, warnings,
+                Violation("property", nm, [(st, "Initial predicate")],
+                          f"initial state violates {nm}'s initial "
+                          f"predicate"))
+        distinct = len(explored_init)
+        self.log(f"Finished computing initial states: {distinct} distinct "
+                 f"state{'s' if distinct != 1 else ''} generated.")
+        return init_rows, explored_init, n_init, None
+
+    def _run_resident(self) -> CheckResult:
+        t0 = time.time()
+        model = self.model
+        layout = self.layout
+        W, K = self.W, self.K
+        warnings = ["resident mode: search runs device-side end to end; "
+                    "no counterexample traces (rerun with the level/"
+                    "host_seen device modes or the interp for a trace)",
+                    "resident mode (W={}): dedup on 128-bit fingerprints; "
+                    "collision probability < n^2 * 2^-129".format(W)]
+        warnings.extend(self._temporal_warnings())
+        if model.symmetry is not None:
+            warnings.append(SYMMETRY_WARNING)
+
+        init_rows, explored_init, n_init, err = \
+            self._prepare_init(t0, warnings)
+        if err is not None:
+            return err
+        generated = n_init
+        distinct = len(explored_init)
+
+        CH = _pow2_at_least(self.chunk, lo=64)
+        # every overflow-growth costs a full XLA recompile (minutes on
+        # the big while_loop program), while capacity is cheap device
+        # memory (seen keys at SC=1<<20 are 20MB) - so on an accelerator
+        # start generous; on CPU (tests) stay small to keep compiles fast
+        on_accel = jax.devices()[0].platform != "cpu"
+        caps = self._res_caps or ({
+            "SC": 1 << 20, "FCap": max(1 << 16, CH),
+            "AccCap": 1 << 17, "VC": 1 << 14} if on_accel else {
+            "SC": _pow2_at_least(max(4 * n_init, 1), lo=1 << 15),
+            "FCap": CH, "AccCap": 1 << 15, "VC": 1 << 13})
+        caps["FCap"] = max(caps["FCap"], _pow2_at_least(max(n_init, 1),
+                                                        lo=CH))
+        # VC can never usefully exceed the dense candidate-grid size
+        # A*CH (and must not: [:VC] slices of C-row arrays assume VC<=C);
+        # AccCap must cover both one VC block past acc_n and the [:FCap]
+        # slice of the accumulator taken for the next frontier
+        caps["VC"] = min(caps["VC"], self.A * CH)
+        caps["AccCap"] = max(caps["AccCap"], 2 * caps["VC"], caps["FCap"])
+        MAXLVL = 64
+
+        frontier = np.full((caps["FCap"], W), SENTINEL, np.int32)
+        frontier[:distinct] = init_rows[explored_init]
+        frontier = jnp.asarray(frontier)
+        fcount = distinct
+
+        init_keys = np.asarray(self._keys_of(
+            jnp.asarray(init_rows), jnp.ones(n_init, bool))) if n_init \
+            else np.zeros((0, K), np.int32)
+        seen = np.full((caps["SC"], K), SENTINEL, np.int32)
+        if n_init:
+            order = np.lexsort(tuple(init_keys[:, i]
+                                     for i in reversed(range(K))))
+            seen[:n_init] = init_keys[order]
+        seen = jnp.asarray(seen)
+        seen_count = n_init
+
+        max_states = jnp.int32(self.max_states or 0)
+        state = (seen, jnp.int32(seen_count), frontier, jnp.int32(fcount),
+                 jnp.int32(distinct), jnp.int32(generated), jnp.int32(0),
+                 jnp.int32(0))
+        grow_flag = {ST_OVF_SEEN: "SC", ST_OVF_FRONT: "FCap",
+                     ST_OVF_ACC: "AccCap", ST_OVF_VC: "VC"}
+        last_progress = time.time()
+        while True:
+            runf = self._get_resident_run(caps["SC"], caps["FCap"],
+                                          caps["AccCap"], caps["VC"],
+                                          CH, MAXLVL)
+            seen, frontier, summary, brow = runf(*state, max_states)
+            summary = np.asarray(summary)
+            stat = int(summary[0])
+            seen_count = int(summary[1])
+            fcount = int(summary[2])
+            distinct = int(summary[3])
+            generated = (int(np.uint32(summary[5])) << 32) | \
+                int(np.uint32(summary[4]))
+            depth = int(summary[6])
+            which = int(summary[7])
+            self._res_caps = dict(caps)
+
+            if stat in grow_flag:
+                what = grow_flag[stat]
+                old = caps[what]
+                # x4: each growth recompiles the whole program, so
+                # over-shooting is much cheaper than growing twice
+                caps[what] = old * 4
+                if what == "VC":
+                    caps[what] = min(caps[what], self.A * CH)
+                if what == "SC":
+                    pad = jnp.full((caps[what] - old, K), SENTINEL,
+                                   jnp.int32)
+                    seen = jnp.concatenate([seen, pad])
+                elif what == "FCap":
+                    pad = jnp.full((caps[what] - old, W), SENTINEL,
+                                   jnp.int32)
+                    frontier = jnp.concatenate([frontier, pad])
+                # keep the cap invariants: AccCap >= 2*VC (block-append
+                # headroom) and AccCap >= FCap ([:FCap] frontier slice of
+                # the accumulator)
+                caps["AccCap"] = max(caps["AccCap"], 2 * caps["VC"],
+                                     caps["FCap"])
+                self.log(f"-- resident: growing {what} to {caps[what]} "
+                         f"(level {depth} redone)")
+            elif stat == ST_CONTINUE:
+                now = time.time()
+                if now - last_progress >= self.progress_every:
+                    last_progress = now
+                    self.log(f"Progress({depth}): {generated} states "
+                             f"generated, {distinct} distinct states "
+                             f"found, {fcount} states left on queue.")
+            elif stat == ST_DONE:
+                self.log("Model checking completed. No error has been "
+                         "found.")
+                self.log(f"{generated} states generated, {distinct} "
+                         f"distinct states found, 0 states left on queue.")
+                self.log(f"The depth of the complete state graph search "
+                         f"is {depth}.")
+                return self._mk_result(True, distinct, generated,
+                                       depth - 1, t0, warnings)
+            elif stat == ST_TRUNC:
+                self.log("-- state limit reached, search truncated")
+                return self._mk_result(True, distinct, generated, depth,
+                                       t0, warnings, None, truncated=True)
+            elif stat == ST_OVF_LANES:
+                return self._mk_result(
+                    False, distinct, generated, depth, t0, warnings,
+                    Violation("error", "capacity overflow", [],
+                              "a container exceeded its lane capacity "
+                              "(raise --seq-cap/--grow-cap/--kv-cap)"))
+            else:
+                st = layout.decode(np.asarray(brow))
+                note = "state reached by resident-mode search (no trace)"
+                if stat == ST_INV:
+                    nm = self.inv_fns[which][0] if 0 <= which < \
+                        len(self.inv_fns) else "invariant"
+                    v = Violation("invariant", nm, [(st, note)])
+                elif stat == ST_DEADLOCK:
+                    v = Violation("deadlock", "deadlock", [(st, note)])
+                else:
+                    v = Violation("assert", "Assert", [(st, note)],
+                                  "assertion failed in an enabled action")
+                return self._mk_result(False, distinct, generated, depth,
+                                       t0, warnings, v)
+            state = (seen, jnp.int32(seen_count), frontier,
+                     jnp.int32(fcount), jnp.int32(distinct),
+                     jnp.int32(summary[4]), jnp.int32(summary[5]),
+                     jnp.int32(depth))
+
     def _run_host_seen(self) -> CheckResult:
         from .. import native_store
         t0 = time.time()
@@ -525,33 +1071,12 @@ class TpuExplorer:
         if model.symmetry is not None:
             warnings.append(SYMMETRY_WARNING)
 
-        rows = {}
-        for st in self.init_states:
-            rows[layout.encode(st).tobytes()] = st
-        init_rows = np.stack([np.frombuffer(kk, dtype=np.int32)
-                              for kk in rows.keys()]) \
-            if rows else np.zeros((0, W), np.int32)
-        n_init = len(init_rows)
+        init_rows, explored_init, n_init, err = \
+            self._prepare_init(t0, warnings)
+        if err is not None:
+            return err
         generated = n_init
-
-        explored_init, init_viol = filter_init_states(model, layout,
-                                                      init_rows)
-        if init_viol is not None:
-            nm, st = init_viol
-            return self._mk_result(
-                False, len(explored_init) + 1, generated, 0, t0, warnings,
-                Violation("invariant", nm, [(st, "Initial predicate")]))
-        rv = self._refine_init(init_rows, explored_init)
-        if rv is not None:
-            nm, st = rv
-            return self._mk_result(
-                False, len(explored_init), generated, 0, t0, warnings,
-                Violation("property", nm, [(st, "Initial predicate")],
-                          f"initial state violates {nm}'s initial "
-                          f"predicate"))
         distinct = len(explored_init)
-        self.log(f"Finished computing initial states: {distinct} distinct "
-                 f"state{'s' if distinct != 1 else ''} generated.")
 
         store = native_store.FingerprintStore()
         init_keys = np.asarray(self._keys_of(
@@ -727,6 +1252,8 @@ class TpuExplorer:
 
     # ---- host-side search loop ----
     def run(self) -> CheckResult:
+        if self.resident:
+            return self._run_resident()
         if self.host_seen:
             return self._run_host_seen()
         t0 = time.time()
@@ -742,33 +1269,12 @@ class TpuExplorer:
                 "wide state (W={}): dedup on 128-bit fingerprints; "
                 "collision probability < n^2 * 2^-129".format(W))
 
-        rows = {}
-        for st in self.init_states:
-            rows[layout.encode(st).tobytes()] = st
-        init_rows = np.stack([np.frombuffer(kk, dtype=np.int32)
-                              for kk in rows.keys()]) \
-            if rows else np.zeros((0, W), np.int32)
-        n_init = len(init_rows)
+        init_rows, explored_init, n_init, err = \
+            self._prepare_init(t0, warnings)
+        if err is not None:
+            return err
         generated = n_init
-
-        explored_init, init_viol = filter_init_states(model, layout,
-                                                      init_rows)
-        if init_viol is not None:
-            nm, st = init_viol
-            return self._mk_result(
-                False, len(explored_init) + 1, generated, 0, t0, warnings,
-                Violation("invariant", nm, [(st, "Initial predicate")]))
-        rv = self._refine_init(init_rows, explored_init)
-        if rv is not None:
-            nm, st = rv
-            return self._mk_result(
-                False, len(explored_init), generated, 0, t0, warnings,
-                Violation("property", nm, [(st, "Initial predicate")],
-                          f"initial state violates {nm}'s initial "
-                          f"predicate"))
         distinct = len(explored_init)
-        self.log(f"Finished computing initial states: {distinct} distinct "
-                 f"state{'s' if distinct != 1 else ''} generated.")
 
         graph = _LiveGraph(self.labels_flat, self.collect_edges) \
             if self.live_obligations else None

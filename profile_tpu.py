@@ -1,0 +1,87 @@
+"""One-off profiler: where does the host_seen chunk loop spend time on TPU?"""
+import os, sys, time
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import numpy as np
+import jax, jax.numpy as jnp
+
+from jaxmc.sem.modules import Loader, bind_model
+from jaxmc.front.cfg import parse_cfg
+from jaxmc.tpu.bfs import TpuExplorer, SENTINEL
+from jaxmc import native_store
+
+_REPO = os.path.dirname(os.path.abspath(__file__))
+SPEC = os.path.join(_REPO, "specs", "MCraftMicro.tla")
+CFG = os.path.join(_REPO, "specs", "MCraft_3s_bench.cfg")
+
+def load_model():
+    ldr = Loader([os.path.join(_REPO, "specs"), "/root/reference/examples"])
+    return bind_model(ldr.load_path(SPEC), parse_cfg(open(CFG).read()))
+
+print("platform:", jax.devices()[0].platform)
+
+# tunnel roundtrip latency
+x = jnp.ones((8,), jnp.int32)
+x.block_until_ready()
+t0 = time.time()
+for _ in range(10):
+    np.asarray(x + 1)
+print(f"scalar roundtrip: {(time.time()-t0)/10*1000:.1f} ms")
+
+big = jnp.ones((614000, 5), jnp.int32)
+big.block_until_ready()
+t0 = time.time()
+np.asarray(big)
+print(f"12MB transfer: {(time.time()-t0)*1000:.1f} ms")
+
+ex = TpuExplorer(load_model(), store_trace=False, host_seen=True)
+print(f"A={ex.A} W={ex.W} chunk={ex.chunk} K={ex.K}")
+
+CH = 2048
+hstep = ex._get_hstep(CH)
+
+# build a frontier from init + run a few levels manually with timers
+rows = {}
+for st in ex.init_states:
+    rows[ex.layout.encode(st).tobytes()] = st
+init_rows = np.stack([np.frombuffer(kk, dtype=np.int32) for kk in rows])
+frontier_np = init_rows
+store = native_store.FingerprintStore()
+
+tot = dict(dispatch=0.0, consume=0.0, insert=0.0, gather=0.0)
+t_all = time.time()
+for depth in range(8):
+    L = len(frontier_np)
+    new_rows_all = []
+    nchunks = 0
+    for base in range(0, L, CH):
+        nchunks += 1
+        cn = min(CH, L - base)
+        buf = np.full((CH, ex.W), SENTINEL, np.int32)
+        buf[:cn] = frontier_np[base:base + cn]
+        t0 = time.time()
+        out = hstep(jnp.asarray(buf), cn)
+        jax.block_until_ready(out)
+        t1 = time.time()
+        cvalid = np.asarray(out["cvalid"])
+        keys = np.asarray(out["keys"])
+        explore = np.asarray(out["explore"])
+        t2 = time.time()
+        valid_idx = np.nonzero(cvalid)[0]
+        new_mask = store.insert(keys[valid_idx][:, 1:])
+        new_idx = valid_idx[new_mask]
+        t3 = time.time()
+        if len(new_idx):
+            rows_np = np.asarray(jnp.take(out["cand"],
+                                          jnp.asarray(new_idx, dtype=np.int32),
+                                          axis=0))
+            new_rows_all.append(rows_np[explore[new_idx]])
+        t4 = time.time()
+        tot["dispatch"] += t1 - t0
+        tot["consume"] += t2 - t1
+        tot["insert"] += t3 - t2
+        tot["gather"] += t4 - t3
+    frontier_np = (np.concatenate(new_rows_all) if new_rows_all
+                   else np.zeros((0, ex.W), np.int32))
+    print(f"level {depth}: frontier {L} -> {len(frontier_np)}  "
+          f"chunks={nchunks}  {dict((k, round(v,2)) for k,v in tot.items())}")
+print(f"total {time.time()-t_all:.1f}s  {tot}")

@@ -12,6 +12,10 @@ os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
 try:
     import jax
     jax.config.update("jax_platforms", "cpu")
+    # NOTE: do NOT enable jax_compilation_cache_dir here — XLA:CPU
+    # persists AOT-compiled blobs whose reload can hang when the cache
+    # was written by a different machine/build (observed: cache hit on
+    # the resident-mode while_loop program never returns).
 except ImportError:
     pass
 

@@ -162,6 +162,90 @@ class TestHostSeen:
         assert len(r.violation.trace) >= 2
 
 
+class TestResident:
+    # resident mode: the whole BFS inside one jitted while_loop
+    # (tpu/bfs.py _run_resident) — built for the high-latency TPU tunnel;
+    # counts must still match the interpreter exactly
+
+    @staticmethod
+    def _raft_micro():
+        ldr = Loader([os.path.join(REFERENCE, "examples"), SPECS])
+        return bind_model(
+            ldr.load_path(os.path.join(SPECS, "MCraftMicro.tla")),
+            parse_cfg(open(os.path.join(SPECS, "MCraft_micro.cfg")).read()))
+
+    def test_raft_micro_exact_counts_and_truncation(self):
+        # flagship workload at the scale that completes (pinned 6185/694
+        # in test_kernel2 for interp/host_seen); small chunk exercises
+        # the multi-chunk accumulator path
+        from jaxmc.tpu.bfs import TpuExplorer
+        ex = TpuExplorer(self._raft_micro(), resident=True, chunk=128)
+        r = ex.run()
+        assert r.ok
+        assert (r.generated, r.distinct) == (6185, 694)
+
+        # truncation at a state limit (same instance: jit cache reused)
+        ex.max_states = 100
+        r2 = ex.run()
+        assert r2.ok and r2.truncated and r2.distinct >= 100
+
+    @pytest.mark.slow
+    def test_resident_growth_redo_exactness(self):
+        # tiny starting caps force every grow-and-redo status (each
+        # growth recompiles, hence slow-marked); counts stay exact
+        from jaxmc.tpu.bfs import TpuExplorer
+        ex = TpuExplorer(self._raft_micro(), resident=True, chunk=128)
+        ex._res_caps = {"SC": 1 << 8, "FCap": 128, "AccCap": 1 << 9,
+                        "VC": 1 << 8}
+        r = ex.run()
+        assert r.ok
+        assert (r.generated, r.distinct) == (6185, 694)
+        # capacities were learned by growth during the run
+        assert ex._res_caps["SC"] >= 1024
+
+    def test_resident_deadlock_depth_matches_interp(self, tmp_path):
+        # deadlock states live in the CURRENT frontier: resident must
+        # report the same diameter as the interp backend (regression:
+        # the level loop used to advance depth before exiting)
+        from jaxmc.engine.explore import Explorer
+        from jaxmc.tpu.bfs import TpuExplorer
+        spec = tmp_path / "cnt.tla"
+        spec.write_text("""---- MODULE cnt ----
+EXTENDS Naturals
+VARIABLE x
+Init == x = 0
+Next == x < 2 /\\ x' = x + 1
+Spec == Init /\\ [][Next]_x
+====
+""")
+        model = load(str(spec), ModelConfig(specification="Spec"))
+        ri = Explorer(model).run()
+        rr = TpuExplorer(model, resident=True).run()
+        assert not ri.ok and not rr.ok
+        assert ri.violation.kind == rr.violation.kind == "deadlock"
+        assert ri.diameter == rr.diameter
+
+    def test_resident_rejects_host_seen_combo(self):
+        # mutually exclusive seen-set homes: must be diagnosed up front,
+        # not silently resolved in favor of one mode
+        from jaxmc.compile.vspec import CompileError
+        from jaxmc.tpu.bfs import TpuExplorer
+        with pytest.raises(CompileError, match="mutually exclusive"):
+            TpuExplorer(self._raft_micro(), resident=True, host_seen=True)
+
+    def test_resident_rejects_temporal_models(self):
+        from jaxmc.compile.vspec import CompileError
+        from jaxmc.tpu.bfs import TpuExplorer
+        path = os.path.join(REFERENCE, "examples", "SpecifyingSystems",
+                            "HourClock", "HourClock2.tla")
+        cfg = parse_cfg(open(os.path.join(
+            REFERENCE, "examples", "SpecifyingSystems", "HourClock",
+            "HourClock2.cfg")).read())
+        model = load(path, cfg)
+        with pytest.raises(CompileError):
+            TpuExplorer(model, resident=True)
+
+
 class TestCorpusOnDevice:
     # seq-heavy corpus models must reproduce the interpreter's exact
     # counts on the device backend (tuple messages, Tail, Lose's dynamic
