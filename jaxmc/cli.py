@@ -94,10 +94,6 @@ def cmd_check(args) -> int:
                       resume_from=args.resume)
         res = ex.run()
     else:
-        if args.checkpoint or args.resume:
-            print("error: --checkpoint/--resume are interp-backend only "
-                  "for now", file=sys.stderr)
-            return 2
         try:
             if getattr(args, "platform", None):
                 import jax
@@ -116,6 +112,9 @@ def cmd_check(args) -> int:
                               progress_every=args.progress_every,
                               host_seen=args.host_seen, chunk=args.chunk,
                               resident=args.resident,
+                              checkpoint_path=args.checkpoint,
+                              checkpoint_every=args.checkpoint_every,
+                              resume_from=args.resume,
                               max_states=args.max_states).run()
         except ModeError as e:
             print(f"error: {e}", file=sys.stderr)
@@ -231,10 +230,11 @@ def main(argv=None) -> int:
                         "device link; no traces, no temporal properties")
     c.add_argument("--checkpoint", default=None,
                    help="write periodic checkpoints to this file "
-                        "(TLC's states/ equivalent)")
+                        "(TLC's states/ equivalent; both backends)")
     c.add_argument("--checkpoint-every", type=float, default=600.0)
     c.add_argument("--resume", default=None,
-                   help="resume an interp-backend run from a checkpoint")
+                   help="resume a run from a checkpoint (the backend and "
+                        "device mode must match the writing run's)")
     c.set_defaults(fn=cmd_check)
 
     m = sub.add_parser("simulate",

@@ -49,6 +49,18 @@ def _load():
                 np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),
             ]
             lib.jaxmc_fps_insert.restype = ctypes.c_uint64
+            lib.jaxmc_fps_export.argtypes = [
+                ctypes.c_void_p,
+                np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS"),
+            ]
+            lib.jaxmc_fps_import.argtypes = [
+                ctypes.c_void_p,
+                np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS"),
+                ctypes.c_uint64,
+            ]
+            lib.jaxmc_fps_import.restype = ctypes.c_uint64
             _lib = lib
         except subprocess.CalledProcessError as ex:
             _build_err = f"{ex}; stderr: {ex.stderr}"
@@ -97,3 +109,23 @@ class FingerprintStore:
         self._lib.jaxmc_fps_insert(self._h, hi, lo,
                                    np.uint64(len(fps)), out)
         return out.astype(bool)
+
+    def dump(self) -> np.ndarray:
+        """Serialize the store: sorted [N, 2] uint64 (hi, lo) rows —
+        the checkpoint surface (SURVEY.md §5 checkpoint/resume)."""
+        n = len(self)
+        hi = np.zeros(n, dtype=np.uint64)
+        lo = np.zeros(n, dtype=np.uint64)
+        self._lib.jaxmc_fps_export(self._h, hi, lo)
+        return np.stack([hi, lo], axis=1)
+
+    def load(self, arr: np.ndarray) -> None:
+        """Replace the contents with a dump() array (sorted, unique)."""
+        arr = np.ascontiguousarray(arr, dtype=np.uint64)
+        hi = np.ascontiguousarray(arr[:, 0])
+        lo = np.ascontiguousarray(arr[:, 1])
+        ok = self._lib.jaxmc_fps_import(self._h, hi, lo,
+                                        np.uint64(len(arr)))
+        if not ok:
+            raise ValueError("fingerprint import rejected: rows are not "
+                             "sorted-unique (corrupt checkpoint?)")

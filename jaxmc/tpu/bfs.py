@@ -213,7 +213,10 @@ class TpuExplorer:
                  bounds: Optional[Bounds] = None,
                  sample_cfg: Tuple[int, int, int] = (800, 40, 60),
                  host_seen: bool = False, chunk: int = 2048,
-                 resident: bool = False):
+                 resident: bool = False,
+                 checkpoint_path: Optional[str] = None,
+                 checkpoint_every: float = 600.0,
+                 resume_from: Optional[str] = None):
         self.model = model
         self.log = log or (lambda s: None)
         self.max_states = max_states
@@ -223,6 +226,9 @@ class TpuExplorer:
         self.host_seen = host_seen
         self.chunk = chunk
         self.resident = resident
+        self.checkpoint_path = checkpoint_path
+        self.checkpoint_every = checkpoint_every
+        self.resume_from = resume_from
 
         base_ctx = model.ctx()
         self.init_states = enumerate_init(model.init, base_ctx, model.vars)
@@ -278,6 +284,7 @@ class TpuExplorer:
         # a warm-up run trains them so the timed run never overflows
         # (and therefore never recompiles)
         self._res_caps: Optional[Dict[str, int]] = None
+        self._res_maxlvl = 64  # levels per resident dispatch
         if resident:
             if host_seen:
                 raise ModeError(
@@ -910,6 +917,110 @@ class TpuExplorer:
                  f"state{'s' if distinct != 1 else ''} generated.")
         return init_rows, explored_init, n_init, None
 
+    # ---- checkpoint/resume (device backends) ----
+    #
+    # TLC checkpoints long runs to states/ (SURVEY.md §5, testout1:10);
+    # the interp engine mirrors that with --checkpoint/--resume. The
+    # device modes checkpoint BETWEEN levels (level and host_seen modes)
+    # or between dispatches (resident mode), so a checkpoint is always a
+    # consistent level boundary and resumed full-run counts stay exact.
+
+    def _layout_sig(self) -> str:
+        """Fingerprint of the lane encoding: a resume is only sound when
+        the resuming process rebuilds the IDENTICAL layout (layout
+        construction is deterministic for a given model + Bounds — BFS
+        prefix sampling, no RNG)."""
+        import hashlib
+        lay = self.layout
+        desc = repr((lay.vars, [lay.specs[v] for v in lay.vars],
+                     [str(v) for v in lay.uni.values]))
+        return hashlib.sha256(desc.encode()).hexdigest()
+
+    def _write_ck(self, mode: str, **state) -> None:
+        import pickle
+        import os as _os
+        payload = dict(kind="jaxmc-device-ck", version=1, mode=mode,
+                       module=self.model.module.name,
+                       vars=list(self.model.vars),
+                       layout_sig=self._layout_sig(), **state)
+        tmp = self.checkpoint_path + ".tmp"
+        with open(tmp, "wb") as fh:
+            pickle.dump(payload, fh)
+        _os.replace(tmp, self.checkpoint_path)
+        self.log(f"Checkpointing run to {self.checkpoint_path}")
+
+    def _load_ck(self, mode: str) -> dict:
+        import pickle
+        try:
+            with open(self.resume_from, "rb") as fh:
+                ck = pickle.load(fh)
+            if not isinstance(ck, dict) or \
+                    ck.get("kind") != "jaxmc-device-ck":
+                raise ValueError("not a jaxmc device checkpoint")
+        except (pickle.UnpicklingError, ValueError, EOFError) as ex:
+            raise ValueError(
+                f"cannot resume: {self.resume_from} is not a valid jaxmc "
+                f"device checkpoint ({ex})")
+        if ck.get("module") != self.model.module.name or \
+                ck.get("vars") != list(self.model.vars):
+            raise ValueError(
+                f"cannot resume: checkpoint is for module "
+                f"{ck.get('module')!r} with variables {ck.get('vars')}, "
+                f"not {self.model.module.name!r}")
+        if ck.get("mode") != mode:
+            raise ValueError(
+                f"cannot resume: checkpoint was written by the "
+                f"{ck.get('mode')!r} device mode, this run uses {mode!r} "
+                f"(re-run with the matching flags)")
+        if ck.get("layout_sig") != self._layout_sig():
+            raise ValueError(
+                "cannot resume: the lane layout differs from the "
+                "checkpoint's (different --seq-cap/--grow-cap/--kv-cap "
+                "or a changed model?)")
+        return ck
+
+    def _restore_ck_state(self, ck, graph):
+        """Shared level/host_seen resume restore: validates trace and
+        behavior-graph compatibility with THIS run's needs, then returns
+        (distinct, generated, depth, trace_levels, frontier_maps, graph,
+        frontier_sids) — the trace pair is None when store_trace is
+        off."""
+        if self.store_trace and ck.get("trace_levels") is None:
+            raise ValueError(
+                "cannot resume with traces: the checkpoint was written "
+                "with --no-trace")
+        frontier_sids = None
+        if graph is not None:
+            ckg = ck.get("graph")
+            if ckg is None:
+                raise ValueError(
+                    "cannot resume with temporal properties: the "
+                    "checkpoint has no behavior graph")
+            if graph.collect_edges and not ckg.collect_edges:
+                # mirror engine/explore.py's interp-resume guard: an
+                # edge log cannot be reconstructed after the fact
+                raise ValueError(
+                    "cannot resume with this PROPERTY set: the "
+                    "checkpoint's behavior graph has no edge log (it "
+                    "was written for 'always'-form obligations only)")
+            graph = ckg
+            frontier_sids = ck["frontier_sids"]
+        trace_levels = ck["trace_levels"] if self.store_trace else None
+        frontier_maps = ck["frontier_maps"] if self.store_trace else None
+        self.log(f"Resumed from {self.resume_from}: {ck['distinct']} "
+                 f"distinct states, {len(ck['frontier'])} on queue.")
+        return (ck["distinct"], ck["generated"], ck["depth"],
+                trace_levels, frontier_maps, graph, frontier_sids)
+
+    def _ck_state_kwargs(self, distinct, generated, depth, trace_levels,
+                         frontier_maps, graph, frontier_sids):
+        """Shared level/host_seen checkpoint payload fields."""
+        return dict(
+            distinct=distinct, generated=generated, depth=depth,
+            trace_levels=trace_levels if self.store_trace else None,
+            frontier_maps=frontier_maps if self.store_trace else None,
+            graph=graph, frontier_sids=frontier_sids)
+
     def _run_resident(self) -> CheckResult:
         t0 = time.time()
         model = self.model
@@ -950,7 +1061,9 @@ class TpuExplorer:
         # slice of the accumulator taken for the next frontier
         caps["VC"] = min(caps["VC"], self.A * CH)
         caps["AccCap"] = max(caps["AccCap"], 2 * caps["VC"], caps["FCap"])
-        MAXLVL = 64
+        # levels per dispatch: the host only sees status (and can only
+        # checkpoint) between dispatches
+        MAXLVL = self._res_maxlvl
 
         frontier = np.full((caps["FCap"], W), SENTINEL, np.int32)
         frontier[:distinct] = init_rows[explored_init]
@@ -968,13 +1081,40 @@ class TpuExplorer:
         seen = jnp.asarray(seen)
         seen_count = n_init
 
+        depth = 0
+        if self.resume_from:
+            ck = self._load_ck("resident")
+            for kk in caps:
+                caps[kk] = max(caps[kk], ck.get("caps", {}).get(kk, 0))
+            # re-apply the cap invariants: the checkpointing run may have
+            # used a different --chunk, and VC must never exceed A*CH
+            caps["VC"] = min(caps["VC"], self.A * CH)
+            caps["AccCap"] = max(caps["AccCap"], 2 * caps["VC"],
+                                 caps["FCap"])
+            cs, fr = ck["seen"], ck["frontier"]
+            seen_np = np.full((caps["SC"], K), SENTINEL, np.int32)
+            seen_np[:len(cs)] = cs
+            seen = jnp.asarray(seen_np)
+            seen_count = len(cs)
+            fr_np = np.full((caps["FCap"], W), SENTINEL, np.int32)
+            fr_np[:len(fr)] = fr
+            frontier = jnp.asarray(fr_np)
+            fcount = len(fr)
+            distinct = ck["distinct"]
+            generated = ck["generated"]
+            depth = ck["depth"]
+            self.log(f"Resumed from {self.resume_from}: {distinct} "
+                     f"distinct states, {fcount} on queue.")
+
         max_states = jnp.int32(self.max_states or 0)
+        gen_lo = int(np.int32(np.uint32(generated & 0xFFFFFFFF)))
+        gen_hi = generated >> 32
         state = (seen, jnp.int32(seen_count), frontier, jnp.int32(fcount),
-                 jnp.int32(distinct), jnp.int32(generated), jnp.int32(0),
-                 jnp.int32(0))
+                 jnp.int32(distinct), jnp.int32(gen_lo), jnp.int32(gen_hi),
+                 jnp.int32(depth))
         grow_flag = {ST_OVF_SEEN: "SC", ST_OVF_FRONT: "FCap",
                      ST_OVF_ACC: "AccCap", ST_OVF_VC: "VC"}
-        last_progress = time.time()
+        last_progress = last_ck = time.time()
         while True:
             runf = self._get_resident_run(caps["SC"], caps["FCap"],
                                           caps["AccCap"], caps["VC"],
@@ -1021,6 +1161,15 @@ class TpuExplorer:
                     self.log(f"Progress({depth}): {generated} states "
                              f"generated, {distinct} distinct states "
                              f"found, {fcount} states left on queue.")
+                if self.checkpoint_path and \
+                        now - last_ck >= self.checkpoint_every:
+                    last_ck = now
+                    self._write_ck(
+                        "resident", caps=dict(caps),
+                        seen=np.asarray(seen[:seen_count]),
+                        frontier=np.asarray(frontier[:fcount]),
+                        distinct=distinct, generated=generated,
+                        depth=depth)
             elif stat == ST_DONE:
                 self.log("Model checking completed. No error has been "
                          "found.")
@@ -1098,7 +1247,17 @@ class TpuExplorer:
         trace_levels = [(np.asarray(init_rows), None, 0)]
         frontier_maps = [np.asarray(explored_init, dtype=np.int64)]
         depth = 0
-        last_progress = time.time()
+        if self.resume_from:
+            ck = self._load_ck("host_seen")
+            (distinct, generated, depth, tl, fm, graph,
+             fsids) = self._restore_ck_state(ck, graph)
+            if self.store_trace:
+                trace_levels, frontier_maps = tl, fm
+            if graph is not None:
+                frontier_sids = fsids
+            store.load(ck["store"])
+            frontier_np = np.ascontiguousarray(ck["frontier"])
+        last_progress = last_ck = time.time()
         hstep = self._get_hstep(CH)
         while len(frontier_np) > 0:
             L = len(frontier_np)
@@ -1233,6 +1392,14 @@ class TpuExplorer:
             frontier_np = new_rows_np[sel]
 
             now = time.time()
+            if self.checkpoint_path and \
+                    now - last_ck >= self.checkpoint_every:
+                last_ck = now
+                self._write_ck(
+                    "host_seen", store=store.dump(), frontier=frontier_np,
+                    **self._ck_state_kwargs(distinct, generated, depth,
+                                            trace_levels, frontier_maps,
+                                            graph, frontier_sids))
             if now - last_progress >= self.progress_every:
                 last_progress = now
                 self.log(f"Progress({depth}): {generated} generated, "
@@ -1309,7 +1476,27 @@ class TpuExplorer:
                                                       dtype=np.int64)]
 
         depth = 0
-        last_progress = time.time()
+        if self.resume_from:
+            ck = self._load_ck("level")
+            (distinct, generated, depth, tl, fm, graph,
+             fsids) = self._restore_ck_state(ck, graph)
+            if self.store_trace:
+                trace_levels, frontier_maps = tl, fm
+            if graph is not None:
+                frontier_sids = fsids
+            cs, fr = ck["seen"], ck["frontier"]
+            SC = _pow2_at_least(len(cs), SC)
+            seen_np = np.full((SC, K), SENTINEL, np.int32)
+            seen_np[:len(cs)] = cs
+            seen = jnp.asarray(seen_np)
+            seen_count = len(cs)
+            FC = _pow2_at_least(max(len(fr), 1), FC)
+            fr_np = np.full((FC, W), SENTINEL, np.int32)
+            fr_np[:len(fr)] = fr
+            frontier = jnp.asarray(fr_np)
+            fcount = len(fr)
+
+        last_progress = last_ck = time.time()
         while fcount > 0:
             C = self.A * FC
             if seen_count + C > SC:
@@ -1414,6 +1601,15 @@ class TpuExplorer:
             fcount = front_count
 
             now = time.time()
+            if self.checkpoint_path and \
+                    now - last_ck >= self.checkpoint_every:
+                last_ck = now
+                self._write_ck(
+                    "level", seen=np.asarray(seen[:seen_count]),
+                    frontier=np.asarray(frontier[:fcount]),
+                    **self._ck_state_kwargs(distinct, generated, depth,
+                                            trace_levels, frontier_maps,
+                                            graph, frontier_sids))
             if now - last_progress >= self.progress_every:
                 last_progress = now
                 self.log(f"Progress({depth}): {generated} states generated, "

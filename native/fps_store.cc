@@ -85,4 +85,33 @@ uint64_t jaxmc_fps_insert(void* p, const uint64_t* hi, const uint64_t* lo,
     return new_count;
 }
 
+// Copies the sorted store contents into hi/lo (each sized to count) —
+// the checkpoint/resume serialization surface.
+void jaxmc_fps_export(void* p, uint64_t* hi, uint64_t* lo) {
+    Store& st = *static_cast<Store*>(p);
+    for (size_t i = 0; i < st.base.size(); ++i) {
+        hi[i] = st.base[i].hi;
+        lo[i] = st.base[i].lo;
+    }
+}
+
+// Replaces the store contents with n fingerprints; input must be sorted
+// and unique (the export format). Returns 1 on success, 0 when the
+// ordering invariant does not hold (store left empty in that case).
+uint64_t jaxmc_fps_import(void* p, const uint64_t* hi, const uint64_t* lo,
+                          uint64_t n) {
+    Store& st = *static_cast<Store*>(p);
+    st.base.clear();
+    st.base.reserve(n);
+    for (uint64_t i = 0; i < n; ++i) {
+        Fp f{hi[i], lo[i]};
+        if (i > 0 && !(st.base.back() < f)) {
+            st.base.clear();
+            return 0;
+        }
+        st.base.push_back(f);
+    }
+    return 1;
+}
+
 }  // extern "C"
