@@ -1,0 +1,316 @@
+r"""Device-side SYMMETRY canonicalization over encoded state rows.
+
+Every cfg SYMMETRY permutation of model values induces an exact
+transformation of the fixed-width lane encoding (compile/vspec.py):
+enum lanes remap through a value table, function/set lanes permute
+position-wise with the domain, and containers with a canonical internal
+order (growset, kvtable) are re-sorted after the element remap — so
+``decode . transform == apply_perm . decode`` lane-for-lane. The device
+canonical representative of a state row is the lexicographic minimum of
+the row over the (closed) permutation group; hashing canonical rows in
+``TpuExplorer._keys_of`` gives the same orbit partition — and therefore
+the same distinct/generated counts — as the interp backend's
+``make_canonicalizer`` (engine/explore.py), TLC's symmetry reduction
+(SURVEY.md §5 state-space reduction).
+
+Encodings that cannot be permuted exactly (a permuted domain member
+missing from a layout universe, heterogeneous per-key function specs
+inside one orbit) raise CompileError; TpuExplorer then falls back to the
+unreduced search with the existing SYMMETRY warning.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .vspec import VS, EnumUniverse, SENTINEL_LANE, CompileError
+
+SENTINEL = np.int32(SENTINEL_LANE)
+
+
+def _hk(k):
+    from .vspec import _hk as h
+    return h(k)
+
+
+def _value_table(pd: Dict, uni: EnumUniverse) -> Optional[np.ndarray]:
+    """Index remap table over the enum universe for permutation pd, or
+    None when pd fixes every universe member (identity on enum lanes)."""
+    n = len(uni)
+    tab = np.arange(n, dtype=np.int32)
+    changed = False
+    for i, v in enumerate(uni.values):
+        w = pd.get(v, v)
+        if w is not v:
+            try:
+                tab[i] = uni.index(w)
+            except CompileError:
+                raise CompileError(
+                    f"symmetry image {w} not in the layout's enum "
+                    f"universe - deepen layout sampling")
+            changed = True
+    return tab if changed else None
+
+
+def _lex_sort_rows(m, key_cols: int):
+    """Stable lexicographic sort of the rows of m [c, w] by the first
+    key_cols columns (LSD: chained single-key stable sorts — multi-key
+    comparators explode XLA compile time inside while loops). SENTINEL
+    padding rows sort last (SENTINEL is the int32 maximum)."""
+    cols = [m[:, j] for j in range(m.shape[1])]
+    for c in reversed(range(key_cols)):
+        res = lax.sort(tuple([cols[c]] + cols), num_keys=1,
+                       is_stable=True)
+        cols = list(res[1:])
+    return jnp.stack(cols, axis=1)
+
+
+def _seg_tf(spec: VS, pd: Dict, uni: EnumUniverse,
+            tab: Optional[np.ndarray]) -> Optional[Callable]:
+    """Transform for one encoded segment (length spec.width) under pd.
+    Returns None when the transform is the identity (common: int lanes,
+    domains untouched by pd). Raises CompileError when the encoding
+    cannot be permuted exactly."""
+    k = spec.kind
+    if k in ("justempty", "int", "bool"):
+        return None
+    if k == "enum":
+        if tab is None:
+            return None
+        jt = jnp.asarray(tab)
+
+        def enum_tf(seg):
+            v = seg[0]
+            out = jnp.where(v == SENTINEL, v,
+                            jt[jnp.clip(v, 0, len(tab) - 1)])
+            return out[None]
+        return enum_tf
+
+    if k == "fcn":
+        # new[key] = old[pd^-1(key)]: position i takes the segment of the
+        # source key, itself element-transformed
+        inv = {_hk(v): kk for kk, v in pd.items()}
+        pos = {_hk(kk): i for i, kk in enumerate(spec.dom)}
+        offs = np.cumsum([0] + [e.width for e in spec.elems])
+        src_idx, sub_tfs, moved = [], [], False
+        for i, kk in enumerate(spec.dom):
+            src = inv.get(_hk(kk), kk)
+            j = pos.get(_hk(src))
+            if j is None:
+                raise CompileError(
+                    f"symmetry moves {src} outside the function domain "
+                    f"{spec.dom}")
+            if spec.elems[j] != spec.elems[i]:
+                raise CompileError(
+                    "heterogeneous function-value specs within one "
+                    "symmetry orbit")
+            src_idx.append(j)
+            moved = moved or j != i
+            sub_tfs.append(_seg_tf(spec.elems[j], pd, uni, tab))
+        if not moved and all(t is None for t in sub_tfs):
+            return None
+
+        def fcn_tf(seg):
+            parts = []
+            for i, j in enumerate(src_idx):
+                sub = seg[offs[j]:offs[j + 1]]
+                parts.append(sub if sub_tfs[i] is None else sub_tfs[i](sub))
+            return jnp.concatenate(parts) if parts else seg
+        return fcn_tf
+
+    if k == "set":
+        inv = {_hk(v): kk for kk, v in pd.items()}
+        pos = {_hk(m): i for i, m in enumerate(spec.dom)}
+        src_idx = []
+        for i, m in enumerate(spec.dom):
+            src = inv.get(_hk(m), m)
+            j = pos.get(_hk(src))
+            if j is None:
+                raise CompileError(
+                    f"symmetry moves {src} outside the set universe "
+                    f"{spec.dom}")
+            src_idx.append(j)
+        if src_idx == list(range(len(spec.dom))):
+            return None
+        gidx = jnp.asarray(np.asarray(src_idx, np.int32))
+
+        def set_tf(seg):
+            return jnp.take(seg, gidx)
+        return set_tf
+
+    if k == "seq":
+        sub = _seg_tf(spec.elem, pd, uni, tab)
+        if sub is None:
+            return None
+        ew = spec.elem.width
+
+        def seq_tf(seg):
+            n = seg[0]
+            parts = [seg[:1]]
+            for j in range(spec.cap):
+                s = seg[1 + j * ew:1 + (j + 1) * ew]
+                # zero padding beyond the length lane must NOT remap
+                parts.append(jnp.where(j < n, sub(s), s))
+            return jnp.concatenate(parts)
+        return seq_tf
+
+    if k == "growset":
+        sub = _seg_tf(spec.elem, pd, uni, tab)
+        if sub is None:
+            return None  # remap is identity => sorted order unchanged
+        ew = spec.elem.width
+
+        def growset_tf(seg):
+            n = seg[0]
+            parts = []
+            for j in range(spec.cap):
+                s = seg[1 + j * ew:1 + (j + 1) * ew]
+                # SENTINEL padding beyond the count must NOT remap
+                parts.append(jnp.where(j < n, sub(s), s))
+            m = jnp.reshape(jnp.concatenate(parts), (spec.cap, ew))
+            m = _lex_sort_rows(m, ew)
+            return jnp.concatenate([seg[:1], m.reshape(-1)])
+        return growset_tf
+
+    if k == "pfcn":
+        inv = {_hk(v): kk for kk, v in pd.items()}
+        pos = {_hk(kk): i for i, kk in enumerate(spec.dom)}
+        offs = np.cumsum([0] + [1 + e.width for e in spec.elems])
+        src_idx, sub_tfs, moved = [], [], False
+        for i, kk in enumerate(spec.dom):
+            src = inv.get(_hk(kk), kk)
+            j = pos.get(_hk(src))
+            if j is None:
+                raise CompileError(
+                    f"symmetry moves {src} outside the pfcn universe")
+            if spec.elems[j] != spec.elems[i]:
+                raise CompileError(
+                    "heterogeneous pfcn value specs within one symmetry "
+                    "orbit")
+            src_idx.append(j)
+            moved = moved or j != i
+            sub_tfs.append(_seg_tf(spec.elems[j], pd, uni, tab))
+        if not moved and all(t is None for t in sub_tfs):
+            return None
+
+        def pfcn_tf(seg):
+            parts = []
+            for i, j in enumerate(src_idx):
+                blk = seg[offs[j]:offs[j + 1]]
+                bit, val = blk[:1], blk[1:]
+                if sub_tfs[i] is not None:
+                    # absent entries are zero-padded: remap only present
+                    val = jnp.where(bit[0] == 1, sub_tfs[i](val), val)
+                parts.append(jnp.concatenate([bit, val]))
+            return jnp.concatenate(parts)
+        return pfcn_tf
+
+    if k == "union":
+        pay = spec.width - 1
+        var_tfs = []
+        any_tf = False
+        for vnames, vfields in spec.variants:
+            offs = np.cumsum([0] + [f.width for f in vfields])
+            subs = [_seg_tf(f, pd, uni, tab) for f in vfields]
+            if any(s is not None for s in subs):
+                any_tf = True
+
+            def vtf(seg, offs=offs, subs=subs):
+                parts = []
+                for i, s in enumerate(subs):
+                    fld = seg[offs[i]:offs[i + 1]]
+                    parts.append(fld if s is None else s(fld))
+                parts.append(seg[offs[-1]:])  # zero tail padding
+                return jnp.concatenate(parts)
+            var_tfs.append(vtf)
+        if not any_tf:
+            return None
+
+        def union_tf(seg):
+            tag, payload = seg[0], seg[1:]
+            out = payload
+            for t, vtf in enumerate(var_tfs):
+                out = jnp.where(tag == t, vtf(payload), out)
+            return jnp.concatenate([seg[:1], out])
+        return union_tf
+
+    if k == "kvtable":
+        ksub = _seg_tf(spec.elem, pd, uni, tab)
+        vsub = _seg_tf(spec.val, pd, uni, tab)
+        if ksub is None and vsub is None:
+            return None
+        kw, vw = spec.elem.width, spec.val.width
+        rw = kw + vw
+
+        def kv_tf(seg):
+            n = seg[0]
+            parts = []
+            for j in range(spec.cap):
+                blk = seg[1 + j * rw:1 + (j + 1) * rw]
+                kb, vb = blk[:kw], blk[kw:]
+                nk = kb if ksub is None else ksub(kb)
+                nv = vb if vsub is None else vsub(vb)
+                nb = jnp.concatenate([nk, nv])
+                # SENTINEL padding rows must NOT remap
+                parts.append(jnp.where(j < n, nb, blk))
+            m = jnp.reshape(jnp.concatenate(parts), (spec.cap, rw))
+            # encode sorts rows by the key lanes (keys unique, so the
+            # stable key-only sort is deterministic)
+            m = _lex_sort_rows(m, kw)
+            return jnp.concatenate([seg[:1], m.reshape(-1)])
+        return kv_tf
+
+    raise AssertionError(k)
+
+
+def build_canon2(model, layout) -> Optional[Callable]:
+    """Canonicalizer over encoded rows: vmapped fn(rows [N, W]) -> rows,
+    each row replaced by the lexicographic minimum of its symmetry
+    orbit. None when the model declares no (non-identity) symmetry.
+    Raises CompileError when some lane encoding cannot be permuted."""
+    from ..sem.symmetry import symmetry_group
+    perms = symmetry_group(model)
+    if not perms:
+        return None
+
+    row_tfs = []
+    widths = [layout.specs[v].width for v in layout.vars]
+    offs = np.cumsum([0] + widths)
+    for pd in perms:
+        tab = _value_table(pd, layout.uni)
+        seg_tfs = [_seg_tf(layout.specs[v], pd, layout.uni, tab)
+                   for v in layout.vars]
+        if all(t is None for t in seg_tfs):
+            continue  # permutation fixes every lane
+
+        def row_tf(row, seg_tfs=seg_tfs):
+            parts = []
+            for i, t in enumerate(seg_tfs):
+                seg = row[offs[i]:offs[i + 1]]
+                parts.append(seg if t is None else t(seg))
+            return jnp.concatenate(parts)
+        row_tfs.append(row_tf)
+    if not row_tfs:
+        return None
+
+    def lex_lt(a, b):
+        # first differing lane decides; signed int32 order matches the
+        # host-side encode ordering
+        diff = a != b
+        idx = jnp.argmax(diff)
+        return jnp.any(diff) & (a[idx] < b[idx])
+
+    def canon_row(row):
+        best = row
+        for tf in row_tfs:
+            cand = tf(row)
+            best = jnp.where(lex_lt(cand, best), cand, best)
+        return best
+
+    return jax.vmap(canon_row)

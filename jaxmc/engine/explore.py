@@ -70,35 +70,8 @@ def make_canonicalizer(model: Model):
     to the least representative under the declared permutation set, the
     standard symmetry reduction (SURVEY.md §5). Returns None when no
     symmetry is declared or every permutation is the identity."""
-    if model.symmetry is None:
-        return None
-    from ..sem.values import enumerate_set
-    base = []
-    val = eval_expr(model.symmetry, model.ctx())
-    for p in enumerate_set(val):
-        if isinstance(p, Fcn):
-            base.append(dict(p.d))
-    # close under composition: TLC canonicalizes over the GROUP the
-    # declared set generates — Permutations(A) \cup Permutations(B) alone
-    # misses the combined A+B permutations and under-reduces
-    def key_of(pd):
-        return tuple(sorted((id(k), id(v)) for k, v in pd.items()))
-
-    group = {key_of(pd): pd for pd in base}
-    frontier = list(base)
-    while frontier:
-        nxt = []
-        for a in frontier:
-            for b in base:
-                comp = {k: b.get(a.get(k, k), a.get(k, k))
-                        for k in set(a) | set(b)}
-                kk = key_of(comp)
-                if kk not in group:
-                    group[kk] = comp
-                    nxt.append(comp)
-        frontier = nxt
-    perms = [pd for pd in group.values()
-             if any(k is not v for k, v in pd.items())]
+    from ..sem.symmetry import symmetry_group
+    perms = symmetry_group(model)
     if not perms:
         return None
 

@@ -251,6 +251,19 @@ class TpuExplorer:
                     [ca.label] * ca.n_slots)
             else:
                 self.labels_flat.append(ca.label)
+        # cfg SYMMETRY: canonicalize rows to their orbit representative
+        # before fingerprinting (same partition, hence same counts, as
+        # the interp's make_canonicalizer); encodings the transform
+        # builder rejects fall back to the unreduced search with the
+        # SYMMETRY warning
+        self.canon_fn = None
+        self._sym_fallback: Optional[str] = None
+        if model.symmetry is not None:
+            from ..compile.symmetry2 import build_canon2
+            try:
+                self.canon_fn = build_canon2(model, self.layout)
+            except CompileError as e:
+                self._sym_fallback = str(e)
         self.inv_fns = [(nm, compile_predicate2(self.kc, ex))
                         for nm, ex in model.invariants]
         self.constraint_fns = [(nm, compile_predicate2(self.kc, ex))
@@ -428,9 +441,21 @@ class TpuExplorer:
         trace.append((sst, self.labels_flat[a]))
         return Violation("property", rc.name, trace, msg)
 
+    def _symmetry_warnings(self) -> List[str]:
+        if self.model.symmetry is None or self.canon_fn is not None:
+            return []
+        return [SYMMETRY_WARNING + (f" ({self._sym_fallback})"
+                                    if self._sym_fallback else "")]
+
     def _keys_of(self, rows, valid):
         """Dedup key lanes: [validity, hash-or-state lanes]. Invalid rows
-        get validity=1 (sorting after all valid rows) and SENTINEL data."""
+        get validity=1 (sorting after all valid rows) and SENTINEL data.
+
+        With cfg SYMMETRY, rows are canonicalized to their orbit's
+        lex-min representative first, so the fingerprint partition is
+        the symmetry-reduced one (compile/symmetry2.py)."""
+        if self.canon_fn is not None:
+            rows = jnp.where(valid[:, None], self.canon_fn(rows), rows)
         if self.fp_mode:
             k = fingerprint128(rows)
         else:
@@ -1032,8 +1057,7 @@ class TpuExplorer:
                     "resident mode (W={}): dedup on 128-bit fingerprints; "
                     "collision probability < n^2 * 2^-129".format(W)]
         warnings.extend(self._temporal_warnings())
-        if model.symmetry is not None:
-            warnings.append(SYMMETRY_WARNING)
+        warnings.extend(self._symmetry_warnings())
 
         init_rows, explored_init, n_init, err = \
             self._prepare_init(t0, warnings)
@@ -1217,8 +1241,7 @@ class TpuExplorer:
         warnings = ["seen-set resident in the native host fingerprint "
                     "store (host_seen); dedup on 128-bit fingerprints"]
         warnings.extend(self._temporal_warnings())
-        if model.symmetry is not None:
-            warnings.append(SYMMETRY_WARNING)
+        warnings.extend(self._symmetry_warnings())
 
         init_rows, explored_init, n_init, err = \
             self._prepare_init(t0, warnings)
@@ -1429,8 +1452,7 @@ class TpuExplorer:
         W, K = self.W, self.K
         warnings = []
         warnings.extend(self._temporal_warnings())
-        if model.symmetry is not None:
-            warnings.append(SYMMETRY_WARNING)
+        warnings.extend(self._symmetry_warnings())
         if self.fp_mode:
             warnings.append(
                 "wide state (W={}): dedup on 128-bit fingerprints; "

@@ -219,8 +219,7 @@ class MeshExplorer(TpuExplorer):
                 "refinement properties NOT checked on the mesh backend "
                 "(single-chip --backend jax checks them): "
                 + ", ".join(rc.name for rc in self.refiners))
-        if model.symmetry is not None:
-            warnings.append(SYMMETRY_WARNING)
+        warnings.extend(self._symmetry_warnings())
 
         rows = {}
         for st in self.init_states:

@@ -1,0 +1,33 @@
+---------------------------- MODULE symtoy ----------------------------
+(* Symmetric toy model for the device SYMMETRY canonicalizer
+   (compile/symmetry2.py): a process set P grabs a token; `owner` is an
+   enum lane, `used` a set-membership block, `turns` a per-process
+   function — exercising the enum remap, set-lane permutation, and
+   function-block permutation transforms. Counts must equal the interp
+   backend's symmetry-reduced counts (cfg SYMMETRY Perms,
+   reference TLC.tla:13-14 Permutations). *)
+EXTENDS Naturals, FiniteSets, TLC
+CONSTANTS P, None
+VARIABLES owner, used, turns
+
+Perms == Permutations(P)
+
+Init == owner = None /\ used = {} /\ turns = [p \in P |-> 0]
+
+Grab(p) == /\ owner' = p
+           /\ used' = used \cup {p}
+           /\ turns' = [turns EXCEPT ![p] = @ + 1]
+
+Release == /\ owner /= None
+           /\ owner' = None
+           /\ UNCHANGED <<used, turns>>
+
+Next == \/ owner = None /\ \E p \in P : turns[p] < 2 /\ Grab(p)
+        \/ Release
+
+Spec == Init /\ [][Next]_<<owner, used, turns>>
+
+TypeInv == /\ owner \in P \cup {None}
+           /\ used \subseteq P
+           /\ turns \in [P -> 0..2]
+=======================================================================

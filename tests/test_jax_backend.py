@@ -162,6 +162,45 @@ class TestHostSeen:
         assert len(r.violation.trace) >= 2
 
 
+class TestDeviceSymmetry:
+    # cfg SYMMETRY on the device backends (VERDICT r1 #7): rows are
+    # canonicalized to orbit representatives before fingerprinting
+    # (compile/symmetry2.py), so device counts equal the interp's
+    # symmetry-reduced counts
+
+    def test_symtoy_reduced_counts_match_interp(self):
+        from jaxmc.engine.explore import Explorer
+        from jaxmc.tpu.bfs import TpuExplorer
+        cfg = parse_cfg(open(os.path.join(SPECS, "symtoy.cfg")).read())
+        cfg.check_deadlock = False
+        model = load(os.path.join(SPECS, "symtoy.tla"), cfg)
+        ri = Explorer(model).run()
+        ex = TpuExplorer(model)
+        assert ex.canon_fn is not None
+        rj = ex.run()
+        assert ri.ok and rj.ok
+        # symmetry-reduced (unreduced would be 109/81)
+        assert (ri.generated, ri.distinct) == (33, 22)
+        assert (rj.generated, rj.distinct) == (33, 22)
+        assert not rj.warnings  # reduction applied: no SYMMETRY warning
+
+    @pytest.mark.slow
+    def test_mcvoting_reduced_counts_match_interp(self):
+        # the corpus's symmetry workhorse (MCPaxos's symmetry is the
+        # identity over its singleton sets): growset-of-records lanes
+        # exercise the element-remap + segment re-sort transform
+        from jaxmc.tpu.bfs import TpuExplorer
+        d = os.path.join(REFERENCE, "examples", "Paxos")
+        cfg = parse_cfg(open(os.path.join(d, "MCVoting.cfg")).read())
+        cfg.check_deadlock = False
+        model = load(os.path.join(d, "MCVoting.tla"), cfg)
+        ex = TpuExplorer(model)
+        assert ex.canon_fn is not None
+        r = ex.run()
+        assert r.ok
+        assert (r.generated, r.distinct) == (406, 77)  # interp pin
+
+
 class TestDeviceCheckpoint:
     # checkpoint/resume on the device backends (VERDICT r1 #7): every
     # device mode checkpoints at level/dispatch boundaries and a resumed
