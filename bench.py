@@ -1,7 +1,8 @@
 r"""jaxmc benchmark: raft states/sec on the device BFS backend.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "states/sec", "vs_baseline": R}
+  {"metric": ..., "value": N, "unit": "states/sec", "vs_baseline": R,
+   "vs_tlc_estimate": R2}
 
 Workload: the BASELINE.json model of record — the reference raft spec
 (/root/reference/examples/raft.tla:482-493 hot path) with Server={s1,s2,s3}
@@ -11,17 +12,24 @@ reported rate covers a full run, not a truncated prefix.
 
 vs_baseline is the speedup over this repo's exact Python interpreter on
 the same workload (measured on a capped prefix, cap stated in the metric).
-It is NOT the BASELINE.md TLC ratio: TLC needs a JVM, which this image
-does not have — BASELINE.md documents that the TLC baseline must be
-measured where one exists. Backend count-equivalence is pinned for THIS
-benchmark model in the slow-marked
+vs_tlc_estimate is the speedup over the DOCUMENTED TLC estimate in
+BASELINE.md (no JVM in this image, so the TLC rate is literature-sourced,
+NOT measured — clearly labeled there). Backend count-equivalence is pinned
+for THIS benchmark model in the slow-marked
 tests/test_kernel2.py::test_raft_3s_bench_whole_run_equivalence (and for
 the smaller MCraft_micro model in default CI).
 
-Platform: probes TPU availability in a SUBPROCESS first (the axon TPU
-plugin can hang the whole process at init when the tunnel is down — a
-timed-out probe costs the subprocess, not the bench), then pins the
-surviving platform before first jax use in this process.
+Resilience (VERDICT r2 #1): the axon TPU tunnel is flaky — plugin init can
+hang for minutes or forever. This script
+  1. probes TPU availability in SUBPROCESSES with retry/backoff for up to
+     JAXMC_BENCH_TPU_WAIT seconds (default 1200) — not one 180 s shot;
+  2. on TPU, first runs profile_tpu.py (subprocess, bounded) so per-step
+     device timings survive in PROFILE_TPU.txt even if the full bench
+     later dies;
+  3. runs the measured bench in a CHILD process pinned to the chosen
+     platform; if the TPU child dies mid-run (tunnel drop), retries once,
+     then falls back to a CPU child — an honest JSON line is emitted in
+     every case.
 """
 
 import json
@@ -37,43 +45,103 @@ SPEC = os.path.join(_REPO, "specs", "MCraftMicro.tla")
 CFG = os.path.join(_REPO, "specs", "MCraft_3s_bench.cfg")
 INTERP_CAP = 20000  # distinct-state cap for the interpreter baseline run
 
+# Documented TLC comparison point (BASELINE.md "TLC rate estimate"):
+# literature/experience-sourced, NOT measured (no JVM in image).
+TLC_EST_STATES_PER_SEC = 5000.0
 
-def probe_platform(timeout_s: float = 180.0) -> str:
-    """'tpu'/'cpu'/... if device init works; 'cpu (tpu init failed: ...)'
-    when the plugin fails or hangs (diagnosed, not silent)."""
+
+def _log(msg):
+    print(f"bench: {msg}", file=sys.stderr, flush=True)
+
+
+def probe_tpu_once(timeout_s: float) -> tuple:
+    """(status, detail): one subprocess attempt at TPU plugin init.
+    status: 'tpu' (up) | 'other' (jax works, no TPU on this machine —
+    terminal) | 'retry' (init hung or errored — tunnel may come back)."""
     code = "import jax; print(jax.devices()[0].platform)"
     try:
         r = subprocess.run([sys.executable, "-c", code],
                            capture_output=True, text=True,
                            timeout=timeout_s)
     except subprocess.TimeoutExpired:
-        return "cpu (tpu init failed: device init timed out after " \
-               f"{timeout_s:.0f}s — axon tunnel down?)"
+        return "retry", f"device init timed out after {timeout_s:.0f}s"
     if r.returncode != 0:
         tail = (r.stderr or "").strip().splitlines()[-1:] or ["?"]
-        return f"cpu (tpu init failed: {tail[0][:120]})"
-    return r.stdout.strip()
+        return "retry", tail[0][:120]
+    plat = r.stdout.strip()
+    if plat == "tpu":
+        return "tpu", plat
+    # jax initialized cleanly on a non-TPU platform: deterministic,
+    # terminal — waiting longer cannot produce a TPU
+    return "other", plat
 
 
-def load_model():
+def wait_for_tpu() -> tuple:
+    """Retry the probe with backoff for up to JAXMC_BENCH_TPU_WAIT
+    seconds (default 20 min). Returns (found, last_detail)."""
+    budget = float(os.environ.get("JAXMC_BENCH_TPU_WAIT", "1200"))
+    t0 = time.time()
+    attempt = 0
+    detail = "no attempt"
+    while time.time() - t0 < budget:
+        attempt += 1
+        left = budget - (time.time() - t0)
+        status, detail = probe_tpu_once(min(180.0, max(30.0, left)))
+        _log(f"tpu probe #{attempt}: "
+             f"{'UP' if status == 'tpu' else detail} "
+             f"({time.time() - t0:.0f}s in)")
+        if status == "tpu":
+            return True, detail
+        if status == "other":
+            return False, f"no TPU on this machine (platform={detail})"
+        time.sleep(min(30.0, max(0.0, budget - (time.time() - t0))))
+    return False, detail
+
+
+def run_profile_tpu():
+    """Capture per-step device timings before the full bench (so a later
+    tunnel drop still leaves evidence). Bounded; failure is non-fatal."""
+    out_path = os.path.join(_REPO, "PROFILE_TPU.txt")
+    try:
+        r = subprocess.run([sys.executable,
+                            os.path.join(_REPO, "profile_tpu.py")],
+                           capture_output=True, text=True, timeout=900)
+        with open(out_path, "w") as fh:
+            fh.write(r.stdout + ("\n--- stderr ---\n" + r.stderr
+                                 if r.returncode else ""))
+        _log(f"profile_tpu.py rc={r.returncode} -> {out_path}")
+    except subprocess.TimeoutExpired as ex:
+        # keep whatever per-step timings made it out before the hang —
+        # that partial evidence is the whole point of profiling first
+        with open(out_path, "w") as fh:
+            fh.write((ex.stdout or "") + "\n--- TIMED OUT at 900s ---\n"
+                     + (ex.stderr or ""))
+        _log(f"profile_tpu.py timed out (900s); partial -> {out_path}")
+    except OSError as ex:
+        _log(f"profile_tpu.py failed to run: {ex}")
+
+
+def child_bench(platform_pin: str):
+    """The measured bench body. Runs in a child process with the platform
+    pinned BEFORE first jax import; prints the JSON line on stdout."""
+    import jax
+    # pin BOTH platforms: a tunnel drop between probe and child start
+    # must fail this child loudly (parent then retries / falls back),
+    # never silently measure on CPU while claiming the TPU slot
+    jax.config.update("jax_platforms", platform_pin)
+    devs = jax.devices()
+    assert devs[0].platform == platform_pin, \
+        f"pinned {platform_pin} but got {devs[0].platform}"
+
     from jaxmc.sem.modules import Loader, bind_model
     from jaxmc.front.cfg import parse_cfg
-    ldr = Loader([os.path.join(_REPO, "specs"),
-                  "/root/reference/examples"])
-    return bind_model(ldr.load_path(SPEC), parse_cfg(open(CFG).read()))
-
-
-def main():
-    platform = probe_platform()
-    import jax
-    if platform.startswith("cpu ("):
-        # plugin is broken/hanging: pin the CPU platform before first use
-        jax.config.update("jax_platforms", "cpu")
-        print(f"bench: {platform}", file=sys.stderr)
-    devs = jax.devices()
-
     from jaxmc.tpu.bfs import TpuExplorer
     from jaxmc.engine.explore import Explorer
+
+    def load_model():
+        ldr = Loader([os.path.join(_REPO, "specs"),
+                      "/root/reference/examples"])
+        return bind_model(ldr.load_path(SPEC), parse_cfg(open(CFG).read()))
 
     # resident device mode: the whole BFS (frontier, fingerprint set,
     # level loop) runs inside one jitted while_loop on the accelerator —
@@ -102,14 +170,66 @@ def main():
             f"{r.generated} generated / {r.distinct} distinct, COMPLETED, "
             f"platform={devs[0].platform}, device-resident BFS); "
             f"vs_baseline = speedup over the exact Python interpreter on "
-            f"the same model ({INTERP_CAP}-distinct-state prefix), NOT "
-            f"TLC (no JVM in image; BASELINE.md documents the TLC-ratio "
-            f"target separately)"),
+            f"the same model ({INTERP_CAP}-distinct-state prefix); "
+            f"vs_tlc_estimate = speedup over the BASELINE.md documented "
+            f"TLC estimate ({TLC_EST_STATES_PER_SEC:.0f} st/s/core, "
+            f"literature-sourced, NOT measured — no JVM in image)"),
         "value": round(jax_rate, 1),
         "unit": "states/sec",
         "vs_baseline": round(jax_rate / interp_rate, 3),
+        "vs_tlc_estimate": round(jax_rate / TLC_EST_STATES_PER_SEC, 3),
     }
-    print(json.dumps(out))
+    print(json.dumps(out), flush=True)
+
+
+def run_child(platform_pin: str, timeout_s: float):
+    """Run child_bench in a subprocess; returns its JSON line or None."""
+    env = dict(os.environ, JAXMC_BENCH_CHILD=platform_pin)
+    try:
+        r = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                           capture_output=True, text=True,
+                           timeout=timeout_s, env=env)
+    except subprocess.TimeoutExpired:
+        _log(f"{platform_pin} bench child timed out after {timeout_s:.0f}s")
+        return None
+    sys.stderr.write(r.stderr or "")
+    if r.returncode != 0:
+        _log(f"{platform_pin} bench child rc={r.returncode}")
+        return None
+    for line in (r.stdout or "").splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            return line
+    _log(f"{platform_pin} bench child produced no JSON line")
+    return None
+
+
+def main():
+    pin = os.environ.get("JAXMC_BENCH_CHILD")
+    if pin:
+        child_bench(pin)
+        return
+
+    found, detail = wait_for_tpu()
+    if found:
+        run_profile_tpu()
+        line = run_child("tpu", 2400.0)
+        if line is None:
+            _log("retrying TPU bench once (tunnel flap?)")
+            line = run_child("tpu", 2400.0)
+        if line is not None:
+            print(line, flush=True)
+            return
+        _log("TPU bench failed twice — falling back to CPU")
+    else:
+        _log(f"tpu unavailable after retry window ({detail}) — CPU bench")
+    line = run_child("cpu", 3000.0)
+    if line is None:
+        # last resort: run inline on CPU so SOME line is emitted
+        _log("CPU child failed; running inline")
+        child_bench("cpu")
+        return
+    print(line, flush=True)
 
 
 if __name__ == "__main__":

@@ -209,19 +209,22 @@ class Explorer:
         from .refinement import build_refinement_checkers
         refiners, live_only = build_refinement_checkers(model)
         warnings = []
+        # temporal obligations are checked over the behavior graph after
+        # the search completes (engine/liveness.py) — collect the full
+        # edge log only when some property needs it.
+        # collect_obligations also adopts the fairness halves of
+        # spec-shaped PROPERTYs (clearing liveness_skipped), so it must
+        # run BEFORE the warning pass below.
+        from .liveness import collect_obligations
+        # 'always' obligations only iterate states — don't pay for the
+        # edge log (RAM + checkpoint size) unless some obligation needs it
+        live_obligations, unsupported, collect_edges = \
+            collect_obligations(model, refiners)
         for rc in refiners:
             if rc.liveness_skipped:
                 warnings.append(
                     f"property {rc.name}: refinement checked stepwise; its "
                     f"fairness conjuncts are NOT checked")
-        # temporal obligations are checked over the behavior graph after
-        # the search completes (engine/liveness.py) — collect the full
-        # edge log only when some property needs it
-        from .liveness import collect_obligations
-        # 'always' obligations only iterate states — don't pay for the
-        # edge log (RAM + checkpoint size) unless some obligation needs it
-        live_obligations, unsupported, collect_edges = \
-            collect_obligations(model, {rc.name for rc in refiners})
         if unsupported:
             warnings.append(
                 "temporal properties NOT checked (unsupported form): "

@@ -12,6 +12,16 @@ forms exactly:
                            MCInnerSerial.tla AlwaysResponds (quantified)
   <>[]Q and [](P => <>[]Q) RealTime/MCRealTimeHourClock.tla:43
                            ErrorTemporal (an expected-to-fail property)
+  WF_v(A) / SF_v(A)        fairness-as-property: MCLiveInternalMemory.cfg:7
+                           PROPERTY Liveness (LiveInternalMemory.tla:17)
+  disjunctions of []<>-class atoms
+                           MCLiveWriteThroughCache.tla:129-143
+                           LM_Inner_Liveness/Liveness2 ([]<>~EnabledX \/
+                           []<><<X>>_v — the hand-instantiated ENABLED
+                           construction), incl. the fairness half of a
+                           spec-shaped PROPERTY (LM_Inner_LISpec, whose
+                           Init/[][Next]_v half the refinement checker
+                           covers stepwise)
 
 with fairness WF_v(A) / SF_v(A), possibly \A-quantified or behind named
 operators (AlternatingBit.tla:72-75 ABFairness).
@@ -44,7 +54,7 @@ from typing import Any, Dict, List, Optional, Set, Tuple
 from ..front import tla_ast as A
 from ..sem.values import EvalError, fmt, tla_eq
 from ..sem.eval import OpClosure, eval_expr, iter_binders, _bool
-from ..sem.enumerate import enumerate_next
+from ..sem.enumerate import Walker
 from ..sem.modules import Model
 
 
@@ -59,11 +69,17 @@ class UnsupportedProperty(Exception):
 @dataclass
 class Obligation:
     """One checkable temporal obligation (a conjunct of a PROPERTY, with
-    any \\A binders already instantiated into `bound`)."""
+    any \\A binders already instantiated into `bound`).
+
+    For kind 'ae_disj', exprs holds atom tuples instead of plain nodes:
+    ('pred', P) for []<>P, ('action', A, v) for []<><<A>>_v, and
+    ('WF'|'SF', A, v) for fairness-as-property — the obligation is the
+    disjunction of the atoms, and its negation (the violation search) is
+    the conjunction of the atoms' <>[]-style negations."""
     prop_name: str
     kind: str          # 'always' | 'ae' | 'ae_action' | 'leadsto' | 'ea'
-    #                    | 'p_ea'
-    exprs: Tuple[A.Node, ...]
+    #                    | 'p_ea' | 'ae_disj'
+    exprs: Tuple[Any, ...]
     bound: Dict[str, Any]
 
     def describe(self) -> str:
@@ -98,6 +114,23 @@ def _op(e, name, nargs=None):
         (nargs is None or len(e.args) == nargs)
 
 
+def _ae_atom(e: A.Node, model: Model):
+    """Recognize one []<>-class disjunct. Returns an atom tuple —
+    ('pred', P) | ('action', A, v) | ('WF'|'SF', A, v) — or None."""
+    e = _chase(e, model)
+    if isinstance(e, A.Fair):
+        return (e.kind, e.action, e.sub)
+    if _op(e, "[]", 1):
+        x = _chase(e.args[0], model)
+        if _op(x, "<>", 1):
+            y = _chase(x.args[0], model)
+            if isinstance(y, A.AngleAction):
+                return ("action", y.action, y.sub)
+            if not _contains_temporal(y, model):
+                return ("pred", y)
+    return None
+
+
 def classify_property(model: Model, prop_name: str, expr: A.Node,
                       bound: Dict[str, Any]) -> List[Obligation]:
     """Split a PROPERTY into obligations; raises UnsupportedProperty."""
@@ -112,6 +145,26 @@ def classify_property(model: Model, prop_name: str, expr: A.Node,
             out.extend(classify_property(model, prop_name, e.body,
                                          {**bound, **b}))
         return out
+    if isinstance(e, A.Fair):
+        # WF_v(A) / SF_v(A) checked AS a property (MCLiveInternalMemory
+        # PROPERTY Liveness): a one-atom disjunction
+        return [Obligation(prop_name, "ae_disj",
+                           ((e.kind, e.action, e.sub),), bound)]
+    if _op(e, "\\/", 2):
+        # disjunction of []<>-class atoms (LM_Inner_Liveness[2]'s
+        # []<>~EnabledX \/ []<><<X>>_v construction)
+        disj: List[A.Node] = []
+        work = [e]
+        while work:
+            d = _chase(work.pop(), model)
+            if _op(d, "\\/", 2):
+                work.extend(d.args)
+            else:
+                disj.append(d)
+        atoms = [_ae_atom(d, model) for d in disj]
+        if all(a is not None for a in atoms):
+            return [Obligation(prop_name, "ae_disj", tuple(atoms), bound)]
+        raise UnsupportedProperty("disjunction outside the []<> fragment")
     if _op(e, "~>", 2):
         return [Obligation(prop_name, "leadsto",
                            (e.args[0], e.args[1]), bound)]
@@ -141,14 +194,25 @@ def classify_property(model: Model, prop_name: str, expr: A.Node,
     raise UnsupportedProperty(f"unsupported temporal form")
 
 
-def collect_obligations(model: Model, refined_names: Set[str]
+def collect_obligations(model: Model, refiners
                         ) -> Tuple[List[Obligation], List[str], bool]:
     """Classify every cfg PROPERTY into temporal obligations — the shared
     policy of the interp and jax backends (verdict/warning parity).
+
+    `refiners` is the list of RefinementCheckers already built for
+    spec-shaped PROPERTYs (engine/refinement.py): their Init/[][Next]_v
+    halves check stepwise, and their fairness conjuncts are classified
+    HERE into temporal obligations (the fairness half of LM_Inner_LISpec,
+    MCLiveWriteThroughCache.cfg:4). On success the checker's
+    liveness_skipped flag is cleared so the "fairness conjuncts are NOT
+    checked" warning disappears. Instance-path refinements (V!Spec) keep
+    the warning: their fairness would need instance-entered evaluation.
+
     Returns (obligations, unsupported_names, collect_edges):
     unsupported_names excludes properties a refinement checker already
     covers; collect_edges is True iff some obligation needs the edge log
     (everything except bare '[]P')."""
+    refined_names = {rc.name for rc in refiners}
     obligations: List[Obligation] = []
     unsupported: List[str] = []
     for pnm, pexpr in model.properties:
@@ -157,6 +221,17 @@ def collect_obligations(model: Model, refined_names: Set[str]
         except (UnsupportedProperty, EvalError):
             if pnm not in refined_names:
                 unsupported.append(pnm)
+    for rc in refiners:
+        if not rc.fair or rc.instances:
+            continue
+        try:
+            obs = []
+            for f in rc.fair:
+                obs.extend(classify_property(model, rc.name, f, {}))
+        except (UnsupportedProperty, EvalError):
+            continue  # keep liveness_skipped: warning stays honest
+        obligations.extend(obs)
+        rc.liveness_skipped = False
     collect_edges = any(ob.kind != "always" for ob in obligations)
     return obligations, unsupported, collect_edges
 
@@ -256,8 +331,11 @@ class LivenessChecker:
         for s, t in edges:
             self.adj[s].append(t)
         self.fair, self.warnings = extract_fairness(model)
-        # per-constraint caches
+        # per-constraint caches: successor sets (enabledness) and edge
+        # classifications (relation evaluation)
         self._succ_cache: List[Dict[int, Set[int]]] = \
+            [dict() for _ in self.fair]
+        self._edge_cache: List[Dict[Tuple[int, int], bool]] = \
             [dict() for _ in self.fair]
         self._state_key = {}
         for i, st in enumerate(states):
@@ -273,7 +351,13 @@ class LivenessChecker:
     def _action_succs(self, c: FairnessConstraint, cache: Dict,
                       sid: int) -> Set[int]:
         """Graph-node ids of <<A>>_v successors of state sid for the
-        action/subscript in `c` (sub must change)."""
+        action/subscript in `c` (sub must change). Used for ENABLEDness
+        only — edge classification is relational (_is_action_edge),
+        because an abstract action (ABCorrectness's CRcvMsg checked as a
+        fairness atom of PROPERTY ABCSpec) assigns only the mapped
+        variables: its instances are completed with the current state's
+        values for unassigned variables (the refinement leaves them
+        existentially free; "unchanged" witnesses enabledness)."""
         hit = cache.get(sid)
         if hit is not None:
             return hit
@@ -283,8 +367,9 @@ class LivenessChecker:
         try:
             v0 = eval_expr(c.sub,
                            self.model.ctx(state=st).with_bound(c.bound))
-            for succ, _lbl in enumerate_next(c.action, ctx,
-                                             self.model.vars, st):
+            w = Walker("next", tuple(self.model.vars), st)
+            for partial, _lbl in w.walk(c.action, ctx, {}, None):
+                succ = {**st, **partial}
                 # <<A>>_v: the subscript must change
                 v1 = eval_expr(c.sub, self.model.ctx(state=succ)
                                .with_bound(c.bound))
@@ -299,14 +384,41 @@ class LivenessChecker:
         cache[sid] = out
         return out
 
-    def _fair_succs(self, ci: int, sid: int) -> Set[int]:
-        return self._action_succs(self.fair[ci], self._succ_cache[ci], sid)
+    def _is_action_edge(self, c: FairnessConstraint, ecache: Dict,
+                        s: int, t: int) -> bool:
+        """Is graph edge (s, t) an <<A>>_v step? Evaluated RELATIONALLY —
+        A as a boolean over (state, primes), like refinement's
+        check_edge — so abstract actions that leave concrete variables
+        unconstrained classify correctly (the concrete step may change
+        them alongside the mapped ones). Evaluation failure counts as
+        "not an A-step": fairness is then never justified by this edge
+        (conservative, same direction as the enabledness fallback)."""
+        key = (s, t)
+        hit = ecache.get(key)
+        if hit is not None:
+            return hit
+        try:
+            ctx = self.model.ctx(state=self.states[s],
+                                 primes=self.states[t]).with_bound(c.bound)
+            ok = _bool(eval_expr(c.action, ctx), "fairness action")
+            if ok:
+                v0 = eval_expr(c.sub, self.model.ctx(
+                    state=self.states[s]).with_bound(c.bound))
+                v1 = eval_expr(c.sub, self.model.ctx(
+                    state=self.states[t]).with_bound(c.bound))
+                ok = not tla_eq(v0, v1)
+        except EvalError:
+            ok = False
+        ecache[key] = ok
+        return ok
 
     def _enabled(self, ci: int, sid: int) -> bool:
-        return bool(self._fair_succs(ci, sid))
+        return bool(self._action_succs(self.fair[ci],
+                                       self._succ_cache[ci], sid))
 
     def _is_fair_edge(self, ci: int, s: int, t: int) -> bool:
-        return t in self._fair_succs(ci, s)
+        return self._is_action_edge(self.fair[ci], self._edge_cache[ci],
+                                    s, t)
 
     # ---- SCC machinery ----
 
@@ -361,11 +473,11 @@ class LivenessChecker:
         return out
 
     def _scc_supports_fair_cycle(self, scc: Set[int], edge_ok=None,
-                                 require: Optional[Set[int]] = None
+                                 require: Optional[List[Set[int]]] = None
                                  ) -> Optional[Set[int]]:
         """A subset of scc through which a fair cycle runs, or None.
         edge_ok(s, t) additionally restricts usable real edges; when
-        `require` is given the cycle must visit one of those states (so
+        `require` is given the cycle must visit one state of EACH set (so
         SF refinement keeps searching sub-cores that still contain one).
         Every node has an implicit stuttering self-loop (usable, never an
         <<A>>_v step), so singleton SCCs are cycles too."""
@@ -378,7 +490,7 @@ class LivenessChecker:
         S = set(scc)
         if not S:
             return None
-        if require is not None and not (S & require):
+        if require is not None and any(not (S & r) for r in require):
             return None
         for ci, c in enumerate(self.fair):
             has_edge = any(self._is_fair_edge(ci, s, t)
@@ -463,10 +575,10 @@ class LivenessChecker:
             # classifies edges (the violating cycle must avoid A-steps)
             action, sub = ob.exprs
             c = FairnessConstraint("", action, sub, ob.bound)
-            cache: Dict[int, Set[int]] = {}
+            cache: Dict[Tuple[int, int], bool] = {}
 
             def edge_ok(s, t):
-                return t not in self._action_succs(c, cache, s)
+                return not self._is_action_edge(c, cache, s, t)
             return self._lasso(
                 ob, allnodes, starts=allnodes, edge_ok=edge_ok,
                 msg="a fair behavior takes the <<A>>_v action only "
@@ -503,6 +615,43 @@ class LivenessChecker:
                 msg="after the ~> antecedent, a fair behavior never "
                     "reaches the consequent")
 
+        if ob.kind == "ae_disj":
+            # violation of  atom1 \/ atom2 \/ ...  =  a fair lasso whose
+            # cycle satisfies EVERY atom's <>[]-negation:
+            #   ('pred', P)      ~[]<>P        : cycle within ~P
+            #   ('action', A, v) ~[]<><<A>>_v  : no <<A>>_v edge on cycle
+            #   ('WF', A, v)     <>[]En /\ <>[]~taken :
+            #                    cycle within ENABLED<<A>>_v, no A-edge
+            #   ('SF', A, v)     []<>En /\ <>[]~taken :
+            #                    cycle meets ENABLED<<A>>_v, no A-edge
+            nodes = set(allnodes)
+            acts: List[Tuple[FairnessConstraint, Dict]] = []
+            requires: List[Set[int]] = []
+            for atom in ob.exprs:
+                if atom[0] == "pred":
+                    nodes = {s for s in nodes
+                             if not self._eval_pred(atom[1], ob.bound, s)}
+                    continue
+                c = FairnessConstraint("", atom[1], atom[2], ob.bound)
+                en_cache: Dict[int, Set[int]] = {}
+                acts.append((c, {}))
+                if atom[0] == "WF":
+                    nodes = {s for s in nodes
+                             if self._action_succs(c, en_cache, s)}
+                elif atom[0] == "SF":
+                    requires.append(
+                        {s for s in allnodes
+                         if self._action_succs(c, en_cache, s)})
+
+            def edge_ok(s, t):
+                return all(not self._is_action_edge(c, ecache, s, t)
+                           for c, ecache in acts)
+            return self._lasso(
+                ob, nodes, starts=nodes,
+                edge_ok=edge_ok if acts else None, require=requires,
+                msg="a fair behavior violates every disjunct: each []<> "
+                    "target (or fairness atom) fails from some point on")
+
         if ob.kind in ("ea", "p_ea"):
             if ob.kind == "p_ea":
                 p, q = ob.exprs
@@ -518,7 +667,7 @@ class LivenessChecker:
             for scc in self._sccs(reach):
                 if not (scc & notq):
                     continue
-                core = self._scc_supports_fair_cycle(scc, require=notq)
+                core = self._scc_supports_fair_cycle(scc, require=[notq])
                 if core is not None:
                     ent = min(core & notq)
                     return (ob.describe(), self._trace_to(ent),
@@ -529,12 +678,14 @@ class LivenessChecker:
         raise AssertionError(ob.kind)
 
     def _lasso(self, ob: Obligation, nodes: Set[int], starts: Set[int],
-               msg: str, edge_ok=None):
+               msg: str, edge_ok=None, require=None):
         """Fair cycle within `nodes`, reachable (inside `nodes`) from
-        `starts` — the generic violation search."""
+        `starts`, meeting each `require` set — the generic violation
+        search."""
         reach = self._reachable_within(starts, nodes)
         for scc in self._sccs(reach, edge_ok):
-            core = self._scc_supports_fair_cycle(scc, edge_ok)
+            core = self._scc_supports_fair_cycle(scc, edge_ok,
+                                                 require or None)
             if core is not None:
                 ent = min(core)
                 return (ob.describe(), self._trace_to(ent), msg)

@@ -282,7 +282,7 @@ class TpuExplorer:
         # engine/liveness.py — same classifier, same checker, same verdict
         from ..engine.liveness import collect_obligations
         self.live_obligations, self.live_unsupported, self.collect_edges = \
-            collect_obligations(model, {rc.name for rc in self.refiners})
+            collect_obligations(model, self.refiners)
         self.A = len(self.labels_flat)
         self.W = self.layout.width
         self.fp_mode = self.W > FP_THRESHOLD
@@ -915,9 +915,19 @@ class TpuExplorer:
         ready-to-return CheckResult when an initial state violates an
         invariant or a refinement's initial predicate, else None."""
         layout = self.layout
+        raw = [layout.encode(st) for st in self.init_states]
+        if raw and self.canon_fn is not None:
+            # cfg SYMMETRY: dedup/count init states by their orbit's
+            # canonical representative, matching the interp's add_state
+            # (which canonicalizes BEFORE the seen probe). Without this,
+            # distinct init states sharing an orbit would inflate the
+            # device counts and seed `seen` with duplicate canonical
+            # fingerprints, breaking the sorted-unique invariant the
+            # resident rank-merge relies on.
+            raw = list(np.asarray(self.canon_fn(np.stack(raw))))
         rows = {}
-        for st in self.init_states:
-            rows[layout.encode(st).tobytes()] = st
+        for rr in raw:
+            rows[np.asarray(rr, np.int32).tobytes()] = True
         init_rows = np.stack([np.frombuffer(kk, dtype=np.int32)
                               for kk in rows.keys()]) \
             if rows else np.zeros((0, self.W), np.int32)

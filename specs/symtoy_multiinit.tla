@@ -1,0 +1,31 @@
+------------------------ MODULE symtoy_multiinit ------------------------
+(* symtoy with a nondeterministic Init whose states share a symmetry
+   orbit: `owner \in P` gives |P| raw initial states that collapse to
+   ONE canonical representative under SYMMETRY Permutations(P). Pins the
+   device backends' init-state canonicalization (advisor r2 high:
+   _prepare_init must dedup by canonical keys, not raw encodings). *)
+EXTENDS Naturals, FiniteSets, TLC
+CONSTANTS P, None
+VARIABLES owner, used, turns
+
+Perms == Permutations(P)
+
+Init == owner \in P /\ used = {} /\ turns = [p \in P |-> 0]
+
+Grab(p) == /\ owner' = p
+           /\ used' = used \cup {p}
+           /\ turns' = [turns EXCEPT ![p] = @ + 1]
+
+Release == /\ owner /= None
+           /\ owner' = None
+           /\ UNCHANGED <<used, turns>>
+
+Next == \/ owner = None /\ \E p \in P : turns[p] < 2 /\ Grab(p)
+        \/ Release
+
+Spec == Init /\ [][Next]_<<owner, used, turns>>
+
+TypeInv == /\ owner \in P \cup {None}
+           /\ used \subseteq P
+           /\ turns \in [P -> 0..2]
+=========================================================================
