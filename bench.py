@@ -102,21 +102,25 @@ def run_profile_tpu():
     """Capture per-step device timings before the full bench (so a later
     tunnel drop still leaves evidence). Bounded; failure is non-fatal."""
     out_path = os.path.join(_REPO, "PROFILE_TPU.txt")
+    # stream the child's output STRAIGHT to the file: on a timeout-kill,
+    # TimeoutExpired.stdout is None with capture_output (verified on this
+    # box), so buffering in the parent would lose exactly the partial
+    # per-step timings this profile-first step exists to preserve
     try:
-        r = subprocess.run([sys.executable,
-                            os.path.join(_REPO, "profile_tpu.py")],
-                           capture_output=True, text=True, timeout=900)
         with open(out_path, "w") as fh:
-            fh.write(r.stdout + ("\n--- stderr ---\n" + r.stderr
-                                 if r.returncode else ""))
-        _log(f"profile_tpu.py rc={r.returncode} -> {out_path}")
-    except subprocess.TimeoutExpired as ex:
-        # keep whatever per-step timings made it out before the hang —
-        # that partial evidence is the whole point of profiling first
-        with open(out_path, "w") as fh:
-            fh.write((ex.stdout or "") + "\n--- TIMED OUT at 900s ---\n"
-                     + (ex.stderr or ""))
-        _log(f"profile_tpu.py timed out (900s); partial -> {out_path}")
+            p = subprocess.Popen([sys.executable,
+                                  os.path.join(_REPO, "profile_tpu.py")],
+                                 stdout=fh, stderr=subprocess.STDOUT,
+                                 text=True)
+            try:
+                rc = p.wait(timeout=900)
+                _log(f"profile_tpu.py rc={rc} -> {out_path}")
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+                fh.write("\n--- TIMED OUT at 900s ---\n")
+                _log(f"profile_tpu.py timed out (900s); "
+                     f"partial -> {out_path}")
     except OSError as ex:
         _log(f"profile_tpu.py failed to run: {ex}")
 

@@ -425,6 +425,12 @@ def format_trace(violation: Violation) -> str:
         lines.append(f"Error: Assertion failed: {violation.message}")
     elif violation.kind == "deadlock":
         lines.append("Error: Deadlock reached.")
+    else:  # engine errors (capacity overflow, ...) — never print silently
+        lines.append(f"Error: {violation.name}"
+                     + (f": {violation.message}" if violation.message
+                        else "."))
+    if not violation.trace:
+        return "\n".join(lines)
     lines.append("The behavior up to this point is:")
     for i, (st, label) in enumerate(violation.trace):
         head = "Initial predicate" if i == 0 else f"Action {label}"

@@ -1,0 +1,207 @@
+r"""Spec mutation testing (SURVEY.md §4.6, VERDICT r2 #4).
+
+The SSI spec documents its own verification protocol: intentionally break
+each rule of Cahill's algorithm and confirm the checker then finds the
+expected serializability violations — eight listed mutations, performed in
+the original work by "commenting-out code (e.g. changing 'IF
+some-condition ...' to 'IF FALSE ...')"
+(/root/reference/examples/serializableSnapshotIsolation.tla:103-123).
+
+This module applies those same breaks as PROGRAMMATIC AST EDITS at bind
+time — the reference files are never touched. Three edit shapes cover all
+eight mutations:
+
+  if_false(n)            the nth IF (pre-order) in the definition body
+                         gets its condition replaced by FALSE — the
+                         guarded abort/bookkeeping can never fire
+  assign_unchanged(v)    every  v' = rhs  assignment in the body becomes
+                         v' = v  (a frame condition): the algorithm
+                         "forgets" to update its tracking state
+  let_empty_set(name)    a LET-bound helper set is pinned to {} — e.g.
+                         Commit's LoserTxns, killing First-Committer-Wins
+                         loser aborts
+
+Every mutator REQUIRES its target to exist (a loud error otherwise), so a
+drifted spec cannot silently turn the mutation suite vacuous.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..front import tla_ast as A
+from .eval import OpClosure
+
+
+class MutationError(Exception):
+    """The mutation's target was not found in the definition body."""
+
+
+# ---------------------------------------------------------------------------
+# generic AST rewriting (nodes are frozen dataclasses)
+# ---------------------------------------------------------------------------
+
+def _rewrite(node: Any, fn: Callable[[A.Node], Optional[A.Node]]) -> Any:
+    """Bottom-up structural rewrite; fn returns a replacement or None."""
+    if isinstance(node, A.Node) and dataclasses.is_dataclass(node):
+        changes = {}
+        for f in dataclasses.fields(node):
+            v = getattr(node, f.name)
+            nv = _rewrite_val(v, fn)
+            if nv is not v:
+                changes[f.name] = nv
+        if changes:
+            node = dataclasses.replace(node, **changes)
+        r = fn(node)
+        return node if r is None else r
+    return node
+
+
+def _rewrite_val(v: Any, fn) -> Any:
+    if isinstance(v, A.Node):
+        return _rewrite(v, fn)
+    if isinstance(v, tuple):
+        out = tuple(_rewrite_val(x, fn) for x in v)
+        if any(o is not x for o, x in zip(out, v)):
+            return out
+        return v
+    return v
+
+
+def _preorder(node: Any):
+    """Yield every Node in the tree, parents before children."""
+    if isinstance(node, A.Node):
+        yield node
+        if dataclasses.is_dataclass(node):
+            for f in dataclasses.fields(node):
+                yield from _preorder_val(getattr(node, f.name))
+
+
+def _preorder_val(v: Any):
+    if isinstance(v, A.Node):
+        yield from _preorder(v)
+    elif isinstance(v, tuple):
+        for x in v:
+            yield from _preorder_val(x)
+
+
+# ---------------------------------------------------------------------------
+# the three mutators
+# ---------------------------------------------------------------------------
+
+def if_false(n: int) -> Callable[[A.Node], A.Node]:
+    """Replace the condition of the nth IF (pre-order) with FALSE."""
+    def apply(body: A.Node) -> A.Node:
+        ifs = [x for x in _preorder(body) if isinstance(x, A.If)]
+        if n >= len(ifs):
+            raise MutationError(
+                f"if_false({n}): body has only {len(ifs)} IF nodes")
+        target = ifs[n]
+
+        def fn(x):
+            if x is target:
+                return dataclasses.replace(x, cond=A.Bool(False))
+            return None
+        return _rewrite(body, fn)
+    return apply
+
+
+def assign_unchanged(var: str) -> Callable[[A.Node], A.Node]:
+    """Rewrite every  var' = rhs  into  var' = var  (frame condition)."""
+    def apply(body: A.Node) -> A.Node:
+        hits = [0]
+
+        def fn(x):
+            if isinstance(x, A.OpApp) and x.name == "=" and \
+                    len(x.args) == 2 and \
+                    isinstance(x.args[0], A.Prime) and \
+                    isinstance(x.args[0].expr, A.Ident) and \
+                    x.args[0].expr.name == var and \
+                    not (isinstance(x.args[1], A.Ident)
+                         and x.args[1].name == var):
+                hits[0] += 1
+                return dataclasses.replace(
+                    x, args=(x.args[0], A.Ident(var)))
+            return None
+        out = _rewrite(body, fn)
+        if not hits[0]:
+            raise MutationError(
+                f"assign_unchanged({var!r}): no {var}' = ... assignment "
+                f"in body")
+        return out
+    return apply
+
+
+def let_empty_set(name: str) -> Callable[[A.Node], A.Node]:
+    """Pin a LET-bound operator to the empty set."""
+    def apply(body: A.Node) -> A.Node:
+        hits = [0]
+
+        def fn(x):
+            if isinstance(x, A.OpDef) and x.name == name:
+                hits[0] += 1
+                return dataclasses.replace(x, body=A.SetEnum(()))
+            return None
+        out = _rewrite(body, fn)
+        if not hits[0]:
+            raise MutationError(
+                f"let_empty_set({name!r}): no LET binding {name} in body")
+        return out
+    return apply
+
+
+# ---------------------------------------------------------------------------
+# the documented SSI mutation suite
+# ---------------------------------------------------------------------------
+
+# serializableSnapshotIsolation.tla:115-123 — the eight intentional
+# rule-breaks, each expected to produce a CahillSerializable /
+# BernsteinSerializable violation. Targets reference the spec's
+# definitions: Commit :432-451, Read :539-553, HelperWriteCanAcquireXLock
+# :700-758 (pre-order IF indices: Commit's dangerous-structure IF is its
+# first; the write helper's dangerous IF is nested inside its outer
+# "any concurrent SIREAD owners?" IF, hence index 1).
+SSI_MUTATIONS: Dict[str, Tuple[str, Callable]] = {
+    # "If Commit cannot abort txn."
+    "commit_cannot_abort": ("Commit", if_false(0)),
+    # "If Commit doesn't abort loser transactions."
+    "commit_no_loser_aborts": ("Commit", let_empty_set("LoserTxns")),
+    # "If Read doesn't acquire SIREAD lock."
+    "read_no_siread_lock": ("Read", assign_unchanged("holdingSIREADlocks")),
+    # "If Read doesn't update inConflict."
+    "read_no_inconflict": ("Read", assign_unchanged("inConflict")),
+    # "If Read cannot abort txn."
+    "read_cannot_abort": ("Read", if_false(0)),
+    # "If Write doesn't set outConflict."
+    "write_no_outconflict": ("HelperWriteCanAcquireXLock",
+                             assign_unchanged("outConflict")),
+    # "If Write doesn't set inConflict."
+    "write_no_inconflict": ("HelperWriteCanAcquireXLock",
+                            assign_unchanged("inConflict")),
+    # "If Write cannot abort txn."
+    "write_cannot_abort": ("HelperWriteCanAcquireXLock", if_false(1)),
+}
+
+
+def apply_mutation(model, def_name: str,
+                   mutator: Callable[[A.Node], A.Node]) -> None:
+    """Mutate `def_name`'s body in model.defs (in place on the model's own
+    defs dict — the loader's module cache is never touched) and reset the
+    model's memo store so no pre-mutation operator results survive."""
+    clo = model.defs.get(def_name)
+    if not isinstance(clo, OpClosure):
+        raise MutationError(f"{def_name} is not a definition")
+    model.defs[def_name] = OpClosure(
+        clo.name, clo.params, mutator(clo.body), clo.bound, clo.defs,
+        stable=clo.stable)
+    model._memo = None
+
+
+def mutation_names() -> List[str]:
+    return list(SSI_MUTATIONS)
+
+
+def apply_ssi_mutation(model, name: str) -> None:
+    def_name, mutator = SSI_MUTATIONS[name]
+    apply_mutation(model, def_name, mutator)

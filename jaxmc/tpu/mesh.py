@@ -10,23 +10,34 @@ Per level, every device expands its frontier shard, the candidate rows and
 their fingerprint keys are all_gather'd over the ICI axis, and each device
 keeps exactly the rows whose fingerprint lands in its range — the
 structural analogue of ring-partitioned attention state for a model
-checker (SURVEY.md §5 "long-context" row). Dedup within a shard is the
-same validity-lane-first lexicographic key sort as tpu/bfs.py; totals are
+checker (SURVEY.md §5 "long-context" row). A hash-routed
+ppermute/all_to_all exchange (traffic ~C*gamma instead of C*D per device)
+is the planned upgrade once profiled on real multi-chip hardware. Dedup within a shard is the same
+validity-lane-first lexicographic key sort as tpu/bfs.py; totals are
 psum'd. CONSTRAINT-discarded states are fingerprinted but never counted,
 checked, or explored (TLC semantics).
 
+Parity features (VERDICT r2 #5):
+  * counterexample TRACES with action provenance: each kept new-frontier
+    row carries its global candidate index off the device; the host keeps
+    per-level (rows, provenance) so a violation replays the shortest path
+    exactly like the single-chip level mode (store_trace=True, default);
+  * NAMED violations: the step reports which invariant failed (index into
+    the cfg INVARIANT list) plus the violating row; deadlock/assert
+    report the offending state row the same way;
+  * checkpoint/resume at level boundaries (--checkpoint/--resume), the
+    TLC states/ equivalent, with full-run count exactness.
+
 The driver validates this path with N virtual CPU devices via
-__graft_entry__.dryrun_multichip (no multi-chip hardware needed).
-Collective-efficiency upgrades (hash-routed ppermute/all_to_all instead of
-all_gather) are planned once profiling on real multi-chip hardware exists.
-Counterexample traces and refinement PROPERTYs are single-chip features
-for now — the mesh reports their absence in warnings.
+__graft_entry__.dryrun_multichip (no multi-chip hardware needed) on the
+raft workload. Refinement and temporal PROPERTYs remain single-chip
+features — the mesh reports their absence in warnings.
 """
 
 from __future__ import annotations
 
 import time
-from typing import Callable, Dict, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 import jax
@@ -36,8 +47,10 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..sem.modules import Model
 from ..engine.explore import CheckResult, Violation
-from .bfs import (SENTINEL, SYMMETRY_WARNING, TpuExplorer, _pow2_at_least,
+from .bfs import (SENTINEL, TpuExplorer, _pow2_at_least,
                   filter_init_states, fingerprint128)
+
+_BIG = np.int32(2 ** 31 - 1)
 
 
 class MeshExplorer(TpuExplorer):
@@ -51,10 +64,11 @@ class MeshExplorer(TpuExplorer):
     def __init__(self, model: Model, mesh: Optional[Mesh] = None,
                  log: Callable[[str], None] = None,
                  max_states: Optional[int] = None,
-                 progress_every: float = 30.0, **kw):
+                 progress_every: float = 30.0, store_trace: bool = True,
+                 **kw):
         super().__init__(model, log=log, max_states=max_states,
                          progress_every=progress_every,
-                         store_trace=False, **kw)
+                         store_trace=store_trace, **kw)
         if mesh is None:
             mesh = Mesh(np.array(jax.devices()), ("d",))
         self.mesh = mesh
@@ -85,9 +99,17 @@ class MeshExplorer(TpuExplorer):
             fvalid = jnp.arange(FC) < fcount[0]
             en, aok, ov, succ = expand(frontier)
             valid = en & fvalid[None, :]
-            assert_bad = jnp.any((~aok) & fvalid[None, :])
+            abad = (~aok) & fvalid[None, :]
+            assert_bad = jnp.any(abad)
+            # first (action, slot) whose enabled evaluation hit a failed
+            # Assert — provenance for the assert trace
+            aflat = jnp.argmax(abad.reshape(-1))
+            asrt_a = (aflat // FC).astype(jnp.int32)
+            asrt_f = (aflat % FC).astype(jnp.int32)
             overflow = jnp.any(ov & fvalid[None, :])
-            dead_local = jnp.any(fvalid & ~jnp.any(en, axis=0))
+            dead = fvalid & ~jnp.any(en, axis=0)
+            dead_local = jnp.any(dead)
+            dead_slot = jnp.argmax(dead).astype(jnp.int32)
             gen_local = jnp.sum(valid)
 
             cand = succ.reshape(C, W)
@@ -128,7 +150,9 @@ class MeshExplorer(TpuExplorer):
             new = (sflag == 1) & rvalid & neq_prev
             new_count = jnp.sum(new)
 
-            # compact the new rows (gather payload by sorted position)
+            # compact the new rows (gather payload by sorted position);
+            # new_cidx is each new row's GLOBAL candidate index — the
+            # provenance the host needs for trace reconstruction
             ops2 = ((1 - new.astype(jnp.int32)), cidx)
             comp = lax.sort(ops2, num_keys=1, is_stable=True)
             new_cidx = comp[1][:G]
@@ -155,27 +179,38 @@ class MeshExplorer(TpuExplorer):
             ops4 = ((1 - explore.astype(jnp.int32)), idx4)
             comp4 = lax.sort(ops4, num_keys=1, is_stable=True)
             front_rows = jnp.take(new_rows, comp4[1], axis=0)
+            # provenance follows the same two compactions
+            front_src = jnp.take(new_cidx, comp4[1])
             front_count = jnp.sum(explore)
             frontvalid = jnp.arange(G) < front_count
-            inv_bad = jnp.asarray(False)
-            for nm, f in inv_fns:
-                inv_bad = inv_bad | jnp.any(frontvalid &
-                                            ~jax.vmap(f)(front_rows))
+            # named invariants: index of the FIRST cfg invariant any kept
+            # row violates, plus the first violating slot
+            inv_which = jnp.int32(_BIG)
+            inv_slot = jnp.int32(-1)
+            for i, (nm, f) in enumerate(inv_fns):
+                bad = frontvalid & ~jax.vmap(f)(front_rows)
+                anyb = jnp.any(bad)
+                hit = anyb & (inv_which == _BIG)
+                inv_which = jnp.where(hit, jnp.int32(i), inv_which)
+                inv_slot = jnp.where(hit,
+                                     jnp.argmax(bad).astype(jnp.int32),
+                                     inv_slot)
 
-            # global reductions over ICI
+            # global totals over ICI; violation flags stay PER-DEVICE so
+            # the host can locate the offending device's row/provenance
             tot_gen = lax.psum(gen_local, "d")
             tot_new = lax.psum(front_count, "d")
-            any_dead = lax.psum(dead_local.astype(jnp.int32), "d") > 0
-            any_assert = lax.psum(assert_bad.astype(jnp.int32), "d") > 0
             any_ovf = lax.psum(overflow.astype(jnp.int32), "d") > 0
-            any_inv = lax.psum(inv_bad.astype(jnp.int32), "d") > 0
             tot_front = lax.psum(front_count, "d")
 
             return (seen2.reshape(1, SC, K), seen_count2.reshape(1),
                     front_rows.reshape(1, G, W), front_count.reshape(1),
+                    front_src.reshape(1, G),
                     tot_gen.reshape(1), tot_new.reshape(1),
-                    any_dead.reshape(1), any_assert.reshape(1),
-                    any_ovf.reshape(1), any_inv.reshape(1),
+                    dead_local.reshape(1), dead_slot.reshape(1),
+                    assert_bad.reshape(1), asrt_a.reshape(1),
+                    asrt_f.reshape(1), any_ovf.reshape(1),
+                    inv_which.reshape(1), inv_slot.reshape(1),
                     tot_front.reshape(1))
 
         try:
@@ -185,7 +220,7 @@ class MeshExplorer(TpuExplorer):
         step = jax.jit(shard_map(
             device_step, mesh=self.mesh,
             in_specs=(P("d"), P("d"), P("d")),
-            out_specs=tuple([P("d")] * 11)))
+            out_specs=tuple([P("d")] * 16)))
         self._mesh_step_cache[key] = step
         return step
 
@@ -199,14 +234,61 @@ class MeshExplorer(TpuExplorer):
         return (fp[:, 0].astype(np.uint32) % np.uint32(self.D)) \
             .astype(np.int64)
 
+    # ---- trace reconstruction (host side) ----
+    #
+    # self._levels[L] = (rows [D, cap_L, W] np, src [D, cap_L] np | None).
+    # Level 0 holds the initial frontier (src None). For L >= 1, slot i on
+    # device d holds global candidate index g = src[d][i]; with C_L =
+    # A * FC_L (the expanding level's capacity): source device g // C_L,
+    # candidate c = g % C_L, action c // FC_L, parent slot c % FC_L.
+
+    def _mesh_trace_to(self, dev: int, slot: int, depth: int,
+                       extra: Optional[Tuple[Dict, str]] = None):
+        if not self.store_trace:
+            return None
+        out = []
+        d, i = dev, slot
+        for lvl in range(depth, -1, -1):
+            rows, src, FC = self._levels[lvl]
+            st = self.layout.decode(np.asarray(rows[d][i]))
+            if lvl == 0:
+                out.append((st, "Initial predicate"))
+            else:
+                g = int(src[d][i])
+                C = self.A * FC
+                a = (g % C) // FC
+                out.append((st, self.labels_flat[a]))
+                d, i = g // C, (g % C) % FC
+        out.reverse()
+        if extra is not None:
+            out.append(extra)
+        return out
+
+    def _viol(self, kind, name, trace, msg=None):
+        if trace is None:
+            note = (f"{kind} found (mesh traces disabled by "
+                    f"store_trace=False)")
+            return Violation(kind, name, [], msg or note)
+        return Violation(kind, name, trace, msg)
+
+    # ---- checkpoint/resume (level boundaries) ----
+
+    def _mesh_ck(self, seen, seen_counts, frontier, fcount, FC, SC,
+                 depth, generated, distinct):
+        self._write_ck(
+            "mesh", D=self.D, FC=FC, SC=SC, depth=depth,
+            generated=generated, distinct=distinct,
+            seen=np.asarray(seen), seen_counts=np.asarray(seen_counts),
+            frontier=np.asarray(frontier), fcount=np.asarray(fcount),
+            levels=self._levels if self.store_trace else None)
+
     def run(self) -> CheckResult:
         t0 = time.time()
         model = self.model
         layout = self.layout
         D, W, K = self.D, self.W, self.K
         warnings = ["mesh backend: dedup on 128-bit fingerprints; "
-                    "collision probability < n^2 * 2^-129; no "
-                    "counterexample traces yet"]
+                    "collision probability < n^2 * 2^-129"]
         warnings.extend(self._temporal_warnings())
         if self.live_obligations:
             warnings.append(
@@ -221,59 +303,72 @@ class MeshExplorer(TpuExplorer):
                 + ", ".join(rc.name for rc in self.refiners))
         warnings.extend(self._symmetry_warnings())
 
-        rows = {}
-        for st in self.init_states:
-            rows[layout.encode(st).tobytes()] = None
-        init_rows = np.stack([np.frombuffer(k, dtype=np.int32)
-                              for k in rows]) if rows \
-            else np.zeros((0, W), np.int32)
-        n_init = len(init_rows)
+        init_rows, explored_init, n_init, err = \
+            self._prepare_init(t0, warnings)
+        if err is not None:
+            return err
         generated = n_init
-
-        explored_init, init_viol = filter_init_states(model, layout,
-                                                      init_rows)
-        if init_viol is not None:
-            nm, st = init_viol
-            return self._mk(False, len(explored_init) + 1, generated, 0,
-                            t0, warnings, Violation(
-                                "invariant", nm,
-                                [(st, "Initial predicate")]))
         explored_mask = np.zeros(n_init, bool)
         explored_mask[explored_init] = True
         distinct = int(explored_mask.sum())
-        self.log(f"Finished computing initial states: {distinct} distinct "
-                 f"state{'s' if distinct != 1 else ''} generated.")
 
-        owner = self._owner_of(init_rows)
-        per_dev = [init_rows[(owner == d) & explored_mask]
-                   for d in range(D)]
-        seen_per_dev = [init_rows[owner == d] for d in range(D)]
-        FC = _pow2_at_least(
-            max(max((len(p) for p in per_dev), default=1), 1), lo=64)
-        SC = _pow2_at_least(4 * FC, lo=256)
+        self._levels: List[Tuple[np.ndarray, Optional[np.ndarray], int]] \
+            = []
 
-        frontier = np.full((D, FC, W), SENTINEL, np.int32)
-        seen = np.full((D, SC, K), SENTINEL, np.int32)
-        seen[:, :, 0] = 1  # empty slots: validity lane 1
-        fcount = np.zeros((D,), np.int32)
-        for d in range(D):
-            p = per_dev[d]
-            frontier[d, :len(p)] = p
-            sp = seen_per_dev[d]
-            if len(sp):
-                k = np.asarray(self._keys_of(
-                    jnp.asarray(sp), jnp.ones(len(sp), bool)))
-                order = np.lexsort(tuple(k[:, i]
-                                         for i in reversed(range(K))))
-                seen[d, :len(sp)] = k[order]
-            fcount[d] = len(p)
-        frontier = jnp.asarray(frontier)
-        seen = jnp.asarray(seen)
-        fcount = jnp.asarray(fcount)
-        seen_counts = np.array([len(p) for p in seen_per_dev], np.int64)
+        if self.resume_from:
+            ck = self._load_ck("mesh")
+            if ck["D"] != D:
+                raise ValueError(
+                    f"cannot resume: checkpoint has {ck['D']} devices, "
+                    f"mesh has {D}")
+            FC, SC = ck["FC"], ck["SC"]
+            depth = ck["depth"]
+            generated = ck["generated"]
+            distinct = ck["distinct"]
+            seen = jnp.asarray(ck["seen"])
+            seen_counts = ck["seen_counts"].astype(np.int64)
+            frontier = jnp.asarray(ck["frontier"])
+            fcount = jnp.asarray(ck["fcount"])
+            if ck.get("levels") is not None:
+                self._levels = ck["levels"]
+            else:
+                self.store_trace = False
+            self.log(f"Resuming mesh run at depth {depth} "
+                     f"({distinct} distinct states)")
+        else:
+            owner = self._owner_of(init_rows)
+            per_dev = [init_rows[(owner == d) & explored_mask]
+                       for d in range(D)]
+            seen_per_dev = [init_rows[owner == d] for d in range(D)]
+            FC = _pow2_at_least(
+                max(max((len(p) for p in per_dev), default=1), 1), lo=64)
+            SC = _pow2_at_least(4 * FC, lo=256)
 
-        depth = 0
-        last_progress = time.time()
+            frontier = np.full((D, FC, W), SENTINEL, np.int32)
+            seen = np.full((D, SC, K), SENTINEL, np.int32)
+            seen[:, :, 0] = 1  # empty slots: validity lane 1
+            fcount = np.zeros((D,), np.int32)
+            for d in range(D):
+                p = per_dev[d]
+                frontier[d, :len(p)] = p
+                sp = seen_per_dev[d]
+                if len(sp):
+                    k = np.asarray(self._keys_of(
+                        jnp.asarray(sp), jnp.ones(len(sp), bool)))
+                    order = np.lexsort(tuple(k[:, i]
+                                             for i in reversed(range(K))))
+                    seen[d, :len(sp)] = k[order]
+                fcount[d] = len(p)
+            if self.store_trace:
+                self._levels.append((frontier.copy(), None, FC))
+            frontier = jnp.asarray(frontier)
+            seen = jnp.asarray(seen)
+            fcount = jnp.asarray(fcount)
+            seen_counts = np.array([len(p) for p in seen_per_dev],
+                                   np.int64)
+            depth = 0
+
+        last_progress = last_ck = time.time()
         while int(np.sum(np.asarray(fcount))) > 0:
             C = self.A * FC
             need = int(seen_counts.max(initial=0)) + D * C
@@ -284,9 +379,11 @@ class MeshExplorer(TpuExplorer):
                 seen = jnp.concatenate([seen, jnp.asarray(pad)], axis=1)
                 SC = SC2
             step = self._get_mesh_step(SC, FC)
-            (seen, seen_cnt, front_rows, front_cnt, tot_gen, tot_new,
-             any_dead, any_assert, any_ovf, any_inv, tot_front) = step(
-                seen, frontier, fcount)
+            expanding_FC = FC
+            (seen, seen_cnt, front_rows, front_cnt, front_src,
+             tot_gen, tot_new, dead_local, dead_slot, assert_local,
+             asrt_a, asrt_f, any_ovf, inv_which, inv_slot,
+             tot_front) = step(seen, frontier, fcount)
 
             if bool(np.asarray(any_ovf)[0]):
                 return self._mk(False, distinct, generated, depth, t0,
@@ -296,48 +393,79 @@ class MeshExplorer(TpuExplorer):
                                     "capacity (raise --seq-cap/--grow-cap/"
                                     "--kv-cap); counts would no longer "
                                     "be exact"))
-            if model.check_deadlock and bool(np.asarray(any_dead)[0]):
+            dead_np = np.asarray(dead_local)
+            if model.check_deadlock and dead_np.any():
+                dv = int(np.argmax(dead_np))
+                ds = int(np.asarray(dead_slot)[dv])
+                trace = self._mesh_trace_to(dv, ds, depth)
                 return self._mk(False, distinct, generated, depth, t0,
-                                warnings, Violation(
-                                    "deadlock", "deadlock", [],
-                                    "deadlock found (mesh backend has no "
-                                    "trace reconstruction yet)"))
-            if bool(np.asarray(any_assert)[0]):
-                return self._mk(False, distinct, generated, depth, t0,
-                                warnings, Violation(
-                                    "assert", "Assert", [],
-                                    "assertion violated (mesh backend has "
-                                    "no trace reconstruction yet)"))
+                                warnings,
+                                self._viol("deadlock", "deadlock", trace))
+            assert_np = np.asarray(assert_local)
+            if assert_np.any():
+                av = int(np.argmax(assert_np))
+                aa = int(np.asarray(asrt_a)[av])
+                af = int(np.asarray(asrt_f)[av])
+                trace = self._mesh_trace_to(av, af, depth)
+                return self._mk(
+                    False, distinct, generated, depth, t0, warnings,
+                    self._viol("assert", "Assert", trace,
+                               f"assertion in {self.labels_flat[aa]}"))
 
             generated += int(np.asarray(tot_gen)[0])
             distinct += int(np.asarray(tot_new)[0])
             seen_counts = np.asarray(seen_cnt).astype(np.int64)
+            max_front = int(np.asarray(front_cnt).max(initial=0))
+            # device->host frontier copies only when something needs
+            # them (tracing, a violation to localize, or FC regrowth):
+            # in the perf configuration (store_trace=False, clean level)
+            # the frontier never leaves the device
+            iw = np.asarray(inv_which)
+            which = int(iw.min())
+            need_host_rows = (self.store_trace or max_front > FC or
+                              which != _BIG)
+            front_rows_np = np.asarray(front_rows) if need_host_rows \
+                else None
+            if self.store_trace:
+                # trim to the occupied prefix: keeping full G = D*A*FC
+                # capacity per level would hold the padded expansion of
+                # the whole search in host RAM
+                keep = max(max_front, 1)
+                self._levels.append(
+                    (front_rows_np[:, :keep],
+                     np.asarray(front_src)[:, :keep], expanding_FC))
 
-            if bool(np.asarray(any_inv)[0]):
+            if which != _BIG:
+                nm = self.inv_fns[which][0]
+                iv_dev = int(np.argmax(iw == which))
+                iv_slot = int(np.asarray(inv_slot)[iv_dev])
+                trace = self._mesh_trace_to(iv_dev, iv_slot, depth + 1)
                 return self._mk(False, distinct, generated, depth + 1, t0,
-                                warnings, Violation(
-                                    "invariant", "invariant", [],
-                                    "invariant violated (mesh backend has "
-                                    "no trace reconstruction yet)"))
+                                warnings,
+                                self._viol("invariant", nm, trace))
             depth += 1
-            if self.max_states and distinct >= self.max_states:
-                self.log("-- state limit reached, search truncated")
-                return self._mk(True, distinct, generated, depth, t0,
-                                warnings, truncated=True)
 
             # next frontier: per-device kept rows; capacity grows to the
             # max shard (hash skew can route up to G rows to one device)
             fcount = front_cnt
-            max_front = int(np.asarray(front_cnt).max(initial=0))
             if max_front > FC:
                 FC = _pow2_at_least(max_front, FC)
-                fr = np.asarray(front_rows)
-                k = min(fr.shape[1], FC)
+                k = min(front_rows_np.shape[1], FC)
                 nf = np.full((D, FC, W), SENTINEL, np.int32)
-                nf[:, :k] = fr[:, :k]
+                nf[:, :k] = front_rows_np[:, :k]
                 frontier = jnp.asarray(nf)
             else:
                 frontier = front_rows[:, :FC]
+
+            if self.max_states and distinct >= self.max_states:
+                # a truncation point IS a level boundary: leave a
+                # checkpoint so the run can be resumed past the limit
+                if self.checkpoint_path:
+                    self._mesh_ck(seen, seen_counts, frontier, fcount,
+                                  FC, SC, depth, generated, distinct)
+                self.log("-- state limit reached, search truncated")
+                return self._mk(True, distinct, generated, depth, t0,
+                                warnings, truncated=True)
 
             now = time.time()
             if now - last_progress >= self.progress_every:
@@ -345,6 +473,11 @@ class MeshExplorer(TpuExplorer):
                 self.log(f"Progress({depth}): {generated} generated, "
                          f"{distinct} distinct, "
                          f"{int(np.asarray(tot_front)[0])} on queue.")
+            if self.checkpoint_path and \
+                    now - last_ck >= self.checkpoint_every:
+                last_ck = now
+                self._mesh_ck(seen, seen_counts, frontier, fcount, FC,
+                              SC, depth, generated, distinct)
 
         self.log("Model checking completed. No error has been found.")
         self.log(f"{generated} states generated, {distinct} distinct "

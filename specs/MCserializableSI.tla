@@ -11,4 +11,85 @@ MCWellFormed == WellFormedTransactionsInHistory(history)
 MCCahillSerializable == CahillSerializable(history)
 
 MCBernsteinSerializable == BernsteinSerializable(history)
+
+\* Prune ChooseToAbort's branching (an abort at every state): algorithmic
+\* aborts (FCW, deadlock-prevention, the three "to preserve
+\* serializability" reasons) stay reachable — they ARE the algorithm
+MCNoVoluntaryAborts ==
+    \A i \in 1..Len(history) :
+        history[i].op = "abort" => history[i].reason /= "voluntary"
+
+\* Seeded initial state following MCtextbookSI's MCInitSeeded idiom: one
+\* transaction has already committed writes to two keys, so every later
+\* txn can read both keys from the start — the write-skew dangerous
+\* structure then needs only the two remaining transactions. Cahill flags
+\* and SIREAD locks start clear, exactly what Begin..Commit of the seed
+\* txn produces (internalAbort/Commit reset them,
+\* serializableSnapshotIsolation.tla:406-416).
+MCSeedTxn == CHOOSE t \in TxnId : TRUE
+MCk1 == CHOOSE k \in Key : TRUE
+MCk2 == CHOOSE k \in Key \ {MCk1} : TRUE
+MCInitSeeded ==
+    /\ history = << [op |-> "begin",  txnid |-> MCSeedTxn],
+                    [op |-> "write",  txnid |-> MCSeedTxn, key |-> MCk1],
+                    [op |-> "write",  txnid |-> MCSeedTxn, key |-> MCk2],
+                    [op |-> "commit", txnid |-> MCSeedTxn] >>
+    /\ holdingXLocks      = [txn \in TxnId |-> {}]
+    /\ waitingForXLock    = [txn \in TxnId |-> NoLock]
+    /\ inConflict         = [txn \in TxnId |-> FALSE]
+    /\ outConflict        = [txn \in TxnId |-> FALSE]
+    /\ holdingSIREADlocks = [txn \in TxnId |-> {}]
+
+\* Tighter seed for the fast end-to-end mutation pin: additionally seed
+\* the second transaction's begin, its read of MCk1 (with the SIREAD
+\* lock that read acquires) and its write of MCk2 (with the xlock) —
+\* conflict flags still all FALSE, exactly what those operations produce
+\* from MCInitSeeded. The write-skew dangerous structure then needs only
+\* ~5 more events. NOT used for the read-family mutations: their
+\* violations need the second transaction's READ to happen after the
+\* mutation is live (a seeded SIREAD lock would mask e.g.
+\* read_no_siread_lock).
+MCTxn2 == CHOOSE t \in TxnId \ {MCSeedTxn} : TRUE
+MCInitSeeded2 ==
+    /\ history = << [op |-> "begin",  txnid |-> MCSeedTxn],
+                    [op |-> "write",  txnid |-> MCSeedTxn, key |-> MCk1],
+                    [op |-> "write",  txnid |-> MCSeedTxn, key |-> MCk2],
+                    [op |-> "commit", txnid |-> MCSeedTxn],
+                    [op |-> "begin",  txnid |-> MCTxn2],
+                    [op |-> "read",   txnid |-> MCTxn2, key |-> MCk1,
+                     ver |-> MCSeedTxn],
+                    [op |-> "write",  txnid |-> MCTxn2, key |-> MCk2] >>
+    /\ holdingXLocks      = [txn \in TxnId |->
+                                IF txn = MCTxn2 THEN {MCk2} ELSE {}]
+    /\ waitingForXLock    = [txn \in TxnId |-> NoLock]
+    /\ inConflict         = [txn \in TxnId |-> FALSE]
+    /\ outConflict        = [txn \in TxnId |-> FALSE]
+    /\ holdingSIREADlocks = [txn \in TxnId |->
+                                IF txn = MCTxn2 THEN {MCk1} ELSE {}]
+
+\* Serializability can only NEWLY fail at a commit: both MVSG encodings
+\* build their graphs from COMMITTED transactions, so a history is
+\* non-serializable iff its prefix ending at the latest commit is. These
+\* guarded forms skip the O(|Txn|^2 |Key|) graph construction on every
+\* non-commit state — same violations, found at the same states.
+MCCahillSerializableAtCommit ==
+    \/ Len(history) = 0
+    \/ history[Len(history)].op /= "commit"
+    \/ CahillSerializable(history)
+
+MCBernsteinSerializableAtCommit ==
+    \/ Len(history) = 0
+    \/ history[Len(history)].op /= "commit"
+    \/ BernsteinSerializable(history)
+
+\* "Interesting history" finders (spec header :94-96): EXPECTED to be
+\* violated — the search must reach a state where SSI actually fired a
+\* serializability abort, proving the dangerous-structure machinery is
+\* exercised (not vacuously passed) at this model size
+MCNoWriteSerializabilityAbort ==
+    ~ AtLeastNTxnsAbortedDueToReason(
+          1, "in attempted write, to preserve serializability")
+MCNoReadSerializabilityAbort ==
+    ~ AtLeastNTxnsAbortedDueToReason(
+          1, "in attempted read, to preserve serializability")
 =============================================================================

@@ -42,8 +42,8 @@ FAST = [c for c in CASES if not c.slow]
     "case", FAST,
     ids=[(c.cfg or c.spec).split("/")[-1] for c in FAST])
 def test_corpus_case(case):
-    ok, detail, _r = run_case(case)
-    assert ok, detail
+    status, detail, _r = run_case(case)
+    assert status == "pass", detail
 
 
 def test_innerserial_matches_golden_testout2():
