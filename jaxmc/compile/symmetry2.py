@@ -21,6 +21,7 @@ unreduced search with the existing SYMMETRY warning.
 
 from __future__ import annotations
 
+import os
 from typing import Callable, Dict, List, Optional
 
 import numpy as np
@@ -278,6 +279,18 @@ def build_canon2(model, layout) -> Optional[Callable]:
     perms = symmetry_group(model)
     if not perms:
         return None
+    # compile-time guard (advisor r2): canon_row unrolls one transform
+    # per non-identity group element into EVERY jitted kernel.
+    # Permutations of a 5-6 element set closes to 119-719 transforms —
+    # an XLA compile explosion. Fall back to the unreduced search (the
+    # caller reports the SYMMETRY warning) above the threshold.
+    limit = int(os.environ.get("JAXMC_SYM_GROUP_LIMIT", "64"))
+    if len(perms) > limit:
+        raise CompileError(
+            f"symmetry group has {len(perms)} non-identity elements "
+            f"(> {limit}): device canonicalization would unroll that "
+            f"many transforms into every kernel; falling back to the "
+            f"unreduced search (set JAXMC_SYM_GROUP_LIMIT to raise)")
 
     row_tfs = []
     widths = [layout.specs[v].width for v in layout.vars]

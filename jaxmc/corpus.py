@@ -93,7 +93,7 @@ CASES: List[Case] = [
     Case(f"{SS}/AsynchronousInterface/AsynchInterface.tla",
          distinct=12, generated=30),
     Case(f"{SS}/AsynchronousInterface/Channel.tla",
-         distinct=12, generated=30),
+         distinct=12, generated=30, jax="yes"),
     Case(f"{SS}/AsynchronousInterface/PrintValues.tla", expect="assumes"),
     Case(f"{SS}/FIFO/MCInnerFIFO.tla", distinct=3864, generated=9660,
          jax="yes"),
@@ -110,7 +110,8 @@ CASES: List[Case] = [
     # ErrorTemporal is EXPECTED to fail (MCRealTimeHourClock.tla:43)
     Case(f"{SS}/RealTime/MCRealTimeHourClock.tla",
          expect="violation:property", distinct=216, generated=696),
-    Case(f"{SS}/TLC/ABCorrectness.tla", distinct=20, generated=36),
+    Case(f"{SS}/TLC/ABCorrectness.tla", distinct=20, generated=36,
+         jax="yes"),
     Case(f"{SS}/TLC/MCAlternatingBit.tla", distinct=240, generated=1392,
          jax="yes"),
     Case(f"{SS}/AdvancedExamples/MCInnerSequential.tla",

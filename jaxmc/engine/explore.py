@@ -187,11 +187,21 @@ class Explorer:
         # so they are not re-processed, but never counted as distinct,
         # never invariant-checked, never explored (Specifying Systems §14)
 
+        view_expr = getattr(model, "view", None)
+
         def add_state(st, parent, label, depth):
             """Returns (sid | None, new). sid None = discarded by
             CONSTRAINT; new is True the first time any state (kept or
             discarded) is seen."""
-            key = _state_key(canon(st) if canon is not None else st, vars)
+            cst = canon(st) if canon is not None else st
+            if view_expr is not None:
+                # cfg VIEW: dedup by the view expression's VALUE (TLC
+                # fingerprints the view, not the state) — the stored
+                # state/trace is still the real state
+                key = ("$view",
+                       eval_expr(view_expr, model.ctx(state=cst)))
+            else:
+                key = _state_key(cst, vars)
             sid = seen.get(key)
             if sid is not None:
                 return (None if sid == VIOL else sid), False
@@ -229,6 +239,18 @@ class Explorer:
             warnings.append(
                 "temporal properties NOT checked (unsupported form): "
                 + ", ".join(unsupported))
+        if view_expr is not None and live_obligations:
+            # the behavior graph under VIEW links view-collapsed
+            # representatives — liveness verdicts over it would be wrong
+            # (TLC likewise refuses VIEW together with liveness)
+            warnings.append(
+                "temporal properties NOT checked: cfg VIEW collapses "
+                "the behavior graph (TLC also rejects VIEW with "
+                "liveness): "
+                + ", ".join(sorted({ob.prop_name
+                                    for ob in live_obligations})))
+            live_obligations = []
+            collect_edges = False
         edges: List[Tuple[int, int]] = []
 
         def result(ok, violation=None, truncated=False):

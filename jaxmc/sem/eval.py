@@ -462,7 +462,18 @@ def _ev_tuple(e: A.TupleExpr, ctx: Ctx):
 
 
 def _ev_setenum(e: A.SetEnum, ctx: Ctx):
-    return frozenset(eval_expr(x, ctx) for x in e.items)
+    vals = [eval_expr(x, ctx) for x in e.items]
+    # TLC raises a comparability error on sets mixing BOOLEAN with 0/1
+    # integers; Python's True == 1 would silently collapse them instead
+    # (the documented deviation in sem/values.py). Guard the one place a
+    # user-written mix enters the value domain.
+    if any(isinstance(v, bool) for v in vals) and \
+            any(isinstance(v, int) and not isinstance(v, bool)
+                for v in vals):
+        raise EvalError(
+            "set enumeration mixes BOOLEAN and integer values "
+            "(incomparable in TLA+; TLC raises here too)")
+    return frozenset(vals)
 
 
 def _ev_setfilter(e: A.SetFilter, ctx: Ctx):

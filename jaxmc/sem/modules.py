@@ -191,6 +191,10 @@ class Model:
     action_constraints: List[Tuple[str, A.Node]]
     properties: List[Tuple[str, A.Node]]
     symmetry: Optional[A.Node]
+    # cfg VIEW: states are deduplicated by this expression's VALUE instead
+    # of the full state (TLC semantics, ConfigFileGrammar.tla:8-11) —
+    # interp backend only; the jax backends reject it loudly
+    view: Optional[A.Node]
     vars: Tuple[str, ...]
     defs: Dict[str, Any]
     check_deadlock: bool = True
@@ -330,10 +334,22 @@ def bind_model(module: LoadedModule, cfg: ModelConfig) -> Model:
     action_constraints = [(nm, named(nm)) for nm in cfg.action_constraints]
     properties = [(nm, named(nm)) for nm in cfg.properties]
     symmetry = named(cfg.symmetry) if cfg.symmetry else None
+    view = None
+    if cfg.view:
+        vd = defs.get(cfg.view)
+        if not isinstance(vd, OpClosure):
+            raise EvalError(f"cfg VIEW names unknown definition "
+                            f"{cfg.view}")
+        if vd.params:
+            # TLC rejects parameterized views at config time too; letting
+            # it through would crash on the unhashable closure later
+            raise EvalError(f"cfg VIEW {cfg.view} takes parameters; a "
+                            f"view must be a state expression")
+        view = A.Ident(cfg.view)
 
     return Model(module=module, cfg=cfg, init=init, next=nxt,
                  invariants=invariants, constraints=constraints,
                  action_constraints=action_constraints,
-                 properties=properties, symmetry=symmetry, vars=vars,
-                 defs=defs, check_deadlock=cfg.check_deadlock,
+                 properties=properties, symmetry=symmetry, view=view,
+                 vars=vars, defs=defs, check_deadlock=cfg.check_deadlock,
                  fairness=fair)

@@ -271,6 +271,9 @@ class TpuExplorer:
         if model.action_constraints:
             raise CompileError("action constraints not compiled yet - "
                                "use the interp backend")
+        if getattr(model, "view", None) is not None:
+            raise CompileError("cfg VIEW is not supported on the jax "
+                               "backends - use --backend interp")
         # refinement PROPERTYs check stepwise on the host over the
         # streamed candidate edges — same verdicts as the interp backend
         from ..engine.refinement import build_refinement_checkers
@@ -636,8 +639,12 @@ class TpuExplorer:
     # pre-level state) and report a grow-and-redo status, so counts stay
     # exact across regrowth.
 
-    def _get_resident_run(self, SC, FCap, AccCap, VC, CH, MAXLVL):
-        key = (SC, FCap, AccCap, VC, CH, MAXLVL)
+    def _get_resident_run(self, SC, FCap, AccCap, VC, CH):
+        # maxlvl (levels per dispatch) is a TRACED argument, not part of
+        # the compile key: the host adapts it to measured dispatch wall
+        # time (so --checkpoint/--progress-every fire at useful
+        # intervals, advisor r2) without recompiling
+        key = (SC, FCap, AccCap, VC, CH)
         if key in self._res_cache:
             return self._res_cache[key]
         A, W, K = self.A, self.W, self.K
@@ -791,15 +798,21 @@ class TpuExplorer:
             # keys are distinct from seen keys
             ranks = _lower_bound(nk_words, new_count, seen_words, AccCap)
             valid_seen_rows = jnp.arange(SC) < seen_count
+            # dropped (invalid) rows get DISTINCT out-of-range indices
+            # (SC + arange): unique_indices=True is a correctness promise
+            # to XLA, and funnelling every invalid row to the same index
+            # would be documented UB even though mode="drop" discards
+            # the writes (advisor r2)
             pos_s = jnp.where(valid_seen_rows,
                               jnp.arange(SC, dtype=jnp.int32) + ranks,
-                              SC)
+                              SC + jnp.arange(SC, dtype=jnp.int32))
             seen2 = jnp.full((SC, K), SENTINEL, jnp.int32)
             seen2 = seen2.at[pos_s].set(seen, mode="drop",
                                         unique_indices=True)
             nk_full = jnp.concatenate(
                 [jnp.zeros((AccCap, 1), jnp.int32), nk_words], axis=1)
-            pos_n = jnp.where(nvalid, nk_lb + sidx, SC)
+            pos_n = jnp.where(nvalid, nk_lb + sidx,
+                              SC + jnp.arange(AccCap, dtype=jnp.int32))
             seen2 = seen2.at[pos_n].set(nk_full, mode="drop",
                                         unique_indices=True)
             seen_count2 = seen_count + new_count
@@ -846,10 +859,10 @@ class TpuExplorer:
                     explore_count, stat, inv_bad_which, bad_row)
 
         def run(seen, seen_count, frontier, fcount, distinct,
-                gen_lo, gen_hi, depth, max_states):
+                gen_lo, gen_hi, depth, max_states, maxlvl):
             def cond(carry):
                 (_, _, _, _, _, _, _, _, lvls, stat, _, _) = carry
-                return (stat == ST_CONTINUE) & (lvls < MAXLVL)
+                return (stat == ST_CONTINUE) & (lvls < maxlvl)
 
             def body(carry):
                 (seen, seen_count, frontier, fcount, distinct,
@@ -1096,8 +1109,15 @@ class TpuExplorer:
         caps["VC"] = min(caps["VC"], self.A * CH)
         caps["AccCap"] = max(caps["AccCap"], 2 * caps["VC"], caps["FCap"])
         # levels per dispatch: the host only sees status (and can only
-        # checkpoint) between dispatches
-        MAXLVL = self._res_maxlvl
+        # checkpoint / log progress) between dispatches, so maxlvl adapts
+        # to measured dispatch wall time — targeting the tighter of
+        # progress_every/checkpoint_every — instead of a fixed 64 that
+        # could run for hours on a large model (advisor r2)
+        maxlvl = self._res_maxlvl
+        target_s = max(1.0, min(
+            self.progress_every or 30.0,
+            (self.checkpoint_every or 1e9) if self.checkpoint_path
+            else 1e9))
 
         frontier = np.full((caps["FCap"], W), SENTINEL, np.int32)
         frontier[:distinct] = init_rows[explored_init]
@@ -1150,10 +1170,25 @@ class TpuExplorer:
                      ST_OVF_ACC: "AccCap", ST_OVF_VC: "VC"}
         last_progress = last_ck = time.time()
         while True:
-            runf = self._get_resident_run(caps["SC"], caps["FCap"],
-                                          caps["AccCap"], caps["VC"],
-                                          CH, MAXLVL)
-            seen, frontier, summary, brow = runf(*state, max_states)
+            ck_key = (caps["SC"], caps["FCap"], caps["AccCap"],
+                      caps["VC"], CH)
+            fresh_compile = ck_key not in self._res_cache
+            runf = self._get_resident_run(*ck_key)
+            t_disp = time.time()
+            seen, frontier, summary, brow = runf(*state, max_states,
+                                                 jnp.int32(maxlvl))
+            jax.block_until_ready(summary)
+            disp_wall = time.time() - t_disp
+            # adapt levels-per-dispatch toward the host-attention target;
+            # a dispatch that just paid an XLA recompile (cap growth) is
+            # not evidence about execution speed — skip it
+            if fresh_compile:
+                pass
+            elif disp_wall > 1.5 * target_s and maxlvl > 1:
+                maxlvl = max(1, maxlvl // 2)
+            elif disp_wall < target_s / 4 and \
+                    maxlvl < self._res_maxlvl:
+                maxlvl = min(self._res_maxlvl, maxlvl * 2)
             summary = np.asarray(summary)
             stat = int(summary[0])
             seen_count = int(summary[1])

@@ -67,6 +67,24 @@ MCInitSeeded2 ==
     /\ holdingSIREADlocks = [txn \in TxnId |->
                                 IF txn = MCTxn2 THEN {MCk1} ELSE {}]
 
+\* 3-key escalation seed (write-family mutations): with 2 keys the
+\* read- and commit-checks alone still block every dangerous cycle a
+\* single write-mutation opens (the late-out hole needs a wr edge
+\* through a THIRD key to close a cycle whose last committer carries at
+\* most one flag). Seed txn commits all three keys.
+MCk3 == CHOOSE k \in Key \ {MCk1, MCk2} : TRUE
+MCInitSeeded3K ==
+    /\ history = << [op |-> "begin",  txnid |-> MCSeedTxn],
+                    [op |-> "write",  txnid |-> MCSeedTxn, key |-> MCk1],
+                    [op |-> "write",  txnid |-> MCSeedTxn, key |-> MCk2],
+                    [op |-> "write",  txnid |-> MCSeedTxn, key |-> MCk3],
+                    [op |-> "commit", txnid |-> MCSeedTxn] >>
+    /\ holdingXLocks      = [txn \in TxnId |-> {}]
+    /\ waitingForXLock    = [txn \in TxnId |-> NoLock]
+    /\ inConflict         = [txn \in TxnId |-> FALSE]
+    /\ outConflict        = [txn \in TxnId |-> FALSE]
+    /\ holdingSIREADlocks = [txn \in TxnId |-> {}]
+
 \* Serializability can only NEWLY fail at a commit: both MVSG encodings
 \* build their graphs from COMMITTED transactions, so a history is
 \* non-serializable iff its prefix ending at the latest commit is. These
