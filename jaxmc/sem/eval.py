@@ -16,7 +16,7 @@ from ..front import tla_ast as A
 from .values import (EvalError, Fcn, FcnSetV, InfiniteSet, ModelValue,
                      BOOLEAN_SET, EMPTY_FCN, INT, NAT, REAL, STRING_SET,
                      enumerate_set, fmt, in_set, mk_record, mk_seq,
-                     sort_key, tla_eq)
+                     sort_key, tla_eq, check_set_mix)
 
 
 class TLCAssertFailure(EvalError):
@@ -463,16 +463,8 @@ def _ev_tuple(e: A.TupleExpr, ctx: Ctx):
 
 def _ev_setenum(e: A.SetEnum, ctx: Ctx):
     vals = [eval_expr(x, ctx) for x in e.items]
-    # TLC raises a comparability error on sets mixing BOOLEAN with 0/1
-    # integers; Python's True == 1 would silently collapse them instead
-    # (the documented deviation in sem/values.py). Guard the one place a
-    # user-written mix enters the value domain.
-    if any(isinstance(v, bool) for v in vals) and \
-            any(isinstance(v, int) and not isinstance(v, bool)
-                for v in vals):
-        raise EvalError(
-            "set enumeration mixes BOOLEAN and integer values "
-            "(incomparable in TLA+; TLC raises here too)")
+    # TLC comparability: {TRUE, 1} is an error, not a True==1 collapse
+    check_set_mix(vals)
     return frozenset(vals)
 
 
@@ -490,6 +482,7 @@ def _ev_setmap(e: A.SetMap, ctx: Ctx):
     out = []
     for b in iter_binders(e.binders, ctx, eval_expr):
         out.append(eval_expr(e.expr, ctx.with_bound(b)))
+    check_set_mix(out)
     return frozenset(out)
 
 

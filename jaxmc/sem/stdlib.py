@@ -20,7 +20,7 @@ from typing import Any, Dict, List
 
 from .values import (EvalError, Fcn, InfiniteSet, ModelValue, EMPTY_FCN,
                      enumerate_set, fmt, in_set, mk_record, mk_seq,
-                     sort_key, tla_eq)
+                     sort_key, tla_eq, check_set_mix)
 from .eval import TLCAssertFailure, apply_op, Ctx
 
 
@@ -85,6 +85,9 @@ def _setop(name):
         a = _set(args[0], name)
         b = _set(args[1], name)
         if name in ("\\cup", "\\union"):
+            # check the OPERANDS' members: True == 1 collapses inside
+            # `a | b` itself, so the result would hide the mix
+            check_set_mix(itertools.chain(a, b))
             return a | b
         if name in ("\\cap", "\\intersect"):
             return a & b
@@ -113,9 +116,10 @@ def _powerset(args, ctx):
 
 
 def _union(args, ctx):
-    out = set()
+    out = []
     for s in enumerate_set(args[0]):
-        out |= _set(s, "UNION")
+        out.extend(_set(s, "UNION"))
+    check_set_mix(out)
     return frozenset(out)
 
 

@@ -8,12 +8,15 @@ immutable Fcn class. Model values come from cfg CONSTANT bindings.
 A total deterministic order over all values (sort_key) fixes CHOOSE witnesses
 and canonical display order, mirroring TLC's deterministic enumeration.
 
-Known deviation: Python's True == 1 means a set or function mixing BOOLEAN
-and 0/1 int values collapses them ({TRUE, 1} has cardinality 1 here). TLC
-raises a comparability error on such mixes; specs that TLC accepts without
-error never hit this. in_set() disambiguates membership tests, and tla_eq
-raises on direct bool-int comparison, but frozenset/dict construction cannot
-be intercepted without wrapping every boolean.
+Known deviation: Python's True == 1 could collapse BOOLEAN/0/1-int mixes.
+TLC raises a comparability error on such mixes; specs that TLC accepts
+without error never hit this. Guarded (raises like TLC): tla_eq on direct
+bool-int comparison, in_set membership, and TOP-LEVEL set construction —
+enumeration {TRUE, 1}, comprehensions, \cup/\union operands, UNION members
+(check_set_mix). Still collapsing (documented residual): NESTED values
+compared structurally, e.g. {{TRUE}, {1}} — the two inner sets compare
+equal via Python before any construction-site check can see the mix;
+preventing that would require wrapping every boolean in the value domain.
 """
 
 from __future__ import annotations
@@ -300,6 +303,23 @@ def sort_key(v):
         # engine-level state tuples (symmetry canonicalization)
         return tuple(sort_key(x) for x in v)
     raise EvalError(f"unorderable value {v!r}")
+
+
+def check_set_mix(vals) -> None:
+    """TLC comparability: a set holding both BOOLEAN and integer members
+    is an error, never a silent True==1 collapse (the documented
+    deviation above). Called by the set CONSTRUCTION sites — enumeration,
+    comprehension, union-family operators (sem/eval.py, sem/stdlib.py)."""
+    has_bool = has_int = False
+    for v in vals:
+        if isinstance(v, bool):
+            has_bool = True
+        elif isinstance(v, int):
+            has_int = True
+        if has_bool and has_int:
+            raise EvalError(
+                "set mixes BOOLEAN and integer values (incomparable in "
+                "TLA+; TLC raises here too)")
 
 
 def values_comparable(a, b) -> bool:

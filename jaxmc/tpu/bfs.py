@@ -1113,7 +1113,12 @@ class TpuExplorer:
         # to measured dispatch wall time — targeting the tighter of
         # progress_every/checkpoint_every — instead of a fixed 64 that
         # could run for hours on a large model (advisor r2)
-        maxlvl = self._res_maxlvl
+        # start SMALL and double up: the first dispatches are the ones
+        # with no timing evidence, and a 64-level opener on a big model
+        # could run for hours before the host could checkpoint or log
+        # progress (review r3) — a few extra cheap dispatches at the
+        # start cost almost nothing
+        maxlvl = min(4, self._res_maxlvl)
         target_s = max(1.0, min(
             self.progress_every or 30.0,
             (self.checkpoint_every or 1e9) if self.checkpoint_path

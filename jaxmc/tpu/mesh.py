@@ -65,7 +65,7 @@ class MeshExplorer(TpuExplorer):
                  log: Callable[[str], None] = None,
                  max_states: Optional[int] = None,
                  progress_every: float = 30.0, store_trace: bool = True,
-                 **kw):
+                 exchange: str = "gather", **kw):
         super().__init__(model, log=log, max_states=max_states,
                          progress_every=progress_every,
                          store_trace=store_trace, **kw)
@@ -76,11 +76,33 @@ class MeshExplorer(TpuExplorer):
         # seen shards store fingerprint keys: force fp mode on any width
         self.fp_mode = True
         self.K = 4 + 1
-        self._mesh_step_cache: Dict[Tuple[int, int], Callable] = {}
+        # ICI exchange strategy (SURVEY.md §2.3 "communication
+        # scheduling"): "gather" all_gathers every candidate to every
+        # device (traffic C*D per device, no routing state); "a2a"
+        # hash-routes each candidate straight to its owner via
+        # all_to_all with per-peer buckets of B = C*gamma/D (traffic
+        # C*gamma). Bucket overflow (hash skew beyond gamma) reruns the
+        # level with gamma doubled.
+        if exchange not in ("gather", "a2a"):
+            raise ValueError(f"exchange must be 'gather' or 'a2a', "
+                             f"got {exchange!r}")
+        self.exchange = exchange
+        self._a2a_gamma = 2.0
+        self._mesh_step_cache: Dict[Tuple, Callable] = {}
 
     # ---- the sharded level step ----
+    def _a2a_bucket(self, C: int, FC: int) -> int:
+        import math
+        # floor: R = D*B must cover the frontier capacity FC, or a
+        # sparse no-overflow level could hand the next step a frontier
+        # narrower than its compiled shape (review r3)
+        return max(1, math.ceil(C * self._a2a_gamma / self.D),
+                   math.ceil(FC / self.D))
+
     def _get_mesh_step(self, SC: int, FC: int) -> Callable:
-        key = (SC, FC)
+        a2a = self.exchange == "a2a"
+        B = self._a2a_bucket(self.A * FC, FC) if a2a else 0
+        key = (SC, FC, B)
         if key in self._mesh_step_cache:
             return self._mesh_step_cache[key]
         A, W, K, D = self.A, self.W, self.K, self.D
@@ -89,7 +111,12 @@ class MeshExplorer(TpuExplorer):
         keys_of = self._keys_of
         expand = self._expand_fn()
         C = A * FC
+        # R: rows each device holds after the exchange. gather: every
+        # candidate from every device (D*C); a2a: my bucket from each
+        # peer (D*B)
         G = D * C
+        R = D * B if a2a else G
+        Pw = K + W + 1  # a2a payload: [keys | row | global-src-index]
 
         def device_step(seen_keys, frontier, fcount):
             # per-device blocks: seen_keys [SC,K], frontier [FC,W], [1]
@@ -117,26 +144,71 @@ class MeshExplorer(TpuExplorer):
             cand = jnp.where(cvalid[:, None], cand, SENTINEL)
             ckeys = keys_of(cand, cvalid)                 # [C, K]
 
-            # ICI exchange: gather all candidates + keys, keep my range
-            gcand = lax.all_gather(cand, "d", tiled=True)    # [G, W]
-            gkeys = lax.all_gather(ckeys, "d", tiled=True)   # [G, K]
-            gvalid = gkeys[:, 0] == 0     # explicit validity lane
-            owner = (gkeys[:, 1].astype(jnp.uint32)
-                     % jnp.uint32(D)).astype(jnp.int32)
-            mine = gvalid & (owner == me)
-            # foreign/invalid rows: validity lane 1 (sorts last), data
-            # lanes sentinel so equal keys cannot straddle the mask
-            gkeys = jnp.where(mine[:, None], gkeys,
-                              jnp.concatenate([jnp.ones(1, jnp.int32),
-                                               jnp.full(K - 1, SENTINEL,
-                                                        jnp.int32)]))
+            invalid_key = jnp.concatenate(
+                [jnp.ones(1, jnp.int32),
+                 jnp.full(K - 1, SENTINEL, jnp.int32)])
+            a2a_ovf = jnp.asarray(False)
+            if a2a:
+                # hash-route each candidate straight to its owner:
+                # bucket-sort by destination, scatter into [D, B] slots,
+                # one all_to_all. Traffic per device: D*B = C*gamma rows
+                # instead of gather's C*D.
+                dest = jnp.where(
+                    cvalid,
+                    (ckeys[:, 1].astype(jnp.uint32)
+                     % jnp.uint32(D)).astype(jnp.int32),
+                    D)
+                sperm = lax.sort(
+                    (dest, jnp.arange(C, dtype=jnp.int32)),
+                    num_keys=1, is_stable=True)[1]
+                sdest = jnp.take(dest, sperm)
+                counts = jnp.zeros((D + 1,), jnp.int32).at[dest].add(1)
+                excl = jnp.concatenate(
+                    [jnp.zeros(1, jnp.int32),
+                     jnp.cumsum(counts)[:-1]])
+                pos = jnp.arange(C, dtype=jnp.int32) -                     jnp.take(excl, sdest)
+                a2a_ovf = jnp.any(counts[:D] > B)
+                slot = jnp.where((sdest < D) & (pos < B),
+                                 sdest * B + pos, D * B)
+                srcid = me.astype(jnp.int32) * C + sperm
+                payload = jnp.concatenate(
+                    [jnp.take(ckeys, sperm, axis=0),
+                     jnp.take(cand, sperm, axis=0),
+                     srcid[:, None]], axis=1)          # [C, Pw]
+                buckets = jnp.full((D * B + 1, Pw), SENTINEL, jnp.int32)
+                buckets = buckets.at[:, 0].set(1)  # invalid slots
+                buckets = buckets.at[slot].set(payload, mode="drop")
+                recv = lax.all_to_all(
+                    buckets[:D * B].reshape(D, B, Pw), "d",
+                    split_axis=0, concat_axis=0).reshape(R, Pw)
+                gkeys = recv[:, :K]
+                gcand = recv[:, K:K + W]
+                gsrc = recv[:, K + W]
+                gvalid = gkeys[:, 0] == 0
+                # routed rows are mine by construction; invalid slots
+                # keep the sorts-last key shape
+                gkeys = jnp.where(gvalid[:, None], gkeys, invalid_key)
+            else:
+                # ICI exchange: gather all candidates + keys, keep my
+                # range
+                gcand = lax.all_gather(cand, "d", tiled=True)  # [G, W]
+                gkeys = lax.all_gather(ckeys, "d", tiled=True)  # [G, K]
+                gsrc = jnp.arange(R, dtype=jnp.int32)
+                gvalid = gkeys[:, 0] == 0     # explicit validity lane
+                owner = (gkeys[:, 1].astype(jnp.uint32)
+                         % jnp.uint32(D)).astype(jnp.int32)
+                mine = gvalid & (owner == me)
+                # foreign/invalid rows: validity lane 1 (sorts last),
+                # data lanes sentinel so equal keys cannot straddle the
+                # mask
+                gkeys = jnp.where(mine[:, None], gkeys, invalid_key)
 
             # merge-dedup against my seen shard (key sort; seen first at
             # equal keys via the flag tiebreaker)
-            allk = jnp.concatenate([seen_keys, gkeys])    # [SC+G, K]
+            allk = jnp.concatenate([seen_keys, gkeys])    # [SC+R, K]
             flag = jnp.concatenate([jnp.zeros(SC, jnp.int32),
-                                    jnp.ones(G, jnp.int32)])
-            idx0 = jnp.arange(SC + G, dtype=jnp.int32)
+                                    jnp.ones(R, jnp.int32)])
+            idx0 = jnp.arange(SC + R, dtype=jnp.int32)
             ops = tuple(allk[:, i] for i in range(K)) + (flag, idx0)
             sorted_ = lax.sort(ops, num_keys=K + 1, is_stable=True)
             skeys = jnp.stack(sorted_[:K], axis=1)
@@ -151,14 +223,15 @@ class MeshExplorer(TpuExplorer):
             new_count = jnp.sum(new)
 
             # compact the new rows (gather payload by sorted position);
-            # new_cidx is each new row's GLOBAL candidate index — the
-            # provenance the host needs for trace reconstruction
+            # new_src is each new row's GLOBAL candidate index (gsrc
+            # lane) — the provenance the host needs for traces
             ops2 = ((1 - new.astype(jnp.int32)), cidx)
             comp = lax.sort(ops2, num_keys=1, is_stable=True)
-            new_cidx = comp[1][:G]
-            safe = jnp.clip(new_cidx, 0, G - 1)
+            new_cidx = comp[1][:R]
+            safe = jnp.clip(new_cidx, 0, R - 1)
             new_rows = jnp.take(gcand, safe, axis=0)
-            nvalid = jnp.arange(G) < new_count
+            new_src = jnp.take(gsrc, safe)
+            nvalid = jnp.arange(R) < new_count
             new_rows = jnp.where(nvalid[:, None], new_rows, SENTINEL)
 
             # merged seen keys, compacted (keeps key order)
@@ -175,14 +248,14 @@ class MeshExplorer(TpuExplorer):
             explore = nvalid
             for nm, f in con_fns:
                 explore = explore & jax.vmap(f)(new_rows)
-            idx4 = jnp.arange(G, dtype=jnp.int32)
+            idx4 = jnp.arange(R, dtype=jnp.int32)
             ops4 = ((1 - explore.astype(jnp.int32)), idx4)
             comp4 = lax.sort(ops4, num_keys=1, is_stable=True)
             front_rows = jnp.take(new_rows, comp4[1], axis=0)
             # provenance follows the same two compactions
-            front_src = jnp.take(new_cidx, comp4[1])
+            front_src = jnp.take(new_src, comp4[1])
             front_count = jnp.sum(explore)
-            frontvalid = jnp.arange(G) < front_count
+            frontvalid = jnp.arange(R) < front_count
             # named invariants: index of the FIRST cfg invariant any kept
             # row violates, plus the first violating slot
             inv_which = jnp.int32(_BIG)
@@ -203,15 +276,16 @@ class MeshExplorer(TpuExplorer):
             any_ovf = lax.psum(overflow.astype(jnp.int32), "d") > 0
             tot_front = lax.psum(front_count, "d")
 
+            any_a2a_ovf = lax.psum(a2a_ovf.astype(jnp.int32), "d") > 0
             return (seen2.reshape(1, SC, K), seen_count2.reshape(1),
-                    front_rows.reshape(1, G, W), front_count.reshape(1),
-                    front_src.reshape(1, G),
+                    front_rows.reshape(1, R, W), front_count.reshape(1),
+                    front_src.reshape(1, R),
                     tot_gen.reshape(1), tot_new.reshape(1),
                     dead_local.reshape(1), dead_slot.reshape(1),
                     assert_bad.reshape(1), asrt_a.reshape(1),
                     asrt_f.reshape(1), any_ovf.reshape(1),
                     inv_which.reshape(1), inv_slot.reshape(1),
-                    tot_front.reshape(1))
+                    tot_front.reshape(1), any_a2a_ovf.reshape(1))
 
         try:
             from jax import shard_map
@@ -220,7 +294,7 @@ class MeshExplorer(TpuExplorer):
         step = jax.jit(shard_map(
             device_step, mesh=self.mesh,
             in_specs=(P("d"), P("d"), P("d")),
-            out_specs=tuple([P("d")] * 16)))
+            out_specs=tuple([P("d")] * 17)))
         self._mesh_step_cache[key] = step
         return step
 
@@ -378,12 +452,24 @@ class MeshExplorer(TpuExplorer):
                 pad[:, :, 0] = 1
                 seen = jnp.concatenate([seen, jnp.asarray(pad)], axis=1)
                 SC = SC2
-            step = self._get_mesh_step(SC, FC)
             expanding_FC = FC
-            (seen, seen_cnt, front_rows, front_cnt, front_src,
-             tot_gen, tot_new, dead_local, dead_slot, assert_local,
-             asrt_a, asrt_f, any_ovf, inv_which, inv_slot,
-             tot_front) = step(seen, frontier, fcount)
+            while True:
+                step = self._get_mesh_step(SC, FC)
+                (seen2_, seen_cnt, front_rows, front_cnt, front_src,
+                 tot_gen, tot_new, dead_local, dead_slot, assert_local,
+                 asrt_a, asrt_f, any_ovf, inv_which, inv_slot,
+                 tot_front, a2a_ovf) = step(seen, frontier, fcount)
+                if self.exchange == "a2a" and \
+                        bool(np.asarray(a2a_ovf)[0]):
+                    # hash skew exceeded the per-peer bucket: rerun the
+                    # level with doubled capacity factor (inputs are
+                    # untouched — the step is functional)
+                    self._a2a_gamma *= 2
+                    self.log(f"-- mesh: a2a bucket overflow, gamma -> "
+                             f"{self._a2a_gamma}")
+                    continue
+                seen = seen2_
+                break
 
             if bool(np.asarray(any_ovf)[0]):
                 return self._mk(False, distinct, generated, depth, t0,

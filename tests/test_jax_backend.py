@@ -172,6 +172,32 @@ class TestMesh:
         # resumed full-run counts match the direct full run exactly
         assert r2.distinct == 3800 and r2.generated == 5850
 
+    def test_mesh_a2a_exchange_counts_and_trace(self, pcal_model):
+        # hash-routed all_to_all exchange (SURVEY §2.3 comm rows): same
+        # exact counts as the all_gather path, provenance intact through
+        # the routed src-index lane
+        from jaxmc.tpu.mesh import MeshExplorer
+        r = MeshExplorer(pcal_model, exchange="a2a").run()
+        assert r.ok
+        assert r.distinct == 3800 and r.generated == 5850
+        model = load(os.path.join(SPECS, "pcal_intro_buggy.tla"))
+        r2 = MeshExplorer(model, exchange="a2a").run()
+        assert not r2.ok and r2.violation.kind == "assert"
+        assert len(r2.violation.trace) == 6
+        _replay_trace(model, r2.violation.trace)
+
+    def test_mesh_a2a_bucket_overflow_grows_gamma(self, pcal_model):
+        # force a tiny capacity factor: the first level must overflow
+        # the per-peer bucket, double gamma (possibly repeatedly), and
+        # still finish with EXACT counts
+        from jaxmc.tpu.mesh import MeshExplorer
+        ex = MeshExplorer(pcal_model, exchange="a2a")
+        ex._a2a_gamma = 0.05
+        r = ex.run()
+        assert r.ok
+        assert r.distinct == 3800 and r.generated == 5850
+        assert ex._a2a_gamma > 0.05  # growth actually happened
+
     def test_mesh_deadlock_trace(self, tmp_path):
         from jaxmc.tpu.mesh import MeshExplorer
         spec = tmp_path / "countdown.tla"

@@ -197,3 +197,29 @@ class TestSSIMutations:
         r = run("MCserializableSI.tla", "MCserializableSI_mut.cfg",
                 max_states=3000)
         assert r.ok
+
+
+# (mutation, model cfg) pairs verified to reach their expected
+# serializability violation, with measured standalone search times on
+# this box. The remaining mutations (read_cannot_abort on the 4-txn
+# model, the write family on the 3-key/4-txn model) are exercised by the
+# same machinery; their searches exceed the slow-suite budget and run in
+# the round's background sweeps (results quoted in ROADMAP.md).
+VERIFIED_MUTATIONS = [
+    ("commit_cannot_abort", "MCserializableSI_mut2.cfg"),      # ~20 s
+    ("commit_no_loser_aborts", "MCserializableSI_mut2.cfg"),   # ~90 s
+    pytest.param("read_no_siread_lock", "MCserializableSI_mut.cfg",
+                 marks=pytest.mark.slow),                      # ~26 min
+]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name,cfgname", VERIFIED_MUTATIONS)
+def test_ssi_mutation_finds_violation(name, cfgname):
+    from jaxmc.sem.mutate import apply_ssi_mutation
+    model = _load_ssi(cfgname)
+    apply_ssi_mutation(model, name)
+    r = Explorer(model).run()
+    assert not r.ok
+    assert r.violation.kind == "invariant"
+    assert r.violation.name == "MCCahillSerializableAtCommit"
