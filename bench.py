@@ -78,8 +78,26 @@ def probe_tpu_once(timeout_s: float) -> tuple:
 
 def wait_for_tpu() -> tuple:
     """Retry the probe with backoff for up to JAXMC_BENCH_TPU_WAIT
-    seconds (default 20 min). Returns (found, last_detail)."""
-    budget = float(os.environ.get("JAXMC_BENCH_TPU_WAIT", "1200"))
+    seconds (default 20 min). Returns (found, last_detail).
+
+    When every probe HANGS (tunnel hard-down, the round-3 state for 8+
+    hours straight) the full budget is wasted driver time: without
+    evidence the TPU was recently alive (/tmp/tpu_up.marker, written by
+    a monitoring loop), cap the wait at ~7 minutes (two hang-length
+    probes). A healthy TPU machine answers the FIRST probe in seconds
+    either way."""
+    env_wait = os.environ.get("JAXMC_BENCH_TPU_WAIT")
+    budget = float(env_wait) if env_wait else 1200.0
+    if env_wait is None:
+        # only the DEFAULT budget is capped — an explicit env request is
+        # honored as-is. "Recently alive" = marker younger than 2 h.
+        try:
+            fresh = (time.time() -
+                     os.path.getmtime("/tmp/tpu_up.marker")) < 7200
+        except OSError:
+            fresh = False
+        if not fresh:
+            budget = min(budget, 420.0)
     t0 = time.time()
     attempt = 0
     detail = "no attempt"

@@ -133,6 +133,34 @@ def assign_unchanged(var: str) -> Callable[[A.Node], A.Node]:
     return apply
 
 
+def if_true_where(ident: str) -> Callable[[A.Node], A.Node]:
+    """Force TRUE the condition of the unique IF whose condition
+    mentions `ident` (e.g. the deadlock-prevention cycle check — forcing
+    'no cycle found' lets the waits-for graph form real cycles)."""
+    def mentions(node) -> bool:
+        for x in _preorder(node):
+            if (isinstance(x, A.Ident) and x.name == ident) or \
+                    (isinstance(x, A.OpApp) and x.name == ident):
+                return True
+        return False
+
+    def apply(body: A.Node) -> A.Node:
+        targets = [x for x in _preorder(body)
+                   if isinstance(x, A.If) and mentions(x.cond)]
+        if len(targets) != 1:
+            raise MutationError(
+                f"if_true_where({ident!r}): {len(targets)} matching IF "
+                f"nodes (need exactly 1)")
+        target = targets[0]
+
+        def fn(x):
+            if x is target:
+                return dataclasses.replace(x, cond=A.Bool(True))
+            return None
+        return _rewrite(body, fn)
+    return apply
+
+
 def let_empty_set(name: str) -> Callable[[A.Node], A.Node]:
     """Pin a LET-bound operator to the empty set."""
     def apply(body: A.Node) -> A.Node:
@@ -182,6 +210,21 @@ SSI_MUTATIONS: Dict[str, Tuple[str, Callable]] = {
     # "If Write cannot abort txn."
     "write_cannot_abort": ("HelperWriteCanAcquireXLock", if_false(1)),
 }
+
+# The NINTH documented check (serializableSnapshotIsolation.tla:103-107,
+# separate from the 8 serializability mutations): "Intentionally break
+# the prevention of transactional deadlock, and verify that TLC reports
+# the resulting specification-deadlock as an error. Checked by altering
+# the Write action to allow creation of cycles in the waiting-for-locks
+# graph." Forcing the cycle check to 'no cycle' makes a blocked write
+# wait into a cycle; the cycle's members then starve and the search hits
+# a real deadlock state (CHECK_DEADLOCK on).
+DEADLOCK_MUTATION = ("HelperWriteConflictsWithXLock",
+                     if_true_where("pathThatCyclesFromTxnToTxn"))
+
+
+def apply_deadlock_mutation(model) -> None:
+    apply_mutation(model, *DEADLOCK_MUTATION)
 
 
 def apply_mutation(model, def_name: str,

@@ -919,6 +919,39 @@ class TpuExplorer:
         self._res_cache[key] = jitted
         return jitted
 
+
+    def _caps_note(self) -> str:
+        """Which variable uses which bounded lane capacity — shown in
+        capacity-overflow violations so the user knows WHAT to raise
+        (the r3 MCraft_3s debugging pain: 'a container overflowed' with
+        no name). Renders inside error paths — never allowed to raise."""
+        try:
+            return self._caps_note_inner()
+        except Exception:  # noqa: BLE001 — diagnostics must not mask
+            return "raise --seq-cap/--grow-cap/--kv-cap"
+
+    def _caps_note_inner(self) -> str:
+        parts: Dict[str, None] = {}  # ordered dedupe (fcn repeats keys)
+
+        def walk(spec, path):
+            k = spec.kind
+            if k in ("seq", "growset", "kvtable"):
+                flag = {"seq": "--seq-cap", "growset": "--grow-cap",
+                        "kvtable": "--kv-cap"}[k]
+                parts.setdefault(f"{path}:{k}[cap {spec.cap}, {flag}]")
+            for sub in (spec.elems or ()):
+                walk(sub, path)
+            for sub in (spec.elem, spec.val):
+                if sub is not None:
+                    walk(sub, path)
+            for _fields, fspecs in (spec.variants or ()):
+                for sub in fspecs:
+                    walk(sub, path)
+
+        for v in self.layout.vars:
+            walk(self.layout.specs[v], v)
+        return "; ".join(parts) if parts else "no bounded containers"
+
     def _prepare_init(self, t0, warnings):
         """Shared init-state preparation for every device search mode:
         encode + dedup the enumerated init states, run the init-state
@@ -1262,7 +1295,7 @@ class TpuExplorer:
                     False, distinct, generated, depth, t0, warnings,
                     Violation("error", "capacity overflow", [],
                               "a container exceeded its lane capacity "
-                              "(raise --seq-cap/--grow-cap/--kv-cap)"))
+                              f"({self._caps_note()})"))
             else:
                 st = layout.decode(np.asarray(brow))
                 note = "state reached by resident-mode search (no trace)"
@@ -1349,7 +1382,7 @@ class TpuExplorer:
                         False, distinct, generated, depth, t0, warnings,
                         Violation("error", "capacity overflow", [],
                                   "a container exceeded its lane capacity "
-                                  "(raise --seq-cap/--grow-cap/--kv-cap)"))
+                                  f"({self._caps_note()})"))
                 if bool(jnp.any(out["assert_bad"])):
                     ab = np.asarray(out["assert_bad"])
                     ai, f = np.unravel_index(np.argmax(ab), ab.shape)
@@ -1584,7 +1617,7 @@ class TpuExplorer:
                     False, distinct, generated, depth, t0, warnings,
                     Violation("error", "capacity overflow", [],
                               "a container exceeded its lane capacity "
-                              "(raise --seq-cap/--grow-cap/--kv-cap); "
+                              f"({self._caps_note()}); "
                               "counts would no longer be exact"))
             if bool(jnp.any(out["assert_bad"])):
                 ab = np.asarray(out["assert_bad"])

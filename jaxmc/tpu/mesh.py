@@ -476,9 +476,8 @@ class MeshExplorer(TpuExplorer):
                                 warnings, Violation(
                                     "error", "capacity overflow", [],
                                     "a container exceeded its lane "
-                                    "capacity (raise --seq-cap/--grow-cap/"
-                                    "--kv-cap); counts would no longer "
-                                    "be exact"))
+                                    f"capacity ({self._caps_note()}); "
+                                    "counts would no longer be exact"))
             dead_np = np.asarray(dead_local)
             if model.check_deadlock and dead_np.any():
                 dv = int(np.argmax(dead_np))
