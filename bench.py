@@ -8,50 +8,251 @@ Workload: the BASELINE.json model of record — the reference raft spec
 (/root/reference/examples/raft.tla:482-493 hot path) with Server={s1,s2,s3}
 and a bounded log, made finite by the MCraftMicro message-domain constraint
 (specs/MCraft_3s_bench.cfg) so the EXHAUSTIVE search completes and the
-reported rate covers a full run, not a truncated prefix.
+reported rate covers a full run, not a truncated prefix. The metric string
+DISCLOSES the bench model's parameter deltas vs the BASELINE model of
+record (MCraft_3s.cfg) — see _MODEL_DELTAS.
 
 vs_baseline is the speedup over this repo's exact Python interpreter on
-the same workload (measured on a capped prefix, cap stated in the metric).
-vs_tlc_estimate is the speedup over the DOCUMENTED TLC estimate in
-BASELINE.md (no JVM in this image, so the TLC rate is literature-sourced,
-NOT measured — clearly labeled there). Backend count-equivalence is pinned
-for THIS benchmark model in the slow-marked
-tests/test_kernel2.py::test_raft_3s_bench_whole_run_equivalence (and for
-the smaller MCraft_micro model in default CI).
+the same workload. vs_tlc_estimate is the speedup over the DOCUMENTED TLC
+estimate in BASELINE.md (no JVM in this image, so the TLC rate is
+literature-sourced, NOT measured — clearly labeled there). Backend
+count-equivalence for the bench model is pinned in
+tests/test_kernel2.py::test_raft_3s_bench_whole_run_equivalence.
 
-Resilience (VERDICT r2 #1): the axon TPU tunnel is flaky — plugin init can
-hang for minutes or forever. This script
-  1. probes TPU availability in SUBPROCESSES with retry/backoff for up to
-     JAXMC_BENCH_TPU_WAIT seconds (default 1200) — not one 180 s shot;
-  2. on TPU, first runs profile_tpu.py (subprocess, bounded) so per-step
-     device timings survive in PROFILE_TPU.txt even if the full bench
-     later dies;
-  3. runs the measured bench in a CHILD process pinned to the chosen
-     platform; if the TPU child dies mid-run (tunnel drop), retries once,
-     then falls back to a CPU child — an honest JSON line is emitted in
-     every case.
+Constitutionally unable to produce nothing (VERDICT r3 #1): everything
+races in parallel against a hard internal deadline
+(JAXMC_BENCH_DEADLINE seconds, default 480):
+
+  - a CPU worker thread immediately runs, in order: an interp-only
+    EMERGENCY child (~30-60 s: no XLA compile at all), a QUICK device
+    rung (MCraft_micro, ~2-3 min cold on this 1-core box), then the
+    FULL bench rung (MCraft_3s_bench) if time remains;
+  - a TPU worker thread probes the axon tunnel (bounded retries); if the
+    TPU answers it runs the quick rung first (a TPU line as early as
+    possible), then a bounded profile capture, then the full rung.
+
+At the deadline (or earlier, once the best-possible line for the
+detected platform exists) the parent prints the best line available,
+priority: tpu/full > tpu/quick > cpu/full > cpu/quick > interp. Every
+line's metric string says exactly which model/platform/mode it measured.
 """
 
 import json
 import os
 import subprocess
 import sys
+import threading
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 _REPO = os.path.dirname(os.path.abspath(__file__))
 
 SPEC = os.path.join(_REPO, "specs", "MCraftMicro.tla")
-CFG = os.path.join(_REPO, "specs", "MCraft_3s_bench.cfg")
+CFG_FULL = os.path.join(_REPO, "specs", "MCraft_3s_bench.cfg")
+CFG_QUICK = os.path.join(_REPO, "specs", "MCraft_micro.cfg")
 INTERP_CAP = 20000  # distinct-state cap for the interpreter baseline run
 
 # Documented TLC comparison point (BASELINE.md "TLC rate estimate"):
 # literature/experience-sourced, NOT measured (no JVM in image).
 TLC_EST_STATES_PER_SEC = 5000.0
 
+# Honest-labeling (VERDICT r3 weak #6): how each rung differs from the
+# BASELINE model of record, specs/MCraft_3s.cfg (3 servers, MaxTerm 3,
+# MaxLogLen 2, MaxClientRequests 2, message domain unbounded).
+_MODEL_DELTAS = {
+    "full": ("MCraft_3s_bench vs BASELINE MCraft_3s: MaxClientRequests "
+             "1 (vs 2), MaxTerm 2 (vs 3), MaxLogLen 1 (vs 2), "
+             "MaxMsgDomain 3 (vs unbounded)"),
+    "quick": ("MCraft_micro vs BASELINE MCraft_3s: 2 servers (vs 3), "
+              "MaxClientRequests 1 (vs 2), MaxTerm 2 (vs 3), MaxLogLen "
+              "1 (vs 2), MaxMsgDomain 2 (vs unbounded)"),
+}
+_RUNG_CFG = {"full": CFG_FULL, "quick": CFG_QUICK}
+
+_DEADLINE = None  # absolute time.time() deadline, set in main()
+
 
 def _log(msg):
     print(f"bench: {msg}", file=sys.stderr, flush=True)
+
+
+def _remaining():
+    return max(0.0, _DEADLINE - time.time())
+
+
+# ---------------------------------------------------------------- children
+
+def child_bench(platform_pin: str, rung: str):
+    """The measured bench body. Runs in a child process with the platform
+    pinned BEFORE first jax import; prints the JSON line on stdout."""
+    import jax
+    # pin the platform: a tunnel drop between probe and child start must
+    # fail this child loudly (parent falls back), never silently measure
+    # on CPU while claiming the TPU slot
+    jax.config.update("jax_platforms", platform_pin)
+    devs = jax.devices()
+    assert devs[0].platform == platform_pin, \
+        f"pinned {platform_pin} but got {devs[0].platform}"
+
+    from jaxmc.sem.modules import Loader, bind_model
+    from jaxmc.front.cfg import parse_cfg
+    from jaxmc.tpu.bfs import TpuExplorer
+    from jaxmc.engine.explore import Explorer
+
+    cfg_path = _RUNG_CFG[rung]
+
+    def load_model():
+        ldr = Loader([os.path.join(_REPO, "specs"),
+                      "/root/reference/examples"])
+        return bind_model(ldr.load_path(SPEC),
+                          parse_cfg(open(cfg_path).read()))
+
+    # resident device mode: the whole BFS (frontier, fingerprint set,
+    # level loop) runs inside one jitted while_loop on the accelerator —
+    # the tunnel's ~160ms round-trip would otherwise dominate. The
+    # warm-up run compiles the jit cache AND trains the capacity buckets,
+    # so the timed run replays with zero recompiles.
+    ex = TpuExplorer(load_model(), store_trace=False, resident=True)
+    r_warm = ex.run()
+    assert r_warm.ok, "bench workload must pass"
+    t0 = time.time()
+    r = ex.run()
+    jax_wall = time.time() - t0
+    assert r.ok and r.distinct == r_warm.distinct
+    jax_rate = r.generated / jax_wall
+
+    # interpreter baseline on a capped prefix of the same workload (the
+    # interp rate is flat in search depth; full run measured at the same
+    # ~5.6k st/s — see specs/MCraft_3s_bench.cfg header)
+    ri = Explorer(load_model(), max_states=INTERP_CAP).run()
+    interp_rate = ri.generated / ri.wall_s
+
+    out = {
+        "metric": (
+            f"states/sec, exhaustive raft (reference raft.tla, "
+            f"{os.path.basename(cfg_path)}: "
+            f"{r.generated} generated / {r.distinct} distinct, COMPLETED, "
+            f"platform={devs[0].platform}, device-resident BFS); "
+            f"model deltas: {_MODEL_DELTAS[rung]}; "
+            f"vs_baseline = speedup over the exact Python interpreter on "
+            f"the same model (capped at {INTERP_CAP} distinct); "
+            f"vs_tlc_estimate = speedup over the BASELINE.md documented "
+            f"TLC estimate ({TLC_EST_STATES_PER_SEC:.0f} st/s/core, "
+            f"literature-sourced, NOT measured — no JVM in image)"),
+        "value": round(jax_rate, 1),
+        "unit": "states/sec",
+        "vs_baseline": round(jax_rate / interp_rate, 3),
+        "vs_tlc_estimate": round(jax_rate / TLC_EST_STATES_PER_SEC, 3),
+    }
+    print(json.dumps(out), flush=True)
+
+
+def child_emergency():
+    """Interp-only floor measurement: no XLA compile anywhere, so it
+    lands in well under a minute. Honest label: interpreter rate,
+    vs_baseline 1.0 by construction."""
+    from jaxmc.sem.modules import Loader, bind_model
+    from jaxmc.front.cfg import parse_cfg
+    from jaxmc.engine.explore import Explorer
+
+    ldr = Loader([os.path.join(_REPO, "specs"), "/root/reference/examples"])
+    model = bind_model(ldr.load_path(SPEC),
+                       parse_cfg(open(CFG_QUICK).read()))
+    r = Explorer(model).run()
+    assert r.ok
+    rate = r.generated / r.wall_s
+    out = {
+        "metric": (
+            f"states/sec, exhaustive raft (reference raft.tla, "
+            f"MCraft_micro: {r.generated} generated / {r.distinct} "
+            f"distinct, COMPLETED, EXACT PYTHON INTERPRETER ONLY — the "
+            f"device bench did not finish inside the bench deadline; "
+            f"model deltas: {_MODEL_DELTAS['quick']}; "
+            f"vs_tlc_estimate vs the BASELINE.md documented TLC estimate "
+            f"({TLC_EST_STATES_PER_SEC:.0f} st/s/core, literature-"
+            f"sourced, NOT measured)"),
+        "value": round(rate, 1),
+        "unit": "states/sec",
+        "vs_baseline": 1.0,
+        "vs_tlc_estimate": round(rate / TLC_EST_STATES_PER_SEC, 3),
+    }
+    print(json.dumps(out), flush=True)
+
+
+# ------------------------------------------------------------------ parent
+
+class _Results:
+    """Thread-safe best-line store with a fixed priority order."""
+    PRIORITY = [("tpu", "full"), ("tpu", "quick"),
+                ("cpu", "full"), ("cpu", "quick"),
+                ("interp", "emergency")]
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._lines = {}
+
+    def put(self, platform, rung, line):
+        with self._lock:
+            self._lines[(platform, rung)] = line
+        _log(f"result in: {platform}/{rung}")
+
+    def has(self, platform, rung):
+        with self._lock:
+            return (platform, rung) in self._lines
+
+    def best(self):
+        with self._lock:
+            for key in self.PRIORITY:
+                if key in self._lines:
+                    return key, self._lines[key]
+        return None, None
+
+
+_RESULTS = _Results()
+_PROCS = []        # live child Popens, killed at exit
+_PROCS_LOCK = threading.Lock()
+_STOPPING = threading.Event()  # set by main() before the kill loop
+
+
+def _run_child(env_extra: dict, timeout_s: float, tag: str):
+    """Run bench.py as a child with env markers; return its JSON line or
+    None. Registers the Popen so main() can kill stragglers at exit."""
+    if timeout_s <= 5 or _STOPPING.is_set():
+        _log(f"{tag}: skipped (no time left)")
+        return None
+    env = dict(os.environ, **env_extra)
+    with _PROCS_LOCK:
+        # check-and-spawn under the lock: a worker racing main()'s kill
+        # loop must not start a fresh multi-minute XLA compile that the
+        # parent's exit would orphan on this 1-core box
+        if _STOPPING.is_set():
+            _log(f"{tag}: skipped (shutting down)")
+            return None
+        p = subprocess.Popen([sys.executable, os.path.abspath(__file__)],
+                             stdout=subprocess.PIPE,
+                             stderr=subprocess.PIPE, text=True, env=env)
+        _PROCS.append(p)
+    try:
+        out, err = p.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        p.kill()
+        p.communicate()
+        _log(f"{tag}: timed out after {timeout_s:.0f}s")
+        return None
+    finally:
+        with _PROCS_LOCK:
+            if p in _PROCS:
+                _PROCS.remove(p)
+    sys.stderr.write(err or "")
+    if p.returncode != 0:
+        _log(f"{tag}: child rc={p.returncode}")
+        return None
+    for line in (out or "").splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            return line
+    _log(f"{tag}: child produced no JSON line")
+    return None
 
 
 def probe_tpu_once(timeout_s: float) -> tuple:
@@ -76,181 +277,141 @@ def probe_tpu_once(timeout_s: float) -> tuple:
     return "other", plat
 
 
-def wait_for_tpu() -> tuple:
-    """Retry the probe with backoff for up to JAXMC_BENCH_TPU_WAIT
-    seconds (default 20 min). Returns (found, last_detail).
+def _cpu_worker():
+    """Emergency interp line first (floor), then quick device rung, then
+    the full rung if the clock allows."""
+    line = _run_child({"JAXMC_BENCH_CHILD": "emergency"},
+                      min(150.0, _remaining()), "cpu/emergency")
+    if line:
+        _RESULTS.put("interp", "emergency", line)
+    line = _run_child({"JAXMC_BENCH_CHILD": "cpu", "JAXMC_BENCH_RUNG":
+                       "quick"}, _remaining(), "cpu/quick")
+    if line:
+        _RESULTS.put("cpu", "quick", line)
+    line = _run_child({"JAXMC_BENCH_CHILD": "cpu", "JAXMC_BENCH_RUNG":
+                       "full"}, _remaining(), "cpu/full")
+    if line:
+        _RESULTS.put("cpu", "full", line)
 
-    When every probe HANGS (tunnel hard-down, the round-3 state for 8+
-    hours straight) the full budget is wasted driver time: without
-    evidence the TPU was recently alive (/tmp/tpu_up.marker, written by
-    a monitoring loop), cap the wait at ~7 minutes (two hang-length
-    probes). A healthy TPU machine answers the FIRST probe in seconds
-    either way."""
-    env_wait = os.environ.get("JAXMC_BENCH_TPU_WAIT")
-    budget = float(env_wait) if env_wait else 1200.0
-    if env_wait is None:
-        # only the DEFAULT budget is capped — an explicit env request is
-        # honored as-is. "Recently alive" = marker younger than 2 h.
-        try:
-            fresh = (time.time() -
-                     os.path.getmtime("/tmp/tpu_up.marker")) < 7200
-        except OSError:
-            fresh = False
-        if not fresh:
-            budget = min(budget, 420.0)
-    t0 = time.time()
+
+def _tpu_worker():
+    """Probe for the tunnel; on success run quick rung first (earliest
+    possible TPU line), bounded profile capture, then the full rung."""
     attempt = 0
-    detail = "no attempt"
-    while time.time() - t0 < budget:
+    found = False
+    # leave >=90 s for a quick TPU rung after the last probe
+    while _remaining() > 90:
         attempt += 1
-        left = budget - (time.time() - t0)
-        status, detail = probe_tpu_once(min(180.0, max(30.0, left)))
+        status, detail = probe_tpu_once(min(120.0, _remaining() - 60))
         _log(f"tpu probe #{attempt}: "
-             f"{'UP' if status == 'tpu' else detail} "
-             f"({time.time() - t0:.0f}s in)")
+             f"{'UP' if status == 'tpu' else detail}")
         if status == "tpu":
-            return True, detail
+            found = True
+            break
         if status == "other":
-            return False, f"no TPU on this machine (platform={detail})"
-        time.sleep(min(30.0, max(0.0, budget - (time.time() - t0))))
-    return False, detail
+            _log(f"no TPU on this machine (platform={detail})")
+            return
+        time.sleep(min(20.0, _remaining()))
+    if not found:
+        return
+    try:  # evidence for the monitoring loop pattern (memory: tpu_up.marker)
+        open("/tmp/tpu_up.marker", "w").write(str(time.time()))
+    except OSError:
+        pass
+    line = _run_child({"JAXMC_BENCH_CHILD": "tpu", "JAXMC_BENCH_RUNG":
+                       "quick"}, _remaining(), "tpu/quick")
+    if line:
+        _RESULTS.put("tpu", "quick", line)
+    # per-step device timings survive in PROFILE_TPU.txt even if the full
+    # rung later dies; bounded so it cannot eat the full rung's slot
+    if _remaining() > 240:
+        _run_profile_tpu(min(300.0, _remaining() / 3))
+    line = _run_child({"JAXMC_BENCH_CHILD": "tpu", "JAXMC_BENCH_RUNG":
+                       "full"}, _remaining(), "tpu/full")
+    if line:
+        _RESULTS.put("tpu", "full", line)
 
 
-def run_profile_tpu():
-    """Capture per-step device timings before the full bench (so a later
-    tunnel drop still leaves evidence). Bounded; failure is non-fatal."""
+def _run_profile_tpu(timeout_s: float):
+    """Capture per-step device timings; failure is non-fatal. Streams
+    STRAIGHT to the file so a timeout-kill keeps the partial output."""
     out_path = os.path.join(_REPO, "PROFILE_TPU.txt")
-    # stream the child's output STRAIGHT to the file: on a timeout-kill,
-    # TimeoutExpired.stdout is None with capture_output (verified on this
-    # box), so buffering in the parent would lose exactly the partial
-    # per-step timings this profile-first step exists to preserve
     try:
         with open(out_path, "w") as fh:
             p = subprocess.Popen([sys.executable,
                                   os.path.join(_REPO, "profile_tpu.py")],
                                  stdout=fh, stderr=subprocess.STDOUT,
                                  text=True)
+            with _PROCS_LOCK:
+                _PROCS.append(p)
             try:
-                rc = p.wait(timeout=900)
+                rc = p.wait(timeout=timeout_s)
                 _log(f"profile_tpu.py rc={rc} -> {out_path}")
             except subprocess.TimeoutExpired:
                 p.kill()
                 p.wait()
-                fh.write("\n--- TIMED OUT at 900s ---\n")
-                _log(f"profile_tpu.py timed out (900s); "
-                     f"partial -> {out_path}")
+                fh.write(f"\n--- TIMED OUT at {timeout_s:.0f}s ---\n")
+                _log(f"profile_tpu.py timed out; partial -> {out_path}")
+            finally:
+                with _PROCS_LOCK:
+                    if p in _PROCS:
+                        _PROCS.remove(p)
     except OSError as ex:
         _log(f"profile_tpu.py failed to run: {ex}")
 
 
-def child_bench(platform_pin: str):
-    """The measured bench body. Runs in a child process with the platform
-    pinned BEFORE first jax import; prints the JSON line on stdout."""
-    import jax
-    # pin BOTH platforms: a tunnel drop between probe and child start
-    # must fail this child loudly (parent then retries / falls back),
-    # never silently measure on CPU while claiming the TPU slot
-    jax.config.update("jax_platforms", platform_pin)
-    devs = jax.devices()
-    assert devs[0].platform == platform_pin, \
-        f"pinned {platform_pin} but got {devs[0].platform}"
-
-    from jaxmc.sem.modules import Loader, bind_model
-    from jaxmc.front.cfg import parse_cfg
-    from jaxmc.tpu.bfs import TpuExplorer
-    from jaxmc.engine.explore import Explorer
-
-    def load_model():
-        ldr = Loader([os.path.join(_REPO, "specs"),
-                      "/root/reference/examples"])
-        return bind_model(ldr.load_path(SPEC), parse_cfg(open(CFG).read()))
-
-    # resident device mode: the whole BFS (frontier, fingerprint set,
-    # level loop) runs inside one jitted while_loop on the accelerator —
-    # the tunnel's ~160ms round-trip would otherwise dominate. The
-    # warm-up run compiles the jit cache AND trains the capacity buckets,
-    # so the timed run replays with zero recompiles.
-    ex = TpuExplorer(load_model(), store_trace=False, resident=True)
-    r_warm = ex.run()
-    assert r_warm.ok, "bench workload must pass"
-    t0 = time.time()
-    r = ex.run()
-    jax_wall = time.time() - t0
-    assert r.ok and r.distinct == r_warm.distinct
-    jax_rate = r.generated / jax_wall
-
-    # interpreter baseline on a capped prefix of the same workload (the
-    # interp rate is flat in search depth; full run measured at the same
-    # ~5.6k st/s — see specs/MCraft_3s_bench.cfg header)
-    ri = Explorer(load_model(), max_states=INTERP_CAP).run()
-    interp_rate = ri.generated / ri.wall_s
-
-    out = {
-        "metric": (
-            f"states/sec, exhaustive raft 3-server "
-            f"(reference raft.tla, MCraft_3s_bench: "
-            f"{r.generated} generated / {r.distinct} distinct, COMPLETED, "
-            f"platform={devs[0].platform}, device-resident BFS); "
-            f"vs_baseline = speedup over the exact Python interpreter on "
-            f"the same model ({INTERP_CAP}-distinct-state prefix); "
-            f"vs_tlc_estimate = speedup over the BASELINE.md documented "
-            f"TLC estimate ({TLC_EST_STATES_PER_SEC:.0f} st/s/core, "
-            f"literature-sourced, NOT measured — no JVM in image)"),
-        "value": round(jax_rate, 1),
-        "unit": "states/sec",
-        "vs_baseline": round(jax_rate / interp_rate, 3),
-        "vs_tlc_estimate": round(jax_rate / TLC_EST_STATES_PER_SEC, 3),
-    }
-    print(json.dumps(out), flush=True)
-
-
-def run_child(platform_pin: str, timeout_s: float):
-    """Run child_bench in a subprocess; returns its JSON line or None."""
-    env = dict(os.environ, JAXMC_BENCH_CHILD=platform_pin)
-    try:
-        r = subprocess.run([sys.executable, os.path.abspath(__file__)],
-                           capture_output=True, text=True,
-                           timeout=timeout_s, env=env)
-    except subprocess.TimeoutExpired:
-        _log(f"{platform_pin} bench child timed out after {timeout_s:.0f}s")
-        return None
-    sys.stderr.write(r.stderr or "")
-    if r.returncode != 0:
-        _log(f"{platform_pin} bench child rc={r.returncode}")
-        return None
-    for line in (r.stdout or "").splitlines():
-        line = line.strip()
-        if line.startswith("{"):
-            return line
-    _log(f"{platform_pin} bench child produced no JSON line")
-    return None
-
-
 def main():
+    global _DEADLINE
     pin = os.environ.get("JAXMC_BENCH_CHILD")
+    if pin == "emergency":
+        child_emergency()
+        return
     if pin:
-        child_bench(pin)
+        child_bench(pin, os.environ.get("JAXMC_BENCH_RUNG", "full"))
         return
 
-    found, detail = wait_for_tpu()
-    if found:
-        run_profile_tpu()
-        line = run_child("tpu", 2400.0)
-        if line is None:
-            _log("retrying TPU bench once (tunnel flap?)")
-            line = run_child("tpu", 2400.0)
-        if line is not None:
-            print(line, flush=True)
-            return
-        _log("TPU bench failed twice — falling back to CPU")
-    else:
-        _log(f"tpu unavailable after retry window ({detail}) — CPU bench")
-    line = run_child("cpu", 3000.0)
+    budget = float(os.environ.get("JAXMC_BENCH_DEADLINE", "480"))
+    _DEADLINE = time.time() + budget
+    _log(f"deadline: {budget:.0f}s from now")
+
+    t_cpu = threading.Thread(target=_cpu_worker, daemon=True)
+    t_tpu = threading.Thread(target=_tpu_worker, daemon=True)
+    t_cpu.start()
+    t_tpu.start()
+
+    # wait until the deadline, or stop early once the best line this
+    # environment can produce is in hand
+    while _remaining() > 10:
+        if _RESULTS.has("tpu", "full"):
+            break
+        if not t_tpu.is_alive() and not t_cpu.is_alive():
+            break
+        if not t_tpu.is_alive():
+            # tpu worker exited: tpu/quick (if it landed) outranks any
+            # later cpu line — waiting further cannot improve best();
+            # without it, cpu/full is the ceiling
+            if _RESULTS.has("tpu", "quick") or _RESULTS.has("cpu", "full"):
+                break
+        time.sleep(3)
+
+    with _PROCS_LOCK:
+        _STOPPING.set()  # under the lock: no worker can spawn past this
+        for p in _PROCS:
+            try:
+                p.kill()
+            except OSError:
+                pass
+    key, line = _RESULTS.best()
     if line is None:
-        # last resort: run inline on CPU so SOME line is emitted
-        _log("CPU child failed; running inline")
-        child_bench("cpu")
-        return
+        # truly nothing (emergency child itself failed): emit an explicit
+        # failure record rather than silence — parseable, value null
+        _log("NO measurement landed before the deadline")
+        print(json.dumps({
+            "metric": "bench produced no measurement before deadline "
+                      "(see stderr)", "value": None,
+            "unit": "states/sec", "vs_baseline": None}), flush=True)
+        sys.exit(1)
+    _log(f"emitting {key[0]}/{key[1]} line")
     print(line, flush=True)
 
 

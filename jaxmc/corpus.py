@@ -293,9 +293,18 @@ def sweep(backend: str = "interp", include_slow: bool = False,
         tallies[status] += 1
         if status == "pass" and case.expect.startswith("violation"):
             expected_violations += 1
+    # advisor r3: disclose the platform isolated cases were pinned to —
+    # `sweep --backend jax` on a TPU machine validates the CPU path
+    # unless JAXMC_SWEEP_PLATFORM says otherwise, and the summary must
+    # say which one actually ran
+    plat_note = ""
+    if isolate:
+        plat_note = (", platform="
+                     f"{os.environ.get('JAXMC_SWEEP_PLATFORM', 'cpu')}"
+                     " [JAXMC_SWEEP_PLATFORM]")
     log(f"{n} corpus models: {tallies['pass']} pass "
         f"({expected_violations} expected-violation), "
         f"{tallies['skip']} SKIP (outside jax subset), "
         f"{tallies['fail']} FAIL "
-        f"({time.time() - t0:.1f}s, backend={backend})")
+        f"({time.time() - t0:.1f}s, backend={backend}{plat_note})")
     return tallies["fail"]

@@ -84,10 +84,12 @@ def _setop(name):
     def f(args, ctx):
         a = _set(args[0], name)
         b = _set(args[1], name)
+        # check the OPERANDS' members for every set operator: True == 1
+        # collapses inside `a | b` / `a & b` / `a - b` itself, so the
+        # result would hide the mix ({TRUE} \cap {1} -> {1}, {TRUE} \ {1}
+        # -> {}) where TLC raises a comparability error
+        check_set_mix(itertools.chain(a, b))
         if name in ("\\cup", "\\union"):
-            # check the OPERANDS' members: True == 1 collapses inside
-            # `a | b` itself, so the result would hide the mix
-            check_set_mix(itertools.chain(a, b))
             return a | b
         if name in ("\\cap", "\\intersect"):
             return a & b

@@ -11,12 +11,16 @@ and canonical display order, mirroring TLC's deterministic enumeration.
 Known deviation: Python's True == 1 could collapse BOOLEAN/0/1-int mixes.
 TLC raises a comparability error on such mixes; specs that TLC accepts
 without error never hit this. Guarded (raises like TLC): tla_eq on direct
-bool-int comparison, in_set membership, and TOP-LEVEL set construction —
-enumeration {TRUE, 1}, comprehensions, \cup/\union operands, UNION members
-(check_set_mix). Still collapsing (documented residual): NESTED values
-compared structurally, e.g. {{TRUE}, {1}} — the two inner sets compare
-equal via Python before any construction-site check can see the mix;
-preventing that would require wrapping every boolean in the value domain.
+bool-int comparison, in_set membership, set-operator operands
+(\cup/\cap/\/UNION/enumeration/comprehension via check_set_mix), and —
+since round 4 — NESTED mixes wherever a collapse could occur: two values
+that are Python-equal only via a nested True==1 conflation (e.g. {{TRUE}}
+vs {{1}}, <<TRUE>> vs <<1>>) raise at the comparison/construction site
+(_assert_no_collapse, gated by the cheap _has_boolish scan). Residual
+deviation (answer-preserving): TLC also raises when comparing nested
+values that are NOT Python-equal, e.g. {{TRUE}} = {{2}} — we return
+FALSE where TLC errors; no wrong answer is produced, only a missing
+error report on specs TLC would reject anyway.
 """
 
 from __future__ import annotations
@@ -229,6 +233,18 @@ def in_set(v, s) -> bool:
             return any(x is v for x in s)
         if isinstance(v, int) and v in (0, 1):
             return any(x == v and not isinstance(x, bool) for x in s)
+        if isinstance(v, (frozenset, Fcn)) and _has_boolish(v):
+            # container membership can match only via a nested True==1
+            # conflation ({1} \in {{TRUE}}): hash-check first (a miss
+            # can't collapse), and only on a hit scan for the Python-
+            # equal member to raise like TLC if the match rides one
+            if v not in s:
+                return False
+            for x in s:
+                if x == v:
+                    _assert_no_collapse(v, x)
+                    return True
+            return True  # unreachable: the hash hit guarantees a match
         return v in s
     if isinstance(s, InfiniteSet):
         return s.contains(v)
@@ -305,17 +321,63 @@ def sort_key(v):
     raise EvalError(f"unorderable value {v!r}")
 
 
+def _has_boolish(v) -> bool:
+    """Could v participate in a True==1 collapse? True iff it contains a
+    bool or a 0/1 integer anywhere. Cheap gate for _assert_no_collapse."""
+    if isinstance(v, bool):
+        return True
+    if isinstance(v, int):
+        return v in (0, 1)
+    if isinstance(v, frozenset):
+        return any(_has_boolish(x) for x in v)
+    if isinstance(v, Fcn):
+        return any(_has_boolish(k) or _has_boolish(x)
+                   for k, x in v.d.items())
+    return False
+
+
+def _assert_no_collapse(a, b) -> None:
+    """Given a == b under PYTHON equality, raise EvalError if that
+    equality rides a True==1 conflation anywhere inside — TLC treats
+    BOOLEAN and integers as incomparable at every depth, so {{TRUE}}
+    vs {{1}} is a comparability error there, never an equality."""
+    if isinstance(a, bool) != isinstance(b, bool):
+        raise EvalError(
+            f"attempted to compare {fmt(a)} with {fmt(b)} (BOOLEAN vs "
+            "integer, incomparable in TLA+; TLC raises here too)")
+    if isinstance(a, frozenset) and isinstance(b, frozenset):
+        bd = {x: x for x in b}
+        for m in a:
+            _assert_no_collapse(m, bd[m])
+    elif isinstance(a, Fcn) and isinstance(b, Fcn):
+        bkeys = {k: k for k in b.d}
+        for k, v in a.d.items():
+            bk = bkeys[k]
+            _assert_no_collapse(k, bk)
+            _assert_no_collapse(v, b.d[bk])
+
+
 def check_set_mix(vals) -> None:
     """TLC comparability: a set holding both BOOLEAN and integer members
     is an error, never a silent True==1 collapse (the documented
     deviation above). Called by the set CONSTRUCTION sites — enumeration,
-    comprehension, union-family operators (sem/eval.py, sem/stdlib.py)."""
+    comprehension, union-family operators (sem/eval.py, sem/stdlib.py).
+    Also catches NESTED collapses: two members that are Python-equal only
+    via an inner True==1 conflation ({{TRUE}, {1}} would silently dedup
+    to a 1-element set before any downstream check could see it)."""
     has_bool = has_int = False
+    nested = None
     for v in vals:
         if isinstance(v, bool):
             has_bool = True
         elif isinstance(v, int):
             has_int = True
+        elif isinstance(v, (frozenset, Fcn)) and _has_boolish(v):
+            if nested is None:
+                nested = {}
+            prev = nested.setdefault(v, v)
+            if prev is not v:
+                _assert_no_collapse(prev, v)
         if has_bool and has_int:
             raise EvalError(
                 "set mixes BOOLEAN and integer values (incomparable in "
@@ -354,7 +416,12 @@ def tla_eq(a, b) -> bool:
         return a == b
     if isinstance(b, FcnSetV):
         return b == a
-    return a == b
+    r = a == b
+    if r and isinstance(a, (frozenset, Fcn)) and _has_boolish(a):
+        # Python-equal containers may be equal only via a nested True==1
+        # conflation ({{TRUE}} == {{1}}): TLC raises there, never equates
+        _assert_no_collapse(a, b)
+    return r
 
 
 def fmt(v) -> str:

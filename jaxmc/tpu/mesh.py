@@ -405,8 +405,13 @@ class MeshExplorer(TpuExplorer):
             fcount = jnp.asarray(ck["fcount"])
             if ck.get("levels") is not None:
                 self._levels = ck["levels"]
-            else:
-                self.store_trace = False
+            elif self.store_trace:
+                # advisor r3: match _restore_ck_state — a user expecting
+                # traces must hear it up front, not get an empty-trace
+                # violation later
+                raise ValueError(
+                    "cannot resume with traces: the checkpoint was "
+                    "written with --no-trace")
             self.log(f"Resuming mesh run at depth {depth} "
                      f"({distinct} distinct states)")
         else:

@@ -518,3 +518,42 @@ def test_bool_int_set_mix_raises():
     assert eval_expr(parse_expr_text("{TRUE, FALSE}"), ctx) == \
         frozenset({True, False})
     assert eval_expr(parse_expr_text("{0, 1}"), ctx) == frozenset({0, 1})
+
+
+def test_bool_int_setop_operand_mix_raises():
+    # advisor r3: \cap and \ operand mixes must raise like \cup does —
+    # {TRUE} \cap {1} is a comparability error in TLC, not {1}
+    from jaxmc.sem.eval import EvalError
+    for src in (r"{TRUE} \cap {1}", r"{TRUE} \ {1}", r"{1} \cap {TRUE}",
+                r"{FALSE} \cup {0}"):
+        with pytest.raises(EvalError, match="BOOLEAN and integer"):
+            ev(src)
+    # disjoint same-kind operands still fine
+    assert ev(r"{TRUE} \cap {FALSE}") == frozenset()
+    assert ev(r"{1} \ {0}") == frozenset({1})
+
+
+def test_nested_bool_int_collapse_raises():
+    # r4: NESTED True==1 conflations raise instead of silently collapsing
+    # ({{TRUE}, {1}} used to dedup to a 1-element set; TLC raises when it
+    # compares the inner TRUE with 1)
+    from jaxmc.sem.eval import EvalError
+    with pytest.raises(EvalError, match="BOOLEAN vs integer"):
+        ev("{{TRUE}, {1}}")
+    with pytest.raises(EvalError, match="BOOLEAN vs integer"):
+        ev("{{0}, {FALSE}}")
+    with pytest.raises(EvalError, match="BOOLEAN vs integer"):
+        ev("{{TRUE}} = {{1}}")
+    with pytest.raises(EvalError, match="BOOLEAN vs integer"):
+        ev("<<TRUE>> = <<1>>")
+    with pytest.raises(EvalError, match="BOOLEAN vs integer"):
+        ev("{1} \\in {{TRUE}}")
+    with pytest.raises(EvalError, match="BOOLEAN vs integer"):
+        ev("[a |-> TRUE] = [a |-> 1]")
+    # no false positives: genuinely equal / unequal nested values
+    assert ev("{{TRUE}} = {{TRUE}}") is True
+    assert ev("{{1}} = {{1}}") is True
+    assert ev("{{TRUE}, {FALSE}} = {{FALSE}, {TRUE}}") is True
+    assert ev("<<1, TRUE>> = <<1, TRUE>>") is True
+    assert ev("{1} \\in {{1}, {2}}") is True
+    assert ev("Cardinality({{0}, {1}})") == 2
