@@ -34,10 +34,95 @@ class GroundedAction:
     items: List[Tuple[A.Node, Dict[str, Any]]]
 
 
+@dataclass
+class ActionArm:
+    """One top-level disjunct of Next, pre-grounding: the unit of hybrid
+    fallback. Compiled arms ground into GroundedActions; an arm whose
+    grounding or kernel compilation fails is enumerated by the exact
+    interpreter (sem/enumerate.py) over decoded frontier states instead
+    of rejecting the whole spec (VERDICT r3 #2). `bound` holds static
+    VALUE bindings only (operator params, static \\E binders) so the
+    interpreter can evaluate the arm via ctx.with_bound(bound). `label`
+    is None when no operator expansion named the arm yet — grounding's
+    first-leaf-conjunct policy (walk2) then assigns it, so a None must
+    be passed through to ground_arm unchanged (display sites default it
+    to "Next")."""
+    label: Optional[str]
+    expr: A.Node
+    bound: Dict[str, Any]
+
+
 def _static_ctx(model: Model) -> Ctx:
     """Context with constants/defs only — evaluating anything that touches
     state raises, which is how we detect non-static constructs."""
     return Ctx(model.defs, {}, None, None, ())
+
+
+def split_arms(model: Model) -> List[ActionArm]:
+    """Decompose Next into its disjunct arms: operator expansion, \\/
+    splits, and static \\E instantiation — the same top structure
+    ground_actions walks, but stopping at conjunctions and at anything
+    non-static (those stay whole inside one arm). The concatenation of
+    ground_arm() over these arms equals ground_actions() on Next, in the
+    same order, so compiled-path labels and traces are unchanged."""
+    ctx = _static_ctx(model)
+    out: List[ActionArm] = []
+
+    def walk(e: A.Node, bound: Dict[str, Any], label) -> None:
+        if isinstance(e, A.OpApp) and e.name == "\\/":
+            for arm in e.args:
+                walk(arm, bound, label)
+            return
+        if isinstance(e, A.Quant) and e.kind == "E":
+            try:
+                bindings = list(iter_binders(
+                    e.binders, ctx.with_bound(bound), eval_expr))
+            except EvalError:
+                # dynamic domain: the whole \E is one arm (the grounder
+                # slot-expands it on the compiled path; the interpreter
+                # enumerates it natively on the fallback path)
+                out.append(ActionArm(label, e, dict(bound)))
+                return
+            for b in bindings:
+                walk(e.body, {**bound, **b}, label)
+            return
+        if isinstance(e, A.OpApp) and e.name not in _LEAF_OPS \
+                and not e.path and e.name not in bound:
+            d = model.defs.get(e.name)
+            if isinstance(d, OpClosure) and len(d.params) == len(e.args):
+                args = []
+                argable = True
+                for a in e.args:
+                    try:
+                        args.append(eval_expr(a, ctx.with_bound(bound)))
+                    except EvalError:
+                        argable = False
+                        break
+                if argable:
+                    nb = {**bound, **dict(zip(d.params, args))}
+                    walk(d.body, nb, _mk_label(e.name, args))
+                    return
+                # non-static args (assigns through params / reads state):
+                # one arm; both paths expand it themselves
+        if isinstance(e, A.Ident):
+            d = model.defs.get(e.name)
+            if isinstance(d, OpClosure) and not d.params \
+                    and e.name not in bound:
+                walk(d.body, bound, e.name)
+                return
+        out.append(ActionArm(label, e, dict(bound)))
+
+    walk(model.next, {}, None)
+    return out
+
+
+def ground_arm(model: Model, arm: ActionArm, max_actions: int = 4096,
+               dyn_slots: int = 0) -> List[GroundedAction]:
+    """Ground one arm (see split_arms); raises CompileError when the arm
+    holds constructs the grounder can't expand — the hybrid engine then
+    demotes that arm to interpreter enumeration."""
+    return _ground_expr(model, arm.expr, arm.bound, arm.label,
+                        max_actions, dyn_slots)
 
 
 def ground_actions(model: Model, max_actions: int = 4096,
@@ -47,6 +132,13 @@ def ground_actions(model: Model, max_actions: int = 4096,
     \\E m \\in ValidMessage(messages), raft.tla:449-478) into one instance
     per table slot; the kernel binds x to slot k's element guarded by the
     slot's membership mask."""
+    return _ground_expr(model, model.next, {}, None, max_actions,
+                        dyn_slots)
+
+
+def _ground_expr(model: Model, root: A.Node, root_bound: Dict[str, Any],
+                 root_label, max_actions: int,
+                 dyn_slots: int) -> List[GroundedAction]:
     ctx = _static_ctx(model)
 
     def static_eval(e, bound):
@@ -149,7 +241,7 @@ def ground_actions(model: Model, max_actions: int = 4096,
                 return walk2(d.body, bound, e.name)
         return [(label, [(e, dict(bound))])]
 
-    for label, items in walk2(model.next, {}, None):
+    for label, items in walk2(root, dict(root_bound), root_label):
         results.append(GroundedAction(label or "Next", items))
         if len(results) > max_actions:
             raise CompileError(f"more than {max_actions} grounded actions")

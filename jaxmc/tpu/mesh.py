@@ -133,7 +133,9 @@ class MeshExplorer(TpuExplorer):
             aflat = jnp.argmax(abad.reshape(-1))
             asrt_a = (aflat // FC).astype(jnp.int32)
             asrt_f = (aflat % FC).astype(jnp.int32)
-            overflow = jnp.any(ov & fvalid[None, :])
+            # ov is the int overflow code (kernel2.OV_*); any nonzero
+            # valid-row code aborts the mesh run
+            overflow = jnp.any(jnp.where(fvalid[None, :], ov, 0) != 0)
             dead = fvalid & ~jnp.any(en, axis=0)
             dead_local = jnp.any(dead)
             dead_slot = jnp.argmax(dead).astype(jnp.int32)
