@@ -342,6 +342,49 @@ def _coerce_lanes(src: VS, dst: VS, lanes, fr: Frame):
                 segs.append(_zeros(dst.width - w))
                 return _cat(segs)
         raise CompileError(f"record {names} not a variant of the union")
+    if sk in ("int", "bool", "enum") and dk == "union":
+        # scalar into a tagged union (buf[p] := NoVal alongside request
+        # records — the CachingMemory shape)
+        want = (f"$scalar:{sk}",)
+        for tag, (vnames, vfields) in enumerate(dst.variants):
+            if vnames == want:
+                return _cat([np.asarray([tag], np.int32),
+                             _as_seg(lanes, 1),
+                             _zeros(dst.width - 2)])
+        raise CompileError(f"scalar {sk} not a variant of the union")
+    if sk == "union" and dk == "union" and src != dst:
+        # re-tag into a superset union (a sub-union value constructed in
+        # an expression lands in the var's merged layout union)
+        smap = {names: (t, fields)
+                for t, (names, fields) in enumerate(src.variants)}
+        dmap = {names: (t, fields)
+                for t, (names, fields) in enumerate(dst.variants)}
+        for names in smap:
+            if names not in dmap:
+                raise CompileError(
+                    f"union variant {names} not in the target union")
+        tag_l = lanes[0]
+        acc_tag = None
+        acc_pay = None
+        for names, (stag, sfields) in smap.items():
+            dtag, dfields = dmap[names]
+            off = 1
+            segs = []
+            w = 0
+            for sf, df in zip(sfields, dfields):
+                segs.append(_coerce_lanes(
+                    sf, df, lanes[off:off + sf.width], fr))
+                off += sf.width
+                w += df.width
+            segs.append(_zeros(dst.width - 1 - w))
+            pay = _cat(segs)
+            cond = _eq_lane(tag_l, stag)
+            dt = np.asarray([dtag], np.int32)
+            acc_tag = dt if acc_tag is None else _select_lanes(
+                cond, dt, acc_tag)
+            acc_pay = pay if acc_pay is None else _select_lanes(
+                cond, pay, acc_pay)
+        return _cat([_as_seg(acc_tag, 1), acc_pay])
     if sk == "fcn" and dk == "pfcn":
         srcmap = {}
         off = 0

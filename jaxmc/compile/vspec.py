@@ -277,7 +277,20 @@ def merge(a: VS, b: VS) -> VS:
         s = a if a.kind == "set" else b
         elem = g.elem
         return VS("growset", cap=max(g.cap, len(s.dom)), elem=elem)
-    raise CompileError(f"cannot merge shapes {a.kind} and {b.kind}")
+    # scalar/record mixes become tagged unions with scalar variants
+    orig_kinds = (a.kind, b.kind)
+    if a.kind in _SCALARS:
+        a = _scalar_to_union(a)
+    if b.kind in _SCALARS:
+        b = _scalar_to_union(b)
+    if a.kind == "fcn" and _is_record(a):
+        a = _record_to_union(a)
+    if b.kind == "fcn" and _is_record(b):
+        b = _record_to_union(b)
+    if a.kind == b.kind == "union":
+        return _merge_unions(a, b)
+    raise CompileError(
+        f"cannot merge shapes {orig_kinds[0]} and {orig_kinds[1]}")
 
 
 def collect_enums_from_value(v, uni: EnumUniverse):
@@ -325,6 +338,23 @@ def _fcn_to_pfcn(f: VS) -> VS:
 
 def _record_to_union(f: VS) -> VS:
     return VS("union", variants=((tuple(f.dom), f.elems),))
+
+
+def _scalar_to_union(s: VS) -> VS:
+    """A scalar (enum/int/bool) as a one-variant union: variant name is
+    the reserved marker ("$scalar:<kind>",) so scalars of different
+    kinds coexist as distinct variants and never merge with record
+    variants (CachingMemory's buf[p] in MReq u Val u {NoVal},
+    /root/reference/examples/SpecifyingSystems/CachingMemory)."""
+    return VS("union", variants=(((f"$scalar:{s.kind}",), (s,)),))
+
+
+_SCALARS = ("int", "bool", "enum")
+
+
+def is_scalar_variant(names: Tuple) -> bool:
+    return len(names) == 1 and isinstance(names[0], str) and \
+        names[0].startswith("$scalar:")
 
 
 def _merge_unions(a: VS, b: VS) -> VS:
@@ -459,7 +489,18 @@ def encode(v, spec: VS, uni: EnumUniverse, out: List[int]):
         if extra:
             raise CompileError(f"pfcn key outside universe: {extra}")
     elif k == "union":
-        if not isinstance(v, Fcn) or not v.is_record():
+        if not isinstance(v, Fcn):
+            want = f"$scalar:{_scalar_kind(v)}"
+            for tag, (vnames, vfields) in enumerate(spec.variants):
+                if vnames == (want,):
+                    out.append(tag)
+                    n0 = len(out)
+                    encode(v, vfields[0], uni, out)
+                    out.extend([0] * (spec.width - 1 - (len(out) - n0)))
+                    return
+            raise CompileError(
+                f"scalar {fmt(v)} not a variant of the union")
+        if not v.is_record():
             raise CompileError(f"expected record, got {fmt(v)}")
         names = tuple(sorted(v.d.keys()))
         for tag, (vnames, vfields) in enumerate(spec.variants):
@@ -499,6 +540,16 @@ def encode(v, spec: VS, uni: EnumUniverse, out: List[int]):
 
 def _hk(k):
     return (type(k).__name__, k.name if isinstance(k, ModelValue) else k)
+
+
+def _scalar_kind(v) -> str:
+    if isinstance(v, bool):
+        return "bool"
+    if isinstance(v, int):
+        return "int"
+    if isinstance(v, (str, ModelValue)):
+        return "enum"
+    raise CompileError(f"not a scalar: {fmt(v)}")
 
 
 def decode(row, i: int, spec: VS, uni: EnumUniverse):
@@ -559,6 +610,9 @@ def decode(row, i: int, spec: VS, uni: EnumUniverse):
         tag = int(row[i])
         i += 1
         names, fields = spec.variants[tag]
+        if is_scalar_variant(names):
+            v, _ = decode(row, i, fields[0], uni)
+            return v, i + spec.width - 1
         d = {}
         j = i
         for nm, fs in zip(names, fields):

@@ -99,10 +99,20 @@ class MeshExplorer(TpuExplorer):
         return max(1, math.ceil(C * self._a2a_gamma / self.D),
                    math.ceil(FC / self.D))
 
-    def _get_mesh_step(self, SC: int, FC: int) -> Callable:
+    def _get_mesh_step(self, SC: int, FC: int,
+                       out_cap: Optional[int] = None) -> Callable:
+        """out_cap=None: the single-controller step (MeshExplorer.run —
+        the host compacts/resizes the frontier between levels). out_cap
+        set: the MULTI-HOST variant (tpu/multihost.py): the new frontier
+        is cropped on device to a fixed [out_cap] shard so the host never
+        needs non-addressable remote rows, and three extra REPLICATED
+        flags (psum'd over the DCN+ICI axis) are appended to the outputs:
+        any_inv (any device saw an invariant violation), fixed_ovf (a
+        frontier/seen shard outgrew its fixed capacity, incl. a2a bucket
+        overflow), any_dead, any_assert."""
         a2a = self.exchange == "a2a"
         B = self._a2a_bucket(self.A * FC, FC) if a2a else 0
-        key = (SC, FC, B)
+        key = (SC, FC, B, out_cap)
         if key in self._mesh_step_cache:
             return self._mesh_step_cache[key]
         A, W, K, D = self.A, self.W, self.K, self.D
@@ -279,6 +289,29 @@ class MeshExplorer(TpuExplorer):
             tot_front = lax.psum(front_count, "d")
 
             any_a2a_ovf = lax.psum(a2a_ovf.astype(jnp.int32), "d") > 0
+            if out_cap is not None:
+                # multi-host: fixed-capacity frontier shard + replicated
+                # abort flags — the host loop reads ONLY replicated
+                # scalars and its own addressable shards. a2a bucket
+                # overflow folds into the fixed-capacity abort (the
+                # multi-host loop cannot re-run a level, so it aborts
+                # loudly instead of retrying with a larger gamma).
+                fixed_ovf = lax.psum(
+                    ((front_count > out_cap) | (seen_count2 > SC) |
+                     a2a_ovf).astype(jnp.int32), "d") > 0
+                any_inv = lax.psum(
+                    (inv_which != _BIG).astype(jnp.int32), "d") > 0
+                any_dead = lax.psum(
+                    dead_local.astype(jnp.int32), "d") > 0
+                any_assert = lax.psum(
+                    assert_bad.astype(jnp.int32), "d") > 0
+                return (seen2.reshape(1, SC, K), seen_count2.reshape(1),
+                        front_rows[:out_cap].reshape(1, out_cap, W),
+                        front_count.reshape(1),
+                        tot_gen.reshape(1), tot_new.reshape(1),
+                        any_ovf.reshape(1), tot_front.reshape(1),
+                        fixed_ovf.reshape(1), any_inv.reshape(1),
+                        any_dead.reshape(1), any_assert.reshape(1))
             return (seen2.reshape(1, SC, K), seen_count2.reshape(1),
                     front_rows.reshape(1, R, W), front_count.reshape(1),
                     front_src.reshape(1, R),
@@ -293,12 +326,42 @@ class MeshExplorer(TpuExplorer):
             from jax import shard_map
         except ImportError:  # older jax
             from jax.experimental.shard_map import shard_map
+        n_out = 12 if out_cap is not None else 17
         step = jax.jit(shard_map(
             device_step, mesh=self.mesh,
             in_specs=(P("d"), P("d"), P("d")),
-            out_specs=tuple([P("d")] * 17)))
+            out_specs=tuple([P("d")] * n_out)))
         self._mesh_step_cache[key] = step
         return step
+
+    def _init_shards(self, init_rows: np.ndarray, explored_idx,
+                     D: int, SC: int, FC: int):
+        """Host-side initial shard construction shared by the
+        single-controller run() and the multi-host loop
+        (tpu/multihost.py): per-owner frontier fill and lexsorted seen
+        keys with the validity-lane-1 empty-slot convention. One layout
+        rule, so host and device dedup can never diverge. Returns
+        (seen [D,SC,K], frontier [D,FC,W], fcount [D]) as numpy."""
+        W, K = self.W, self.K
+        owner = self._owner_of(init_rows)
+        exp = np.zeros(len(init_rows), bool)
+        exp[np.asarray(explored_idx, int)] = True
+        frontier = np.full((D, FC, W), SENTINEL, np.int32)
+        seen = np.full((D, SC, K), SENTINEL, np.int32)
+        seen[:, :, 0] = 1  # empty slots: validity lane 1
+        fcount = np.zeros((D,), np.int32)
+        for d in range(D):
+            p = init_rows[(owner == d) & exp]
+            frontier[d, :len(p)] = p
+            sp = init_rows[owner == d]
+            if len(sp):
+                k = np.asarray(self._keys_of(
+                    jnp.asarray(sp), jnp.ones(len(sp), bool)))
+                order = np.lexsort(tuple(k[:, i]
+                                         for i in reversed(range(K))))
+                seen[d, :len(sp)] = k[order]
+            fcount[d] = len(p)
+        return seen, frontier, fcount
 
     def _owner_of(self, rows: np.ndarray) -> np.ndarray:
         """Host-side owner routing — the SAME fingerprint the device keys
@@ -420,33 +483,19 @@ class MeshExplorer(TpuExplorer):
             owner = self._owner_of(init_rows)
             per_dev = [init_rows[(owner == d) & explored_mask]
                        for d in range(D)]
-            seen_per_dev = [init_rows[owner == d] for d in range(D)]
             FC = _pow2_at_least(
                 max(max((len(p) for p in per_dev), default=1), 1), lo=64)
             SC = _pow2_at_least(4 * FC, lo=256)
-
-            frontier = np.full((D, FC, W), SENTINEL, np.int32)
-            seen = np.full((D, SC, K), SENTINEL, np.int32)
-            seen[:, :, 0] = 1  # empty slots: validity lane 1
-            fcount = np.zeros((D,), np.int32)
-            for d in range(D):
-                p = per_dev[d]
-                frontier[d, :len(p)] = p
-                sp = seen_per_dev[d]
-                if len(sp):
-                    k = np.asarray(self._keys_of(
-                        jnp.asarray(sp), jnp.ones(len(sp), bool)))
-                    order = np.lexsort(tuple(k[:, i]
-                                             for i in reversed(range(K))))
-                    seen[d, :len(sp)] = k[order]
-                fcount[d] = len(p)
+            explored_idx = np.nonzero(explored_mask)[0]
+            seen, frontier, fcount = self._init_shards(
+                init_rows, explored_idx, D, SC, FC)
             if self.store_trace:
                 self._levels.append((frontier.copy(), None, FC))
             frontier = jnp.asarray(frontier)
             seen = jnp.asarray(seen)
             fcount = jnp.asarray(fcount)
-            seen_counts = np.array([len(p) for p in seen_per_dev],
-                                   np.int64)
+            seen_counts = np.array([int((owner == d).sum())
+                                    for d in range(D)], np.int64)
             depth = 0
 
         last_progress = last_ck = time.time()
