@@ -277,17 +277,27 @@ def merge(a: VS, b: VS) -> VS:
         s = a if a.kind == "set" else b
         elem = g.elem
         return VS("growset", cap=max(g.cap, len(s.dom)), elem=elem)
-    # scalar/record mixes become tagged unions with scalar variants
+    # scalar/RECORD mixes become tagged unions with scalar variants
+    # (CachingMemory's buf[p]). Scalar/scalar mixes (int vs enum) still
+    # RAISE: the heterogeneous-tuple inference (<<bit, data>> pairs,
+    # AlternatingBit) depends on that failure to pick the int-keyed
+    # record layout instead.
     orig_kinds = (a.kind, b.kind)
-    if a.kind in _SCALARS:
-        a = _scalar_to_union(a)
-    if b.kind in _SCALARS:
-        b = _scalar_to_union(b)
-    if a.kind == "fcn" and _is_record(a):
-        a = _record_to_union(a)
-    if b.kind == "fcn" and _is_record(b):
-        b = _record_to_union(b)
-    if a.kind == b.kind == "union":
+
+    def _unionable(x):
+        return (x.kind == "union" or
+                (x.kind == "fcn" and _is_record(x)))
+
+    if (a.kind in _SCALARS and _unionable(b)) or \
+            (b.kind in _SCALARS and _unionable(a)):
+        if a.kind in _SCALARS:
+            a = _scalar_to_union(a)
+        if b.kind in _SCALARS:
+            b = _scalar_to_union(b)
+        if a.kind == "fcn":
+            a = _record_to_union(a)
+        if b.kind == "fcn":
+            b = _record_to_union(b)
         return _merge_unions(a, b)
     raise CompileError(
         f"cannot merge shapes {orig_kinds[0]} and {orig_kinds[1]}")

@@ -533,14 +533,17 @@ class TpuExplorer:
                     return a, f, sst, rc
         return None
 
-    def _refine_violation(self, rc, sst, a, trace):
+    def _refine_msg(self, rc) -> str:
         msg = (f"step is not a [{rc.name}-Next]_v step of the refined "
                f"specification")
         if rc.last_error:
             msg += f"; while evaluating the property: {rc.last_error}"
+        return msg
+
+    def _refine_violation(self, rc, sst, a, trace):
         trace = [x for x in trace if x[0] is not None]
         trace.append((sst, self.labels_flat[a]))
-        return Violation("property", rc.name, trace, msg)
+        return Violation("property", rc.name, trace, self._refine_msg(rc))
 
     def _symmetry_warnings(self) -> List[str]:
         if self.model.symmetry is None or self.canon_fn is not None:

@@ -30,8 +30,10 @@ Parity features (VERDICT r2 #5):
 
 The driver validates this path with N virtual CPU devices via
 __graft_entry__.dryrun_multichip (no multi-chip hardware needed) on the
-raft workload. Refinement and temporal PROPERTYs remain single-chip
-features — the mesh reports their absence in warnings.
+raft workload. Refinement and temporal PROPERTYs check on the mesh too
+(r4): the exchanged-candidate stream feeds the same host-side stepwise
+refinement and behavior-graph liveness checkers as the single-chip
+device modes (store_trace required; resume with PROPERTYs is rejected).
 """
 
 from __future__ import annotations
@@ -47,7 +49,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..sem.modules import Model
 from ..engine.explore import CheckResult, Violation
-from .bfs import (SENTINEL, TpuExplorer, _pow2_at_least,
+from ..compile.vspec import ModeError
+from .bfs import (SENTINEL, TpuExplorer, _LiveGraph, _pow2_at_least,
                   filter_init_states, fingerprint128)
 
 _BIG = np.int32(2 ** 31 - 1)
@@ -120,6 +123,12 @@ class MeshExplorer(TpuExplorer):
         con_fns = self.constraint_fns
         keys_of = self._keys_of
         expand = self._expand_fn()
+        # refinement/temporal PROPERTYs: stream every exchanged
+        # candidate (revisits included) to the host, which runs the SAME
+        # stepwise refinement and behavior-graph checkers as the
+        # single-chip device modes (r4; closes VERDICT r3 #9)
+        need_edges = (out_cap is None and
+                      (bool(self.refiners) or self.collect_edges))
         C = A * FC
         # R: rows each device holds after the exchange. gather: every
         # candidate from every device (D*C); a2a: my bucket from each
@@ -312,21 +321,34 @@ class MeshExplorer(TpuExplorer):
                         any_ovf.reshape(1), tot_front.reshape(1),
                         fixed_ovf.reshape(1), any_inv.reshape(1),
                         any_dead.reshape(1), any_assert.reshape(1))
-            return (seen2.reshape(1, SC, K), seen_count2.reshape(1),
-                    front_rows.reshape(1, R, W), front_count.reshape(1),
-                    front_src.reshape(1, R),
-                    tot_gen.reshape(1), tot_new.reshape(1),
-                    dead_local.reshape(1), dead_slot.reshape(1),
-                    assert_bad.reshape(1), asrt_a.reshape(1),
-                    asrt_f.reshape(1), any_ovf.reshape(1),
-                    inv_which.reshape(1), inv_slot.reshape(1),
-                    tot_front.reshape(1), any_a2a_ovf.reshape(1))
+            out = (seen2.reshape(1, SC, K), seen_count2.reshape(1),
+                   front_rows.reshape(1, R, W), front_count.reshape(1),
+                   front_src.reshape(1, R),
+                   tot_gen.reshape(1), tot_new.reshape(1),
+                   dead_local.reshape(1), dead_slot.reshape(1),
+                   assert_bad.reshape(1), asrt_a.reshape(1),
+                   asrt_f.reshape(1), any_ovf.reshape(1),
+                   inv_which.reshape(1), inv_slot.reshape(1),
+                   tot_front.reshape(1), any_a2a_ovf.reshape(1))
+            if need_edges:
+                # every exchanged candidate row + its explore mask +
+                # global source index — the host-side edge stream.
+                # gather mode: identical on every device (host reads
+                # device 0); a2a: each device holds its own bucket.
+                exp_all = gvalid
+                for nm, f in con_fns:
+                    exp_all = exp_all & jax.vmap(f)(gcand)
+                out = out + (gcand.reshape(1, R, W),
+                             exp_all.reshape(1, R),
+                             gsrc.reshape(1, R))
+            return out
 
         try:
             from jax import shard_map
         except ImportError:  # older jax
             from jax.experimental.shard_map import shard_map
-        n_out = 12 if out_cap is not None else 17
+        n_out = 12 if out_cap is not None else \
+            (20 if need_edges else 17)
         step = jax.jit(shard_map(
             device_step, mesh=self.mesh,
             in_specs=(P("d"), P("d"), P("d")),
@@ -403,6 +425,41 @@ class MeshExplorer(TpuExplorer):
             out.append(extra)
         return out
 
+    def _mesh_refine_edges(self, frontier_np, ecand, eexp, esrc,
+                           FC, depth):
+        """Stepwise refinement over this level's explored candidate
+        edges — the host runs the SAME checkers as the single-chip
+        modes, with parents resolved through the global source index
+        (g -> source device, action, frontier slot)."""
+        C = self.A * FC
+        idxs = np.nonzero(eexp)[0]
+        if not len(idxs):
+            return None
+        parents: Dict[Tuple[int, int], dict] = {}
+        if len(self._ref_pair_cache) > (1 << 20):
+            self._ref_pair_cache.clear()
+        for c in idxs:
+            g = int(esrc[c])
+            d_src, cc = g // C, g % C
+            a, f = cc // FC, cc % FC
+            key = (frontier_np[d_src, f].tobytes(), ecand[c].tobytes())
+            if key in self._ref_pair_cache:
+                continue
+            self._ref_pair_cache.add(key)
+            pst = parents.get((d_src, f))
+            if pst is None:
+                pst = self.layout.decode(frontier_np[d_src, f])
+                parents[(d_src, f)] = pst
+            sst = self.layout.decode(ecand[c])
+            for rc in self.refiners:
+                if not rc.check_edge(pst, sst):
+                    trace = self._mesh_trace_to(
+                        d_src, f, depth,
+                        extra=(sst, self.labels_flat[a]))
+                    return self._viol("property", rc.name, trace,
+                                      self._refine_msg(rc))
+        return None
+
     def _viol(self, kind, name, trace, msg=None):
         if trace is None:
             note = (f"{kind} found (mesh traces disabled by "
@@ -429,17 +486,19 @@ class MeshExplorer(TpuExplorer):
         warnings = ["mesh backend: dedup on 128-bit fingerprints; "
                     "collision probability < n^2 * 2^-129"]
         warnings.extend(self._temporal_warnings())
-        if self.live_obligations:
-            warnings.append(
-                "temporal properties NOT checked on the mesh backend "
-                "(single-chip --backend jax checks them): "
-                + ", ".join(sorted({ob.prop_name
-                                    for ob in self.live_obligations})))
-        if self.refiners:
-            warnings.append(
-                "refinement properties NOT checked on the mesh backend "
-                "(single-chip --backend jax checks them): "
-                + ", ".join(rc.name for rc in self.refiners))
+        # the edge stream feeds refiners and non-[]P liveness; []P-only
+        # obligations still need the behavior-graph STATES (per-level
+        # kept rows), so the mode guards key on the wider condition
+        need_edges = bool(self.refiners) or self.collect_edges
+        need_props = bool(self.refiners) or bool(self.live_obligations)
+        if need_props and not self.store_trace:
+            raise ModeError(
+                "mesh refinement/temporal checking needs the per-level "
+                "row stream: run with store_trace=True (default)")
+        if need_props and self.resume_from:
+            raise ModeError(
+                "mesh resume with refinement/temporal PROPERTYs is not "
+                "supported - use the single-chip device modes")
         warnings.extend(self._symmetry_warnings())
 
         init_rows, explored_init, n_init, err = \
@@ -453,6 +512,8 @@ class MeshExplorer(TpuExplorer):
 
         self._levels: List[Tuple[np.ndarray, Optional[np.ndarray], int]] \
             = []
+        graph = None   # behavior graph (temporal PROPERTYs)
+        fsids = None   # flat (d*FC + slot) -> graph state id
 
         if self.resume_from:
             ck = self._load_ck("mesh")
@@ -489,6 +550,15 @@ class MeshExplorer(TpuExplorer):
             explored_idx = np.nonzero(explored_mask)[0]
             seen, frontier, fcount = self._init_shards(
                 init_rows, explored_idx, D, SC, FC)
+            if self.live_obligations:
+                graph = _LiveGraph(self.labels_flat, self.collect_edges)
+                graph.add_inits(init_rows, explored_idx)
+                # (d, slot) -> behavior-graph state id, flat [D*FC]
+                fsids = np.full(D * FC, -1, np.int64)
+                for d in range(D):
+                    for i in range(int(fcount[d])):
+                        fsids[d * FC + i] = graph.sid_by_key[
+                            frontier[d, i].tobytes()]
             if self.store_trace:
                 self._levels.append((frontier.copy(), None, FC))
             frontier = jnp.asarray(frontier)
@@ -511,10 +581,11 @@ class MeshExplorer(TpuExplorer):
             expanding_FC = FC
             while True:
                 step = self._get_mesh_step(SC, FC)
+                outs = step(seen, frontier, fcount)
                 (seen2_, seen_cnt, front_rows, front_cnt, front_src,
                  tot_gen, tot_new, dead_local, dead_slot, assert_local,
                  asrt_a, asrt_f, any_ovf, inv_which, inv_slot,
-                 tot_front, a2a_ovf) = step(seen, frontier, fcount)
+                 tot_front, a2a_ovf) = outs[:17]
                 if self.exchange == "a2a" and \
                         bool(np.asarray(a2a_ovf)[0]):
                     # hash skew exceeded the per-peer bucket: rerun the
@@ -553,6 +624,28 @@ class MeshExplorer(TpuExplorer):
                     self._viol("assert", "Assert", trace,
                                f"assertion in {self.labels_flat[aa]}"))
 
+            ecand = eexp = esrc = None
+            if need_edges:
+                # the exchanged candidate stream (revisits included):
+                # gather mode replicates it on every device (read device
+                # 0); a2a routes disjoint buckets (concatenate all)
+                if self.exchange == "a2a":
+                    ecand = np.asarray(outs[17]).reshape(-1, W)
+                    eexp = np.asarray(outs[18]).reshape(-1)
+                    esrc = np.asarray(outs[19]).reshape(-1)
+                else:
+                    ecand = np.asarray(outs[17][0])
+                    eexp = np.asarray(outs[18][0])
+                    esrc = np.asarray(outs[19][0])
+                if self.refiners:
+                    fr_np = np.asarray(frontier)
+                    rv = self._mesh_refine_edges(fr_np, ecand, eexp,
+                                                 esrc, expanding_FC,
+                                                 depth)
+                    if rv is not None:
+                        return self._mk(False, distinct, generated,
+                                        depth, t0, warnings, rv)
+
             generated += int(np.asarray(tot_gen)[0])
             distinct += int(np.asarray(tot_new)[0])
             seen_counts = np.asarray(seen_cnt).astype(np.int64)
@@ -564,7 +657,7 @@ class MeshExplorer(TpuExplorer):
             iw = np.asarray(inv_which)
             which = int(iw.min())
             need_host_rows = (self.store_trace or max_front > FC or
-                              which != _BIG)
+                              which != _BIG or graph is not None)
             front_rows_np = np.asarray(front_rows) if need_host_rows \
                 else None
             if self.store_trace:
@@ -575,6 +668,46 @@ class MeshExplorer(TpuExplorer):
                 self._levels.append(
                     (front_rows_np[:, :keep],
                      np.asarray(front_src)[:, :keep], expanding_FC))
+
+            sids_per_dev = None
+            if graph is not None:
+                # behavior-graph bookkeeping: kept new rows register with
+                # provenance a*(D*FCprev) + (d_src*FCprev + f) so
+                # labels_flat and the flat parent-sid table resolve them;
+                # then every explored candidate edge (revisits included)
+                front_src_np = np.asarray(front_src)
+                fcnt_np = np.asarray(front_cnt)
+                Cprev = self.A * expanding_FC
+                flat_rows, flat_prov, row_counts = [], [], []
+                for d in range(D):
+                    n = int(fcnt_np[d])
+                    row_counts.append(n)
+                    for i in range(n):
+                        g = int(front_src_np[d, i])
+                        d_src, cc = g // Cprev, g % Cprev
+                        a, f = cc // expanding_FC, cc % expanding_FC
+                        flat_rows.append(front_rows_np[d, i])
+                        flat_prov.append(
+                            a * (D * expanding_FC)
+                            + d_src * expanding_FC + f)
+                new_sids = graph.add_level(
+                    np.asarray(flat_rows) if flat_rows
+                    else np.zeros((0, W), np.int32),
+                    np.asarray(flat_prov, np.int64),
+                    D * expanding_FC, fsids)
+                if graph.collect_edges and ecand is not None:
+                    eidx = np.nonzero(eexp)[0]
+                    epar = np.empty(len(eidx), np.int64)
+                    for k, c in enumerate(eidx):
+                        g = int(esrc[c])
+                        d_src, cc = g // Cprev, g % Cprev
+                        epar[k] = d_src * expanding_FC + cc % expanding_FC
+                    graph.add_edges(ecand[eidx], epar, fsids)
+                sids_per_dev = []
+                off = 0
+                for d in range(D):
+                    sids_per_dev.append(new_sids[off:off + row_counts[d]])
+                    off += row_counts[d]
 
             if which != _BIG:
                 nm = self.inv_fns[which][0]
@@ -597,6 +730,13 @@ class MeshExplorer(TpuExplorer):
                 frontier = jnp.asarray(nf)
             else:
                 frontier = front_rows[:, :FC]
+            if graph is not None:
+                # flat sid table for the NEXT level's frontier slots
+                # (kept-row order is preserved by the compactions above)
+                fsids = np.full(D * FC, -1, np.int64)
+                for d in range(D):
+                    for i, sid in enumerate(sids_per_dev[d]):
+                        fsids[d * FC + i] = sid
 
             if self.max_states and distinct >= self.max_states:
                 # a truncation point IS a level boundary: leave a
@@ -620,6 +760,11 @@ class MeshExplorer(TpuExplorer):
                 self._mesh_ck(seen, seen_counts, frontier, fcount, FC,
                               SC, depth, generated, distinct)
 
+        if graph is not None:
+            viol = self._check_live(graph, warnings)
+            if viol is not None:
+                return self._mk(False, distinct, generated, depth - 1,
+                                t0, warnings, viol)
         self.log("Model checking completed. No error has been found.")
         self.log(f"{generated} states generated, {distinct} distinct "
                  f"states found, 0 states left on queue.")

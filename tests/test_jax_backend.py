@@ -751,3 +751,53 @@ def test_multihost_dcn_dryrun():
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     mod.dryrun_multihost(num_processes=2, local_devices=4)
+
+
+class TestMeshRefinementTemporal:
+    """Refinement + temporal PROPERTYs on the MESH backend (VERDICT r3
+    #9): the host runs the same stepwise/behavior-graph checkers over
+    the streamed exchanged-candidate edges; verdicts match interp."""
+
+    def test_mesh_hourclock2_refinement_checked(self):
+        from jaxmc.tpu.mesh import MeshExplorer
+        d = os.path.join(REFERENCE, "examples/SpecifyingSystems/HourClock")
+        cfg = parse_cfg(open(os.path.join(d, "HourClock2.cfg")).read())
+        model = load(os.path.join(d, "HourClock2.tla"), cfg)
+        r = MeshExplorer(model).run()
+        assert r.ok and r.distinct == 12 and r.generated == 24
+        assert not any("NOT checked" in w for w in r.warnings), r.warnings
+
+    def test_mesh_non_refinement_detected(self, tmp_path):
+        from jaxmc.tpu.mesh import MeshExplorer
+        spec = tmp_path / "badhc.tla"
+        spec.write_text("""---- MODULE badhc ----
+EXTENDS Naturals
+VARIABLE hr
+HCini == hr \\in 1..12
+HCnxt == hr' = IF hr >= 11 THEN 1 ELSE hr + 2
+HC == HCini /\\ [][HCnxt]_hr
+Jump == hr' = IF hr = 12 THEN 1 ELSE hr + 1
+JumpSpec == HCini /\\ [][Jump]_hr
+====
+""")
+        cfg = ModelConfig(specification="HC", properties=["JumpSpec"],
+                          check_deadlock=False)
+        model = load(str(spec), cfg)
+        r = MeshExplorer(model).run()
+        assert not r.ok
+        assert r.violation.kind == "property"
+        assert r.violation.name == "JumpSpec"
+        assert len(r.violation.trace) >= 2
+
+    @pytest.mark.slow
+    def test_mesh_alternating_bit_liveness_checked(self):
+        # SentLeadsToRcvd (under ABSpec fairness) + ABCSpec refinement
+        # verified over the mesh's streamed behavior graph — the exact
+        # deliverable model of VERDICT r3 #9
+        from jaxmc.tpu.mesh import MeshExplorer
+        d = os.path.join(REFERENCE, "examples/SpecifyingSystems/TLC")
+        cfg = parse_cfg(open(os.path.join(d, "MCAlternatingBit.cfg")).read())
+        model = load(os.path.join(d, "MCAlternatingBit.tla"), cfg)
+        r = MeshExplorer(model).run()
+        assert r.ok and r.distinct == 240 and r.generated == 1392
+        assert not any("NOT checked" in w for w in r.warnings), r.warnings
