@@ -112,6 +112,7 @@ def cmd_check(args) -> int:
                               progress_every=args.progress_every,
                               host_seen=args.host_seen, chunk=args.chunk,
                               resident=args.resident,
+                              sample_cfg=tuple(args.sample),
                               checkpoint_path=args.checkpoint,
                               checkpoint_every=args.checkpoint_every,
                               resume_from=args.resume,
@@ -220,6 +221,15 @@ def main(argv=None) -> int:
                    help="jax backend: keep the seen-set in the native C++ "
                         "fingerprint store (state spaces beyond device "
                         "memory; usually faster)")
+    c.add_argument("--sample", type=int, nargs=3,
+                   default=[800, 40, 60],
+                   metavar=("BFS", "WALKS", "DEPTH"),
+                   help="jax backend: layout-sampling effort (BFS-prefix "
+                        "states, random walks, walk depth). Deep models "
+                        "need more walks/depth so every container shape "
+                        "and record variant is OBSERVED - an unobserved "
+                        "variant demotes its reader kernels to the "
+                        "interpreter (hybrid) or aborts")
     c.add_argument("--chunk", type=int, default=2048,
                    help="jax backend: frontier rows expanded per kernel "
                         "call (bounds device memory; host-seen mode)")

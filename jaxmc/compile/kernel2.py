@@ -167,10 +167,10 @@ class KernelCtx:
 class Frame:
     """Per-expression evaluation frame."""
     __slots__ = ("kc", "bound", "state", "primes", "overflow", "strict",
-                 "guard", "demo")
+                 "guard", "demo", "memo")
 
     def __init__(self, kc: KernelCtx, bound, state, primes, overflow,
-                 strict=False, guard=True, demo=None):
+                 strict=False, guard=True, demo=None, memo=None):
         self.kc = kc
         self.bound = bound      # name -> SymV | static python value
         self.state = state      # var -> SymV
@@ -188,16 +188,22 @@ class Frame:
         # by demoting the arm to the interpreter — land here, separate
         # from genuine capacity overflows (see flag_demoted)
         self.demo = demo
+        # STRICT-frame symbolic-value memo (sym_eval2): predicates carry
+        # no overflow flags (they raise instead) and guard never affects
+        # VALUES, so identical (expr, relevant-bound) subterms can share
+        # one traced result — this collapses exponential unrolls
+        # (MCVoting's mutually recursive VotesSafeAt) into a DAG
+        self.memo = memo
 
     def with_bound(self, extra):
         return Frame(self.kc, {**self.bound, **extra}, self.state,
                      self.primes, self.overflow, self.strict, self.guard,
-                     self.demo)
+                     self.demo, self.memo)
 
     def with_guard(self, g):
         return Frame(self.kc, self.bound, self.state, self.primes,
                      self.overflow, self.strict, _land(self.guard, g),
-                     self.demo)
+                     self.demo, self.memo)
 
     def flag_overflow(self, cond):
         """A genuine capacity/spec overflow: a value outgrew its lanes
@@ -1263,7 +1269,70 @@ class Elems:
         self.items = items  # list of (guard, SymV | static)
 
 
+_IDENT_NAMES_CACHE: Dict[int, Tuple[Any, frozenset]] = {}
+
+
+def _ident_names(e) -> frozenset:
+    """Every name under e that a symbolic evaluation may look up in
+    fr.bound: Ident names, OpApp operator names (LET-bound operators
+    resolve through bound), and "@" for EXCEPT's A.At. A cheap
+    over-approximation of the free variables, memoized by node identity
+    — the node object is pinned in the cache value so ids cannot be
+    recycled. The cache is size-capped: a long-lived process sweeping
+    many models must not pin every AST it ever compiled."""
+    hit = _IDENT_NAMES_CACHE.get(id(e))
+    if hit is not None and hit[0] is e:
+        return hit[1]
+    out = set()
+
+    def walk(x):
+        if isinstance(x, A.Ident):
+            out.add(x.name)
+        elif isinstance(x, A.OpApp):
+            out.add(x.name)
+        elif isinstance(x, A.At):
+            out.add("@")
+        if isinstance(x, A.Node):
+            for fname in getattr(x, "__dataclass_fields__", {}):
+                walk(getattr(x, fname))
+        elif isinstance(x, (tuple, list)):
+            for y in x:
+                walk(y)
+
+    walk(e)
+    ns = frozenset(out)
+    if len(_IDENT_NAMES_CACHE) > 400_000:
+        _IDENT_NAMES_CACHE.clear()
+    _IDENT_NAMES_CACHE[id(e)] = (e, ns)
+    return ns
+
+
+_MEMO_TYPES = (A.OpApp, A.Quant, A.Let, A.If, A.Choose, A.Dot,
+               A.FnApp, A.SetFilter, A.SetMap)
+_MISS = object()
+
+
 def sym_eval2(e: A.Node, fr: Frame):
+    memo = fr.memo
+    if memo is not None and isinstance(e, _MEMO_TYPES):
+        names = _ident_names(e)
+        bound = fr.bound
+        rel = tuple(sorted((n, id(bound[n]))
+                           for n in names if n in bound))
+        key = (id(e), rel)
+        hit = memo.get(key, _MISS)
+        if hit is not _MISS:
+            return hit[1]
+        r = _sym_eval2_inner(e, fr)
+        # the entry PINS the bound values: their ids appear in the key,
+        # so they must stay alive as long as the entry does (CPython id
+        # recycling would otherwise alias a later binding to this one)
+        memo[key] = (tuple(bound[n] for n in names if n in bound), r)
+        return r
+    return _sym_eval2_inner(e, fr)
+
+
+def _sym_eval2_inner(e: A.Node, fr: Frame):
     t = type(e)
     kc = fr.kc
     if t is A.Num:
@@ -2435,7 +2504,7 @@ def compile_predicate2(kc: KernelCtx, expr: A.Node) -> Callable:
             sp = layout.specs[v]
             state[v] = SymV(sp, row[off:off + sp.width])
             off += sp.width
-        fr = Frame(kc, {}, state, {}, [False], strict=True)
+        fr = Frame(kc, {}, state, {}, [False], strict=True, memo={})
         r = as_bool(sym_eval2(expr, fr), fr)
         return r if _is_traced(r) else jnp.asarray(bool(r))
 

@@ -88,7 +88,7 @@ CASES: List[Case] = [
     Case("examples/Paxos/MCConsensus.tla", distinct=4, generated=7,
          no_deadlock=True, jax="yes"),
     Case("examples/Paxos/MCVoting.tla", distinct=77, generated=406,
-         no_deadlock=True),
+         no_deadlock=True, jax="yes"),
     Case("examples/Paxos/MCPaxos.tla", distinct=25, generated=82,
          jax="yes"),
     # -- Specifying Systems chapters
