@@ -1309,12 +1309,18 @@ def _ident_names(e) -> frozenset:
 
 _MEMO_TYPES = (A.OpApp, A.Quant, A.Let, A.If, A.Choose, A.Dot,
                A.FnApp, A.SetFilter, A.SetMap)
+_CASE_CHAIN_CACHE: Dict[int, Tuple[Any, Any]] = {}
 _MISS = object()
 
 
 def sym_eval2(e: A.Node, fr: Frame):
     memo = fr.memo
-    if memo is not None and isinstance(e, _MEMO_TYPES):
+    # memoize only under a statically-True guard: in strict frames a
+    # statically-False guard SUPPRESSES the CompileError that
+    # flag_overflow/flag_demoted would raise, so a recovery value cached
+    # in a guarded-out context must never replay into a live one
+    if memo is not None and fr.guard is True \
+            and isinstance(e, _MEMO_TYPES):
         names = _ident_names(e)
         bound = fr.bound
         rel = tuple(sorted((n, id(bound[n]))
@@ -1401,12 +1407,21 @@ def _sym_eval2_inner(e: A.Node, fr: Frame):
             return a
         return _merge_values(c, a, b, fr)
     if t is A.Case:
-        node = None
-        for g, b in reversed(e.arms):
-            if node is None:
-                node = A.If(g, b, e.other) if e.other is not None else b
-            else:
-                node = A.If(g, b, node)
+        # cache the If-chain rewrite per Case node: fresh allocations on
+        # every evaluation would defeat the memo (new ids each time) and
+        # churn _IDENT_NAMES_CACHE with one-shot pinned entries
+        hit = _CASE_CHAIN_CACHE.get(id(e))
+        if hit is not None and hit[0] is e:
+            node = hit[1]
+        else:
+            node = None
+            for g, b in reversed(e.arms):
+                if node is None:
+                    node = A.If(g, b, e.other) if e.other is not None \
+                        else b
+                else:
+                    node = A.If(g, b, node)
+            _CASE_CHAIN_CACHE[id(e)] = (e, node)
         return sym_eval2(node, fr)
     if t is A.TupleExpr:
         items = [sym_eval2(x, fr) for x in e.items]

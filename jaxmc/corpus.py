@@ -150,7 +150,7 @@ CASES: List[Case] = [
     # (textbookSnapshotIsolation.tla:91-96; VERDICT r2 weak #3)
     Case("specs/MCtextbookSI.tla", root="repo",
          cfg="specs/MCtextbookSI_skew_fast.cfg", includes=("examples",),
-         expect="violation:invariant"),
+         expect="violation:invariant", jax="yes"),
     # SSI at its documented envelope floor (2 keys x 3 txns, seeded):
     # serializability HOLDS while write skew is attempted and aborted
     Case("specs/MCserializableSI.tla", root="repo",

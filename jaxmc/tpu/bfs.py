@@ -316,45 +316,40 @@ class TpuExplorer:
         # 900 s case timeout; the exact interpreter checks such
         # predicates on new rows at negligible cost instead.
         budget = float(os.environ.get("JAXMC_PRED_TRACE_BUDGET", "15"))
-        self.inv_fns = []
-        self.fb_invs: List[Tuple[str, Any, str]] = []  # (name, ast, why)
-        for nm, ex in model.invariants:
-            f = compile_predicate2(self.kc, ex)
-            t_tr = time.time()
-            try:
-                jax.eval_shape(f, row_spec)
-            except CompileError as e:
-                self.fb_invs.append((nm, ex, str(e)))
-                continue
-            t_tr = time.time() - t_tr
-            if t_tr > budget and host_seen:
-                # only host_seen can absorb the demotion (hybrid); other
-                # modes keep the slow compiled predicate rather than
-                # refusing to run on a slow box
-                self.fb_invs.append(
-                    (nm, ex, f"trace budget exceeded ({t_tr:.0f}s > "
-                             f"{budget:.0f}s [JAXMC_PRED_TRACE_BUDGET]; "
-                             f"the compiled program would dwarf the "
-                             f"model)"))
-                continue
-            self.inv_fns.append((nm, f))
-        self.constraint_fns = []
-        self.fb_cons: List[Tuple[str, Any, str]] = []
-        for nm, ex in model.constraints:
-            f = compile_predicate2(self.kc, ex)
-            t_tr = time.time()
-            try:
-                jax.eval_shape(f, row_spec)
-            except CompileError as e:
-                self.fb_cons.append((nm, ex, str(e)))
-                continue
-            t_tr = time.time() - t_tr
-            if t_tr > budget and host_seen:
-                self.fb_cons.append(
-                    (nm, ex, f"trace budget exceeded ({t_tr:.0f}s > "
-                             f"{budget:.0f}s [JAXMC_PRED_TRACE_BUDGET])"))
-                continue
-            self.constraint_fns.append((nm, f))
+
+        def _compile_preds(pairs, may_demote_on_budget):
+            """(compiled, demoted) for a predicate list. Uncompilable
+            predicates always demote (hybrid checks them exactly); a
+            predicate whose abstract trace exceeds the budget demotes
+            only when may_demote_on_budget — callers keep slow compiled
+            predicates when demotion would make the run unsupported
+            (non-host_seen modes; constraints under temporal/refinement
+            PROPERTYs), so a loaded box never REFUSES a spec an idle
+            box accepts."""
+            compiled, demoted = [], []
+            for nm, ex in pairs:
+                f = compile_predicate2(self.kc, ex)
+                t_tr = time.time()
+                try:
+                    jax.eval_shape(f, row_spec)
+                except CompileError as e:
+                    demoted.append((nm, ex, str(e)))
+                    continue
+                t_tr = time.time() - t_tr
+                if t_tr > budget and may_demote_on_budget:
+                    demoted.append(
+                        (nm, ex,
+                         f"trace budget exceeded ({t_tr:.0f}s > "
+                         f"{budget:.0f}s [JAXMC_PRED_TRACE_BUDGET]; the "
+                         f"compiled program would dwarf the model)"))
+                    continue
+                compiled.append((nm, f))
+            return compiled, demoted
+
+        self.inv_fns, self.fb_invs = _compile_preds(
+            model.invariants, host_seen)
+        self.constraint_fns, self.fb_cons = _compile_preds(
+            model.constraints, host_seen and not model.properties)
         if model.action_constraints:
             raise CompileError("action constraints not compiled yet - "
                                "use the interp backend")
@@ -1897,7 +1892,6 @@ class TpuExplorer:
         gen_inc = 0
         cand_rows: List[np.ndarray] = []
         cand_prov: List[int] = []
-        cand_explore: List[bool] = []
 
         def _mk(viol):
             return self._mk_result(False, distinct, generated + gen_inc,
@@ -1922,6 +1916,17 @@ class TpuExplorer:
                     fb_enabled[f] = True
                 gen_inc += len(succs)
                 for sst in succs:
+                    # constraint check FIRST: a discarded successor is
+                    # never explored, counted, or edge-checked, so it
+                    # needs no encoding at all — its value shapes may
+                    # legitimately be absent from the sampled layout
+                    # (skew_fast's cfg discards abort histories, so no
+                    # sampled state holds an abort record). Dropping it
+                    # here is count-equivalent to fingerprint-and-
+                    # discard: satisfaction is state-determined, so the
+                    # state can never reappear in an explored context.
+                    if not satisfies_constraints(model, sst):
+                        continue
                     try:
                         row = np.asarray(layout.encode(sst), np.int32)
                     except (CompileError, EvalError) as ex:
@@ -1930,24 +1935,22 @@ class TpuExplorer:
                             "a fallback successor exceeded its lane "
                             f"capacity ({ex}; {self._caps_note()}); "
                             "counts would no longer be exact"))
-                    explore = satisfies_constraints(model, sst)
-                    if explore:
-                        # EVERY invariant (compiled and demoted alike)
-                        # checks host-side on fallback successors: the
-                        # device inv pass only sees device candidates
-                        ictx = model.ctx(state=sst)
-                        for inm, iex in model.invariants:
-                            if not _bool(eval_expr(iex, ictx),
-                                         f"invariant {inm}"):
-                                trace = self._trace_to(
-                                    trace_levels, frontier_maps, depth, f)
-                                trace = [x for x in trace
-                                         if x[0] is not None]
-                                trace.append(
-                                    (sst, self.labels_flat[self.A + j]))
-                                return gen_inc, 0, _mk(Violation(
-                                    "invariant", inm, trace))
-                    if explore and self.refiners:
+                    # EVERY invariant (compiled and demoted alike)
+                    # checks host-side on fallback successors: the
+                    # device inv pass only sees device candidates
+                    ictx = model.ctx(state=sst)
+                    for inm, iex in model.invariants:
+                        if not _bool(eval_expr(iex, ictx),
+                                     f"invariant {inm}"):
+                            trace = self._trace_to(
+                                trace_levels, frontier_maps, depth, f)
+                            trace = [x for x in trace
+                                     if x[0] is not None]
+                            trace.append(
+                                (sst, self.labels_flat[self.A + j]))
+                            return gen_inc, 0, _mk(Violation(
+                                "invariant", inm, trace))
+                    if self.refiners:
                         for rc in self.refiners:
                             if not rc.check_edge(pst, sst):
                                 trace = self._trace_to(
@@ -1957,30 +1960,29 @@ class TpuExplorer:
                                         rc, sst, self.A + j, trace))
                     cand_rows.append(row)
                     cand_prov.append((self.A + j) * L + f)
-                    cand_explore.append(explore)
 
         if not cand_rows:
             return gen_inc, 0, None
+        # every row collected above is constraint-satisfying (discarded
+        # successors were dropped before encoding — they are never
+        # counted, checked, or explored, so the drop is count-equivalent
+        # to TLC's fingerprint-and-discard)
         rows_mat = np.stack(cand_rows)
-        explore_arr = np.asarray(cand_explore)
         if self.collect_edges:
             # every explored successor EDGE (revisits included) feeds the
             # behavior graph, mirroring the device candidate stream
-            eidx = np.nonzero(explore_arr)[0]
-            if len(eidx):
-                lvl_edges.append(
-                    (rows_mat[eidx],
-                     np.asarray([cand_prov[i] % L for i in eidx])))
+            lvl_edges.append(
+                (rows_mat, np.asarray([p % L for p in cand_prov])))
         keys = np.asarray(self._keys_of(
             jnp.asarray(rows_mat), jnp.ones(len(rows_mat), bool)))
         new_mask = store.insert(keys[:, 1:])
         new_idx = np.nonzero(new_mask)[0]
-        dist_inc = int(explore_arr[new_idx].sum())
+        dist_inc = len(new_idx)
         if len(new_idx):
             lvl_new_rows.append(rows_mat[new_idx])
             lvl_new_prov.append(np.asarray(
                 [cand_prov[i] for i in new_idx], np.int64))
-            lvl_explore.append(explore_arr[new_idx])
+            lvl_explore.append(np.ones(len(new_idx), bool))
         return gen_inc, dist_inc, None
 
     def _demote_arms(self, arm_idxs) -> List[str]:

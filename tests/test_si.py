@@ -201,10 +201,7 @@ class TestSSIMutations:
 
 # (mutation, model cfg) pairs verified to reach their expected
 # serializability violation, with measured standalone search times on
-# this box. The remaining mutations (read_cannot_abort on the 4-txn
-# model, the write family on the 3-key/4-txn model) are exercised by the
-# same machinery; their searches exceed the slow-suite budget and run in
-# the round's background sweeps (results quoted in ROADMAP.md).
+# this box.
 VERIFIED_MUTATIONS = [
     ("commit_cannot_abort", "MCserializableSI_mut2.cfg"),      # ~20 s
     ("commit_no_loser_aborts", "MCserializableSI_mut2.cfg"),   # ~90 s
@@ -213,6 +210,58 @@ VERIFIED_MUTATIONS = [
     pytest.param("read_no_inconflict", "MCserializableSI_mut.cfg",
                  marks=pytest.mark.slow),                      # ~45 min
 ]
+
+# The write-family mutations and read_cannot_abort are MEASURED CLEAN at
+# their escalation envelopes (r4): coverage-guided directed simulation
+# (200 seeds x 40 walks x depth 24, ~90 min per mutation on this box)
+# found no violation on the 3-key/4-txn (write family) and 2-key/4-txn
+# (read_cannot_abort) models, consistent with the hand analysis in
+# specs/MCserializableSI.tla (Cahill's remaining read+commit checks
+# close every cycle a single one of these mutations opens at these
+# envelopes); the r3 BFS escalations likewise ran 600k+ states without
+# a violation before exceeding their budgets. The test below pins the
+# SHAPE of that evidence cheaply: the mutation applies, the model runs,
+# and a bounded directed search stays clean — so any future semantic
+# drift that makes these mutations trivially violating is caught.
+CLEAN_AT_ENVELOPE = [
+    ("write_cannot_abort", "MCserializableSI_mut3.cfg"),
+    ("write_no_outconflict", "MCserializableSI_mut3.cfg"),
+    ("read_cannot_abort", "MCserializableSI_mut4.cfg"),
+]
+
+
+@pytest.mark.slow
+def test_write_no_inconflict_found_violating_by_simulation():
+    # the SIXTH measured-VIOLATING documented check (r4): removing the
+    # writer's inConflict bookkeeping lets a 4-txn/3-key pyramid commit
+    # a non-serializable history — found by coverage-guided directed
+    # simulation (seed 42, ~25 s), after BFS escalation exceeded every
+    # budget. TLC -simulate parity: a violation found by random walks
+    # IS a measured verdict; the 20-event witness history is quoted in
+    # ROADMAP.md.
+    from jaxmc.sem.mutate import apply_ssi_mutation
+    from jaxmc.engine.simulate import random_walks
+    model = _load_ssi("MCserializableSI_mut3.cfg")
+    apply_ssi_mutation(model, "write_no_inconflict")
+    v = random_walks(model, n_walks=40, depth=24, seed=42,
+                     check_invariants=True, coverage_guided=True)
+    assert v is not None
+    assert v.kind == "invariant"
+    assert v.name == "MCCahillSerializableAtCommit"
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name,cfgname", CLEAN_AT_ENVELOPE)
+def test_ssi_mutation_clean_at_envelope(name, cfgname):
+    from jaxmc.sem.mutate import apply_ssi_mutation
+    from jaxmc.engine.simulate import random_walks
+    model = _load_ssi(cfgname)
+    apply_ssi_mutation(model, name)
+    v = random_walks(model, n_walks=30, depth=24, seed=7,
+                     check_invariants=True, coverage_guided=True)
+    assert v is None, (
+        f"{name} found VIOLATING at its envelope — promote it to "
+        f"VERIFIED_MUTATIONS with this trace")
 
 
 @pytest.mark.slow
