@@ -220,7 +220,9 @@ class TpuExplorer:
                  resident: bool = False,
                  checkpoint_path: Optional[str] = None,
                  checkpoint_every: float = 600.0,
-                 resume_from: Optional[str] = None):
+                 resume_from: Optional[str] = None,
+                 extra_samples: Optional[List[Dict[str, Any]]] = None,
+                 relayouts_left: int = 3):
         self.model = model
         self.log = log or (lambda s: None)
         self.max_states = max_states
@@ -233,12 +235,24 @@ class TpuExplorer:
         self.checkpoint_path = checkpoint_path
         self.checkpoint_every = checkpoint_every
         self.resume_from = resume_from
+        self.sample_cfg = sample_cfg
+        # ADAPTIVE RELAYOUT (hybrid, host_seen): when a compile-recovery
+        # demotion fires because a value SHAPE was never observed by the
+        # layout sampler (a deep model's rare message variant), the
+        # engine re-samples from the abort-time frontier, rebuilds the
+        # layout and kernels with the enriched observation set, and
+        # restarts COMPILED — falling back to whole-arm interpretation
+        # only after relayouts_left attempts.
+        self.extra_samples = list(extra_samples or [])
+        self.relayouts_left = relayouts_left
+        self._last_frontier_np: Optional[np.ndarray] = None
 
         base_ctx = model.ctx()
         self.init_states = enumerate_init(model.init, base_ctx, model.vars)
         bfs_n, walks, depth = sample_cfg
         sampled = sample_states(model, bfs_states=bfs_n, n_walks=walks,
                                 walk_depth=depth)
+        sampled = list(sampled) + self.extra_samples
         self.layout = build_layout2(model, sampled, self.bounds)
         self.kc = KernelCtx(model, self.layout, self.bounds)
         # dynamic \E expansion applies to message tables AND to
@@ -1641,6 +1655,7 @@ class TpuExplorer:
                 ovc = int(out["overflow"])
                 if ovc:
                     self._last_ovf_code = ovc
+                    self._last_frontier_np = frontier_np
                     if ovc == OV_DEMOTED:
                         msg = ("a demoted compile-recovery fired (the "
                                "kernel under-approximates here); the "
@@ -1930,6 +1945,17 @@ class TpuExplorer:
                     try:
                         row = np.asarray(layout.encode(sst), np.int32)
                     except (CompileError, EvalError) as ex:
+                        # ANY fallback-encode failure is an OBSERVATION
+                        # gap relayout can fix: missing variants get
+                        # their union slot, and capacity shortfalls grow
+                        # because build_layout2 re-derives caps from the
+                        # enriched observations. The failing state rides
+                        # along so recovery is deterministic even when
+                        # the frontier outgrows the enrichment cap.
+                        self._last_ovf_code = OV_DEMOTED
+                        self._relayout_hint = True
+                        self._last_frontier_np = frontier_np
+                        self._relayout_states = [sst]
                         return gen_inc, 0, _mk(Violation(
                             "error", "capacity overflow", [],
                             "a fallback successor exceeded its lane "
@@ -1985,6 +2011,63 @@ class TpuExplorer:
             lvl_explore.append(np.ones(len(new_idx), bool))
         return gen_inc, dist_inc, None
 
+    def _relayout_and_restart(self) -> Optional[CheckResult]:
+        """Adaptive relayout (hybrid): decode the abort-time frontier,
+        interp-enumerate one exact level of its successors, and build a
+        FRESH engine whose layout sampling includes those states — the
+        value shape that fired the demotion is then observed, its union
+        variant exists, and the restarted search stays compiled.
+        Returns the fresh engine's result, or None when enrichment
+        fails (caller falls back to arm demotion)."""
+        model = self.model
+        cap = 20000
+        rows = self._last_frontier_np
+        if len(rows) > cap:
+            self.log(f"hybrid: relayout enrichment capped at {cap} of "
+                     f"{len(rows)} abort-frontier rows")
+            rows = rows[:cap]
+        # states whose encode failed are known exactly — include them
+        # directly so recovery never depends on the cap
+        enrich: List[Dict[str, Any]] = list(self._relayout_states)
+        base_ctx = model.ctx()
+        try:
+            for row in rows:
+                # frontier states themselves are already encodable (they
+                # were just decoded from this layout): only their
+                # SUCCESSORS can carry unobserved shapes
+                st = self.layout.decode(np.asarray(row))
+                for succ, _ in enumerate_next(model.next, base_ctx,
+                                              model.vars, st):
+                    enrich.append(succ)
+        except (EvalError, TLCAssertFailure):
+            return None
+        self.log(f"hybrid: adaptive relayout — re-sampling with "
+                 f"{len(enrich)} abort-frontier states, rebuilding "
+                 f"kernels, restarting compiled "
+                 f"({self.relayouts_left - 1} attempts left)")
+        if self.checkpoint_path:
+            # a checkpoint written under the enriched layout could not
+            # be resumed (the resume path re-derives the layout from
+            # plain sampling, so the layout signature would mismatch):
+            # disable checkpointing rather than strand the user with an
+            # unresumable file. Persisting enrichment states in the
+            # checkpoint is the known follow-up (ROADMAP).
+            self.log("hybrid: relayout disables checkpointing for the "
+                     "restarted run (the enriched layout would make "
+                     "checkpoints unresumable)")
+        try:
+            ex2 = TpuExplorer(
+                model, log=self.log, max_states=self.max_states,
+                store_trace=self.store_trace,
+                progress_every=self.progress_every, bounds=self.bounds,
+                sample_cfg=self.sample_cfg, host_seen=True,
+                chunk=self.chunk,
+                extra_samples=self.extra_samples + enrich,
+                relayouts_left=self.relayouts_left - 1)
+        except (CompileError, ModeError):
+            return None
+        return ex2.run()
+
     def _demote_arms(self, arm_idxs) -> List[str]:
         """Hybrid runtime demotion: move the given arms' compiled
         kernels to the interpreter-fallback list and clear the step
@@ -2030,19 +2113,44 @@ class TpuExplorer:
             return self._run_resident()
         if self.host_seen:
             self._last_ovf_code = 0
+            self._relayout_hint = False
+            self._relayout_states: List[Dict[str, Any]] = []
             r = self._run_host_seen()
-            if not r.ok and r.violation is not None \
+            while not r.ok and r.violation is not None \
                     and r.violation.kind == "error" \
-                    and self._last_ovf_code == OV_DEMOTED \
-                    and self._demotable:
-                # the abort may be a demoted guard conjunct firing (an
-                # under-approximation guard), not a true lane overflow:
-                # demote those arms to the interpreter and re-search —
-                # a genuine capacity overflow aborts again either way
+                    and self._last_ovf_code == OV_DEMOTED:
+                # a compile-recovery demotion fired (never a true lane
+                # overflow — that keeps code OV_CAPACITY). First choice:
+                # ADAPTIVE RELAYOUT — when the cause is an OBSERVATION
+                # gap (a value shape the sampler missed), re-sampling
+                # from the abort frontier and rebuilding the kernels
+                # keeps the model fully COMPILED. Structural compiler
+                # limitations (extensional-set equality, unbounded
+                # CHOOSE, Lambda, unsupported binders) can never be
+                # fixed by observation — those demote the arms to the
+                # interpreter (exact, slower).
+                def _structural(why):
+                    return ("extensional" in why or
+                            "unbounded CHOOSE" in why or
+                            "Lambda" in why or "not supported" in why)
+                fixable = self._relayout_hint or any(
+                    not _structural(why)
+                    for ca in self.compiled for why in ca.demoted_guards)
+                if fixable and self.relayouts_left > 0 and \
+                        self._last_frontier_np is not None and \
+                        len(self._last_frontier_np):
+                    r2 = self._relayout_and_restart()
+                    if r2 is not None:
+                        return r2
+                if not self._demotable:
+                    break
                 demoted = self._demote_arms(self._demotable)
-                self.log(f"hybrid: overflow abort with demoted guard "
-                         f"conjuncts in {demoted} — falling those arms "
-                         f"back to the interpreter and restarting")
+                self.log(f"hybrid: demotion abort — falling "
+                         f"{demoted} back to the interpreter and "
+                         f"restarting")
+                self._last_ovf_code = 0
+                self._relayout_hint = False
+                self._relayout_states = []
                 r = self._run_host_seen()
             return r
         t0 = time.time()

@@ -801,3 +801,34 @@ JumpSpec == HCini /\\ [][Jump]_hr
         r = MeshExplorer(model).run()
         assert r.ok and r.distinct == 240 and r.generated == 1392
         assert not any("NOT checked" in w for w in r.warnings), r.warnings
+
+
+def test_adaptive_relayout_recovers_unobserved_variant(tmp_path):
+    # hybrid adaptive relayout (r4): a value shape the layout sampler
+    # never OBSERVED (a record appearing only at depth 10) makes its
+    # encode fail mid-search; the engine re-samples from the abort-time
+    # frontier, rebuilds the layout with the variant present, restarts,
+    # and completes with exact counts — no arm demotion needed
+    from jaxmc.tpu.bfs import TpuExplorer
+    from jaxmc.engine.explore import Explorer
+    spec = tmp_path / "deepvar.tla"
+    spec.write_text("""---- MODULE deepvar ----
+EXTENDS Naturals
+VARIABLES x, n
+Init == n = 0 /\\ x = "none"
+Step == n < 9 /\\ n' = n + 1 /\\ UNCHANGED x
+Deep == n = 9 /\\ n' = n /\\ x' = [a |-> n]
+Next == Step \\/ Deep
+====
+""")
+    cfg = ModelConfig(specification=None, init="Init", next="Next",
+                      check_deadlock=False)
+    model = load(str(spec), cfg)
+    ri = Explorer(model).run()
+    assert ri.ok
+    # sampling far too shallow to ever see the Deep record
+    ex = TpuExplorer(model, store_trace=False, host_seen=True,
+                     sample_cfg=(3, 2, 3))
+    r = ex.run()
+    assert r.ok
+    assert (r.generated, r.distinct) == (ri.generated, ri.distinct)
