@@ -28,6 +28,6 @@ bench:
 # build the native host fingerprint store (also built on demand at import)
 native:
 	mkdir -p native/build
-	g++ -O2 -shared -fPIC -std=c++17 native/fps_store.cc -o native/build/libjaxmc_fps.so
+	g++ -O2 -shared -fPIC -std=c++17 -pthread native/fps_store.cc -o native/build/libjaxmc_fps.so
 
 .PHONY: all check check-corpus test bench native

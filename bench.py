@@ -24,12 +24,14 @@ races in parallel against a hard internal deadline
 (JAXMC_BENCH_DEADLINE seconds, default 480):
 
   - a CPU worker thread immediately runs, in order: an interp-only
-    EMERGENCY child (~30-60 s: no XLA compile at all), a QUICK device
-    rung (MCraft_micro, ~2-3 min cold on this 1-core box), then the
-    FULL bench rung (MCraft_3s_bench) if time remains;
-  - a TPU worker thread probes the axon tunnel (bounded retries); if the
-    TPU answers it runs the quick rung first (a TPU line as early as
-    possible), then a bounded profile capture, then the full rung.
+    EMERGENCY child (~30-60 s: no XLA compile at all), then the FULL
+    bench rung (MCraft_3s_bench — the artifact of record gets the big
+    slot, r4 weak #1), then the QUICK rung only if full failed;
+  - a TPU worker thread consults the round-long probe loop's verdict
+    (/tmp/tpu_probe.log, /tmp/tpu_up.marker) before burning the single
+    core on probe children of its own; if the TPU answers it runs the
+    quick rung first (a TPU line as early as possible), then a bounded
+    profile capture, then the full rung.
 
 At the deadline (or earlier, once the best-possible line for the
 detected platform exists) the parent prints the best line available,
@@ -278,40 +280,93 @@ def probe_tpu_once(timeout_s: float) -> tuple:
 
 
 def _cpu_worker():
-    """Emergency interp line first (floor), then quick device rung, then
-    the full rung if the clock allows."""
+    """Emergency interp line first (floor), then the FULL bench rung —
+    the artifact of record (BENCH_r02 proved it lands on this box when
+    given the window; r4 starved it behind the quick rung + probes,
+    VERDICT r4 weak #1) — then the quick rung only as a leftover filler."""
     line = _run_child({"JAXMC_BENCH_CHILD": "emergency"},
                       min(150.0, _remaining()), "cpu/emergency")
     if line:
         _RESULTS.put("interp", "emergency", line)
     line = _run_child({"JAXMC_BENCH_CHILD": "cpu", "JAXMC_BENCH_RUNG":
-                       "quick"}, _remaining(), "cpu/quick")
-    if line:
-        _RESULTS.put("cpu", "quick", line)
-    line = _run_child({"JAXMC_BENCH_CHILD": "cpu", "JAXMC_BENCH_RUNG":
                        "full"}, _remaining(), "cpu/full")
     if line:
         _RESULTS.put("cpu", "full", line)
+    else:
+        line = _run_child({"JAXMC_BENCH_CHILD": "cpu", "JAXMC_BENCH_RUNG":
+                           "quick"}, _remaining(), "cpu/quick")
+        if line:
+            _RESULTS.put("cpu", "quick", line)
+
+
+def _tunnel_oracle() -> str:
+    """'up' / 'down' / 'unknown' from the round-long probe-loop artifacts
+    (/tmp/tpu_probe_loop.py writes /tmp/tpu_probe.log every ~10 min and
+    /tmp/tpu_up.marker on success). A fresh verdict saves the bench from
+    burning the single core on its own 120 s probe children — the r4
+    starvation mode — while a stale or absent log falls back to probing."""
+    fresh_s = 30 * 60
+    try:
+        if (time.time() - os.path.getmtime("/tmp/tpu_up.marker")
+                < fresh_s):
+            return "up"
+    except OSError:
+        pass
+    try:
+        with open("/tmp/tpu_probe.log") as fh:
+            lines = [ln.strip() for ln in fh if ln.strip()]
+        if lines and (time.time() - os.path.getmtime("/tmp/tpu_probe.log")
+                      < fresh_s):
+            # exact line grammar of /tmp/tpu_probe_loop.py: success is
+            # "HH:MM:SS TPU UP (...)"; failures are "no tpu (...)" /
+            # "probe timed out ..." / "probe error ..." — substring
+            # matching on "tpu" alone would read "no tpu" as up
+            last = lines[-1]
+            if "TPU UP" in last:
+                return "up"
+            if ("no tpu" in last or "timed out" in last
+                    or "probe error" in last):
+                return "down"
+    except OSError:
+        pass
+    return "unknown"
 
 
 def _tpu_worker():
     """Probe for the tunnel; on success run quick rung first (earliest
     possible TPU line), bounded profile capture, then the full rung."""
-    attempt = 0
-    found = False
-    # leave >=90 s for a quick TPU rung after the last probe
-    while _remaining() > 90:
-        attempt += 1
-        status, detail = probe_tpu_once(min(120.0, _remaining() - 60))
-        _log(f"tpu probe #{attempt}: "
+    oracle = _tunnel_oracle()
+    found = oracle == "up"
+    if found:
+        _log("tunnel oracle: probe loop says TPU is UP — skipping probes")
+    elif oracle == "down":
+        # one cheap verification probe only: the probe loop has fresh
+        # evidence the tunnel is down, and probe children burn the core
+        # the cpu/full child needs
+        _log("tunnel oracle: probe loop says tunnel is DOWN")
+        status, detail = probe_tpu_once(min(60.0, max(_remaining() - 60,
+                                                      10.0)))
+        _log(f"tpu probe (verify): "
              f"{'UP' if status == 'tpu' else detail}")
-        if status == "tpu":
-            found = True
-            break
-        if status == "other":
-            _log(f"no TPU on this machine (platform={detail})")
+        found = status == "tpu"
+        if not found:
             return
-        time.sleep(min(20.0, _remaining()))
+    else:
+        attempt = 0
+        # leave >=90 s for a quick TPU rung after the last probe; at most
+        # two probes so the cpu/full child keeps the core (r4 weak #1)
+        while _remaining() > 90 and attempt < 2:
+            attempt += 1
+            status, detail = probe_tpu_once(min(120.0, _remaining() - 60))
+            _log(f"tpu probe #{attempt}: "
+                 f"{'UP' if status == 'tpu' else detail}")
+            if status == "tpu":
+                found = True
+                break
+            if status == "other":
+                _log(f"no TPU on this machine (platform={detail})")
+                return
+            time.sleep(min(20.0, _remaining()))
     if not found:
         return
     try:  # evidence for the monitoring loop pattern (memory: tpu_up.marker)

@@ -60,19 +60,62 @@ def _static_ctx(model: Model) -> Ctx:
 
 def split_arms(model: Model) -> List[ActionArm]:
     """Decompose Next into its disjunct arms: operator expansion, \\/
-    splits, and static \\E instantiation — the same top structure
-    ground_actions walks, but stopping at conjunctions and at anything
-    non-static (those stay whole inside one arm). The concatenation of
-    ground_arm() over these arms equals ground_actions() on Next, in the
-    same order, so compiled-path labels and traces are unchanged."""
-    ctx = _static_ctx(model)
-    out: List[ActionArm] = []
+    splits, static \\E instantiation, AND distribution of rider
+    conjuncts over a splitting conjunct (VERDICT r4 #3) — the same top
+    structure ground_actions walks, stopping at anything non-static
+    (those stay whole inside one arm). The concatenation of ground_arm()
+    over these arms equals ground_actions() on Next — same instances,
+    same order, same labels, same conjunct exprs — so compiled-path
+    labels and traces are unchanged. Sole deviation: a rider conjunct
+    distributed under a \\E's static binding carries that binding in its
+    static env (inert by construction — occurs_free guarantees the rider
+    never references it; the whole-grounding walk scopes the binding to
+    the \\E body only).
 
-    def walk(e: A.Node, bound: Dict[str, Any], label) -> None:
+    Conjunction distribution: raft's
+    Next == /\\ (\\/ ...10 action families...) /\\ allLogs' = ...
+    (/root/reference/examples/raft.tla:482-493) is ONE top-level
+    conjunction; without distribution the whole transition relation was
+    a single arm, so one uncompilable message variant demoted ALL of
+    raft to the interpreter (the r4 mid4 abort). (a /\\ b) where a
+    splits into arms L_i becomes arms (L_i /\\ b) — exact by
+    distributivity of /\\ over \\/, order-preserving (left-outer /
+    right-inner, ground_actions' own walk order). New binder bindings
+    introduced by one side must not capture free names of the other
+    (occurs_free); on a collision the conjunction stays one arm."""
+    ctx = _static_ctx(model)
+    from ..front.subst import occurs_free
+
+    def walk(e: A.Node, bound: Dict[str, Any], label) -> List[ActionArm]:
         if isinstance(e, A.OpApp) and e.name == "\\/":
+            res: List[ActionArm] = []
             for arm in e.args:
-                walk(arm, bound, label)
-            return
+                res.extend(walk(arm, bound, label))
+            return res
+        if isinstance(e, A.OpApp) and e.name == "/\\":
+            left = walk(e.args[0], bound, label)
+            right = walk(e.args[1], bound, label)
+            if len(left) == 1 and len(right) == 1:
+                # nothing under the conjunction splits: stay one arm
+                # (the grounder expands it; do NOT decompose a plain
+                # conjunction into per-conjunct arms)
+                return [ActionArm(label, e, dict(bound))]
+            base = set(bound)
+            res = []
+            for la in left:
+                newl = set(la.bound) - base
+                for ra in right:
+                    newr = set(ra.bound) - base
+                    if (newl & newr or occurs_free(ra.expr, newl)
+                            or occurs_free(la.expr, newr)):
+                        # capture risk: keep the whole conjunction as
+                        # one arm rather than mis-scope a rider
+                        return [ActionArm(label, e, dict(bound))]
+                    res.append(ActionArm(
+                        la.label or ra.label or label,
+                        A.OpApp("/\\", (la.expr, ra.expr), ()),
+                        {**la.bound, **ra.bound}))
+            return res
         if isinstance(e, A.Quant) and e.kind == "E":
             try:
                 bindings = list(iter_binders(
@@ -81,11 +124,11 @@ def split_arms(model: Model) -> List[ActionArm]:
                 # dynamic domain: the whole \E is one arm (the grounder
                 # slot-expands it on the compiled path; the interpreter
                 # enumerates it natively on the fallback path)
-                out.append(ActionArm(label, e, dict(bound)))
-                return
+                return [ActionArm(label, e, dict(bound))]
+            res = []
             for b in bindings:
-                walk(e.body, {**bound, **b}, label)
-            return
+                res.extend(walk(e.body, {**bound, **b}, label))
+            return res
         if isinstance(e, A.OpApp) and e.name not in _LEAF_OPS \
                 and not e.path and e.name not in bound:
             d = model.defs.get(e.name)
@@ -100,20 +143,17 @@ def split_arms(model: Model) -> List[ActionArm]:
                         break
                 if argable:
                     nb = {**bound, **dict(zip(d.params, args))}
-                    walk(d.body, nb, _mk_label(e.name, args))
-                    return
+                    return walk(d.body, nb, _mk_label(e.name, args))
                 # non-static args (assigns through params / reads state):
                 # one arm; both paths expand it themselves
         if isinstance(e, A.Ident):
             d = model.defs.get(e.name)
             if isinstance(d, OpClosure) and not d.params \
                     and e.name not in bound:
-                walk(d.body, bound, e.name)
-                return
-        out.append(ActionArm(label, e, dict(bound)))
+                return walk(d.body, bound, e.name)
+        return [ActionArm(label, e, dict(bound))]
 
-    walk(model.next, {}, None)
-    return out
+    return walk(model.next, {}, None)
 
 
 def ground_arm(model: Model, arm: ActionArm, max_actions: int = 4096,

@@ -1321,6 +1321,13 @@ def sym_eval2(e: A.Node, fr: Frame):
     # in a guarded-out context must never replay into a live one
     if memo is not None and fr.guard is True \
             and isinstance(e, _MEMO_TYPES):
+        # the key covers (expr id, bound-value ids) but NOT fr.state or
+        # fr.primes — sound only because memos are created fresh per
+        # compile_predicate2 trace, where state is a single fixed tuple
+        # and primes stays empty. Fail loudly if a future caller ever
+        # hands a memo to action frames whose primes mutate mid-trace
+        assert not fr.primes, \
+            "sym_eval2 memo used in a frame with primes (stale replay)"
         names = _ident_names(e)
         bound = fr.bound
         rel = tuple(sorted((n, id(bound[n]))
@@ -1421,6 +1428,10 @@ def _sym_eval2_inner(e: A.Node, fr: Frame):
                         else b
                 else:
                     node = A.If(g, b, node)
+            # capped like _IDENT_NAMES_CACHE: a long-lived process
+            # sweeping many models must not pin every Case AST forever
+            if len(_CASE_CHAIN_CACHE) > 100_000:
+                _CASE_CHAIN_CACHE.clear()
             _CASE_CHAIN_CACHE[id(e)] = (e, node)
         return sym_eval2(node, fr)
     if t is A.TupleExpr:

@@ -156,6 +156,99 @@ def subst(e: A.Node, m: Dict[str, A.Node]) -> A.Node:
     return e
 
 
+def occurs_free(e: A.Node, names) -> bool:
+    """Does any of `names` occur FREE in e — as an identifier reference
+    or an applied-operator name — under the same shadowing rules subst
+    uses? ground.split_arms asks this before distributing a rider
+    conjunct under a disjunct's binder bindings (raft's Next shape,
+    /root/reference/examples/raft.tla:482-493): a rider whose free names
+    collide with the new bindings would be captured, so the conjunction
+    then stays one arm."""
+    ns = set(names)
+    if not ns:
+        return False
+
+    def tup(tv, sh) -> bool:
+        for x in tv:
+            if isinstance(x, A.Node):
+                if go(x, sh):
+                    return True
+            elif isinstance(x, tuple):
+                if tup(x, sh):
+                    return True
+        return False
+
+    def go(x, sh) -> bool:
+        t = type(x)
+        if t is A.Ident:
+            return x.name in ns and x.name not in sh
+        if t in (A.Num, A.Str, A.Bool, A.At):
+            return False
+        if t is A.OpApp:
+            if x.name in ns and x.name not in sh:
+                return True
+            if any(go(a, sh) for a in x.args):
+                return True
+            return any(go(a, sh)
+                       for _n, args in x.path for a in args)
+        if t is A.SetFilter:
+            return go(x.set, sh) or go(x.pred, sh | _names_of(x.var))
+        if t in (A.SetMap, A.FnDef):
+            bound = set()
+            for bn, s in x.binders:
+                if s is not None and go(s, sh | bound):
+                    return True
+                for pat in bn:
+                    bound |= _names_of(pat)
+            body = x.expr if t is A.SetMap else x.body
+            return go(body, sh | bound)
+        if t is A.Quant:
+            bound = set()
+            for bn, s in x.binders:
+                if s is not None and go(s, sh | bound):
+                    return True
+                for pat in bn:
+                    bound |= _names_of(pat)
+            return go(x.body, sh | bound)
+        if t is A.Choose:
+            if x.set is not None and go(x.set, sh):
+                return True
+            return go(x.pred, sh | _names_of(x.var))
+        if t is A.Let:
+            bound = set()
+            for d in x.defs:
+                if isinstance(d, A.OpDef):
+                    if go(d.body, sh | bound | set(d.params)):
+                        return True
+                    bound.add(d.name)
+                elif isinstance(d, A.FnConstrDef):
+                    bn = set()
+                    for nms, s in d.binders:
+                        if s is not None and go(s, sh | bound):
+                            return True
+                        for pat in nms:
+                            bn |= _names_of(pat)
+                    if go(d.body, sh | bound | bn | {d.name}):
+                        return True
+                    bound.add(d.name)
+            return go(x.body, sh | bound)
+        if t is A.Lambda:
+            return go(x.body, sh | set(x.params))
+        if t is A.TemporalQuant:
+            return go(x.body, sh | set(x.vars))
+        for f in getattr(x, "__dataclass_fields__", {}):
+            v = getattr(x, f)
+            if isinstance(v, A.Node):
+                if go(v, sh):
+                    return True
+            elif isinstance(v, tuple):
+                if tup(v, sh):
+                    return True
+        return False
+
+    return go(e, frozenset())
+
+
 _CONTAINS_PRIME_CACHE: dict = {}
 
 

@@ -64,13 +64,14 @@ class ModelValue:
 class Fcn:
     """Immutable TLA+ function. Sequences are functions with domain 1..n,
     records functions with string domain — all compare uniformly."""
-    __slots__ = ("_d", "_hash", "_sk")
+    __slots__ = ("_d", "_hash", "_sk", "_hb")
 
     def __init__(self, mapping: Iterable):
         d = dict(mapping)
         self._d = d
         self._hash = None
         self._sk = None  # cached sort_key (never pickled — see __reduce__)
+        self._hb = None  # cached _has_bool (rebuilt on unpickle too)
 
     @property
     def d(self) -> dict:
@@ -230,14 +231,19 @@ def in_set(v, s) -> bool:
         # Python's True == 1 must not leak into TLA+ semantics where
         # TRUE /= 1: disambiguate bool/int hash collisions by scan.
         if isinstance(v, bool):
+            if not _has_bool(s):
+                return False  # no bool anywhere in s; a hash hit is 0/1
             return any(x is v for x in s)
         if isinstance(v, int) and v in (0, 1):
+            if not _has_bool(s):
+                return v in s  # every hash-equal member is an int
             return any(x == v and not isinstance(x, bool) for x in s)
-        if isinstance(v, (frozenset, Fcn)) and _has_boolish(v):
+        if isinstance(v, (frozenset, Fcn)) and (_has_bool(v)
+                                                or _has_bool(s)):
             # container membership can match only via a nested True==1
-            # conflation ({1} \in {{TRUE}}): hash-check first (a miss
-            # can't collapse), and only on a hit scan for the Python-
-            # equal member to raise like TLC if the match rides one
+            # conflation ({1} \in {{TRUE}}), which needs a bool on one
+            # side: hash-check first (a miss can't collapse), and only on
+            # a hit scan for the Python-equal member to raise like TLC
             if v not in s:
                 return False
             for x in s:
@@ -322,8 +328,11 @@ def sort_key(v):
 
 
 def _has_boolish(v) -> bool:
-    """Could v participate in a True==1 collapse? True iff it contains a
-    bool or a 0/1 integer anywhere. Cheap gate for _assert_no_collapse."""
+    """Could v participate in a True==1 collapse from EITHER side? True iff
+    it contains a bool or a 0/1 integer anywhere. Used only at set
+    CONSTRUCTION sites (check_set_mix), where pure-int members must still
+    enter the nested-dedup dict so a later bool-bearing member can collide
+    with them ({1} before {TRUE})."""
     if isinstance(v, bool):
         return True
     if isinstance(v, int):
@@ -333,6 +342,43 @@ def _has_boolish(v) -> bool:
     if isinstance(v, Fcn):
         return any(_has_boolish(k) or _has_boolish(x)
                    for k, x in v.d.items())
+    return False
+
+
+_HAS_BOOL_CACHE: Dict[int, Tuple[Any, bool]] = {}
+_HAS_BOOL_CACHE_CAP = 1 << 16
+
+
+def _has_bool(v) -> bool:
+    """Does v contain an ACTUAL bool anywhere? A True==1 conflation needs a
+    bool on at least one side (int-vs-int positions never raise), so for a
+    PAIR of Python-equal values `_has_bool(a) or _has_bool(b)` is the exact
+    gate for _assert_no_collapse — unlike _has_boolish, a pure-int sequence
+    or record (domain keys 1..n, 0/1 payloads) gates False and the hot
+    equality/membership paths stay single-pass. Cached per container object
+    (Fcn slot; id-keyed strong-ref table for frozensets)."""
+    if isinstance(v, bool):
+        return True
+    if isinstance(v, Fcn):
+        hb = v._hb
+        if hb is None:
+            # _materialized_items (not ._d) so a lazy RecFcn is forced
+            # BEFORE the scan — scanning a partially-evaluated memo dict
+            # would cache a stale False and silently equate a later
+            # True==1 conflation instead of raising
+            hb = any(_has_bool(k) or _has_bool(x)
+                     for k, x in v._materialized_items())
+            v._hb = hb
+        return hb
+    if isinstance(v, frozenset):
+        e = _HAS_BOOL_CACHE.get(id(v))
+        if e is not None and e[0] is v:
+            return e[1]
+        r = any(_has_bool(x) for x in v)
+        if len(_HAS_BOOL_CACHE) >= _HAS_BOOL_CACHE_CAP:
+            _HAS_BOOL_CACHE.clear()
+        _HAS_BOOL_CACHE[id(v)] = (v, r)
+        return r
     return False
 
 
@@ -417,9 +463,11 @@ def tla_eq(a, b) -> bool:
     if isinstance(b, FcnSetV):
         return b == a
     r = a == b
-    if r and isinstance(a, (frozenset, Fcn)) and _has_boolish(a):
+    if r and isinstance(a, (frozenset, Fcn)) and (_has_bool(a)
+                                                  or _has_bool(b)):
         # Python-equal containers may be equal only via a nested True==1
-        # conflation ({{TRUE}} == {{1}}): TLC raises there, never equates
+        # conflation ({{TRUE}} == {{1}}), which needs an actual bool on
+        # one side: TLC raises there, never equates
         _assert_no_collapse(a, b)
     return r
 

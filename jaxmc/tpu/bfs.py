@@ -287,6 +287,14 @@ class TpuExplorer:
             except CompileError as e:
                 self.fb_arms.append((arm, str(e)))
                 continue
+            except RecursionError:
+                # a RECURSIVE operator with symbolic arguments unrolls
+                # forever at trace time — demote the arm like any other
+                # uncompilable construct instead of crashing the build
+                self.fb_arms.append(
+                    (arm, "recursive operator expansion diverges at "
+                          "compile time (RecursionError)"))
+                continue
             self.actions.extend(gas)
             self.compiled.extend(cas)
             self._ca_arm.extend([ai] * len(cas))
@@ -348,6 +356,11 @@ class TpuExplorer:
                     jax.eval_shape(f, row_spec)
                 except CompileError as e:
                     demoted.append((nm, ex, str(e)))
+                    continue
+                except RecursionError:
+                    demoted.append(
+                        (nm, ex, "recursive operator expansion diverges "
+                                 "at compile time (RecursionError)"))
                     continue
                 t_tr = time.time() - t_tr
                 if t_tr > budget and may_demote_on_budget:
@@ -934,7 +947,7 @@ class TpuExplorer:
 
             def chunk_body(carry):
                 (ci, acc_keys, acc_rows, acc_n, gen, stat,
-                 bad_row) = carry
+                 bad_row, ovcode) = carry
                 base = ci * CH
                 chunk = lax.dynamic_slice(frontier, (base, 0), (CH, W))
                 fvalid = (jnp.arange(CH) + base) < fcount
@@ -942,9 +955,14 @@ class TpuExplorer:
                 valid = en & fvalid[None, :]
                 gen = gen + jnp.sum(valid, dtype=jnp.int32)
 
-                # lane-capacity overflow inside an enabled action: abort
-                ovf_lanes = jnp.any(jnp.where(fvalid[None, :], ov, 0)
-                                    != 0)
+                # lane-capacity overflow inside an enabled action: abort.
+                # The max OV_* CODE rides along so the host can tell a
+                # compile-recovery demotion (OV_DEMOTED — raise no caps,
+                # run host_seen) from a real capacity overflow
+                ov_codes = jnp.where(fvalid[None, :], ov, 0)
+                ovf_lanes = jnp.any(ov_codes != 0)
+                ovcode = jnp.maximum(ovcode,
+                                     jnp.max(ov_codes).astype(jnp.int32))
                 # Assert(FALSE) inside an enabled action
                 abad = (~aok) & fvalid[None, :]
                 assert_any = jnp.any(abad)
@@ -1000,24 +1018,26 @@ class TpuExplorer:
                     jnp.where((stat == ST_CONTINUE) & dead_any,
                               ST_DEADLOCK, stat))
                 return (ci + 1, acc_keys, acc_rows, acc_n, gen, stat,
-                        bad_row)
+                        bad_row, ovcode)
 
             def chunk_cond(carry):
                 # stop at the FIRST non-continue status: carrying on after
                 # an assert/deadlock would skip the accumulator-overflow
                 # checks (they only arm while stat == CONTINUE) and let
                 # clamped writes clobber earlier candidate blocks
-                ci, _, _, _, _, stat, _ = carry
+                ci, _, _, _, _, stat, _, _ = carry
                 return (ci < nchunks) & (stat == ST_CONTINUE)
 
             acc_keys0 = jnp.full((AccCap, K), SENTINEL, jnp.int32)
             acc_rows0 = jnp.full((AccCap, W), SENTINEL, jnp.int32)
             bad_row0 = jnp.full((W,), SENTINEL, jnp.int32)
-            (_, acc_keys, acc_rows, acc_n, gen, stat, bad_row) = \
+            (_, acc_keys, acc_rows, acc_n, gen, stat, bad_row,
+             ovcode) = \
                 lax.while_loop(chunk_cond, chunk_body,
                                (jnp.int32(0), acc_keys0, acc_rows0,
                                 jnp.int32(0), jnp.int32(0),
-                                jnp.int32(ST_CONTINUE), bad_row0))
+                                jnp.int32(ST_CONTINUE), bad_row0,
+                                jnp.int32(0)))
 
             # conservative seen-capacity check BEFORE the merge: every
             # accumulated candidate could be new
@@ -1130,20 +1150,21 @@ class TpuExplorer:
                              ST_INV, stat)
 
             return (seen2, seen_count2, front_rows, explore_count, gen,
-                    explore_count, stat, inv_bad_which, bad_row)
+                    explore_count, stat, inv_bad_which, bad_row, ovcode)
 
         def run(seen, seen_count, frontier, fcount, distinct,
                 gen_lo, gen_hi, depth, max_states, maxlvl):
             def cond(carry):
-                (_, _, _, _, _, _, _, _, lvls, stat, _, _) = carry
+                (_, _, _, _, _, _, _, _, lvls, stat, _, _, _) = carry
                 return (stat == ST_CONTINUE) & (lvls < maxlvl)
 
             def body(carry):
                 (seen, seen_count, frontier, fcount, distinct,
-                 gen_lo, gen_hi, depth, lvls, stat, which, brow) = carry
+                 gen_lo, gen_hi, depth, lvls, stat, which, brow,
+                 ovcode) = carry
                 (seen2, seen_count2, front2, fcount2, gen_l, kept,
-                 lstat, lwhich, lbrow) = level(seen, seen_count,
-                                               frontier, fcount)
+                 lstat, lwhich, lbrow, lovcode) = level(seen, seen_count,
+                                                        frontier, fcount)
                 ovf = (lstat == ST_OVF_SEEN) | (lstat == ST_OVF_FRONT) | \
                     (lstat == ST_OVF_ACC) | (lstat == ST_OVF_VC) | \
                     (lstat == ST_OVF_LANES)
@@ -1176,17 +1197,20 @@ class TpuExplorer:
                                         ST_TRUNC, ST_CONTINUE)))
                 return (seen2, seen_count2, front2, fcount2, distinct2,
                         gen_lo2, gen_hi2, depth2, lvls + 1, stat2,
-                        jnp.where(lstat == ST_INV, lwhich, which), lbrow)
+                        jnp.where(lstat == ST_INV, lwhich, which), lbrow,
+                        jnp.where(lstat == ST_OVF_LANES, lovcode,
+                                  ovcode))
 
             carry0 = (seen, seen_count, frontier, fcount, distinct,
                       gen_lo, gen_hi, depth, jnp.int32(0),
                       jnp.int32(ST_CONTINUE), jnp.int32(-1),
-                      jnp.full((W,), SENTINEL, jnp.int32))
+                      jnp.full((W,), SENTINEL, jnp.int32),
+                      jnp.int32(0))
             (seen, seen_count, frontier, fcount, distinct, gen_lo,
-             gen_hi, depth, _, stat, which, brow) = lax.while_loop(
-                cond, body, carry0)
+             gen_hi, depth, _, stat, which, brow, ovcode) = \
+                lax.while_loop(cond, body, carry0)
             summary = jnp.stack([stat, seen_count, fcount, distinct,
-                                 gen_lo, gen_hi, depth, which])
+                                 gen_lo, gen_hi, depth, which, ovcode])
             return seen, frontier, summary, brow
 
         jitted = jax.jit(run, static_argnames=())
@@ -1510,6 +1534,7 @@ class TpuExplorer:
                 int(np.uint32(summary[4]))
             depth = int(summary[6])
             which = int(summary[7])
+            ovcode = int(summary[8])
             self._res_caps = dict(caps)
 
             if stat in grow_flag:
@@ -1565,11 +1590,18 @@ class TpuExplorer:
                 return self._mk_result(True, distinct, generated, depth,
                                        t0, warnings, None, truncated=True)
             elif stat == ST_OVF_LANES:
+                if ovcode == OV_DEMOTED:
+                    msg = ("a demoted compile-recovery fired (the "
+                           "kernel under-approximates here): run the "
+                           "host_seen mode, which demotes the arm to "
+                           "the interpreter and restarts — raising "
+                           "caps cannot help")
+                else:
+                    msg = ("a container exceeded its lane capacity "
+                           f"({self._caps_note()})")
                 return self._mk_result(
                     False, distinct, generated, depth, t0, warnings,
-                    Violation("error", "capacity overflow", [],
-                              "a container exceeded its lane capacity "
-                              f"({self._caps_note()})"))
+                    Violation("error", "capacity overflow", [], msg))
             else:
                 st = layout.decode(np.asarray(brow))
                 note = "state reached by resident-mode search (no trace)"

@@ -50,6 +50,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..sem.modules import Model
 from ..engine.explore import CheckResult, Violation
 from ..compile.vspec import ModeError
+from ..compile.kernel2 import OV_DEMOTED
 from .bfs import (SENTINEL, TpuExplorer, _LiveGraph, _pow2_at_least,
                   filter_init_states, fingerprint128)
 
@@ -153,8 +154,12 @@ class MeshExplorer(TpuExplorer):
             asrt_a = (aflat // FC).astype(jnp.int32)
             asrt_f = (aflat % FC).astype(jnp.int32)
             # ov is the int overflow code (kernel2.OV_*); any nonzero
-            # valid-row code aborts the mesh run
-            overflow = jnp.any(jnp.where(fvalid[None, :], ov, 0) != 0)
+            # valid-row code aborts the mesh run. The MAX code is kept
+            # (not just a flag) so the host can tell OV_DEMOTED — a
+            # compile-recovery demotion, where raising caps cannot help —
+            # from a real lane-capacity overflow
+            overflow = jnp.max(jnp.where(fvalid[None, :], ov, 0)) \
+                .astype(jnp.int32)
             dead = fvalid & ~jnp.any(en, axis=0)
             dead_local = jnp.any(dead)
             dead_slot = jnp.argmax(dead).astype(jnp.int32)
@@ -294,7 +299,7 @@ class MeshExplorer(TpuExplorer):
             # the host can locate the offending device's row/provenance
             tot_gen = lax.psum(gen_local, "d")
             tot_new = lax.psum(front_count, "d")
-            any_ovf = lax.psum(overflow.astype(jnp.int32), "d") > 0
+            any_ovf = lax.pmax(overflow, "d")  # 0 = none, else max OV_*
             tot_front = lax.psum(front_count, "d")
 
             any_a2a_ovf = lax.psum(a2a_ovf.astype(jnp.int32), "d") > 0
@@ -314,13 +319,23 @@ class MeshExplorer(TpuExplorer):
                     dead_local.astype(jnp.int32), "d") > 0
                 any_assert = lax.psum(
                     assert_bad.astype(jnp.int32), "d") > 0
+                # indices 0-11 are the r4 surface; 12+ add PER-DEVICE
+                # provenance (each process reads only its own shards) so
+                # the multi-host loop can assemble exact counterexample
+                # traces via the process-allgather protocol
+                # (multihost.py, VERDICT r4 #7)
                 return (seen2.reshape(1, SC, K), seen_count2.reshape(1),
                         front_rows[:out_cap].reshape(1, out_cap, W),
                         front_count.reshape(1),
                         tot_gen.reshape(1), tot_new.reshape(1),
                         any_ovf.reshape(1), tot_front.reshape(1),
                         fixed_ovf.reshape(1), any_inv.reshape(1),
-                        any_dead.reshape(1), any_assert.reshape(1))
+                        any_dead.reshape(1), any_assert.reshape(1),
+                        front_src[:out_cap].reshape(1, out_cap),
+                        inv_which.reshape(1), inv_slot.reshape(1),
+                        dead_local.reshape(1), dead_slot.reshape(1),
+                        assert_bad.reshape(1), asrt_a.reshape(1),
+                        asrt_f.reshape(1))
             out = (seen2.reshape(1, SC, K), seen_count2.reshape(1),
                    front_rows.reshape(1, R, W), front_count.reshape(1),
                    front_src.reshape(1, R),
@@ -347,7 +362,7 @@ class MeshExplorer(TpuExplorer):
             from jax import shard_map
         except ImportError:  # older jax
             from jax.experimental.shard_map import shard_map
-        n_out = 12 if out_cap is not None else \
+        n_out = 20 if out_cap is not None else \
             (20 if need_edges else 17)
         step = jax.jit(shard_map(
             device_step, mesh=self.mesh,
@@ -598,13 +613,22 @@ class MeshExplorer(TpuExplorer):
                 seen = seen2_
                 break
 
-            if bool(np.asarray(any_ovf)[0]):
+            ovc = int(np.asarray(any_ovf)[0])
+            if ovc:
+                if ovc == OV_DEMOTED:
+                    msg = ("a demoted compile-recovery fired (the "
+                           "kernel under-approximates here): run the "
+                           "host_seen mode, which demotes the arm to "
+                           "the interpreter and restarts — raising "
+                           "caps cannot help")
+                else:
+                    msg = ("a container exceeded its lane capacity "
+                           f"({self._caps_note()}); counts would no "
+                           "longer be exact")
                 return self._mk(False, distinct, generated, depth, t0,
                                 warnings, Violation(
                                     "error", "capacity overflow", [],
-                                    "a container exceeded its lane "
-                                    f"capacity ({self._caps_note()}); "
-                                    "counts would no longer be exact"))
+                                    msg))
             dead_np = np.asarray(dead_local)
             if model.check_deadlock and dead_np.any():
                 dv = int(np.argmax(dead_np))

@@ -48,10 +48,16 @@ def run_multihost_child(process_id: int, num_processes: int,
                         coordinator: str, local_devices: int = 4,
                         spec: str = None, cfg: str = None,
                         FC: int = 256, SC: int = 4096,
-                        max_levels: int = 200) -> Tuple[int, int]:
+                        max_levels: int = 200,
+                        store_trace: bool = True):
     """One process of the multi-host run. MUST be called before any other
-    jax initialization in the process. Returns (generated, distinct) —
-    identical on every process (psum'd totals)."""
+    jax initialization in the process. Returns (generated, distinct,
+    violation) — identical on every process (psum'd totals + the same
+    gathered trace); violation is None for a clean run, else
+    (kind, name, trace) with trace = [(state, action-label), ...], the
+    exact counterexample the single-chip MeshExplorer produces for the
+    same model over the same global device count (trace contract:
+    /root/reference/README.md:268-318)."""
     import re
     flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
                    os.environ.get("XLA_FLAGS", ""))
@@ -78,9 +84,16 @@ def run_multihost_child(process_id: int, num_processes: int,
 
     spec = spec or os.path.join(_REPO, "specs", "MCraftMicro.tla")
     cfg = cfg or os.path.join(_REPO, "specs", "MCraft_micro.cfg")
+    # the MC shims EXTEND specs that live in the reference checkout;
+    # its location is machine-specific, so take it from the environment
+    # rather than hardcoding this dev box's path
+    ref_root = os.environ.get("JAXMC_REFERENCE_ROOT", "/root/reference")
+    ref_examples = os.path.join(ref_root, "examples")
+    search = [os.path.dirname(spec)]
+    if os.path.isdir(ref_examples):
+        search.append(ref_examples)
     model = bind_model(
-        Loader([os.path.dirname(spec),
-                "/root/reference/examples"]).load_path(spec),
+        Loader(search).load_path(spec),
         parse_cfg(open(cfg).read()))
 
     # the compile pipeline is process-local and deterministic: both
@@ -110,29 +123,155 @@ def run_multihost_child(process_id: int, num_processes: int,
     distinct = len(explored)
     step = me._get_mesh_step(SC, FC, out_cap=FC)
     depth = 0
+
+    # ---- trace recording (VERDICT r4 #7): every process records ONLY
+    # its own devices' frontier/provenance shards per level; on a
+    # violation the full per-level arrays are reassembled with a
+    # process_allgather PULL (the "gather protocol") and every process
+    # independently walks the same provenance chain the single-chip
+    # MeshExplorer walks (mesh.py _mesh_trace_to), producing the exact
+    # same counterexample trace. Level 0 is the init frontier, which
+    # every process computed identically on the host.
+    from .bfs import SENTINEL
+
+    def _partials(garr, fill, dtype):
+        """(partial-full-array, ownership-mask) from MY addressable
+        shards of a [D, ...]-sharded global array."""
+        part = np.full(garr.shape, fill, dtype)
+        mask = np.zeros(garr.shape[0], bool)
+        for sh in garr.addressable_shards:
+            part[sh.index] = np.asarray(sh.data)
+            mask[sh.index[0]] = True
+        return part, mask
+
+    def _gather_full(part, mask):
+        from jax.experimental import multihost_utils as mhu
+        parts = np.asarray(mhu.process_allgather(part))
+        masks = np.asarray(mhu.process_allgather(mask))
+        out = part.copy()
+        for pi in range(parts.shape[0]):
+            out[masks[pi]] = parts[pi][masks[pi]]
+        return out
+
+    levels = [(front_h, None, np.ones(D, bool))] if store_trace else None
+
+    def _assemble_trace(dev, slot, lvl, extra=None):
+        full = []
+        for rows_p, src_p, mask in levels[:lvl + 1]:
+            if mask.all():
+                full.append((rows_p, src_p))
+            else:
+                full.append((_gather_full(rows_p, mask),
+                             _gather_full(src_p, mask)
+                             if src_p is not None else None))
+        out = []
+        d, i = dev, slot
+        C = me.A * FC
+        for lv in range(lvl, -1, -1):
+            rows, src = full[lv]
+            st = me.layout.decode(np.asarray(rows[d][i]))
+            if lv == 0:
+                out.append((st, "Initial predicate"))
+            else:
+                g = int(src[d][i])
+                a = (g % C) // FC
+                out.append((st, me.labels_flat[a]))
+                d, i = g // C, (g % C) % FC
+        out.reverse()
+        if extra is not None:
+            out.append(extra)
+        return out
+
+    def _first_bad_device(per_dev_partial, mask, pred):
+        full = _gather_full(per_dev_partial, mask)
+        for d in range(D):
+            if pred(full[d]):
+                return d, full
+        return None, full
+
     while depth < max_levels:
+        outs = step(seen, frontier, fcount)
         (seen, _seen_cnt, frontier, fcount, tot_gen, tot_new,
          any_ovf, tot_front, fixed_ovf, any_inv, any_dead,
-         any_assert) = step(seen, frontier, fcount)
-        if _local_scalar(any_ovf):
+         any_assert) = outs[:12]
+        (front_src, inv_which, inv_slot, dead_local, dead_slot,
+         assert_bad, asrt_a, asrt_f) = outs[12:]
+        ovc = _local_scalar(any_ovf)  # 0 = none, else max kernel2.OV_*
+        if ovc:
+            from ..compile.kernel2 import OV_DEMOTED
+            if ovc == OV_DEMOTED:
+                raise RuntimeError(
+                    "a demoted compile-recovery fired in the multi-host "
+                    "run (kernel under-approximates here): run the "
+                    "host_seen mode — raising caps cannot help")
             raise RuntimeError("kernel capacity overflow in the "
                                "multi-host run")
         if _local_scalar(fixed_ovf):
             raise RuntimeError(
                 f"fixed shard capacity exceeded (FC={FC}, SC={SC}): "
                 f"raise them for this model")
+        if store_trace:
+            rows_p, mask = _partials(frontier, SENTINEL, np.int32)
+            src_p, _ = _partials(front_src, -1, np.int32)
+            levels.append((rows_p, src_p, mask))
+        # violation precedence mirrors the single-chip MeshExplorer host
+        # loop EXACTLY (mesh.py: deadlock -> assert -> invariant) so a
+        # level with simultaneous violations yields the same verdict and
+        # the same counterexample on both backends
+        if model.check_deadlock and _local_scalar(any_dead):
+            if store_trace:
+                dl, mk = _partials(dead_local, 0, np.int32)
+                ds = _partials(dead_slot, -1, np.int32)[0]
+                d, _ = _first_bad_device(dl, mk, lambda x: x != 0)
+                ds_f = _gather_full(ds, mk)
+                tr = _assemble_trace(d, int(ds_f[d]), depth)
+                return generated, distinct, ("deadlock", "deadlock", tr)
+            raise RuntimeError("deadlock in the dryrun model")
         if _local_scalar(any_assert):
+            # assert fires while EXPANDING the current frontier (level
+            # `depth`): provenance is (action instance, frontier slot)
+            if store_trace:
+                ab, mk = _partials(assert_bad, 0, np.int32)
+                am = _partials(asrt_a, -1, np.int32)[0]
+                af = _partials(asrt_f, -1, np.int32)[0]
+                d, ab_full = _first_bad_device(ab, mk, lambda x: x != 0)
+                am_f = _gather_full(am, mk)
+                af_f = _gather_full(af, mk)
+                tr = _assemble_trace(d, int(af_f[d]), depth)
+                nm = f"assertion in {me.labels_flat[int(am_f[d])]}"
+                return generated, distinct, ("assert", nm, tr)
             raise RuntimeError("Assert violation in the dryrun model")
         if _local_scalar(any_inv):
+            # invariant violations live in the NEW frontier (depth+1).
+            # Selection mirrors mesh.py: the globally LOWEST violated
+            # cfg-invariant index wins, then the first device holding it
+            if store_trace:
+                from .mesh import _BIG
+                iw, mk = _partials(inv_which, int(_BIG), np.int32)
+                isl = _partials(inv_slot, -1, np.int32)[0]
+                iw_full = _gather_full(iw, mk)
+                which = int(iw_full.min())
+                d = int(np.argmax(iw_full == which))
+                isl_f = _gather_full(isl, mk)
+                nm = me.inv_fns[which][0]
+                tr = _assemble_trace(d, int(isl_f[d]), depth + 1)
+                return generated, distinct, ("invariant", nm, tr)
             raise RuntimeError("invariant violation in the dryrun model")
-        if model.check_deadlock and _local_scalar(any_dead):
-            raise RuntimeError("deadlock in the dryrun model")
         generated += _local_scalar(tot_gen)
         distinct += _local_scalar(tot_new)
         depth += 1
         if _local_scalar(tot_front) == 0:
-            return generated, distinct
+            return generated, distinct, None
     raise RuntimeError(f"did not converge in {max_levels} levels")
+
+
+def fmt_trace_line(i, st, label) -> str:
+    """One parseable line per trace step: deterministic state rendering
+    (sorted vars, sem.values.fmt) so parent processes and tests compare
+    multi-host traces against single-chip ones textually."""
+    from ..sem.values import fmt
+    body = " /\\ ".join(f"{v} = {fmt(st[v])}" for v in sorted(st))
+    return f"MHTRACE {i}: [{label}] {body}"
 
 
 def main():
@@ -142,9 +281,20 @@ def main():
     ap.add_argument("--num-processes", type=int, default=2)
     ap.add_argument("--coordinator", default="localhost:29521")
     ap.add_argument("--local-devices", type=int, default=4)
+    ap.add_argument("--spec", default=None)
+    ap.add_argument("--cfg", default=None)
+    ap.add_argument("--fc", type=int, default=256)
+    ap.add_argument("--sc", type=int, default=4096)
     a = ap.parse_args()
-    gen, dist_ = run_multihost_child(
-        a.process_id, a.num_processes, a.coordinator, a.local_devices)
+    gen, dist_, viol = run_multihost_child(
+        a.process_id, a.num_processes, a.coordinator, a.local_devices,
+        spec=a.spec, cfg=a.cfg, FC=a.fc, SC=a.sc)
+    if viol is not None:
+        kind, name, trace = viol
+        print(f"MHVIOLATION p{a.process_id}: {kind} {name} "
+              f"({len(trace)} states)", flush=True)
+        for i, (st, label) in enumerate(trace):
+            print(fmt_trace_line(i, st, label), flush=True)
     print(f"MULTIHOST p{a.process_id}: {gen} generated / "
           f"{dist_} distinct", flush=True)
 

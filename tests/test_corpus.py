@@ -42,7 +42,7 @@ FAST = [c for c in CASES if not c.slow]
     "case", FAST,
     ids=[(c.cfg or c.spec).split("/")[-1] for c in FAST])
 def test_corpus_case(case):
-    status, detail, _r = run_case(case)
+    status, detail, _r, _mode = run_case(case)
     assert status == "pass", detail
 
 

@@ -557,3 +557,19 @@ def test_nested_bool_int_collapse_raises():
     assert ev("<<1, TRUE>> = <<1, TRUE>>") is True
     assert ev("{1} \\in {{1}, {2}}") is True
     assert ev("Cardinality({{0}, {1}})") == 2
+
+
+def test_recfcn_bool_collapse_detected():
+    # r5 regression (code-review find): the _has_bool cache must force a
+    # lazy RecFcn before scanning — probing membership FIRST (which scans
+    # the then-empty memo dict) must not cache a stale False that lets a
+    # later TRUE-vs-1 equality slip through silently
+    from jaxmc.sem.eval import RecFcn
+    from jaxmc.sem.values import tla_eq, in_set, Fcn, EvalError
+    f = RecFcn([1], lambda a: True)  # f = [x \in {1} |-> TRUE], lazy
+    in_set(f, frozenset({Fcn({1: 2})}))  # scans f before it is forced
+    with pytest.raises(EvalError, match="BOOLEAN vs integer"):
+        tla_eq(f, Fcn({1: 1}))
+    g = RecFcn([1], lambda a: True)
+    with pytest.raises(EvalError, match="BOOLEAN vs integer"):
+        tla_eq(g, Fcn({1: 1}))

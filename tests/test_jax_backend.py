@@ -753,6 +753,83 @@ def test_multihost_dcn_dryrun():
     mod.dryrun_multihost(num_processes=2, local_devices=4)
 
 
+@pytest.mark.slow
+def test_multihost_trace_parity(tmp_path):
+    # VERDICT r4 #7: a violating model on the 2x4 multi-host dryrun must
+    # reproduce the EXACT single-chip counterexample trace. The child
+    # processes record only their own frontier/provenance shards and
+    # reassemble the chain with the process_allgather pull protocol;
+    # every process prints the same trace, equal line-for-line to the
+    # single-process MeshExplorer's over the same 8 global devices.
+    import socket
+    import subprocess
+    import sys as _sys
+    import time as _time
+    spec = tmp_path / "mhviol.tla"
+    spec.write_text("""---- MODULE mhviol ----
+EXTENDS Naturals
+VARIABLES x, y
+Init == x = 0 /\\ y = 0
+Next == \\/ x < 6 /\\ x' = x + 1 /\\ UNCHANGED y
+        \\/ y < 6 /\\ y' = y + 1 /\\ UNCHANGED x
+Inv == x + y < 5
+====
+""")
+    cfgp = tmp_path / "mhviol.cfg"
+    cfgp.write_text("INIT Init\nNEXT Next\nINVARIANT Inv\n")
+
+    # single-chip reference: MeshExplorer over this process's 8 virtual
+    # devices (same global device count as 2 procs x 4 below)
+    from jaxmc.tpu.mesh import MeshExplorer
+    from jaxmc.tpu.multihost import fmt_trace_line
+    model = load(str(spec), parse_cfg(cfgp.read_text()))
+    r = MeshExplorer(model).run()
+    assert not r.ok and r.violation.kind == "invariant"
+    assert r.violation.name == "Inv"
+    _replay_trace(model, r.violation.trace)
+    ref_lines = [fmt_trace_line(i, st, lbl)
+                 for i, (st, lbl) in enumerate(r.violation.trace)]
+
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    repo = os.path.dirname(SPECS)
+    procs, logs = [], []
+    for pid in range(2):
+        env = dict(os.environ, PYTHONPATH=repo)
+        env.pop("JAX_PLATFORMS", None)
+        log = tmp_path / f"mh{pid}.log"
+        logs.append(log)
+        procs.append(subprocess.Popen(
+            [_sys.executable, "-m", "jaxmc.tpu.multihost",
+             "--process-id", str(pid), "--num-processes", "2",
+             "--coordinator", f"localhost:{port}",
+             "--local-devices", "4",
+             "--spec", str(spec), "--cfg", str(cfgp)],
+            stdout=open(log, "w"), stderr=subprocess.STDOUT,
+            text=True, env=env, cwd=repo))
+    deadline = _time.time() + 1200
+    for p in procs:
+        try:
+            p.wait(timeout=max(1.0, deadline - _time.time()))
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+    per_proc = []
+    for pid, log in enumerate(logs):
+        text = log.read_text()
+        assert procs[pid].returncode == 0, text[-2000:]
+        assert "MHVIOLATION" in text, text[-2000:]
+        lines = [ln for ln in text.splitlines()
+                 if ln.startswith("MHTRACE ")]
+        per_proc.append(lines)
+    assert per_proc[0] == per_proc[1], "processes disagree on the trace"
+    assert per_proc[0] == ref_lines, (
+        "multi-host trace differs from the single-chip mesh trace:\n"
+        + "\n".join(per_proc[0]) + "\n--- vs ---\n" + "\n".join(ref_lines))
+
+
 class TestMeshRefinementTemporal:
     """Refinement + temporal PROPERTYs on the MESH backend (VERDICT r3
     #9): the host runs the same stepwise/behavior-graph checkers over
@@ -801,6 +878,45 @@ JumpSpec == HCini /\\ [][Jump]_hr
         r = MeshExplorer(model).run()
         assert r.ok and r.distinct == 240 and r.generated == 1392
         assert not any("NOT checked" in w for w in r.warnings), r.warnings
+
+
+def test_per_arm_demotion_keeps_siblings_compiled(tmp_path):
+    # VERDICT r4 #3 (finer demotion granularity): Next has raft's shape
+    # /\ (\/ ...actions...) /\ rider (raft.tla:482-493). split_arms now
+    # distributes the rider over the disjuncts, so ONE uncompilable
+    # action (recursion here) demotes only its own arm — the sibling
+    # arms stay compiled — and the hybrid run still matches the
+    # interpreter exactly. Before this, the whole conjunction was a
+    # single arm and any demotion sent 100% of the model to the interp.
+    from jaxmc.tpu.bfs import TpuExplorer
+    from jaxmc.engine.explore import Explorer
+    spec = tmp_path / "armgran.tla"
+    spec.write_text("""---- MODULE armgran ----
+EXTENDS Naturals
+VARIABLES x, h
+RECURSIVE Fib(_)
+Fib(n) == IF n <= 1 THEN n ELSE Fib(n - 1) + Fib(n - 2)
+Init == x = 0 /\\ h = {}
+Bump == x < 6 /\\ x' = x + 1
+Drop == x > 2 /\\ x' = x - 2
+Weird == x = 6 /\\ x' = Fib(x) % 5
+Next == /\\ Bump \\/ Drop \\/ Weird
+        /\\ h' = h \\cup {x}
+====
+""")
+    cfg = ModelConfig(specification=None, init="Init", next="Next",
+                      check_deadlock=False)
+    model = load(str(spec), cfg)
+    ri = Explorer(model).run()
+    assert ri.ok
+    ex = TpuExplorer(model, store_trace=False, host_seen=True)
+    assert len(ex.fb_arms) == 1, \
+        [r for _, r in ex.fb_arms]  # only Weird demotes
+    assert ex.A >= 2  # Bump and Drop (with the rider) stay compiled
+    assert len(ex.arms) == 3
+    r = ex.run()
+    assert r.ok
+    assert (r.generated, r.distinct) == (ri.generated, ri.distinct)
 
 
 def test_adaptive_relayout_recovers_unobserved_variant(tmp_path):
