@@ -791,6 +791,7 @@ class TpuExplorer:
                             overflow=jnp.max(overflow, initial=0),
                             inv_ok=inv_ok, explore=explore)
 
+            hstep.is_async = True  # fused jit: dispatch is asynchronous
             self._hstep_cache[FC] = hstep
             return hstep
 
@@ -1679,11 +1680,34 @@ class TpuExplorer:
             lvl_edges: List[Tuple[np.ndarray, np.ndarray]] = []
             lvl_dead = np.zeros(L, bool)  # deferred when fb arms exist
             inv_hit = None
+
+            # SURVEY §2.3 pipeline overlap: chunk i+1 is DISPATCHED to
+            # the device before chunk i's outputs are forced, so
+            # successor generation overlaps the host-side spill (native
+            # store insert), deferred predicate checks, and trace
+            # bookkeeping. Exact: the device step depends only on its
+            # own chunk, and host processing stays in chunk order.
+            # Only when the step actually dispatches asynchronously
+            # (the fused jit path — _get_hstep tags it): prefetching a
+            # synchronous split step yields no overlap and pays one
+            # full wasted chunk on every early exit (OV_DEMOTED
+            # restarts included). Cost when active: TWO chunks'
+            # [A*CH, W] outputs live at once — size --chunk with that
+            # 2x in mind.
+            prefetch = getattr(hstep, "is_async", False)
+
+            def _dispatch(b, fnp=frontier_np, ll=L):
+                c = min(CH, ll - b)
+                bf = np.full((CH, W), SENTINEL, np.int32)
+                bf[:c] = fnp[b:b + c]
+                return b, c, bf, hstep(jnp.asarray(bf), c)
+
+            nxt = None  # one-slot prefetch: the chunk dispatched early
             for base in range(0, L, CH):
-                cn = min(CH, L - base)
-                buf = np.full((CH, W), SENTINEL, np.int32)
-                buf[:cn] = frontier_np[base:base + cn]
-                out = hstep(jnp.asarray(buf), cn)
+                _b, cn, buf, out = nxt if nxt is not None \
+                    else _dispatch(base)
+                nxt = _dispatch(base + CH) \
+                    if prefetch and base + CH < L else None
                 ovc = int(out["overflow"])
                 if ovc:
                     self._last_ovf_code = ovc
