@@ -1693,8 +1693,10 @@ class TpuExplorer:
             # full wasted chunk on every early exit (OV_DEMOTED
             # restarts included). Cost when active: TWO chunks'
             # [A*CH, W] outputs live at once — size --chunk with that
-            # 2x in mind.
-            prefetch = getattr(hstep, "is_async", False)
+            # 2x in mind, or set JAXMC_NO_PREFETCH=1 to restore the
+            # sequential loop when the doubled working set won't fit
+            prefetch = getattr(hstep, "is_async", False) and \
+                os.environ.get("JAXMC_NO_PREFETCH") != "1"
 
             def _dispatch(b, fnp=frontier_np, ll=L):
                 c = min(CH, ll - b)
@@ -2079,9 +2081,25 @@ class TpuExplorer:
         cap = 20000
         rows = self._last_frontier_np
         if len(rows) > cap:
-            self.log(f"hybrid: relayout enrichment capped at {cap} of "
-                     f"{len(rows)} abort-frontier rows")
-            rows = rows[:cap]
+            if self.relayouts_left <= 1 and len(rows) <= 10 * cap:
+                # last attempt: pay for the FULL frontier (bounded at
+                # 10x the per-attempt cap) — a sample that misses the
+                # offending parent row would repeat the same abort and
+                # waste the attempt. Frontiers beyond the bound stay
+                # strided; arm demotion remains the exact safety valve
+                self.log(f"hybrid: final relayout attempt — enriching "
+                         f"from ALL {len(rows)} abort-frontier rows")
+            else:
+                # stride over the WHOLE frontier (not a prefix: the
+                # missing variant's parent can sit anywhere), with a
+                # per-attempt offset so a repeated abort at the same
+                # frontier enriches from DIFFERENT rows each time
+                stride = -(-len(rows) // cap)
+                off = self.relayouts_left % stride
+                self.log(f"hybrid: relayout enrichment strided (rows "
+                         f"{off}::{stride} of {len(rows)} in the abort "
+                         f"frontier)")
+                rows = rows[off::stride]
         # states whose encode failed are known exactly — include them
         # directly so recovery never depends on the cap
         enrich: List[Dict[str, Any]] = list(self._relayout_states)
