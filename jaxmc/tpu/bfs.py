@@ -2104,6 +2104,7 @@ class TpuExplorer:
         # directly so recovery never depends on the cap
         enrich: List[Dict[str, Any]] = list(self._relayout_states)
         base_ctx = model.ctx()
+        enrich_cap = 400_000  # hard memory ceiling on successor dicts
         try:
             for row in rows:
                 # frontier states themselves are already encodable (they
@@ -2113,6 +2114,11 @@ class TpuExplorer:
                 for succ, _ in enumerate_next(model.next, base_ctx,
                                               model.vars, st):
                     enrich.append(succ)
+                if len(enrich) >= enrich_cap:
+                    self.log(f"hybrid: relayout enrichment truncated "
+                             f"at {len(enrich)} successor states "
+                             f"(memory ceiling)")
+                    break
         except (EvalError, TLCAssertFailure):
             return None
         self.log(f"hybrid: adaptive relayout — re-sampling with "

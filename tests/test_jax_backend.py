@@ -754,6 +754,28 @@ def test_multihost_dcn_dryrun():
 
 
 @pytest.mark.slow
+def test_mcraft_3s_mid4_completes_exhaustively():
+    # The MCraft_3s ladder's first completed rung (VERDICT r4 #2):
+    # reference raft.tla with Server={s1,s2,s3}, MaxMsgDomain 4
+    # (specs/MCraft_3s_mid4.cfg — one step below the BASELINE model of
+    # record). First measured completion: 11,883,463 generated /
+    # 714,286 distinct, no violation, via the per-arm-granular hybrid
+    # with strided adaptive relayout (one relayout recovered the
+    # message variant the sampler missed). ~46 min on the contended
+    # 1-core dev box at 6.6k st/s steady state.
+    from jaxmc.tpu.bfs import TpuExplorer
+    ldr = Loader([os.path.join(REFERENCE, "examples"), SPECS])
+    model = bind_model(
+        ldr.load_path(os.path.join(SPECS, "MCraftMicro.tla")),
+        parse_cfg(open(os.path.join(SPECS, "MCraft_3s_mid4.cfg")).read()))
+    ex = TpuExplorer(model, store_trace=False, host_seen=True,
+                     sample_cfg=(3000, 200, 100))
+    r = ex.run()
+    assert r.ok
+    assert (r.generated, r.distinct) == (11883463, 714286)
+
+
+@pytest.mark.slow
 def test_multihost_trace_parity(tmp_path):
     # VERDICT r4 #7: a violating model on the 2x4 multi-host dryrun must
     # reproduce the EXACT single-chip counterexample trace. The child
