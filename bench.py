@@ -49,6 +49,16 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 _REPO = os.path.dirname(os.path.abspath(__file__))
 
+from jaxmc import obs  # noqa: E402  (needs the sys.path insert; no jax)
+
+# Parent-side phase recorder (ISSUE 1 / BENCH_r05 forensics): every child
+# run, probe, and profile capture reports a span, and the final JSON line
+# carries the rollup — so a deadline blowout names its culprit (device
+# init vs compile vs BFS) instead of only "device bench did not finish".
+# Children still in flight at emit time surface as open=True partial
+# spans with their elapsed-so-far. main() swaps in a real Telemetry.
+_TEL = obs.NullTelemetry()
+
 SPEC = os.path.join(_REPO, "specs", "MCraftMicro.tla")
 CFG_FULL = os.path.join(_REPO, "specs", "MCraft_3s_bench.cfg")
 CFG_QUICK = os.path.join(_REPO, "specs", "MCraft_micro.cfg")
@@ -87,12 +97,14 @@ def _remaining():
 def child_bench(platform_pin: str, rung: str):
     """The measured bench body. Runs in a child process with the platform
     pinned BEFORE first jax import; prints the JSON line on stdout."""
-    import jax
-    # pin the platform: a tunnel drop between probe and child start must
-    # fail this child loudly (parent falls back), never silently measure
-    # on CPU while claiming the TPU slot
-    jax.config.update("jax_platforms", platform_pin)
-    devs = jax.devices()
+    tel = obs.Telemetry()
+    with tel.span("device_init", platform=platform_pin):
+        import jax
+        # pin the platform: a tunnel drop between probe and child start
+        # must fail this child loudly (parent falls back), never silently
+        # measure on CPU while claiming the TPU slot
+        jax.config.update("jax_platforms", platform_pin)
+        devs = jax.devices()
     assert devs[0].platform == platform_pin, \
         f"pinned {platform_pin} but got {devs[0].platform}"
 
@@ -106,30 +118,44 @@ def child_bench(platform_pin: str, rung: str):
     def load_model():
         ldr = Loader([os.path.join(_REPO, "specs"),
                       "/root/reference/examples"])
-        return bind_model(ldr.load_path(SPEC),
-                          parse_cfg(open(cfg_path).read()))
+        with open(cfg_path) as fh:
+            return bind_model(ldr.load_path(SPEC), parse_cfg(fh.read()))
 
     # resident device mode: the whole BFS (frontier, fingerprint set,
     # level loop) runs inside one jitted while_loop on the accelerator —
     # the tunnel's ~160ms round-trip would otherwise dominate. The
     # warm-up run compiles the jit cache AND trains the capacity buckets,
     # so the timed run replays with zero recompiles.
-    ex = TpuExplorer(load_model(), store_trace=False, resident=True)
-    r_warm = ex.run()
-    assert r_warm.ok, "bench workload must pass"
-    t0 = time.time()
-    r = ex.run()
-    jax_wall = time.time() - t0
-    assert r.ok and r.distinct == r_warm.distinct
-    jax_rate = r.generated / jax_wall
+    #
+    # Child-side phase breakdown: the spans ride the JSON line out, so
+    # the artifact of record says how the child's own wall time split
+    # between device init, engine build (layout + kernel compile), the
+    # warm-up (XLA compile proper), the timed run, and the interp
+    # baseline.
+    with obs.use(tel):
+        with tel.span("engine_build"):
+            ex = TpuExplorer(load_model(), store_trace=False,
+                             resident=True)
+        with tel.span("warmup_run"):
+            r_warm = ex.run()
+        assert r_warm.ok, "bench workload must pass"
+        tel.reset_levels("timed run replay")
+        t0 = time.time()
+        with tel.span("timed_run"):
+            r = ex.run()
+        jax_wall = time.time() - t0
+        assert r.ok and r.distinct == r_warm.distinct
+        jax_rate = r.generated / jax_wall
 
-    # interpreter baseline on a capped prefix of the same workload (the
-    # interp rate is flat in search depth; full run measured at the same
-    # ~5.6k st/s — see specs/MCraft_3s_bench.cfg header)
-    ri = Explorer(load_model(), max_states=INTERP_CAP).run()
-    interp_rate = ri.generated / ri.wall_s
+        # interpreter baseline on a capped prefix of the same workload
+        # (the interp rate is flat in search depth; full run measured at
+        # the same ~5.6k st/s — see specs/MCraft_3s_bench.cfg header)
+        with tel.span("interp_baseline"):
+            ri = Explorer(load_model(), max_states=INTERP_CAP).run()
+        interp_rate = ri.generated / ri.wall_s
 
     out = {
+        "phases": tel.phase_list(),
         "metric": (
             f"states/sec, exhaustive raft (reference raft.tla, "
             f"{os.path.basename(cfg_path)}: "
@@ -152,18 +178,27 @@ def child_bench(platform_pin: str, rung: str):
 def child_emergency():
     """Interp-only floor measurement: no XLA compile anywhere, so it
     lands in well under a minute. Honest label: interpreter rate,
-    vs_baseline 1.0 by construction."""
+    vs_baseline 1.0 by construction. Phase spans ride along even here —
+    the emergency line is exactly the one that used to say only 'the
+    device bench did not finish' with no forensic record."""
     from jaxmc.sem.modules import Loader, bind_model
     from jaxmc.front.cfg import parse_cfg
     from jaxmc.engine.explore import Explorer
 
-    ldr = Loader([os.path.join(_REPO, "specs"), "/root/reference/examples"])
-    model = bind_model(ldr.load_path(SPEC),
-                       parse_cfg(open(CFG_QUICK).read()))
-    r = Explorer(model).run()
+    tel = obs.Telemetry()
+    with obs.use(tel):
+        with tel.span("load"):
+            ldr = Loader([os.path.join(_REPO, "specs"),
+                          "/root/reference/examples"])
+            with open(CFG_QUICK) as fh:
+                model = bind_model(ldr.load_path(SPEC),
+                                   parse_cfg(fh.read()))
+        with tel.span("search"):
+            r = Explorer(model).run()
     assert r.ok
     rate = r.generated / r.wall_s
     out = {
+        "phases": tel.phase_list(),
         "metric": (
             f"states/sec, exhaustive raft (reference raft.tla, "
             f"MCraft_micro: {r.generated} generated / {r.distinct} "
@@ -218,7 +253,9 @@ _STOPPING = threading.Event()  # set by main() before the kill loop
 
 def _run_child(env_extra: dict, timeout_s: float, tag: str):
     """Run bench.py as a child with env markers; return its JSON line or
-    None. Registers the Popen so main() can kill stragglers at exit."""
+    None. Registers the Popen so main() can kill stragglers at exit.
+    Each attempt is a parent-side span (outcome in the attrs), so the
+    emitted line's phase rollup says where the deadline budget went."""
     if timeout_s <= 5 or _STOPPING.is_set():
         _log(f"{tag}: skipped (no time left)")
         return None
@@ -234,17 +271,20 @@ def _run_child(env_extra: dict, timeout_s: float, tag: str):
                              stdout=subprocess.PIPE,
                              stderr=subprocess.PIPE, text=True, env=env)
         _PROCS.append(p)
-    try:
-        out, err = p.communicate(timeout=timeout_s)
-    except subprocess.TimeoutExpired:
-        p.kill()
-        p.communicate()
-        _log(f"{tag}: timed out after {timeout_s:.0f}s")
-        return None
-    finally:
-        with _PROCS_LOCK:
-            if p in _PROCS:
-                _PROCS.remove(p)
+    with _TEL.span(f"child:{tag}",
+                   timeout_s=round(timeout_s, 1)) as span:
+        try:
+            out, err = p.communicate(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            p.communicate()
+            _log(f"{tag}: timed out after {timeout_s:.0f}s")
+            span.attrs["outcome"] = "timeout"
+            return None
+        finally:
+            with _PROCS_LOCK:
+                if p in _PROCS:
+                    _PROCS.remove(p)
     sys.stderr.write(err or "")
     if p.returncode != 0:
         _log(f"{tag}: child rc={p.returncode}")
@@ -263,9 +303,10 @@ def probe_tpu_once(timeout_s: float) -> tuple:
     terminal) | 'retry' (init hung or errored — tunnel may come back)."""
     code = "import jax; print(jax.devices()[0].platform)"
     try:
-        r = subprocess.run([sys.executable, "-c", code],
-                           capture_output=True, text=True,
-                           timeout=timeout_s)
+        with _TEL.span("tpu_probe", timeout_s=round(timeout_s, 1)):
+            r = subprocess.run([sys.executable, "-c", code],
+                               capture_output=True, text=True,
+                               timeout=timeout_s)
     except subprocess.TimeoutExpired:
         return "retry", f"device init timed out after {timeout_s:.0f}s"
     if r.returncode != 0:
@@ -392,7 +433,8 @@ def _run_profile_tpu(timeout_s: float):
     STRAIGHT to the file so a timeout-kill keeps the partial output."""
     out_path = os.path.join(_REPO, "PROFILE_TPU.txt")
     try:
-        with open(out_path, "w") as fh:
+        with _TEL.span("profile_tpu", timeout_s=round(timeout_s, 1)), \
+                open(out_path, "w") as fh:
             p = subprocess.Popen([sys.executable,
                                   os.path.join(_REPO, "profile_tpu.py")],
                                  stdout=fh, stderr=subprocess.STDOUT,
@@ -416,7 +458,7 @@ def _run_profile_tpu(timeout_s: float):
 
 
 def main():
-    global _DEADLINE
+    global _DEADLINE, _TEL
     pin = os.environ.get("JAXMC_BENCH_CHILD")
     if pin == "emergency":
         child_emergency()
@@ -427,6 +469,8 @@ def main():
 
     budget = float(os.environ.get("JAXMC_BENCH_DEADLINE", "480"))
     _DEADLINE = time.time() + budget
+    _TEL = obs.Telemetry(meta={"command": "bench",
+                               "deadline_s": budget})
     _log(f"deadline: {budget:.0f}s from now")
 
     t_cpu = threading.Thread(target=_cpu_worker, daemon=True)
@@ -457,6 +501,13 @@ def main():
             except OSError:
                 pass
     key, line = _RESULTS.best()
+    # orchestration phases: every child attempt/probe/profile span, with
+    # open=True partials for work still in flight at emit time — the
+    # record that says where the deadline budget went even when the
+    # device path never produced a line
+    orch = {"deadline_s": budget,
+            "spent_s": round(budget - _remaining(), 1),
+            "phases": _TEL.phase_list()}
     if line is None:
         # truly nothing (emergency child itself failed): emit an explicit
         # failure record rather than silence — parseable, value null
@@ -464,9 +515,16 @@ def main():
         print(json.dumps({
             "metric": "bench produced no measurement before deadline "
                       "(see stderr)", "value": None,
-            "unit": "states/sec", "vs_baseline": None}), flush=True)
+            "unit": "states/sec", "vs_baseline": None,
+            "orchestration": orch}), flush=True)
         sys.exit(1)
     _log(f"emitting {key[0]}/{key[1]} line")
+    try:
+        rec = json.loads(line)
+        rec["orchestration"] = orch
+        line = json.dumps(rec)
+    except ValueError:
+        pass  # never let telemetry break the artifact of record
     print(line, flush=True)
 
 

@@ -17,6 +17,13 @@ import sys
 import time
 
 
+def _read_text(path: str) -> str:
+    """Read a cfg/spec file WITHOUT leaking the handle (the old
+    `open(...).read()` pattern relied on refcount finalization)."""
+    with open(path, encoding="utf-8", errors="replace") as fh:
+        return fh.read()
+
+
 def _load_model(spec_path: str, cfg_path, no_deadlock: bool,
                 includes=()):
     from .front.cfg import parse_cfg, ModelConfig
@@ -27,8 +34,7 @@ def _load_model(spec_path: str, cfg_path, no_deadlock: bool,
         if os.path.exists(guess):
             cfg_path = guess
     if cfg_path:
-        cfg = parse_cfg(open(cfg_path, encoding="utf-8",
-                             errors="replace").read())
+        cfg = parse_cfg(_read_text(cfg_path))
     else:
         cfg = ModelConfig(specification="Spec")
     if no_deadlock:
@@ -48,8 +54,7 @@ def _check_assumes(spec_path: str, cfg_path, includes=()) -> int:
     from .sem.eval import Ctx, eval_expr
     from .sem.values import fmt
 
-    cfg = parse_cfg(open(cfg_path, encoding="utf-8", errors="replace").read()) \
-        if cfg_path else ModelConfig()
+    cfg = parse_cfg(_read_text(cfg_path)) if cfg_path else ModelConfig()
     ldr = Loader([os.path.dirname(os.path.abspath(spec_path))] +
                  list(includes))
     mod = ldr.load_path(spec_path)
@@ -74,56 +79,109 @@ def _check_assumes(spec_path: str, cfg_path, includes=()) -> int:
 
 
 def cmd_check(args) -> int:
+    from . import obs
+
+    t0 = time.time()
+    # telemetry is a PARALLEL channel: stdout stays byte-identical; a
+    # NullTelemetry (every method a no-op) serves runs that asked for no
+    # artifact, so the engines' instrumentation costs nothing
+    want_tel = bool(args.metrics_out or args.trace)
+    tel = obs.Telemetry(
+        trace_path=args.trace,
+        meta={"command": "check", "backend": args.backend,
+              "spec": args.spec, "cfg": args.cfg,
+              "argv": list(sys.argv[1:])}) if want_tel \
+        else obs.NullTelemetry()
+    log = obs.Logger(tel, quiet=args.quiet)
+    try:
+        with obs.use(tel):
+            return _run_check(args, tel, log, t0)
+    finally:
+        tel.close()
+
+
+def _metrics_error(args, tel, error: str) -> None:
+    if args.metrics_out:
+        tel.write_metrics(args.metrics_out,
+                          result={"ok": False, "distinct": 0,
+                                  "generated": 0, "diameter": 0,
+                                  "truncated": False, "error": error})
+
+
+def _run_check(args, tel, log, t0) -> int:
     from .engine.explore import Explorer, format_trace
     from .front.cfg import parse_cfg
 
-    t0 = time.time()
     if args.cfg or os.path.exists(os.path.splitext(args.spec)[0] + ".cfg"):
         cfgp = args.cfg or os.path.splitext(args.spec)[0] + ".cfg"
-        c = parse_cfg(open(cfgp, encoding="utf-8", errors="replace").read())
+        c = parse_cfg(_read_text(cfgp))
         if not c.specification and not c.init:
-            return _check_assumes(args.spec, cfgp, args.include)
-    model = _load_model(args.spec, args.cfg, args.no_deadlock,
-                        args.include)
-    log = (lambda s: None) if args.quiet else print
+            rc = _check_assumes(args.spec, cfgp, args.include)
+            if args.metrics_out:
+                tel.write_metrics(args.metrics_out,
+                                  result={"ok": rc == 0, "distinct": 0,
+                                          "generated": 0, "diameter": 0,
+                                          "truncated": False,
+                                          "mode": "assumes"})
+            return rc
+    with tel.span("load", spec=args.spec):
+        model = _load_model(args.spec, args.cfg, args.no_deadlock,
+                            args.include)
     if args.backend == "interp":
-        ex = Explorer(model, log=log, max_states=args.max_states,
-                      progress_every=args.progress_every,
-                      checkpoint_path=args.checkpoint,
-                      checkpoint_every=args.checkpoint_every,
-                      resume_from=args.resume)
-        res = ex.run()
+        with tel.span("search"):
+            ex = Explorer(model, log=log, max_states=args.max_states,
+                          progress_every=args.progress_every,
+                          checkpoint_path=args.checkpoint,
+                          checkpoint_every=args.checkpoint_every,
+                          resume_from=args.resume)
+            res = ex.run()
     else:
         try:
-            if getattr(args, "platform", None):
+            platform = getattr(args, "platform", None)
+            with tel.span("device_init",
+                          platform=platform or "default"):
                 import jax
-                jax.config.update("jax_platforms", args.platform)
-            from .tpu.bfs import TpuExplorer
+                if platform:
+                    jax.config.update("jax_platforms", platform)
+                from .tpu.bfs import TpuExplorer
+                if tel.enabled:
+                    # force plugin/device init inside the span so a hung
+                    # tunnel is attributed to device_init, not compile
+                    tel.gauge("device.platform",
+                              jax.devices()[0].platform)
+                    tel.gauge("device.count", len(jax.devices()))
         except ImportError as e:
             print(f"error: the jax backend is not available in this build "
                   f"({e})", file=sys.stderr)
+            _metrics_error(args, tel, f"jax unavailable: {e}")
             return 2
         from .compile.vspec import Bounds, CompileError, ModeError
         bounds = Bounds(seq_cap=args.seq_cap, grow_cap=args.grow_cap,
                         kv_cap=args.kv_cap)
         try:
-            res = TpuExplorer(model, log=log, bounds=bounds,
-                              store_trace=not args.no_trace,
-                              progress_every=args.progress_every,
-                              host_seen=args.host_seen, chunk=args.chunk,
-                              resident=args.resident,
-                              sample_cfg=tuple(args.sample),
-                              checkpoint_path=args.checkpoint,
-                              checkpoint_every=args.checkpoint_every,
-                              resume_from=args.resume,
-                              max_states=args.max_states).run()
+            with tel.span("engine_build"):
+                ex = TpuExplorer(model, log=log, bounds=bounds,
+                                 store_trace=not args.no_trace,
+                                 progress_every=args.progress_every,
+                                 host_seen=args.host_seen,
+                                 chunk=args.chunk,
+                                 resident=args.resident,
+                                 sample_cfg=tuple(args.sample),
+                                 checkpoint_path=args.checkpoint,
+                                 checkpoint_every=args.checkpoint_every,
+                                 resume_from=args.resume,
+                                 max_states=args.max_states)
+            with tel.span("search"):
+                res = ex.run()
         except ModeError as e:
             print(f"error: {e}", file=sys.stderr)
+            _metrics_error(args, tel, str(e))
             return 2
         except CompileError as e:
             print(f"error: this spec is outside the jax backend's "
                   f"compilable subset ({e}); re-run with "
                   f"--backend interp", file=sys.stderr)
+            _metrics_error(args, tel, str(e))
             return 2
     wall = time.time() - t0
     print(f"{res.generated} states generated, {res.distinct} distinct states "
@@ -131,6 +189,20 @@ def cmd_check(args) -> int:
           f"backend={args.backend}, wall {wall:.2f}s)")
     for w in getattr(res, "warnings", []):
         print(f"Warning: {w}")
+    if args.metrics_out:
+        mst = getattr(model, "_memo", None)
+        if mst is not None:
+            tel.gauge("memo.hits", mst.hits)
+            tel.gauge("memo.misses", mst.misses)
+        result = {"ok": res.ok, "distinct": res.distinct,
+                  "generated": res.generated, "diameter": res.diameter,
+                  "truncated": bool(getattr(res, "truncated", False)),
+                  "wall_s": round(res.wall_s, 6),
+                  "warnings": list(getattr(res, "warnings", []))}
+        if res.violation is not None:
+            result["violation"] = {"kind": res.violation.kind,
+                                   "name": res.violation.name}
+        tel.write_metrics(args.metrics_out, result=result)
     if res.ok:
         if getattr(res, "truncated", False):
             print("Search TRUNCATED at state limit - no error found in the "
@@ -164,8 +236,8 @@ def cmd_simulate(args) -> int:
 
 def cmd_sweep(args) -> int:
     from .corpus import sweep
-    return 1 if sweep(backend=args.backend,
-                      include_slow=args.slow) else 0
+    return 1 if sweep(backend=args.backend, include_slow=args.slow,
+                      metrics_out=args.metrics_out) else 0
 
 
 def cmd_info(args) -> int:
@@ -245,6 +317,17 @@ def main(argv=None) -> int:
     c.add_argument("--resume", default=None,
                    help="resume a run from a checkpoint (the backend and "
                         "device mode must match the writing run's)")
+    c.add_argument("--metrics-out", default=None, metavar="FILE",
+                   help="write an end-of-run JSON metrics artifact: "
+                        "phase wall times, per-level BFS counts, "
+                        "expansion-mode/memo/fingerprint counters and "
+                        "the result block (schema jaxmc.metrics/1; see "
+                        "jaxmc/obs/schema.py)")
+    c.add_argument("--trace", default=None, metavar="FILE",
+                   help="stream telemetry events as JSONL while the run "
+                        "is live (span_open/span/level/log); a killed "
+                        "run leaves open spans naming the phase it "
+                        "died in")
     c.set_defaults(fn=cmd_check)
 
     m = sub.add_parser("simulate",
@@ -273,6 +356,10 @@ def main(argv=None) -> int:
                    default="interp")
     s.add_argument("--slow", action="store_true",
                    help="include the multi-minute models")
+    s.add_argument("--metrics-out", default=None, metavar="FILE",
+                   help="write a per-case JSON metrics artifact "
+                        "(status, wall time, expansion mode) next to "
+                        "the sweep log")
     s.set_defaults(fn=cmd_sweep)
 
     args = ap.parse_args(argv)

@@ -2177,6 +2177,7 @@ class Layout2:
 def build_layout2(model: Model, sampled_states: List[Dict[str, Any]],
                   bounds: Bounds) -> Layout2:
     from .vspec import (apply_bounds, collect_enums_from_value, infer)
+    from .. import obs
     uni = EnumUniverse()
     # enum universe: every sampled value + every string literal in the
     # module AST + cfg model values (guards may compare against literals
@@ -2195,7 +2196,11 @@ def build_layout2(model: Model, sampled_states: List[Dict[str, Any]],
             s2 = infer(st[var], uni)
             sp = s2 if sp is None else vs_merge(sp, s2)
         specs[var] = apply_bounds(sp, bounds)
-    return Layout2(tuple(model.vars), specs, uni)
+    lay = Layout2(tuple(model.vars), specs, uni)
+    tel = obs.current()
+    tel.gauge("layout.enum_universe", len(uni.values))
+    tel.gauge("layout.samples", len(sampled_states))
+    return lay
 
 
 def _collect_ast_strings(model: Model, uni: EnumUniverse):
@@ -2435,7 +2440,10 @@ def compile_action2(kc: KernelCtx, ga) -> CompiledAction2:
                        jnp.where(cap, OV_CAPACITY, 0)).astype(jnp.int32)
         return en, ak, ov, succ
 
+    from .. import obs
+    obs.current().counter("compile.kernels_built")
     if slotted:
+        obs.current().counter("compile.slotted_instances", n_slots)
         return CompiledAction2(ga.label, fn, n_slots=n_slots,
                                demoted_guards=demoted_guards)
     return CompiledAction2(ga.label, lambda row: fn(row, None),

@@ -189,8 +189,11 @@ def run_case(case: Case, backend: str = "interp"):
 
     spec = case.spec_path()
     cfgp = case.cfg_path()
-    cfg = parse_cfg(open(cfgp).read()) if cfgp else ModelConfig(
-        specification="Spec")
+    if cfgp:
+        with open(cfgp) as fh:
+            cfg = parse_cfg(fh.read())
+    else:
+        cfg = ModelConfig(specification="Spec")
     if case.no_deadlock:
         cfg.check_deadlock = False
     ldr = Loader([os.path.dirname(spec)] + case.include_dirs())
@@ -331,17 +334,24 @@ def _run_case_isolated(idx: int, backend: str, timeout_s: float):
 
 
 def sweep(backend: str = "interp", include_slow: bool = False,
-          log=print, isolate: Optional[bool] = None) -> int:
+          log=print, isolate: Optional[bool] = None,
+          metrics_out: Optional[str] = None) -> int:
     """Check the whole corpus; returns the number of failures.
     Logs explicit pass/violation/skip/fail tallies — a sweep where every
-    model skips is visibly NOT a clean sweep."""
+    model skips is visibly NOT a clean sweep. With metrics_out (or env
+    JAXMC_SWEEP_METRICS_OUT) the per-case record — status, wall time,
+    expansion mode — lands in a JSON artifact so future SWEEP logs carry
+    a machine-readable phase breakdown, not only free text."""
     if isolate is None:
         isolate = backend == "jax" and \
             os.environ.get("JAXMC_SWEEP_INPROC") != "1"
+    if metrics_out is None:
+        metrics_out = os.environ.get("JAXMC_SWEEP_METRICS_OUT") or None
     timeout_s = float(os.environ.get("JAXMC_SWEEP_TIMEOUT", "900"))
     tallies = {"pass": 0, "fail": 0, "skip": 0}
     modes = {"compiled": 0, "hybrid": 0, "interp-arms": 0}
     expected_violations = 0
+    case_records = []
     t0 = time.time()
     n = 0
     for i, case in enumerate(CASES):
@@ -367,6 +377,10 @@ def sweep(backend: str = "interp", include_slow: bool = False,
             expected_violations += 1
         if mode in modes:
             modes[mode] += 1
+        case_records.append({"case": name, "status": status,
+                             "expect": case.expect, "mode": mode,
+                             "wall_s": round(time.time() - t1, 3),
+                             "detail": detail})
     # advisor r3: disclose the platform isolated cases were pinned to —
     # `sweep --backend jax` on a TPU machine validates the CPU path
     # unless JAXMC_SWEEP_PLATFORM says otherwise, and the summary must
@@ -390,4 +404,16 @@ def sweep(backend: str = "interp", include_slow: bool = False,
         f"{tallies['fail']} FAIL "
         f"({time.time() - t0:.1f}s, backend={backend}{plat_note})"
         f"{mode_note}")
+    if metrics_out:
+        from . import obs
+        art = {"schema": "jaxmc.sweep-metrics/1", "backend": backend,
+               "isolated": bool(isolate),
+               "platform": os.environ.get("JAXMC_SWEEP_PLATFORM", "cpu")
+               if isolate else None,
+               "wall_s": round(time.time() - t0, 3),
+               "tallies": dict(tallies, total=n,
+                               expected_violations=expected_violations),
+               "modes": modes, "cases": case_records}
+        obs.write_json_atomic(metrics_out, art)
+        log(f"sweep metrics written to {metrics_out}")
     return tallies["fail"]

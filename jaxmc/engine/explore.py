@@ -96,8 +96,12 @@ class Explorer:
                  checkpoint_path: Optional[str] = None,
                  checkpoint_every: float = 600.0,
                  resume_from: Optional[str] = None):
+        from .. import obs
         self.model = model
-        self.log = log or (lambda s: None)
+        # default sink: silent on stdout but still mirrored into the
+        # telemetry trace (obs.Logger is THE log funnel — cli.py passes
+        # a printing one; library callers get the quiet one)
+        self.log = log if log is not None else obs.Logger(quiet=True)
         self.max_states = max_states
         self.progress_every = progress_every
         self.trace_parents = trace_parents
@@ -138,9 +142,11 @@ class Explorer:
         return out
 
     def run(self) -> CheckResult:
+        from .. import obs
         model = self.model
         vars = model.vars
         t0 = time.time()
+        tel = obs.current()
         base_ctx = self._ctx()
 
         # state table
@@ -253,11 +259,33 @@ class Explorer:
             collect_edges = False
         edges: List[Tuple[int, int]] = []
 
+        # per-level BFS telemetry: record level d when its last state has
+        # been expanded (the queue is depth-ordered, so the first pop of
+        # depth d+1 closes level d); `lv` accumulates the in-flight level
+        lv = {"depth": 0, "frontier": 0, "generated": 0, "new": 0,
+              "t0": time.time()}
+
+        def flush_level():
+            if lv["frontier"] == 0 and lv["generated"] == 0:
+                return
+            tel.level(lv["depth"], frontier=lv["frontier"],
+                      generated=lv["generated"], new=lv["new"],
+                      distinct=len(states), seen=len(seen),
+                      queue=len(queue),
+                      wall_s=round(time.time() - lv["t0"], 6))
+            lv.update(frontier=0, generated=0, new=0, t0=time.time())
+
         def result(ok, violation=None, truncated=False):
             if truncated and live_obligations:
                 warnings.append("temporal properties NOT checked: the "
                                 "search was truncated (behavior graph "
                                 "incomplete)")
+            flush_level()
+            mst = model._memo
+            if mst is not None:
+                tel.gauge("memo.hits", mst.hits)
+                tel.gauge("memo.misses", mst.misses)
+            tel.gauge("fingerprint.occupancy", len(seen))
             return CheckResult(ok=ok, distinct=len(states),
                                generated=generated, diameter=diameter,
                                violation=violation, wall_s=time.time() - t0,
@@ -352,6 +380,10 @@ class Explorer:
             sid = queue.popleft()
             st = states[sid]
             depth = depth_of[sid]
+            if depth > lv["depth"]:
+                flush_level()
+                lv["depth"] = depth
+            lv["frontier"] += 1
             diameter = max(diameter, depth)
             succ_count = 0
             gen_at_pop = generated
@@ -361,6 +393,7 @@ class Explorer:
                                                   st):
                     succ_count += 1
                     generated += 1
+                    lv["generated"] += 1
                     if model.action_constraints and not \
                             self._satisfies_action_constraints(st, succ):
                         continue
@@ -384,6 +417,7 @@ class Explorer:
                                 "property", rc.name, trace, msg))
                     if not new:
                         continue
+                    lv["new"] += 1
                     bad = self._check_state_preds(succ)
                     if bad is not None:
                         return result(False, Violation(

@@ -1,0 +1,26 @@
+r"""jaxmc.obs — run telemetry (phase spans, counters, per-level BFS
+metrics) with JSONL trace streaming and a JSON summary artifact.
+
+    from jaxmc import obs
+
+    tel = obs.Telemetry(trace_path="run.jsonl", meta={"backend": "jax"})
+    with obs.use(tel):                       # engines see it via current()
+        with tel.span("load"):
+            ...
+    tel.write_metrics("m.json", result={...})
+
+Engines report through `obs.current()` — a no-op NullTelemetry unless a
+real recorder is installed — so instrumentation costs nothing when no
+artifact was requested. See obs/telemetry.py for the model and
+obs/schema.py for the artifact schema.
+"""
+
+from .telemetry import (Logger, NullTelemetry, Telemetry, current,
+                        device_mem_high_water, use, write_json_atomic)
+from .schema import (CHECK_KEYS, REQUIRED_KEYS, RESULT_KEYS, SCHEMA,
+                     validate_summary)
+
+__all__ = ["Logger", "NullTelemetry", "Telemetry", "current",
+           "device_mem_high_water", "use", "write_json_atomic", "SCHEMA",
+           "REQUIRED_KEYS", "CHECK_KEYS", "RESULT_KEYS",
+           "validate_summary"]

@@ -1,0 +1,80 @@
+r"""The `--metrics-out` / `--trace` event schema, as data.
+
+One place pins what every artifact must carry so the CLI, bench.py, the
+sweep driver, and tests/test_obs.py agree. `validate_summary` raises
+ValueError with the missing/ill-typed field names — it is deliberately
+structural (required keys + types + level-index monotonicity), not
+exhaustive: engines are free to add fields.
+
+Trace JSONL event grammar (one JSON object per line, `ev` discriminates):
+
+  run_start  {t, meta}
+  span_open  {name, t, parent, attrs}      -- partial-span forensics
+  span       {name, t0, wall_s, attrs[, error]}
+  level      {level, t, frontier?, generated?, new?, distinct?, ...}
+  counter/gauge changes are rolled up in the summary only
+  log        {t, msg}                      -- mirror of the stdout line
+  run_end    {t}
+
+Summary (metrics-out) required surface: see REQUIRED_KEYS below; each
+phases[i] carries {name, wall_s, count} (+optional open=True for spans
+still running at rollup — the deadline-blowout record); each levels[i]
+carries at least {level} with non-decreasing level indices.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+SCHEMA = "jaxmc.metrics/1"
+
+# top-level summary keys every artifact must carry
+REQUIRED_KEYS = ("schema", "started_at", "wall_s", "phases", "counters",
+                 "gauges", "levels")
+
+# keys a `check` run's artifact adds
+CHECK_KEYS = ("backend", "spec", "result")
+
+# required fields of summary["result"] for a check run
+RESULT_KEYS = ("ok", "distinct", "generated", "diameter", "truncated")
+
+PHASE_KEYS = ("name", "wall_s", "count")
+
+
+def validate_summary(s: Dict[str, Any], check_run: bool = False) -> None:
+    """Structural validation; raises ValueError naming the defect."""
+    if not isinstance(s, dict):
+        raise ValueError(f"summary is {type(s).__name__}, not a dict")
+    missing = [k for k in REQUIRED_KEYS if k not in s]
+    if check_run:
+        missing += [k for k in CHECK_KEYS if k not in s]
+    if missing:
+        raise ValueError(f"summary missing keys: {missing}")
+    if s["schema"] != SCHEMA:
+        raise ValueError(f"schema {s['schema']!r} != {SCHEMA!r}")
+    if not isinstance(s["phases"], list):
+        raise ValueError("phases is not a list")
+    for ph in s["phases"]:
+        miss = [k for k in PHASE_KEYS if k not in ph]
+        if miss:
+            raise ValueError(f"phase {ph!r} missing {miss}")
+        if ph["wall_s"] < 0:
+            raise ValueError(f"phase {ph['name']} has negative wall_s")
+    if not isinstance(s["counters"], dict) or \
+            not isinstance(s["gauges"], dict):
+        raise ValueError("counters/gauges must be dicts")
+    if not isinstance(s["levels"], list):
+        raise ValueError("levels is not a list")
+    prev = None
+    for rec in s["levels"]:
+        if "level" not in rec:
+            raise ValueError(f"level record {rec!r} missing 'level'")
+        if prev is not None and rec["level"] < prev:
+            raise ValueError(
+                f"level indices not monotone: {rec['level']} after {prev}")
+        prev = rec["level"]
+    if check_run:
+        res = s["result"]
+        miss = [k for k in RESULT_KEYS if k not in res]
+        if miss:
+            raise ValueError(f"result missing keys: {miss}")

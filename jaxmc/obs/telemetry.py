@@ -1,0 +1,404 @@
+r"""Run telemetry: spans, counters, per-level BFS records (no third-party
+deps).
+
+Motivation (ISSUE 1 / BENCH_r05): the device bench blew its deadline and
+degraded to the interpreter with no record of WHERE the budget went —
+device init, kernel compilation, or the BFS itself. Every engine phase now
+reports into one `Telemetry` object: phases as spans (wall time, nesting),
+scalar counters/gauges (expansion-mode tallies, memo-cache hits,
+fingerprint occupancy, device-memory high-water), and one record per BFS
+level (frontier/generated/distinct). Events stream as JSONL (`--trace
+FILE`) while the run is live — a killed process leaves `span_open` events
+naming the phase it died in — and roll up into an end-of-run summary
+(`--metrics-out FILE`, schema in obs/schema.py).
+
+Telemetry is a PARALLEL channel: TLC-style stdout stays byte-identical.
+Engines reach the active recorder through `current()` (a NullTelemetry by
+default, every method a no-op), so deep code needs no constructor
+plumbing; the CLI installs a real recorder with `use(...)` only when the
+user asked for an artifact.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+SCHEMA = "jaxmc.metrics/1"
+
+
+def write_json_atomic(path: str, obj) -> None:
+    """Dump `obj` as JSON via a sibling tmp file + os.replace, so a
+    crash mid-write never leaves a truncated artifact."""
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(obj, fh, indent=1, sort_keys=False)
+        fh.write("\n")
+    os.replace(tmp, path)
+
+
+def _jsonable(v):
+    """Best-effort plain-JSON coercion for attribute values."""
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
+    if isinstance(v, dict):
+        return {str(k): _jsonable(x) for k, x in v.items()}
+    try:  # numpy scalars
+        return v.item()
+    except Exception:  # non-scalar array, no .item(): never break a run
+        return str(v)
+
+
+class _SpanHandle:
+    """Context manager for one phase span. Re-entrant use is not needed:
+    each `span()` call makes a fresh handle."""
+
+    __slots__ = ("tel", "name", "attrs", "t0", "_done")
+
+    def __init__(self, tel: "Telemetry", name: str, attrs: Dict[str, Any]):
+        self.tel = tel
+        self.name = name
+        self.attrs = attrs
+        self.t0 = None
+        self._done = False
+
+    def __enter__(self):
+        self.t0 = self.tel._clock()
+        self.tel._span_open(self)
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.done(error=exc_type.__name__ if exc_type else None)
+        return False
+
+    def done(self, error: Optional[str] = None):
+        if self._done:
+            return
+        self._done = True
+        self.tel._span_close(self, error)
+
+
+class NullTelemetry:
+    """The default recorder: every method a no-op, so instrumented hot
+    paths cost one attribute lookup and a truth test when telemetry is
+    off."""
+
+    enabled = False
+
+    def span(self, name: str, **attrs):
+        return _NULL_SPAN
+
+    def counter(self, name: str, inc: int = 1) -> None:
+        pass
+
+    def gauge(self, name: str, value) -> None:
+        pass
+
+    def high_water(self, name: str, value) -> None:
+        pass
+
+    def level(self, index: int, **fields) -> None:
+        pass
+
+    def reset_levels(self, reason: str = "") -> None:
+        pass
+
+    def event(self, name: str, **fields) -> None:
+        pass
+
+    def log_line(self, msg: str) -> None:
+        pass
+
+    def set_meta(self, **fields) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+class _NullSpan:
+    __slots__ = ()
+
+    @property
+    def attrs(self):
+        # a fresh throwaway dict per access: callers may annotate
+        # (`span.attrs["outcome"] = ...`) without caring whether
+        # telemetry is live
+        return {}
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+    def done(self, error=None):
+        pass
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class Telemetry(NullTelemetry):
+    """A run recorder. Thread-safe: bench workers and engine threads may
+    report into one instance (spans nest per-thread via a thread-local
+    stack; counters/levels share one lock)."""
+
+    enabled = True
+
+    def __init__(self, trace_path: Optional[str] = None,
+                 meta: Optional[Dict[str, Any]] = None,
+                 clock=time.time):
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._local = threading.local()
+        self.t_start = clock()
+        self.meta: Dict[str, Any] = dict(meta or {})
+        # phases aggregate spans by name, in first-start order
+        self._phases: Dict[str, Dict[str, Any]] = {}
+        self._open_spans: List[_SpanHandle] = []
+        self.counters: Dict[str, int] = {}
+        self.gauges: Dict[str, Any] = {}
+        self.levels: List[Dict[str, Any]] = []
+        self._trace_fh = None
+        if trace_path:
+            self._trace_fh = open(trace_path, "w", encoding="utf-8")
+        self._emit({"ev": "run_start", "t": self.t_start,
+                    "meta": _jsonable(self.meta)})
+
+    # ---- trace stream ----
+    def _emit(self, obj: Dict[str, Any]) -> None:
+        fh = self._trace_fh
+        if fh is None:
+            return
+        with self._lock:
+            try:
+                fh.write(json.dumps(obj) + "\n")
+                fh.flush()
+            except ValueError:  # closed file: late event after close()
+                pass
+
+    # ---- spans ----
+    def _stack(self) -> List[str]:
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = self._local.stack = []
+        return st
+
+    def span(self, name: str, **attrs):
+        return _SpanHandle(self, name, {k: _jsonable(v)
+                                        for k, v in attrs.items()})
+
+    def _span_open(self, h: _SpanHandle) -> None:
+        stack = self._stack()
+        parent = stack[-1] if stack else None
+        stack.append(h.name)
+        with self._lock:
+            self._open_spans.append(h)
+            ph = self._phases.setdefault(
+                h.name, {"name": h.name, "wall_s": 0.0, "count": 0,
+                         "open": 0})
+            ph["open"] += 1
+        self._emit({"ev": "span_open", "name": h.name, "t": h.t0,
+                    "parent": parent, "attrs": h.attrs})
+
+    def _span_close(self, h: _SpanHandle, error: Optional[str]) -> None:
+        t1 = self._clock()
+        stack = self._stack()
+        if stack and stack[-1] == h.name:
+            stack.pop()
+        with self._lock:
+            if h in self._open_spans:
+                self._open_spans.remove(h)
+            ph = self._phases[h.name]
+            ph["wall_s"] += t1 - h.t0
+            ph["count"] += 1
+            ph["open"] -= 1
+        ev = {"ev": "span", "name": h.name, "t0": h.t0,
+              "wall_s": round(t1 - h.t0, 6), "attrs": h.attrs}
+        if error:
+            ev["error"] = error
+        self._emit(ev)
+
+    # ---- scalars ----
+    def counter(self, name: str, inc: int = 1) -> None:
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + inc
+
+    def gauge(self, name: str, value) -> None:
+        with self._lock:
+            self.gauges[name] = _jsonable(value)
+
+    def high_water(self, name: str, value) -> None:
+        if value is None:
+            return
+        value = _jsonable(value)
+        with self._lock:
+            old = self.gauges.get(name)
+            if old is None or value > old:
+                self.gauges[name] = value
+
+    # ---- per-level BFS records ----
+    def level(self, index: int, **fields) -> None:
+        rec = {"level": int(index)}
+        rec.update({k: _jsonable(v) for k, v in fields.items()})
+        with self._lock:
+            self.levels.append(rec)
+        self._emit(dict(rec, ev="level", t=self._clock()))
+
+    def reset_levels(self, reason: str = "") -> None:
+        """A search RESTART (hybrid demotion, adaptive relayout) replays
+        from level 0: drop the stale records so the summary's level list
+        describes the search that produced the final counts. The trace
+        stream keeps everything, separated by this restart event."""
+        with self._lock:
+            n = len(self.levels)
+            self.levels = []
+        self.counter("search.restarts")
+        self._emit({"ev": "search_restart", "t": self._clock(),
+                    "reason": reason, "levels_dropped": n})
+
+    # ---- free-form events / log mirror ----
+    def event(self, name: str, **fields) -> None:
+        self._emit(dict({k: _jsonable(v) for k, v in fields.items()},
+                        ev=name, t=self._clock()))
+
+    def log_line(self, msg: str) -> None:
+        self._emit({"ev": "log", "t": self._clock(), "msg": msg})
+
+    def set_meta(self, **fields) -> None:
+        with self._lock:
+            self.meta.update({k: _jsonable(v) for k, v in fields.items()})
+
+    # ---- rollup ----
+    def phase_list(self) -> List[Dict[str, Any]]:
+        """Phases in first-start order; spans still open contribute their
+        elapsed-so-far with open=True (the deadline-blowout forensics:
+        a partial span names its culprit)."""
+        now = self._clock()
+        with self._lock:
+            out = []
+            open_extra: Dict[str, float] = {}
+            for h in self._open_spans:
+                open_extra[h.name] = open_extra.get(h.name, 0.0) \
+                    + (now - h.t0)
+            for ph in self._phases.values():
+                d = {"name": ph["name"],
+                     "wall_s": round(ph["wall_s"]
+                                     + open_extra.get(ph["name"], 0.0), 6),
+                     "count": ph["count"] + ph["open"]}
+                if ph["open"]:
+                    d["open"] = True
+                out.append(d)
+            return out
+
+    def summary(self, result: Optional[Dict[str, Any]] = None
+                ) -> Dict[str, Any]:
+        with self._lock:
+            counters = dict(self.counters)
+            gauges = dict(self.gauges)
+            levels = list(self.levels)
+            meta = dict(self.meta)
+        out = {
+            "schema": SCHEMA,
+            "started_at": self.t_start,
+            "wall_s": round(self._clock() - self.t_start, 6),
+            "phases": self.phase_list(),
+            "counters": counters,
+            "gauges": gauges,
+            "levels": levels,
+        }
+        out.update(meta)
+        if result is not None:
+            out["result"] = _jsonable(result)
+        return out
+
+    def write_metrics(self, path: str,
+                      result: Optional[Dict[str, Any]] = None) -> None:
+        write_json_atomic(path, self.summary(result))
+
+    def close(self) -> None:
+        self._emit({"ev": "run_end", "t": self._clock()})
+        fh = self._trace_fh
+        self._trace_fh = None
+        if fh is not None:
+            fh.close()
+
+
+# ---- the process-wide current recorder ----
+
+_CURRENT: NullTelemetry = NullTelemetry()
+
+
+def current() -> NullTelemetry:
+    """The active recorder (a shared no-op unless the CLI/bench installed
+    a real one)."""
+    return _CURRENT
+
+
+class use:
+    """Install `tel` as the process-wide recorder for a with-block."""
+
+    def __init__(self, tel: NullTelemetry):
+        self.tel = tel
+        self._prev = None
+
+    def __enter__(self):
+        global _CURRENT
+        self._prev = _CURRENT
+        _CURRENT = self.tel
+        return self.tel
+
+    def __exit__(self, *a):
+        global _CURRENT
+        _CURRENT = self._prev
+        return False
+
+
+class Logger:
+    """The ONE engine log sink: prints the TLC-style line (unless quiet)
+    and mirrors it into the telemetry trace. Replaces the ad-hoc
+    `(lambda s: None) if quiet else print` plumbing in cli.py — every
+    engine's `log:` callback funnels through here so stdout and the
+    trace always carry the same strings."""
+
+    __slots__ = ("tel", "quiet", "sink")
+
+    def __init__(self, tel: Optional[NullTelemetry] = None,
+                 quiet: bool = False, sink=print):
+        self.tel = tel
+        self.quiet = quiet
+        self.sink = sink
+
+    def __call__(self, msg: str) -> None:
+        if not self.quiet:
+            self.sink(msg)
+        tel = self.tel if self.tel is not None else current()
+        tel.log_line(msg)
+
+
+def device_mem_high_water() -> Optional[int]:
+    """Sum of per-device peak allocation bytes, when the jax backend
+    exposes memory_stats (TPU/GPU; CPU usually returns None). Never
+    raises — telemetry must not break a run."""
+    try:
+        import jax
+        total = 0
+        seen = False
+        for d in jax.devices():
+            ms = getattr(d, "memory_stats", None)
+            st = ms() if callable(ms) else None
+            if not st:
+                continue
+            peak = st.get("peak_bytes_in_use", st.get("bytes_in_use"))
+            if peak is not None:
+                total += int(peak)
+                seen = True
+        return total if seen else None
+    except Exception:  # noqa: BLE001 — diagnostics must not mask
+        return None

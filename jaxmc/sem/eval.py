@@ -247,7 +247,9 @@ def _force(v, ctx, name=""):
             if key is not None:
                 hit = store.vals.get(key, _MISS)
                 if hit is not _MISS:
+                    store.hits += 1
                     return hit
+                store.misses += 1
                 val = eval_expr(v.body, ctx)
                 store.put(key, val)
                 return val
@@ -323,7 +325,9 @@ def apply_op(opv, args: List[Any], ctx: Ctx):
             if key is not None:
                 hit = store.vals.get(key, _MISS)
                 if hit is not _MISS:
+                    store.hits += 1
                     return hit
+                store.misses += 1
                 inner = ctx.with_bound(dict(zip(opv.params, args)))
                 val = eval_expr(opv.body, inner)
                 store.put(key, val)

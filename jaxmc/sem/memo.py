@@ -57,14 +57,18 @@ class MemoStore:
     evaluation runs under exactly this table — name resolution (and so the
     dependency analysis) is table-relative, and instance/LET contexts swap
     the table.
+    hits/misses: cache-effectiveness counters (plain ints, incremented on
+    the eval hot path) — read by the obs telemetry rollup at end of run.
     """
-    __slots__ = ("deps", "vals", "base_defs")
+    __slots__ = ("deps", "vals", "base_defs", "hits", "misses")
 
     def __init__(self, base_defs=None):
         self.deps: Dict[int, Tuple[Any, Optional[Tuple[Tuple[str, ...],
                                                        Tuple[str, ...]]]]] = {}
         self.vals: Dict[tuple, Any] = {}
         self.base_defs = base_defs
+        self.hits = 0
+        self.misses = 0
 
     def put(self, key: tuple, val: Any) -> None:
         if len(self.vals) >= _VALS_CAP:

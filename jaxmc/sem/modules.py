@@ -101,12 +101,18 @@ class Loader:
         raise EvalError(f"module {name} not found in {self.search_dirs}")
 
     def _parse_file(self, path: str) -> A.Module:
-        src = open(path, encoding="utf-8", errors="replace").read()
-        ast = parse_module_text(src)
+        from .. import obs
+        tel = obs.current()
+        with open(path, encoding="utf-8", errors="replace") as fh:
+            src = fh.read()
+        with tel.span("parse", module=os.path.basename(path)):
+            ast = parse_module_text(src)
         from ..front.pcal import has_algorithm, translate_module
         if has_algorithm(src):
             # the in-memory equivalent of `make transpile` (Makefile:4)
-            ast = translate_module(src, ast)
+            with tel.span("pcal_translate",
+                          module=os.path.basename(path)):
+                ast = translate_module(src, ast)
         return ast
 
     def load(self, name: str) -> LoadedModule:

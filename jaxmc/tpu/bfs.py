@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .. import obs
 from ..sem.modules import Model, satisfies_constraints
 from ..sem.enumerate import enumerate_init, enumerate_next
 from ..sem.eval import TLCAssertFailure, eval_expr, _bool
@@ -224,7 +225,9 @@ class TpuExplorer:
                  extra_samples: Optional[List[Dict[str, Any]]] = None,
                  relayouts_left: int = 3):
         self.model = model
-        self.log = log or (lambda s: None)
+        # same funnel as cli.py: silent on stdout by default, but the
+        # strings still mirror into the telemetry trace
+        self.log = log if log is not None else obs.Logger(quiet=True)
         self.max_states = max_states
         self.store_trace = store_trace
         self.progress_every = progress_every
@@ -247,13 +250,17 @@ class TpuExplorer:
         self.relayouts_left = relayouts_left
         self._last_frontier_np: Optional[np.ndarray] = None
 
+        tel = obs.current()
         base_ctx = model.ctx()
         self.init_states = enumerate_init(model.init, base_ctx, model.vars)
         bfs_n, walks, depth = sample_cfg
-        sampled = sample_states(model, bfs_states=bfs_n, n_walks=walks,
-                                walk_depth=depth)
+        with tel.span("layout_sample", bfs_states=bfs_n, walks=walks,
+                      walk_depth=depth):
+            sampled = sample_states(model, bfs_states=bfs_n,
+                                    n_walks=walks, walk_depth=depth)
         sampled = list(sampled) + self.extra_samples
-        self.layout = build_layout2(model, sampled, self.bounds)
+        with tel.span("layout_build", samples=len(sampled)):
+            self.layout = build_layout2(model, sampled, self.bounds)
         self.kc = KernelCtx(model, self.layout, self.bounds)
         # dynamic \E expansion applies to message tables AND to
         # state-dependent intervals (\E i \in 1..Len(q), AlternatingBit's
@@ -275,15 +282,21 @@ class TpuExplorer:
         self.fb_arms: List[Tuple[Any, str]] = []  # (ActionArm, reason)
         for ai, arm in enumerate(self.arms):
             try:
-                gas = ground_arm(model, arm, dyn_slots=self.bounds.kv_cap)
-                cas = []
-                for ga in gas:
-                    ca = compile_action2(self.kc, ga)
-                    if ca.n_slots:
-                        jax.eval_shape(ca.fn, row_spec, slot_spec)
-                    else:
-                        jax.eval_shape(ca.fn, row_spec)
-                    cas.append(ca)
+                # the span covers grounding + kernel build + the forced
+                # abstract trace — the per-arm compile cost the bench
+                # forensics need (BENCH_r05: nothing said whether compile
+                # or BFS ate the deadline)
+                with tel.span("compile_arm", arm=arm.label or "Next"):
+                    gas = ground_arm(model, arm,
+                                     dyn_slots=self.bounds.kv_cap)
+                    cas = []
+                    for ga in gas:
+                        ca = compile_action2(self.kc, ga)
+                        if ca.n_slots:
+                            jax.eval_shape(ca.fn, row_spec, slot_spec)
+                        else:
+                            jax.eval_shape(ca.fn, row_spec)
+                        cas.append(ca)
             except CompileError as e:
                 self.fb_arms.append((arm, str(e)))
                 continue
@@ -373,10 +386,13 @@ class TpuExplorer:
                 compiled.append((nm, f))
             return compiled, demoted
 
-        self.inv_fns, self.fb_invs = _compile_preds(
-            model.invariants, host_seen)
-        self.constraint_fns, self.fb_cons = _compile_preds(
-            model.constraints, host_seen and not model.properties)
+        with tel.span("compile_predicates",
+                      invariants=len(model.invariants),
+                      constraints=len(model.constraints)):
+            self.inv_fns, self.fb_invs = _compile_preds(
+                model.invariants, host_seen)
+            self.constraint_fns, self.fb_cons = _compile_preds(
+                model.constraints, host_seen and not model.properties)
         if model.action_constraints:
             raise CompileError("action constraints not compiled yet - "
                                "use the interp backend")
@@ -425,6 +441,20 @@ class TpuExplorer:
             [arm.label or "Next" for arm, _ in self.fb_arms]
         self.W = self.layout.width
         self.fp_mode = self.W > FP_THRESHOLD
+        # expansion-mode disclosure, machine-readable (mirrors the sweep's
+        # per-case note): gauges overwrite on relayout restarts so the
+        # artifact reports the engine that actually ran
+        tel.gauge("expand.arms_total", len(self.arms))
+        tel.gauge("expand.arms_compiled",
+                  len(self.arms) - len(self.fb_arms))
+        tel.gauge("expand.arms_interp", len(self.fb_arms))
+        tel.gauge("expand.compiled_instances", self.A)
+        tel.gauge("expand.invariants_interp", len(self.fb_invs))
+        tel.gauge("expand.constraints_interp", len(self.fb_cons))
+        tel.gauge("expand.mode",
+                  "compiled" if not self.fb_arms
+                  else ("hybrid" if self.A else "interp-arms"))
+        tel.gauge("layout.width_lanes", self.W)
         # dedup key lanes: an explicit validity lane FIRST (0=valid row,
         # 1=invalid) — validity must never be encoded in-band in hash
         # output or state lanes, either could legitimately equal SENTINEL
@@ -1403,6 +1433,7 @@ class TpuExplorer:
 
     def _run_resident(self) -> CheckResult:
         t0 = time.time()
+        tel = obs.current()
         model = self.model
         layout = self.layout
         W, K = self.W, self.K
@@ -1527,6 +1558,7 @@ class TpuExplorer:
                     maxlvl < self._res_maxlvl:
                 maxlvl = min(self._res_maxlvl, maxlvl * 2)
             summary = np.asarray(summary)
+            fcount_in, gen_in, dist_in = fcount, generated, distinct
             stat = int(summary[0])
             seen_count = int(summary[1])
             fcount = int(summary[2])
@@ -1537,6 +1569,19 @@ class TpuExplorer:
             which = int(summary[7])
             ovcode = int(summary[8])
             self._res_caps = dict(caps)
+            # one record per DISPATCH (the host only sees level batches
+            # in resident mode): `level` is the depth reached, so indices
+            # stay monotone — equal across an overflow-redo dispatch.
+            # frontier/generated/new keep the other paths' semantics:
+            # frontier going IN, per-dispatch generated/new deltas (so
+            # summing `generated` across records gives the run total)
+            tel.level(depth, dispatch=True, frontier=fcount_in,
+                      generated=generated - gen_in,
+                      new=distinct - dist_in, distinct=distinct,
+                      seen=seen_count, status=stat,
+                      fresh_compile=fresh_compile,
+                      wall_s=round(disp_wall, 6))
+            self._fp_occupancy = seen_count
 
             if stat in grow_flag:
                 what = grow_flag[stat]
@@ -1625,6 +1670,7 @@ class TpuExplorer:
     def _run_host_seen(self) -> CheckResult:
         from .. import native_store
         t0 = time.time()
+        tel = obs.current()
         model = self.model
         layout = self.layout
         W = self.W
@@ -1674,6 +1720,8 @@ class TpuExplorer:
         hstep = self._get_hstep(CH)
         while len(frontier_np) > 0:
             L = len(frontier_np)
+            lvl_t0 = time.time()
+            lvl_gen0 = generated
             lvl_new_rows: List[np.ndarray] = []
             lvl_new_prov: List[np.ndarray] = []
             lvl_explore: List[np.ndarray] = []
@@ -1912,6 +1960,10 @@ class TpuExplorer:
                 frontier_sids = new_sids
             if self.store_trace:
                 frontier_maps.append(sel.astype(np.int64))
+            tel.level(depth, frontier=L, generated=generated - lvl_gen0,
+                      new=len(sel), distinct=distinct, seen=len(store),
+                      wall_s=round(time.time() - lvl_t0, 6))
+            self._fp_occupancy = len(store)
             depth += 1
             if self.max_states and distinct >= self.max_states:
                 self.log("-- state limit reached, search truncated")
@@ -2125,6 +2177,8 @@ class TpuExplorer:
                  f"{len(enrich)} abort-frontier states, rebuilding "
                  f"kernels, restarting compiled "
                  f"({self.relayouts_left - 1} attempts left)")
+        obs.current().counter("expand.relayouts")
+        obs.current().reset_levels("adaptive relayout restart")
         if self.checkpoint_path:
             # a checkpoint written under the enriched layout could not
             # be resumed (the resume path re-derives the layout from
@@ -2185,6 +2239,7 @@ class TpuExplorer:
         self._step_cache.clear()
         self._hstep_cache.clear()
         self._res_cache.clear()
+        obs.current().counter("expand.recovery_demotions", len(idxset))
         return labels
 
     # ---- host-side search loop ----
@@ -2225,6 +2280,7 @@ class TpuExplorer:
                 if not self._demotable:
                     break
                 demoted = self._demote_arms(self._demotable)
+                obs.current().reset_levels("hybrid demotion restart")
                 self.log(f"hybrid: demotion abort — falling "
                          f"{demoted} back to the interpreter and "
                          f"restarting")
@@ -2234,6 +2290,7 @@ class TpuExplorer:
                 r = self._run_host_seen()
             return r
         t0 = time.time()
+        tel = obs.current()
         model = self.model
         layout = self.layout
         W, K = self.W, self.K
@@ -2307,6 +2364,7 @@ class TpuExplorer:
 
         last_progress = last_ck = time.time()
         while fcount > 0:
+            lvl_t0 = time.time()
             C = self.A * FC
             if seen_count + C > SC:
                 SC2 = _pow2_at_least(seen_count + C, SC)
@@ -2365,6 +2423,10 @@ class TpuExplorer:
             distinct += front_count  # kept states only (discards excluded)
             seen = out["seen"]
             seen_count = int(out["seen_count"])
+            tel.level(depth, frontier=fcount, generated=int(out["gen"]),
+                      new=front_count, distinct=distinct, seen=seen_count,
+                      wall_s=round(time.time() - lvl_t0, 6))
+            self._fp_occupancy = seen_count
 
             if graph is not None:
                 new_sids = graph.add_level(
@@ -2447,6 +2509,12 @@ class TpuExplorer:
 
     def _mk_result(self, ok, distinct, generated, diameter, t0, warnings,
                    violation=None, truncated=False) -> CheckResult:
+        tel = obs.current()
+        tel.high_water("device.mem_high_water_bytes",
+                       obs.device_mem_high_water())
+        occ = getattr(self, "_fp_occupancy", None)
+        if occ is not None:
+            tel.gauge("fingerprint.occupancy", occ)
         if truncated and self.live_obligations:
             warnings.append("temporal properties NOT checked: the "
                             "search was truncated (behavior graph "

@@ -47,6 +47,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from .. import obs
 from ..sem.modules import Model
 from ..engine.explore import CheckResult, Violation
 from ..compile.vspec import ModeError
@@ -495,6 +496,7 @@ class MeshExplorer(TpuExplorer):
 
     def run(self) -> CheckResult:
         t0 = time.time()
+        tel = obs.current()
         model = self.model
         layout = self.layout
         D, W, K = self.D, self.W, self.K
@@ -584,7 +586,10 @@ class MeshExplorer(TpuExplorer):
             depth = 0
 
         last_progress = last_ck = time.time()
-        while int(np.sum(np.asarray(fcount))) > 0:
+        lvl_frontier = int(np.sum(np.asarray(fcount)))
+        while lvl_frontier > 0:
+            lvl_t0 = time.time()
+            lvl_gen0 = generated
             C = self.A * FC
             need = int(seen_counts.max(initial=0)) + D * C
             if need > SC:
@@ -673,6 +678,12 @@ class MeshExplorer(TpuExplorer):
             generated += int(np.asarray(tot_gen)[0])
             distinct += int(np.asarray(tot_new)[0])
             seen_counts = np.asarray(seen_cnt).astype(np.int64)
+            tel.level(depth, frontier=lvl_frontier,
+                      generated=generated - lvl_gen0,
+                      new=int(np.asarray(tot_new)[0]), distinct=distinct,
+                      seen=int(seen_counts.sum()), devices=D,
+                      wall_s=round(time.time() - lvl_t0, 6))
+            self._fp_occupancy = int(seen_counts.sum())
             max_front = int(np.asarray(front_cnt).max(initial=0))
             # device->host frontier copies only when something needs
             # them (tracing, a violation to localize, or FC regrowth):
@@ -783,6 +794,7 @@ class MeshExplorer(TpuExplorer):
                 last_ck = now
                 self._mesh_ck(seen, seen_counts, frontier, fcount, FC,
                               SC, depth, generated, distinct)
+            lvl_frontier = int(np.sum(np.asarray(fcount)))
 
         if graph is not None:
             viol = self._check_live(graph, warnings)
@@ -796,6 +808,12 @@ class MeshExplorer(TpuExplorer):
 
     def _mk(self, ok, distinct, generated, diameter, t0, warnings,
             violation=None, truncated=False):
+        tel = obs.current()
+        tel.high_water("device.mem_high_water_bytes",
+                       obs.device_mem_high_water())
+        occ = getattr(self, "_fp_occupancy", None)
+        if occ is not None:
+            tel.gauge("fingerprint.occupancy", occ)
         return CheckResult(ok=ok, distinct=distinct, generated=generated,
                            diameter=max(diameter, 0), violation=violation,
                            wall_s=time.time() - t0, truncated=truncated,

@@ -1,0 +1,195 @@
+r"""Telemetry (jaxmc/obs) tests: the metrics schema, cross-backend count
+agreement, JSONL trace streaming, and the span/counter API itself.
+
+Tier-1 fast by construction: CPU only (conftest pins jax to cpu), micro
+models only (specs/symtoy.tla — 22 distinct states on BOTH backends, the
+corpus pin), no reference-corpus dependency.
+"""
+
+import json
+import os
+
+import pytest
+
+from jaxmc import obs
+from jaxmc.cli import main
+
+pytestmark = pytest.mark.obs
+
+SPECS = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "specs")
+SYMTOY = os.path.join(SPECS, "symtoy.tla")
+SYMTOY_CFG = os.path.join(SPECS, "symtoy.cfg")
+SYMTOY_DISTINCT = 22   # corpus pin (jaxmc/corpus.py CASES)
+SYMTOY_GENERATED = 33
+
+
+def run_check(tmp_path, backend, extra=()):
+    m = tmp_path / f"metrics_{backend}.json"
+    tr = tmp_path / f"trace_{backend}.jsonl"
+    rc = main(["check", SYMTOY, "--cfg", SYMTOY_CFG, "--backend", backend,
+               "--no-deadlock", "--quiet", "--metrics-out", str(m),
+               "--trace", str(tr)] + list(extra))
+    assert rc == 0
+    with open(m) as fh:
+        summary = json.load(fh)
+    return summary, tr
+
+
+class TestMetricsArtifact:
+    @pytest.fixture(scope="class")
+    def both(self, tmp_path_factory):
+        tmp = tmp_path_factory.mktemp("obs")
+        return {b: run_check(tmp, b) for b in ("interp", "jax")}
+
+    def test_schema_valid_on_both_backends(self, both):
+        for backend, (summary, _) in both.items():
+            obs.validate_summary(summary, check_run=True)
+            assert summary["backend"] == backend
+            assert summary["spec"] == SYMTOY
+
+    def test_distinct_counts_match_explorer_and_backends(self, both):
+        for backend, (summary, _) in both.items():
+            res = summary["result"]
+            assert res["ok"] is True
+            assert res["distinct"] == SYMTOY_DISTINCT, backend
+            assert res["generated"] == SYMTOY_GENERATED, backend
+        assert both["interp"][0]["result"]["distinct"] == \
+            both["jax"][0]["result"]["distinct"]
+
+    def test_level_records_monotone_and_consistent(self, both):
+        for backend, (summary, _) in both.items():
+            levels = summary["levels"]
+            assert levels, f"{backend}: no level records"
+            idxs = [r["level"] for r in levels]
+            assert idxs == sorted(idxs), backend
+            # level-by-level accumulation reaches the final result
+            assert levels[-1]["distinct"] == SYMTOY_DISTINCT, backend
+            for r in levels:
+                for k in ("frontier", "generated", "new", "distinct",
+                          "wall_s"):
+                    assert k in r, (backend, r)
+
+    def test_phase_spans_present(self, both):
+        names_i = {p["name"] for p in both["interp"][0]["phases"]}
+        assert {"load", "search", "parse"} <= names_i
+        names_j = {p["name"] for p in both["jax"][0]["phases"]}
+        assert {"load", "search", "engine_build", "device_init",
+                "layout_sample", "layout_build", "compile_arm",
+                "compile_predicates"} <= names_j
+        for _, (summary, _) in both.items():
+            for ph in summary["phases"]:
+                assert ph["wall_s"] >= 0 and ph["count"] >= 1
+
+    def test_counters_and_gauges(self, both):
+        gi = both["interp"][0]["gauges"]
+        assert "memo.hits" in gi and "memo.misses" in gi
+        assert gi["fingerprint.occupancy"] >= SYMTOY_DISTINCT
+        sj = both["jax"][0]
+        gj = sj["gauges"]
+        assert gj["expand.mode"] in ("compiled", "hybrid", "interp-arms")
+        assert gj["expand.arms_total"] >= 1
+        assert gj["fingerprint.occupancy"] >= SYMTOY_DISTINCT
+        assert sj["counters"].get("compile.kernels_built", 0) >= 1
+
+    def test_trace_jsonl_stream(self, both):
+        for backend, (_, tr) in both.items():
+            with open(tr) as fh:
+                events = [json.loads(ln) for ln in fh if ln.strip()]
+            assert events[0]["ev"] == "run_start"
+            assert events[-1]["ev"] == "run_end"
+            kinds = {e["ev"] for e in events}
+            assert {"span_open", "span", "level", "log"} <= kinds, backend
+            # every span_open eventually closed (clean run)
+            opens = sum(1 for e in events if e["ev"] == "span_open")
+            closes = sum(1 for e in events if e["ev"] == "span")
+            assert opens == closes, backend
+
+
+class TestTelemetryApi:
+    def test_null_telemetry_is_inert(self):
+        tel = obs.NullTelemetry()
+        with tel.span("x"):
+            tel.counter("c")
+            tel.level(0, frontier=1)
+        assert not tel.enabled
+
+    def test_spans_counters_levels_rollup(self, tmp_path):
+        clock = iter(float(i) for i in range(100))
+        tel = obs.Telemetry(meta={"backend": "test"},
+                            clock=lambda: next(clock))
+        with tel.span("a"):
+            with tel.span("b"):
+                pass
+        tel.counter("n", 2)
+        tel.counter("n")
+        tel.gauge("g", 7)
+        tel.high_water("hw", 5)
+        tel.high_water("hw", 3)   # lower: ignored
+        tel.high_water("hw", None)  # None: ignored
+        tel.level(0, frontier=4)
+        tel.level(1, frontier=2)
+        s = tel.summary(result={"ok": True})
+        obs.validate_summary(s)
+        assert s["counters"]["n"] == 3
+        assert s["gauges"] == {"g": 7, "hw": 5}
+        assert [r["level"] for r in s["levels"]] == [0, 1]
+        by = {p["name"]: p for p in s["phases"]}
+        assert by["a"]["count"] == 1 and by["b"]["count"] == 1
+        assert by["a"]["wall_s"] > by["b"]["wall_s"]
+        p = tmp_path / "m.json"
+        tel.write_metrics(str(p), result={"ok": True})
+        with open(p) as fh:
+            obs.validate_summary(json.load(fh))
+
+    def test_open_span_reports_partial_wall(self):
+        tel = obs.Telemetry()
+        h = tel.span("stuck")
+        h.__enter__()
+        phases = tel.phase_list()
+        (ph,) = [p for p in phases if p["name"] == "stuck"]
+        assert ph.get("open") is True and ph["wall_s"] >= 0
+        h.done()
+        (ph2,) = [p for p in tel.phase_list() if p["name"] == "stuck"]
+        assert "open" not in ph2
+
+    def test_reset_levels_keeps_monotonicity(self):
+        tel = obs.Telemetry()
+        tel.level(0)
+        tel.level(1)
+        tel.reset_levels("restart")
+        tel.level(0)
+        s = tel.summary()
+        obs.validate_summary(s)
+        assert [r["level"] for r in s["levels"]] == [0]
+        assert s["counters"]["search.restarts"] == 1
+
+    def test_validate_rejects_bad_summaries(self):
+        tel = obs.Telemetry()
+        s = tel.summary()
+        bad = dict(s)
+        del bad["phases"]
+        with pytest.raises(ValueError):
+            obs.validate_summary(bad)
+        bad2 = dict(s, levels=[{"level": 2}, {"level": 1}])
+        with pytest.raises(ValueError):
+            obs.validate_summary(bad2)
+        with pytest.raises(ValueError):
+            obs.validate_summary(dict(s), check_run=True)  # no result
+
+    def test_logger_single_sink(self):
+        tel = obs.Telemetry()
+        out = []
+        log = obs.Logger(tel, quiet=False, sink=out.append)
+        log("Progress(1): hello")
+        assert out == ["Progress(1): hello"]
+        quiet = obs.Logger(tel, quiet=True, sink=out.append)
+        quiet("suppressed")
+        assert out == ["Progress(1): hello"]
+
+    def test_current_use_scoping(self):
+        base = obs.current()
+        tel = obs.Telemetry()
+        with obs.use(tel):
+            assert obs.current() is tel
+        assert obs.current() is base
