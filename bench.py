@@ -28,10 +28,17 @@ races in parallel against a hard internal deadline
     bench rung (MCraft_3s_bench — the artifact of record gets the big
     slot, r4 weak #1), then the QUICK rung only if full failed;
   - a TPU worker thread consults the round-long probe loop's verdict
-    (/tmp/tpu_probe.log, /tmp/tpu_up.marker) before burning the single
-    core on probe children of its own; if the TPU answers it runs the
-    quick rung first (a TPU line as early as possible), then a bounded
-    profile capture, then the full rung.
+    ($JAXMC_PROBE_DIR/tpu_probe.log, $JAXMC_PROBE_DIR/tpu_up.marker;
+    default /tmp — point JAXMC_PROBE_DIR elsewhere to keep parallel
+    benches from clobbering each other's verdicts) before burning the
+    single core on probe children of its own; if the TPU answers it runs
+    the quick rung first (a TPU line as early as possible), then a
+    bounded profile capture, then the full rung.
+
+A watchdog heartbeat thread (jaxmc/obs/watchdog.py) rides along in
+every child (the processes with real phase activity): a wedged device
+init or BFS level is named on stderr WHILE it hangs, instead of only
+in the post-mortem rollup.
 
 At the deadline (or earlier, once the best-possible line for the
 detected platform exists) the parent prints the best line available,
@@ -62,6 +69,13 @@ _TEL = obs.NullTelemetry()
 SPEC = os.path.join(_REPO, "specs", "MCraftMicro.tla")
 CFG_FULL = os.path.join(_REPO, "specs", "MCraft_3s_bench.cfg")
 CFG_QUICK = os.path.join(_REPO, "specs", "MCraft_micro.cfg")
+
+# Probe-loop artifacts (JAXMC_PROBE_DIR, default /tmp): parallel benches
+# point this somewhere private so one bench's probe verdict never
+# clobbers — or is misread as — another's.
+_PROBE_DIR = os.environ.get("JAXMC_PROBE_DIR", "/tmp")
+_PROBE_LOG = os.path.join(_PROBE_DIR, "tpu_probe.log")
+_UP_MARKER = os.path.join(_PROBE_DIR, "tpu_up.marker")
 INTERP_CAP = 20000  # distinct-state cap for the interpreter baseline run
 
 # Documented TLC comparison point (BASELINE.md "TLC rate estimate"):
@@ -98,6 +112,11 @@ def child_bench(platform_pin: str, rung: str):
     """The measured bench body. Runs in a child process with the platform
     pinned BEFORE first jax import; prints the JSON line on stdout."""
     tel = obs.Telemetry()
+    # stall floor 60s: XLA compiles on this box legitimately run long;
+    # the watchdog should name a wedged tunnel, not a working compile
+    wd = obs.Watchdog(tel, min_stall_s=60.0,
+                      on_stall=lambda m: _log(f"WATCHDOG({platform_pin}/"
+                                              f"{rung}): {m}")).start()
     with tel.span("device_init", platform=platform_pin):
         import jax
         # pin the platform: a tunnel drop between probe and child start
@@ -154,8 +173,10 @@ def child_bench(platform_pin: str, rung: str):
             ri = Explorer(load_model(), max_states=INTERP_CAP).run()
         interp_rate = ri.generated / ri.wall_s
 
+    wd.stop()
     out = {
         "phases": tel.phase_list(),
+        "env": obs.environment_meta(),
         "metric": (
             f"states/sec, exhaustive raft (reference raft.tla, "
             f"{os.path.basename(cfg_path)}: "
@@ -186,6 +207,8 @@ def child_emergency():
     from jaxmc.engine.explore import Explorer
 
     tel = obs.Telemetry()
+    wd = obs.Watchdog(tel, on_stall=lambda m: _log(
+        f"WATCHDOG(emergency): {m}")).start()
     with obs.use(tel):
         with tel.span("load"):
             ldr = Loader([os.path.join(_REPO, "specs"),
@@ -195,10 +218,12 @@ def child_emergency():
                                    parse_cfg(fh.read()))
         with tel.span("search"):
             r = Explorer(model).run()
+    wd.stop()
     assert r.ok
     rate = r.generated / r.wall_s
     out = {
         "phases": tel.phase_list(),
+        "env": obs.environment_meta(),
         "metric": (
             f"states/sec, exhaustive raft (reference raft.tla, "
             f"MCraft_micro: {r.generated} generated / {r.distinct} "
@@ -342,23 +367,24 @@ def _cpu_worker():
 
 def _tunnel_oracle() -> str:
     """'up' / 'down' / 'unknown' from the round-long probe-loop artifacts
-    (/tmp/tpu_probe_loop.py writes /tmp/tpu_probe.log every ~10 min and
-    /tmp/tpu_up.marker on success). A fresh verdict saves the bench from
-    burning the single core on its own 120 s probe children — the r4
-    starvation mode — while a stale or absent log falls back to probing."""
+    (the probe loop writes $JAXMC_PROBE_DIR/tpu_probe.log every ~10 min
+    and $JAXMC_PROBE_DIR/tpu_up.marker on success; default /tmp). A fresh
+    verdict saves the bench from burning the single core on its own 120 s
+    probe children — the r4 starvation mode — while a stale or absent log
+    falls back to probing."""
     fresh_s = 30 * 60
     try:
-        if (time.time() - os.path.getmtime("/tmp/tpu_up.marker")
+        if (time.time() - os.path.getmtime(_UP_MARKER)
                 < fresh_s):
             return "up"
     except OSError:
         pass
     try:
-        with open("/tmp/tpu_probe.log") as fh:
+        with open(_PROBE_LOG) as fh:
             lines = [ln.strip() for ln in fh if ln.strip()]
-        if lines and (time.time() - os.path.getmtime("/tmp/tpu_probe.log")
+        if lines and (time.time() - os.path.getmtime(_PROBE_LOG)
                       < fresh_s):
-            # exact line grammar of /tmp/tpu_probe_loop.py: success is
+            # exact line grammar of the probe loop: success is
             # "HH:MM:SS TPU UP (...)"; failures are "no tpu (...)" /
             # "probe timed out ..." / "probe error ..." — substring
             # matching on "tpu" alone would read "no tpu" as up
@@ -411,7 +437,8 @@ def _tpu_worker():
     if not found:
         return
     try:  # evidence for the monitoring loop pattern (memory: tpu_up.marker)
-        open("/tmp/tpu_up.marker", "w").write(str(time.time()))
+        with open(_UP_MARKER, "w") as fh:
+            fh.write(str(time.time()))
     except OSError:
         pass
     line = _run_child({"JAXMC_BENCH_CHILD": "tpu", "JAXMC_BENCH_RUNG":
@@ -471,6 +498,12 @@ def main():
     _DEADLINE = time.time() + budget
     _TEL = obs.Telemetry(meta={"command": "bench",
                                "deadline_s": budget})
+    # NO parent watchdog: the parent's only telemetry is one child:* span
+    # per attempt, held open for the child's whole (healthy, multi-minute)
+    # run — any parent-side stall threshold under the deadline would flag
+    # normal rounds. The CHILDREN carry the watchdogs: they have real
+    # phase activity (device_init/engine_build/warmup/timed), so their
+    # stall lines name the actual wedge on the shared stderr.
     _log(f"deadline: {budget:.0f}s from now")
 
     t_cpu = threading.Thread(target=_cpu_worker, daemon=True)
@@ -507,7 +540,8 @@ def main():
     # device path never produced a line
     orch = {"deadline_s": budget,
             "spent_s": round(budget - _remaining(), 1),
-            "phases": _TEL.phase_list()}
+            "phases": _TEL.phase_list(),
+            "env": obs.environment_meta()}
     if line is None:
         # truly nothing (emergency child itself failed): emit an explicit
         # failure record rather than silence — parseable, value null

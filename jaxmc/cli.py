@@ -90,13 +90,19 @@ def cmd_check(args) -> int:
         trace_path=args.trace,
         meta={"command": "check", "backend": args.backend,
               "spec": args.spec, "cfg": args.cfg,
-              "argv": list(sys.argv[1:])}) if want_tel \
+              "argv": list(sys.argv[1:]),
+              "env": obs.environment_meta()}) if want_tel \
         else obs.NullTelemetry()
     log = obs.Logger(tel, quiet=args.quiet)
+    # the watchdog names a wedged phase (device init, a pathological BFS
+    # level) on stderr and in the trace WHILE it hangs — start() is a
+    # no-op on the NullTelemetry, so runs without an artifact pay nothing
+    wd = obs.Watchdog(tel).start()
     try:
         with obs.use(tel):
             return _run_check(args, tel, log, t0)
     finally:
+        wd.stop()
         tel.close()
 
 
@@ -150,6 +156,10 @@ def _run_check(args, tel, log, t0) -> int:
                     tel.gauge("device.platform",
                               jax.devices()[0].platform)
                     tel.gauge("device.count", len(jax.devices()))
+                    # re-stamp the env fingerprint now that jax is
+                    # initialized: platform/device_count become real
+                    from . import obs
+                    tel.set_meta(env=obs.environment_meta())
         except ImportError as e:
             print(f"error: the jax backend is not available in this build "
                   f"({e})", file=sys.stderr)
@@ -320,14 +330,20 @@ def main(argv=None) -> int:
     c.add_argument("--metrics-out", default=None, metavar="FILE",
                    help="write an end-of-run JSON metrics artifact: "
                         "phase wall times, per-level BFS counts, "
-                        "expansion-mode/memo/fingerprint counters and "
-                        "the result block (schema jaxmc.metrics/1; see "
-                        "jaxmc/obs/schema.py)")
+                        "expansion-mode/memo/fingerprint/compile-cost "
+                        "counters, the env fingerprint and the result "
+                        "block (schema jaxmc.metrics/2; see "
+                        "jaxmc/obs/schema.py; render/compare with "
+                        "python -m jaxmc.obs report|diff)")
     c.add_argument("--trace", default=None, metavar="FILE",
                    help="stream telemetry events as JSONL while the run "
-                        "is live (span_open/span/level/log); a killed "
+                        "is live (span_open/span/level/log plus "
+                        "watchdog heartbeat/stall beats); a killed "
                         "run leaves open spans naming the phase it "
-                        "died in")
+                        "died in, and a wedged phase is flagged by a "
+                        "stall event while it hangs (knobs: "
+                        "JAXMC_HEARTBEAT_EVERY/JAXMC_STALL_FACTOR/"
+                        "JAXMC_STALL_MIN_S)")
     c.set_defaults(fn=cmd_check)
 
     m = sub.add_parser("simulate",

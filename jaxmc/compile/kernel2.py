@@ -21,6 +21,7 @@ as a hard error — never silent truncation, counts stay exact
 from __future__ import annotations
 
 import itertools
+import os
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -2526,6 +2527,43 @@ def _unchanged2(e: A.Node, kc: KernelCtx, state, primes, vars):
             _unchanged2(x, kc, state, primes, vars)
         return
     raise CompileError(f"unsupported UNCHANGED {e!r}")
+
+
+def introspect_kernel(fn: Callable, args, want_cost: bool = True
+                      ) -> Dict[str, int]:
+    """Compile-cost introspection for one kernel (ISSUE 2): jaxpr size
+    (equations — the compile-time driver: XLA:CPU compile wall grows
+    superlinearly in it, the r3 MCVoting blowup) and, when the backend's
+    HLO cost model answers, lowered flops / bytes accessed.
+
+    The make_jaxpr trace DOUBLES AS THE FORCED ABSTRACT TRACE: it raises
+    lazy CompileError/RecursionError exactly like jax.eval_shape, so a
+    telemetry-enabled build calls this INSTEAD of eval_shape — one trace,
+    not two, and the compile_arm span measures what an untelemetered run
+    would pay. Only the cost-analysis half is best-effort/never-raise
+    (the cost model is absent on some backends; the lowering it needs is
+    also the expensive part, so JAXMC_COMPILE_INTROSPECT=0 skips it).
+
+    Returns {jaxpr_eqns} plus {hlo_flops, hlo_bytes} when available."""
+    jx = jax.make_jaxpr(fn)(*args)  # propagates trace-time errors
+    out: Dict[str, int] = {"jaxpr_eqns": len(jx.eqns)}
+    if not want_cost or \
+            os.environ.get("JAXMC_COMPILE_INTROSPECT") == "0":
+        return out
+    try:
+        ca = jax.jit(fn).lower(*args).cost_analysis()
+        if isinstance(ca, (list, tuple)):  # older jax: one per device
+            ca = ca[0] if ca else None
+        if ca:
+            flops = ca.get("flops")
+            nbytes = ca.get("bytes accessed")
+            if flops is not None and flops == flops:  # NaN-guard
+                out["hlo_flops"] = int(flops)
+            if nbytes is not None and nbytes == nbytes:
+                out["hlo_bytes"] = int(nbytes)
+    except Exception:  # noqa: BLE001 — cost model absent on some backends
+        pass
+    return out
 
 
 def compile_predicate2(kc: KernelCtx, expr: A.Node) -> Callable:

@@ -375,6 +375,14 @@ class Explorer:
                      f"distinct state{'s' if init_count != 1 else ''} "
                      f"generated.")
 
+        # first progress record IMMEDIATELY (ISSUE 2): a short run used
+        # to produce zero progress lines because the first one waited a
+        # full --progress-every interval
+        d0 = depth_of[queue[0]] if queue else 0
+        self.log(f"Progress({d0}): {generated} states generated, "
+                 f"{len(states)} distinct states found, "
+                 f"{len(queue)} states left on queue.")
+
         # ---- BFS ----
         while queue:
             sid = queue.popleft()

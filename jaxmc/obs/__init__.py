@@ -1,26 +1,36 @@
 r"""jaxmc.obs — run telemetry (phase spans, counters, per-level BFS
-metrics) with JSONL trace streaming and a JSON summary artifact.
+metrics) with JSONL trace streaming, a JSON summary artifact, a
+watchdog heartbeat/stall monitor, and a cross-run report CLI.
 
     from jaxmc import obs
 
     tel = obs.Telemetry(trace_path="run.jsonl", meta={"backend": "jax"})
+    wd = obs.Watchdog(tel).start()           # heartbeat + stall events
     with obs.use(tel):                       # engines see it via current()
         with tel.span("load"):
             ...
+    wd.stop()
     tel.write_metrics("m.json", result={...})
 
 Engines report through `obs.current()` — a no-op NullTelemetry unless a
 real recorder is installed — so instrumentation costs nothing when no
-artifact was requested. See obs/telemetry.py for the model and
-obs/schema.py for the artifact schema.
+artifact was requested. See obs/telemetry.py for the model,
+obs/schema.py for the artifact schema (jaxmc.metrics/2),
+obs/watchdog.py for live stall diagnosis, and obs/report.py for
+`python -m jaxmc.obs report|diff` over artifacts.
 """
 
 from .telemetry import (Logger, NullTelemetry, Telemetry, current,
-                        device_mem_high_water, use, write_json_atomic)
-from .schema import (CHECK_KEYS, REQUIRED_KEYS, RESULT_KEYS, SCHEMA,
-                     validate_summary)
+                        device_mem_high_water, environment_meta,
+                        rss_bytes, use, write_json_atomic)
+from .schema import (CHECK_KEYS, HEARTBEAT_KEYS, REQUIRED_KEYS,
+                     RESULT_KEYS, SCHEMA, SCHEMAS, STALL_KEYS,
+                     validate_summary, validate_trace_event)
+from .watchdog import Watchdog
 
-__all__ = ["Logger", "NullTelemetry", "Telemetry", "current",
-           "device_mem_high_water", "use", "write_json_atomic", "SCHEMA",
+__all__ = ["Logger", "NullTelemetry", "Telemetry", "Watchdog", "current",
+           "device_mem_high_water", "environment_meta", "rss_bytes",
+           "use", "write_json_atomic", "SCHEMA", "SCHEMAS",
            "REQUIRED_KEYS", "CHECK_KEYS", "RESULT_KEYS",
-           "validate_summary"]
+           "HEARTBEAT_KEYS", "STALL_KEYS", "validate_summary",
+           "validate_trace_event"]

@@ -1,0 +1,11 @@
+"""`python -m jaxmc.obs` — the metrics report/diff CLI (obs/report.py).
+
+Deliberately free of jax imports: the report path must work (and is
+smoke-tested) in environments where only the interpreter backend runs.
+"""
+
+import sys
+
+from .report import main
+
+sys.exit(main())

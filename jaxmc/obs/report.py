@@ -1,0 +1,341 @@
+r"""Cross-run metrics reporting: `python -m jaxmc.obs {report,diff}`.
+
+PR 1 made one run legible (`--metrics-out` / `--trace`); this closes the
+loop ACROSS runs. Two subcommands, both pure stdlib (no jax import — the
+entrypoint must work in an interp-only environment and is smoke-tested
+against import rot):
+
+  report FILE            render one artifact as a human phase/level
+                         breakdown (phases table, level rollup,
+                         throughput, compile/watchdog highlights)
+  diff FILE FILE [...]   ingest 2+ artifacts — `--metrics-out` JSONs
+                         and/or the BENCH_r*.json family — and emit a
+                         trajectory table with regression flags:
+                         states/sec drops, phase wall blowups, backend
+                         demotions (tpu -> cpu -> interp). With
+                         --fail-on-regress the exit status is 1 when
+                         any flag fired, so the bench driver can gate.
+
+Both input shapes normalize into one record (`load_record`):
+  - a metrics artifact (schema jaxmc.metrics/1 or /2, obs/schema.py);
+  - a bench rollup {n, cmd, rc, tail, parsed:{metric, value, ...}} or a
+    bare bench line {metric, value, unit, vs_baseline, orchestration?}
+    as printed by bench.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, List, Optional
+
+# platform rank for demotion flags: higher is better; a later run with a
+# lower rank means the bench/check fell off its accelerator
+_RANK = {"interp": 0, "cpu": 1, "gpu": 2, "tpu": 3}
+
+
+def _fmt_s(x) -> str:
+    if x is None:
+        return "-"
+    if x >= 100:
+        return f"{x:.0f}s"
+    return f"{x:.2f}s"
+
+
+def _fmt_rate(x) -> str:
+    return "-" if x is None else f"{x:,.1f}"
+
+
+def _pct(new, old) -> Optional[float]:
+    if new is None or old is None or old == 0:
+        return None
+    return (new - old) / old * 100.0
+
+
+# --------------------------------------------------------------- loading
+
+def load_record(path: str) -> Dict[str, Any]:
+    """Normalize one artifact file into the common record the table and
+    the regression rules consume. Raises ValueError on unrecognized
+    shapes (naming the path)."""
+    with open(path, encoding="utf-8") as fh:
+        obj = json.load(fh)
+    if not isinstance(obj, dict):
+        raise ValueError(f"{path}: not a JSON object")
+    label = os.path.basename(path)
+    for ext in (".json", ".jsonl"):
+        if label.endswith(ext):
+            label = label[:-len(ext)]
+    if "schema" in obj and "phases" in obj:
+        return _from_metrics(obj, path, label)
+    if "parsed" in obj and isinstance(obj["parsed"], dict):
+        rec = _from_bench(obj["parsed"], path, label)
+        if obj.get("n") is not None:
+            rec["label"] = f"r{int(obj['n']):02d}"
+        return rec
+    if "metric" in obj and "value" in obj:
+        return _from_bench(obj, path, label)
+    raise ValueError(
+        f"{path}: neither a jaxmc.metrics artifact nor a bench JSON "
+        f"(keys: {sorted(obj)[:8]})")
+
+
+def _from_metrics(s: Dict[str, Any], path: str, label: str
+                  ) -> Dict[str, Any]:
+    res = s.get("result") or {}
+    wall = res.get("wall_s") or s.get("wall_s")
+    gen = res.get("generated")
+    rate = (gen / wall) if gen and wall else None
+    env = s.get("env") or {}
+    platform = env.get("platform") or s.get("gauges", {}).get(
+        "device.platform")
+    backend = s.get("backend")
+    if backend == "interp" or (backend is None and platform is None):
+        plat_key = "interp"
+    else:
+        plat_key = platform or "cpu"
+    return {
+        "path": path, "label": label, "kind": "metrics",
+        "states_per_sec": rate,
+        "backend": backend or "?",
+        "platform": plat_key,
+        "rank": _RANK.get(plat_key, 1),
+        "mode": s.get("gauges", {}).get("expand.mode"),
+        "wall_s": s.get("wall_s"),
+        "phases": {p["name"]: p["wall_s"] for p in s.get("phases", [])},
+        "env": env,
+        "result": res,
+        "summary": s,
+    }
+
+
+def _from_bench(b: Dict[str, Any], path: str, label: str
+                ) -> Dict[str, Any]:
+    metric = str(b.get("metric") or "")
+    if "EXACT PYTHON INTERPRETER" in metric:
+        plat_key = "interp"
+    else:
+        m = re.search(r"platform=(\w+)", metric)
+        plat_key = m.group(1) if m else "interp"
+    phases: Dict[str, float] = {}
+    for src in (b.get("phases"),
+                (b.get("orchestration") or {}).get("phases")):
+        for p in src or []:
+            phases[p["name"]] = phases.get(p["name"], 0.0) + p["wall_s"]
+    orch = b.get("orchestration") or {}
+    return {
+        "path": path, "label": label, "kind": "bench",
+        "states_per_sec": b.get("value"),
+        "backend": "bench",
+        "platform": plat_key,
+        "rank": _RANK.get(plat_key, 1),
+        "mode": None,
+        "wall_s": orch.get("spent_s"),
+        "phases": phases,
+        "env": b.get("env") or {},
+        "result": {"vs_baseline": b.get("vs_baseline"),
+                   "vs_tlc_estimate": b.get("vs_tlc_estimate")},
+        "metric": metric,
+    }
+
+
+# ---------------------------------------------------------------- report
+
+def _phase_table(phases: List[Dict[str, Any]], out) -> int:
+    """Render a summary's phase list; returns the number of rows."""
+    if not phases:
+        print("  (no phases recorded)", file=out)
+        return 0
+    w = max(len(p["name"]) for p in phases)
+    total = sum(p["wall_s"] for p in phases)
+    for p in phases:
+        share = (p["wall_s"] / total * 100.0) if total else 0.0
+        flags = "  OPEN" if p.get("open") else ""
+        print(f"  {p['name']:<{w}}  {p['wall_s']:>9.3f}s  "
+              f"x{p['count']:<4d} {share:5.1f}%{flags}", file=out)
+    return len(phases)
+
+
+def cmd_report(args, out=sys.stdout) -> int:
+    rec = load_record(args.file)
+    print(f"== {rec['label']} ({rec['kind']}: {args.file})", file=out)
+    env = rec["env"]
+    bits = [f"backend={rec['backend']}", f"platform={rec['platform']}"]
+    if rec["mode"]:
+        bits.append(f"mode={rec['mode']}")
+    if env.get("jax_version"):
+        bits.append(f"jax={env['jax_version']}")
+    if env.get("device_count"):
+        bits.append(f"devices={env['device_count']}")
+    print("  " + "  ".join(bits), file=out)
+    if rec["kind"] == "bench":
+        print(f"  states/sec: {_fmt_rate(rec['states_per_sec'])}  "
+              f"vs_baseline={rec['result'].get('vs_baseline')}  "
+              f"vs_tlc_estimate={rec['result'].get('vs_tlc_estimate')}",
+              file=out)
+        print("phases (child + orchestration):", file=out)
+        _phase_table(
+            [{"name": k, "wall_s": v, "count": 1}
+             for k, v in rec["phases"].items()], out)
+        # pre-PR1 bench lines carry no phases — that is a fact about the
+        # artifact, not a rendering failure
+        return 0
+    s = rec["summary"]
+    res = rec["result"]
+    if res:
+        print(f"  result: ok={res.get('ok')}  "
+              f"distinct={res.get('distinct')}  "
+              f"generated={res.get('generated')}  "
+              f"diameter={res.get('diameter')}  "
+              f"truncated={res.get('truncated')}", file=out)
+        print(f"  throughput: {_fmt_rate(rec['states_per_sec'])} "
+              f"states/sec over {_fmt_s(res.get('wall_s'))} search "
+              f"({_fmt_s(s.get('wall_s'))} total)", file=out)
+    print("phases:", file=out)
+    rows = _phase_table(s.get("phases", []), out)
+    levels = s.get("levels", [])
+    if levels:
+        gen = sum(r.get("generated", 0) for r in levels)
+        walls = [r["wall_s"] for r in levels
+                 if isinstance(r.get("wall_s"), (int, float))]
+        print(f"levels: {len(levels)} records to depth "
+              f"{levels[-1]['level']}; {gen} generated; "
+              f"slowest level {_fmt_s(max(walls) if walls else None)}",
+              file=out)
+    hl = []
+    c, g = s.get("counters", {}), s.get("gauges", {})
+    for k in ("compile.kernels_built", "compile.cache_hits",
+              "compile.cache_misses", "compile.jaxpr_eqns_total",
+              "compile.hlo_flops_total", "watchdog.stalls"):
+        if k in c:
+            hl.append(f"{k}={c[k]}")
+    for k in ("expand.mode", "fingerprint.occupancy",
+              "device.mem_high_water_bytes", "watchdog.max_stall_s"):
+        if k in g:
+            hl.append(f"{k}={g[k]}")
+    if hl:
+        print("highlights: " + "  ".join(hl), file=out)
+    return 0 if rows else 1
+
+
+# ------------------------------------------------------------------ diff
+
+def _env_changes(a: Dict[str, Any], b: Dict[str, Any]) -> List[str]:
+    out = []
+    for k in ("jax_version", "platform", "device_count", "python"):
+        va, vb = a.get(k), b.get(k)
+        if va is not None and vb is not None and va != vb:
+            out.append(f"{k}: {va} -> {vb}")
+    return out
+
+
+def find_regressions(prev: Dict[str, Any], cur: Dict[str, Any],
+                     threshold_pct: float) -> List[str]:
+    """Regression flags between two consecutive records. Environment
+    changes are reported alongside each flag so a demotion caused by a
+    jax upgrade (or a dead tunnel) reads as such."""
+    flags = []
+    step = f"{prev['label']} -> {cur['label']}"
+    d = _pct(cur["states_per_sec"], prev["states_per_sec"])
+    if d is not None and d < -threshold_pct:
+        flags.append(
+            f"REGRESS states/sec {step}: "
+            f"{_fmt_rate(prev['states_per_sec'])} -> "
+            f"{_fmt_rate(cur['states_per_sec'])} ({d:+.1f}%)")
+    if cur["rank"] < prev["rank"]:
+        flags.append(
+            f"REGRESS backend demotion {step}: {prev['platform']} -> "
+            f"{cur['platform']}")
+    for name in sorted(set(prev["phases"]) & set(cur["phases"])):
+        pw, cw = prev["phases"][name], cur["phases"][name]
+        pd = _pct(cw, pw)
+        # absolute floor: a 3 ms parse doubling is noise, not a flag
+        if pd is not None and pd > threshold_pct and cw - pw > 1.0:
+            flags.append(
+                f"REGRESS phase {name} {step}: {_fmt_s(pw)} -> "
+                f"{_fmt_s(cw)} ({pd:+.1f}%)")
+    if flags:
+        env = _env_changes(prev["env"], cur["env"])
+        if env:
+            flags.append(f"  note {step}: environment changed "
+                         f"({'; '.join(env)})")
+    return flags
+
+
+def cmd_diff(args, out=sys.stdout) -> int:
+    recs = [load_record(p) for p in args.files]
+    # trajectory table: one row per run, the shared top phases as columns
+    phase_tot: Dict[str, float] = {}
+    for r in recs:
+        for k, v in r["phases"].items():
+            phase_tot[k] = phase_tot.get(k, 0.0) + v
+    cols = [k for k, _ in sorted(phase_tot.items(),
+                                 key=lambda kv: -kv[1])[:5]]
+    lw = max([5] + [len(r["label"]) for r in recs])
+    head = (f"{'run':<{lw}}  {'states/sec':>12}  {'platform':>8}  "
+            + "  ".join(f"{c:>14}" for c in cols))
+    print(head, file=out)
+    print("-" * len(head), file=out)
+    for r in recs:
+        cells = "  ".join(
+            f"{_fmt_s(r['phases'].get(c)):>14}" for c in cols)
+        print(f"{r['label']:<{lw}}  "
+              f"{_fmt_rate(r['states_per_sec']):>12}  "
+              f"{r['platform']:>8}  {cells}", file=out)
+    flags: List[str] = []
+    for prev, cur in zip(recs, recs[1:]):
+        flags.extend(find_regressions(prev, cur, args.threshold))
+    print("", file=out)
+    if flags:
+        print("regressions:", file=out)
+        for f in flags:
+            print(f"  {f}", file=out)
+    else:
+        print("no regressions flagged "
+              f"(threshold {args.threshold:.0f}%).", file=out)
+    real = [f for f in flags if f.lstrip().startswith("REGRESS")]
+    if real and args.fail_on_regress:
+        return 1
+    return 0
+
+
+# ------------------------------------------------------------------ main
+
+def main(argv: Optional[List[str]] = None, out=sys.stdout) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m jaxmc.obs",
+        description="render and compare jaxmc metrics artifacts")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    r = sub.add_parser("report", help="render one metrics/bench artifact")
+    r.add_argument("file")
+    d = sub.add_parser("diff",
+                       help="trajectory table + regression flags over "
+                            "2+ metrics/bench artifacts (in run order)")
+    d.add_argument("files", nargs="+")
+    d.add_argument("--threshold", type=float, default=10.0,
+                   metavar="PCT",
+                   help="relative change that counts as a regression "
+                        "(default 10%%; phase flags also need >1s "
+                        "absolute growth)")
+    d.add_argument("--fail-on-regress", action="store_true",
+                   help="exit 1 when any REGRESS flag fired (bench/CI "
+                        "gate)")
+    args = ap.parse_args(argv)
+    try:
+        if args.cmd == "report":
+            return cmd_report(args, out)
+        if len(args.files) < 2:
+            print("error: diff needs at least two artifacts",
+                  file=sys.stderr)
+            return 2
+        return cmd_diff(args, out)
+    except (OSError, ValueError, KeyError) as e:
+        print(f"error: {type(e).__name__}: {e}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -12,6 +12,11 @@ Trace JSONL event grammar (one JSON object per line, `ev` discriminates):
   span_open  {name, t, parent, attrs}      -- partial-span forensics
   span       {name, t0, wall_s, attrs[, error]}
   level      {level, t, frontier?, generated?, new?, distinct?, ...}
+  heartbeat  {t, wall_s, rss_bytes, open_spans, last_level,
+              progress_seq}                -- periodic watchdog beat
+  stall      {t, stalled_for_s, threshold_s, open_spans, last_level,
+              median_level_s}              -- watchdog: no span/level
+                                              progress for too long
   counter/gauge changes are rolled up in the summary only
   log        {t, msg}                      -- mirror of the stdout line
   run_end    {t}
@@ -20,13 +25,35 @@ Summary (metrics-out) required surface: see REQUIRED_KEYS below; each
 phases[i] carries {name, wall_s, count} (+optional open=True for spans
 still running at rollup — the deadline-blowout record); each levels[i]
 carries at least {level} with non-decreasing level indices.
+
+Schema history (additive — every jaxmc.metrics/1 artifact is a valid
+jaxmc.metrics/2 artifact minus the new optional surface, so readers and
+`validate_summary` accept both):
+
+  jaxmc.metrics/1  (PR 1) the surface above minus heartbeat/stall.
+  jaxmc.metrics/2  (PR 2) adds, all optional:
+    - meta block `env` = {jax_version, platform, device_count}: the
+      environment fingerprint `python -m jaxmc.obs diff` uses to
+      attribute regressions to environment changes;
+    - trace events `heartbeat` / `stall` (jaxmc/obs/watchdog.py);
+    - compile-introspection gauges: `compile.arm_cost` ({arm label ->
+      {jaxpr_eqns, hlo_flops?, hlo_bytes?}}), counters
+      `compile.jaxpr_eqns_total`, `compile.hlo_flops_total`,
+      `compile.hlo_bytes_total`, and jit-cache effectiveness counters
+      `compile.cache_hits` / `compile.cache_misses`;
+    - watchdog counters `watchdog.heartbeats` / `watchdog.stalls` and
+      the `watchdog.max_stall_s` high-water gauge.
 """
 
 from __future__ import annotations
 
 from typing import Any, Dict
 
-SCHEMA = "jaxmc.metrics/1"
+SCHEMA = "jaxmc.metrics/2"
+
+# every schema revision an artifact may carry and a reader must accept
+# (additive history: a v1 artifact simply lacks the v2 optional surface)
+SCHEMAS = ("jaxmc.metrics/1", "jaxmc.metrics/2")
 
 # top-level summary keys every artifact must carry
 REQUIRED_KEYS = ("schema", "started_at", "wall_s", "phases", "counters",
@@ -40,6 +67,12 @@ RESULT_KEYS = ("ok", "distinct", "generated", "diameter", "truncated")
 
 PHASE_KEYS = ("name", "wall_s", "count")
 
+# required fields of the watchdog trace events (jaxmc/obs/watchdog.py)
+HEARTBEAT_KEYS = ("ev", "t", "wall_s", "open_spans", "last_level",
+                  "progress_seq")
+STALL_KEYS = ("ev", "t", "stalled_for_s", "threshold_s", "open_spans",
+              "last_level")
+
 
 def validate_summary(s: Dict[str, Any], check_run: bool = False) -> None:
     """Structural validation; raises ValueError naming the defect."""
@@ -50,8 +83,8 @@ def validate_summary(s: Dict[str, Any], check_run: bool = False) -> None:
         missing += [k for k in CHECK_KEYS if k not in s]
     if missing:
         raise ValueError(f"summary missing keys: {missing}")
-    if s["schema"] != SCHEMA:
-        raise ValueError(f"schema {s['schema']!r} != {SCHEMA!r}")
+    if s["schema"] not in SCHEMAS:
+        raise ValueError(f"schema {s['schema']!r} not in {SCHEMAS!r}")
     if not isinstance(s["phases"], list):
         raise ValueError("phases is not a list")
     for ph in s["phases"]:
@@ -78,3 +111,31 @@ def validate_summary(s: Dict[str, Any], check_run: bool = False) -> None:
         miss = [k for k in RESULT_KEYS if k not in res]
         if miss:
             raise ValueError(f"result missing keys: {miss}")
+
+
+def validate_trace_event(e: Dict[str, Any]) -> None:
+    """Structural validation of one trace JSONL event. Only the watchdog
+    events carry enough required structure to pin; other event kinds
+    need just the `ev`/`t` envelope."""
+    if not isinstance(e, dict):
+        raise ValueError(f"event is {type(e).__name__}, not a dict")
+    if "ev" not in e:
+        raise ValueError("event missing 'ev'")
+    # every event is timestamped: `t` everywhere except span-close,
+    # which carries its open time as `t0` (see the grammar above)
+    tkey = "t0" if e["ev"] == "span" else "t"
+    if tkey not in e:
+        raise ValueError(f"event {e['ev']!r} missing {tkey!r}")
+    required = {"heartbeat": HEARTBEAT_KEYS, "stall": STALL_KEYS}.get(
+        e["ev"])
+    if required is None:
+        return
+    miss = [k for k in required if k not in e]
+    if miss:
+        raise ValueError(f"{e['ev']} event missing {miss}")
+    if not isinstance(e["open_spans"], list):
+        raise ValueError(f"{e['ev']}.open_spans is not a list")
+    if e["ev"] == "heartbeat" and e["wall_s"] < 0:
+        raise ValueError("heartbeat has negative wall_s")
+    if e["ev"] == "stall" and e["stalled_for_s"] < 0:
+        raise ValueError("stall has negative stalled_for_s")

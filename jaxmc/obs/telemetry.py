@@ -23,11 +23,12 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import threading
 import time
 from typing import Any, Dict, List, Optional
 
-SCHEMA = "jaxmc.metrics/1"
+from .schema import SCHEMA  # one source of truth for the artifact schema
 
 
 def write_json_atomic(path: str, obj) -> None:
@@ -89,6 +90,8 @@ class NullTelemetry:
     off."""
 
     enabled = False
+    progress_seq = 0  # never advances: a watchdog on a null recorder
+    # would see an eternal stall, so Watchdog refuses to start on one
 
     def span(self, name: str, **attrs):
         return _NULL_SPAN
@@ -158,6 +161,10 @@ class Telemetry(NullTelemetry):
         self._lock = threading.Lock()
         self._local = threading.local()
         self.t_start = clock()
+        # bumped on every span open/close and level record — the
+        # watchdog's liveness signal: a run whose progress_seq stops
+        # moving is wedged inside whatever span is still open
+        self.progress_seq = 0
         self.meta: Dict[str, Any] = dict(meta or {})
         # phases aggregate spans by name, in first-start order
         self._phases: Dict[str, Dict[str, Any]] = {}
@@ -199,6 +206,7 @@ class Telemetry(NullTelemetry):
         parent = stack[-1] if stack else None
         stack.append(h.name)
         with self._lock:
+            self.progress_seq += 1
             self._open_spans.append(h)
             ph = self._phases.setdefault(
                 h.name, {"name": h.name, "wall_s": 0.0, "count": 0,
@@ -213,6 +221,7 @@ class Telemetry(NullTelemetry):
         if stack and stack[-1] == h.name:
             stack.pop()
         with self._lock:
+            self.progress_seq += 1
             if h in self._open_spans:
                 self._open_spans.remove(h)
             ph = self._phases[h.name]
@@ -248,6 +257,7 @@ class Telemetry(NullTelemetry):
         rec = {"level": int(index)}
         rec.update({k: _jsonable(v) for k, v in fields.items()})
         with self._lock:
+            self.progress_seq += 1
             self.levels.append(rec)
         self._emit(dict(rec, ev="level", t=self._clock()))
 
@@ -274,6 +284,22 @@ class Telemetry(NullTelemetry):
     def set_meta(self, **fields) -> None:
         with self._lock:
             self.meta.update({k: _jsonable(v) for k, v in fields.items()})
+
+    def watch_snapshot(self) -> Dict[str, Any]:
+        """One consistent liveness snapshot for the watchdog: the
+        progress sequence number, the open-span names (outermost first),
+        the last completed BFS level, and the per-level wall times (for
+        the stall threshold's median)."""
+        with self._lock:
+            last = self.levels[-1] if self.levels else None
+            return {
+                "progress_seq": self.progress_seq,
+                "open_spans": [h.name for h in self._open_spans],
+                "last_level": None if last is None else last.get("level"),
+                "level_walls": [r["wall_s"] for r in self.levels
+                                if isinstance(r.get("wall_s"),
+                                              (int, float))],
+            }
 
     # ---- rollup ----
     def phase_list(self) -> List[Dict[str, Any]]:
@@ -380,6 +406,53 @@ class Logger:
             self.sink(msg)
         tel = self.tel if self.tel is not None else current()
         tel.log_line(msg)
+
+
+def rss_bytes() -> Optional[int]:
+    """This process's resident set size, or None when the platform has
+    no cheap way to ask. /proc is the normal path (linux containers);
+    the getrusage fallback reports the PEAK rss, which is still the
+    useful number for a watchdog heartbeat."""
+    try:
+        with open("/proc/self/statm") as fh:
+            pages = int(fh.read().split()[1])
+        return pages * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, ValueError, IndexError):
+        pass
+    try:
+        import resource
+        rss_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        return int(rss_kb) * 1024
+    except Exception:  # noqa: BLE001 — diagnostics must not mask
+        return None
+
+
+def environment_meta() -> Dict[str, Any]:
+    """The environment fingerprint recorded in the metrics `meta` block
+    (and the bench JSON line) so `python -m jaxmc.obs diff` can
+    attribute a regression to an environment change instead of a code
+    change. Deliberately does NOT import jax: an interp run must not pay
+    (or hang on) device-plugin init for telemetry's sake — platform and
+    device count appear only when the caller already initialized jax."""
+    out: Dict[str, Any] = {"python": sys.version.split()[0],
+                           "jax_version": None, "platform": None,
+                           "device_count": None}
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        out["jax_version"] = getattr(jax, "__version__", None)
+        try:
+            devs = jax.devices()
+            out["platform"] = devs[0].platform
+            out["device_count"] = len(devs)
+        except Exception:  # noqa: BLE001 — backend init may be broken
+            pass
+    else:
+        try:  # metadata read only — no import, no device init
+            from importlib.metadata import version
+            out["jax_version"] = version("jax")
+        except Exception:  # noqa: BLE001
+            pass
+    return out
 
 
 def device_mem_high_water() -> Optional[int]:

@@ -42,7 +42,7 @@ from ..engine.simulate import sample_states
 from ..compile.vspec import Bounds, CompileError, ModeError
 from ..compile.kernel2 import (KernelCtx, Layout2, OV_DEMOTED,
                                build_layout2, compile_action2,
-                               compile_predicate2)
+                               compile_predicate2, introspect_kernel)
 from ..compile.ground import ground_arm, split_arms
 
 SENTINEL = np.int32(2**31 - 1)
@@ -280,19 +280,48 @@ class TpuExplorer:
         self.compiled = []
         self._ca_arm: List[int] = []  # arm index per compiled action
         self.fb_arms: List[Tuple[Any, str]] = []  # (ActionArm, reason)
+        # per-arm compile introspection (ISSUE 2): jaxpr equation count
+        # and HLO flops/bytes per kernel, aggregated per arm label. The
+        # introspection trace replaces the eval_shape forced trace, so
+        # the only extra cost vs an untelemetered build is the lowering
+        # for cost_analysis (JAXMC_COMPILE_INTROSPECT=0 skips it).
+        arm_costs: Dict[str, Dict[str, int]] = {}
+        zero_row = jnp.zeros((self.layout.width,), jnp.int32)
+        zero_slot = jnp.zeros((), jnp.int32)
         for ai, arm in enumerate(self.arms):
             try:
                 # the span covers grounding + kernel build + the forced
                 # abstract trace — the per-arm compile cost the bench
                 # forensics need (BENCH_r05: nothing said whether compile
                 # or BFS ate the deadline)
-                with tel.span("compile_arm", arm=arm.label or "Next"):
+                with tel.span("compile_arm",
+                              arm=arm.label or "Next") as asp:
                     gas = ground_arm(model, arm,
                                      dyn_slots=self.bounds.kv_cap)
                     cas = []
                     for ga in gas:
                         ca = compile_action2(self.kc, ga)
-                        if ca.n_slots:
+                        if tel.enabled:
+                            # the introspection trace IS the forced
+                            # abstract trace (same lazy CompileError/
+                            # RecursionError surface as eval_shape) —
+                            # one trace per kernel either way
+                            info = introspect_kernel(
+                                ca.fn, (zero_row, zero_slot)
+                                if ca.n_slots else (zero_row,))
+                            acc = arm_costs.setdefault(
+                                arm.label or "Next", {})
+                            for k, v in info.items():
+                                acc[k] = acc.get(k, 0) + v
+                                asp.attrs[k] = asp.attrs.get(k, 0) + v
+                                tel.counter(
+                                    {"jaxpr_eqns":
+                                     "compile.jaxpr_eqns_total",
+                                     "hlo_flops":
+                                     "compile.hlo_flops_total",
+                                     "hlo_bytes":
+                                     "compile.hlo_bytes_total"}[k], v)
+                        elif ca.n_slots:
                             jax.eval_shape(ca.fn, row_spec, slot_spec)
                         else:
                             jax.eval_shape(ca.fn, row_spec)
@@ -311,6 +340,10 @@ class TpuExplorer:
             self.actions.extend(gas)
             self.compiled.extend(cas)
             self._ca_arm.extend([ai] * len(cas))
+        if arm_costs:
+            # machine-readable per-arm compile-cost map (schema v2):
+            # {arm label -> {jaxpr_eqns, hlo_flops?, hlo_bytes?}}
+            tel.gauge("compile.arm_cost", arm_costs)
         # kernels that compiled only by DEMOTING a guard conjunct (False
         # + abort flag) under-approximate behind a runtime abort. Most
         # demotions never fire (raft's Receive reads fields of message
@@ -654,7 +687,9 @@ class TpuExplorer:
     def _get_step(self, SC: int, FC: int) -> Callable:
         key = (SC, FC)
         if key in self._step_cache:
+            obs.current().counter("compile.cache_hits")
             return self._step_cache[key]
+        obs.current().counter("compile.cache_misses")
         A, W, K = self.A, self.W, self.K
         inv_fns = self.inv_fns
         con_fns = self.constraint_fns
@@ -777,7 +812,9 @@ class TpuExplorer:
         layer of SURVEY.md §7.5 — so the device does expansion, hashing,
         and predicate checks while membership runs on the host."""
         if FC in self._hstep_cache:
+            obs.current().counter("compile.cache_hits")
             return self._hstep_cache[FC]
+        obs.current().counter("compile.cache_misses")
         A, W = self.A, self.W
         inv_fns = self.inv_fns
         con_fns = self.constraint_fns
@@ -866,7 +903,10 @@ class TpuExplorer:
             for ca in acts:
                 key = ("hjit", FC)
                 jf = ca.__dict__.get(key)
-                if jf is None:
+                if jf is not None:
+                    obs.current().counter("compile.cache_hits")
+                else:
+                    obs.current().counter("compile.cache_misses")
                     if ca.n_slots:
                         jf = jax.jit(jax.vmap(
                             jax.vmap(ca.fn, in_axes=(0, None)),
@@ -924,7 +964,10 @@ class TpuExplorer:
         cap = _pow2_at_least(n, lo=64)
         ckey = (cap, skip_cons)
         jf = self._newcheck_cache.get(ckey)
-        if jf is None:
+        if jf is not None:
+            obs.current().counter("compile.cache_hits")
+        else:
+            obs.current().counter("compile.cache_misses")
             inv_fns = self.inv_fns
             con_fns = [] if skip_cons else self.constraint_fns
 
@@ -963,7 +1006,9 @@ class TpuExplorer:
         # intervals, advisor r2) without recompiling
         key = (SC, FCap, AccCap, VC, CH)
         if key in self._res_cache:
+            obs.current().counter("compile.cache_hits")
             return self._res_cache[key]
+        obs.current().counter("compile.cache_misses")
         A, W, K = self.A, self.W, self.K
         C = A * CH
         inv_fns = self.inv_fns
@@ -1536,6 +1581,11 @@ class TpuExplorer:
                  jnp.int32(depth))
         grow_flag = {ST_OVF_SEEN: "SC", ST_OVF_FRONT: "FCap",
                      ST_OVF_ACC: "AccCap", ST_OVF_VC: "VC"}
+        # first progress line immediately (ISSUE 2): short runs get at
+        # least one record; same format as the interval lines below
+        self.log(f"Progress({depth}): {generated} states generated, "
+                 f"{distinct} distinct states found, "
+                 f"{fcount} states left on queue.")
         last_progress = last_ck = time.time()
         while True:
             ck_key = (caps["SC"], caps["FCap"], caps["AccCap"],
@@ -1716,6 +1766,11 @@ class TpuExplorer:
                 frontier_sids = fsids
             store.load(ck["store"])
             frontier_np = np.ascontiguousarray(ck["frontier"])
+        # first progress line immediately (ISSUE 2), in this engine's own
+        # interval-line format (see the loop's progress_every site)
+        self.log(f"Progress({depth}): {generated} generated, "
+                 f"{distinct} distinct, {len(frontier_np)} on "
+                 f"queue.")
         last_progress = last_ck = time.time()
         hstep = self._get_hstep(CH)
         while len(frontier_np) > 0:
@@ -2362,6 +2417,9 @@ class TpuExplorer:
             frontier = jnp.asarray(fr_np)
             fcount = len(fr)
 
+        self.log(f"Progress({depth}): {generated} states generated, "
+                 f"{distinct} distinct states found, "
+                 f"{fcount} states left on queue.")
         last_progress = last_ck = time.time()
         while fcount > 0:
             lvl_t0 = time.time()

@@ -47,6 +47,39 @@ class TestMetricsArtifact:
             obs.validate_summary(summary, check_run=True)
             assert summary["backend"] == backend
             assert summary["spec"] == SYMTOY
+            assert summary["schema"] == obs.SCHEMA
+
+    def test_env_fingerprint_recorded(self, both):
+        # schema v2: the env block obs diff uses to attribute
+        # regressions to environment changes
+        for backend, (summary, _) in both.items():
+            env = summary["env"]
+            assert env["jax_version"], backend
+            assert env["python"], backend
+        # the jax run initialized devices, so platform/count are real
+        envj = both["jax"][0]["env"]
+        assert envj["platform"] == "cpu"
+        assert envj["device_count"] >= 1
+
+    def test_v1_artifacts_still_validate(self):
+        # additive migration: a jaxmc.metrics/1 artifact (no env block,
+        # no watchdog/compile counters) must keep validating
+        tel = obs.Telemetry()
+        s = tel.summary()
+        s["schema"] = "jaxmc.metrics/1"
+        obs.validate_summary(s)
+        s["schema"] = "jaxmc.metrics/99"
+        with pytest.raises(ValueError):
+            obs.validate_summary(s)
+
+    def test_compile_introspection_gauges(self, both):
+        sj = both["jax"][0]
+        cost = sj["gauges"].get("compile.arm_cost")
+        assert cost, "per-arm compile-cost gauge missing"
+        assert all("jaxpr_eqns" in v for v in cost.values())
+        assert sj["counters"]["compile.jaxpr_eqns_total"] >= 1
+        # every jit-cache build is counted; symtoy compiles at least one
+        assert sj["counters"].get("compile.cache_misses", 0) >= 1
 
     def test_distinct_counts_match_explorer_and_backends(self, both):
         for backend, (summary, _) in both.items():

@@ -1,0 +1,192 @@
+r"""`python -m jaxmc.obs` report/diff tests: artifact normalization,
+the trajectory table, regression flags (seeded throughput drop, phase
+blowup, backend demotion), --fail-on-regress gating, and the subprocess
+smoke test that guards the entrypoint against import rot.
+
+Tier-1 fast: fixture artifacts are built with a fake-clock Telemetry
+(no jax); the one real run is an interp check on the symtoy micro model.
+"""
+
+import io
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from jaxmc import obs
+from jaxmc.obs import report
+
+pytestmark = pytest.mark.obs
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SPECS = os.path.join(REPO, "specs")
+
+
+def mk_artifact(path, rate, platform, phases, jax_version="0.4.37",
+                generated=100000):
+    """A minimal-but-valid jaxmc.metrics/2 check artifact: `generated`
+    states over generated/rate seconds, the given phase walls."""
+    clk = {"t": 1000.0}
+    tel = obs.Telemetry(clock=lambda: clk["t"])
+    for name, wall in phases.items():
+        h = tel.span(name)
+        h.__enter__()
+        clk["t"] += wall
+        h.done()
+    tel.level(0, frontier=1, generated=generated, wall_s=sum(
+        phases.values()))
+    tel.set_meta(backend="jax" if platform != "interp" else "interp",
+                 spec="specs/symtoy.tla",
+                 env={"jax_version": jax_version, "platform":
+                      None if platform == "interp" else platform,
+                      "device_count":
+                      None if platform == "interp" else 1})
+    tel.write_metrics(str(path), result={
+        "ok": True, "distinct": generated // 2, "generated": generated,
+        "diameter": 10, "truncated": False,
+        "wall_s": generated / rate})
+    with open(path) as fh:
+        obs.validate_summary(json.load(fh), check_run=True)
+    return str(path)
+
+
+def mk_bench(path, n, value, metric):
+    with open(path, "w") as fh:
+        json.dump({"n": n, "cmd": "python bench.py", "rc": 0,
+                   "parsed": {"metric": metric, "value": value,
+                              "unit": "states/sec", "vs_baseline": 1.0,
+                              "vs_tlc_estimate": 0.5}}, fh)
+    return str(path)
+
+
+def run_cli(argv):
+    out = io.StringIO()
+    rc = report.main(argv, out=out)
+    return rc, out.getvalue()
+
+
+class TestReport:
+    def test_report_renders_phases_and_result(self, tmp_path):
+        p = mk_artifact(tmp_path / "a.json", rate=5000.0, platform="tpu",
+                        phases={"load": 0.5, "device_init": 12.0,
+                                "search": 7.5})
+        rc, out = run_cli(["report", p])
+        assert rc == 0
+        assert "device_init" in out and "search" in out
+        assert "ok=True" in out and "generated=100000" in out
+        assert "5,000" in out  # states/sec
+
+    def test_report_unreadable_exits_2(self, tmp_path):
+        bad = tmp_path / "nope.json"
+        bad.write_text("{\"hello\": 1}")
+        assert report.main(["report", str(bad)]) == 2
+        assert report.main(["report", str(tmp_path / "missing.json")]) == 2
+
+
+class TestDiff:
+    def seeded(self, tmp_path):
+        good = mk_artifact(tmp_path / "r1.json", rate=8000.0,
+                           platform="tpu",
+                           phases={"device_init": 2.0, "search": 10.0})
+        bad = mk_artifact(tmp_path / "r2.json", rate=900.0,
+                          platform="interp",
+                          phases={"device_init": 95.0, "search": 10.5},
+                          jax_version="0.5.0")
+        return good, bad
+
+    def test_seeded_regression_is_flagged(self, tmp_path):
+        good, bad = self.seeded(tmp_path)
+        rc, out = run_cli(["diff", good, bad])
+        assert rc == 0  # informational without --fail-on-regress
+        assert "REGRESS states/sec" in out
+        assert "REGRESS backend demotion" in out and "tpu -> interp" in out
+        assert "REGRESS phase device_init" in out
+        # the env-change note attributes it (jax upgrade in the fixture)
+        assert "jax_version: 0.4.37 -> 0.5.0" in out
+
+    def test_fail_on_regress_gates_exit_code(self, tmp_path):
+        good, bad = self.seeded(tmp_path)
+        rc, _ = run_cli(["diff", good, bad, "--fail-on-regress"])
+        assert rc == 1
+        # reversed order is an improvement: exit 0
+        rc, out = run_cli(["diff", bad, good, "--fail-on-regress"])
+        assert rc == 0
+        # self-diff: no flags
+        rc, out = run_cli(["diff", good, good, "--fail-on-regress"])
+        assert rc == 0 and "no regressions flagged" in out
+
+    def test_bench_family_demotion(self, tmp_path):
+        b4 = mk_bench(tmp_path / "BENCH_r04.json", 4, 1729.6,
+                      "states/sec, exhaustive raft (... COMPLETED, "
+                      "platform=cpu, device-resident BFS)")
+        b5 = mk_bench(tmp_path / "BENCH_r05.json", 5, 6204.1,
+                      "states/sec, exhaustive raft (... COMPLETED, "
+                      "EXACT PYTHON INTERPRETER ONLY ...)")
+        rc, out = run_cli(["diff", b4, b5, "--fail-on-regress"])
+        assert rc == 1
+        assert "REGRESS backend demotion r04 -> r05" in out
+        assert "cpu -> interp" in out
+
+    def test_repo_bench_artifacts_ingest(self, tmp_path):
+        r4 = os.path.join(REPO, "BENCH_r04.json")
+        r5 = os.path.join(REPO, "BENCH_r05.json")
+        if not (os.path.exists(r4) and os.path.exists(r5)):
+            pytest.skip("repo bench artifacts not present")
+        rc, out = run_cli(["diff", r4, r5, "--fail-on-regress"])
+        # r05 demoted to the interpreter: the flag (and gate) must fire
+        assert rc == 1
+        assert "REGRESS backend demotion" in out
+
+    def test_mixed_kinds_and_three_way(self, tmp_path):
+        a = mk_artifact(tmp_path / "a.json", rate=4000.0, platform="cpu",
+                        phases={"search": 5.0})
+        b = mk_bench(tmp_path / "b.json", 7, 4100.0,
+                     "raft (... platform=cpu ...)")
+        c = mk_artifact(tmp_path / "c.json", rate=4500.0, platform="cpu",
+                        phases={"search": 4.0})
+        rc, out = run_cli(["diff", a, b, c])
+        assert rc == 0
+        for label in ("a", "r07", "c"):
+            assert label in out
+
+    def test_diff_needs_two(self, tmp_path):
+        a = mk_artifact(tmp_path / "a.json", rate=1000.0,
+                        platform="cpu", phases={"search": 1.0})
+        assert report.main(["diff", a]) == 2
+
+
+class TestEntrypointSmoke:
+    """Guards `python -m jaxmc.obs` against import rot: a real interp
+    run's artifact must render with exit 0 and a non-empty phase table
+    through the actual module entrypoint (fresh interpreter)."""
+
+    def test_report_subprocess_on_real_artifact(self, tmp_path):
+        from jaxmc.cli import main as cli_main
+        art = tmp_path / "interp.metrics.json"
+        rc = cli_main(["check", os.path.join(SPECS, "symtoy.tla"),
+                       "--cfg", os.path.join(SPECS, "symtoy.cfg"),
+                       "--no-deadlock", "--quiet",
+                       "--metrics-out", str(art)])
+        assert rc == 0 and art.exists()
+        r = subprocess.run(
+            [sys.executable, "-m", "jaxmc.obs", "report", str(art)],
+            capture_output=True, text=True, cwd=REPO, timeout=120)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "phases:" in r.stdout
+        # non-empty table: the interp pipeline's phases all render
+        for phase in ("load", "search"):
+            assert phase in r.stdout, r.stdout
+
+    def test_diff_subprocess_exit_codes(self, tmp_path):
+        good = mk_artifact(tmp_path / "g.json", rate=9000.0,
+                           platform="tpu", phases={"search": 3.0})
+        bad = mk_artifact(tmp_path / "b.json", rate=100.0,
+                          platform="interp", phases={"search": 3.0})
+        r = subprocess.run(
+            [sys.executable, "-m", "jaxmc.obs", "diff", good, bad,
+             "--fail-on-regress"],
+            capture_output=True, text=True, cwd=REPO, timeout=120)
+        assert r.returncode == 1, r.stdout + r.stderr
+        assert "states/sec" in r.stdout
