@@ -25,9 +25,42 @@ test:
 bench:
 	$(PY) bench.py
 
+# perf-regression gate: run a short fixed-model exact-engine bench twice
+# (one serial leg, one --workers 4 leg) and gate each leg LIKE-FOR-LIKE
+# against the baseline artifact saved by the previous bench-check run
+# (python -m jaxmc.obs diff --fail-on-regress: states/sec drop, backend
+# demotion, phase blowups). First invocation snapshots the baselines;
+# run it on main before a perf-sensitive change, then again after.
+# `make bench-check-reset` discards the baselines.
+BENCH_CHECK_SPEC ?= specs/transfer_scaled.tla
+BENCH_CHECK_DIR  ?= /tmp
+bench-check:
+	JAX_PLATFORMS=cpu $(PY) -m jaxmc check $(BENCH_CHECK_SPEC) \
+	    --workers 1 --max-states 20000 --quiet \
+	    --metrics-out $(BENCH_CHECK_DIR)/jaxmc_bench_check_serial.json
+	JAX_PLATFORMS=cpu $(PY) -m jaxmc check $(BENCH_CHECK_SPEC) \
+	    --workers 4 --max-states 20000 --quiet \
+	    --metrics-out $(BENCH_CHECK_DIR)/jaxmc_bench_check_par.json
+	@for leg in serial par; do \
+	  cur=$(BENCH_CHECK_DIR)/jaxmc_bench_check_$$leg.json; \
+	  base=$(BENCH_CHECK_DIR)/jaxmc_bench_check_$$leg.baseline.json; \
+	  if [ -f $$base ]; then \
+	    echo "== $$leg leg vs saved baseline =="; \
+	    $(PY) -m jaxmc.obs diff --fail-on-regress --threshold 25 \
+	        $$base $$cur || exit 1; \
+	  else \
+	    cp $$cur $$base; \
+	    echo "$$leg baseline saved -> $$base"; \
+	  fi; \
+	done
+
+bench-check-reset:
+	rm -f $(BENCH_CHECK_DIR)/jaxmc_bench_check_serial.baseline.json \
+	      $(BENCH_CHECK_DIR)/jaxmc_bench_check_par.baseline.json
+
 # build the native host fingerprint store (also built on demand at import)
 native:
 	mkdir -p native/build
 	g++ -O2 -shared -fPIC -std=c++17 -pthread native/fps_store.cc -o native/build/libjaxmc_fps.so
 
-.PHONY: all check check-corpus test bench native
+.PHONY: all check check-corpus test bench bench-check bench-check-reset native

@@ -134,12 +134,23 @@ def _run_check(args, tel, log, t0) -> int:
         model = _load_model(args.spec, args.cfg, args.no_deadlock,
                             args.include)
     if args.backend == "interp":
-        with tel.span("search"):
-            ex = Explorer(model, log=log, max_states=args.max_states,
-                          progress_every=args.progress_every,
-                          checkpoint_path=args.checkpoint,
-                          checkpoint_every=args.checkpoint_every,
-                          resume_from=args.resume)
+        from .engine.parallel import ParallelExplorer, default_workers
+        # None or 0 = auto (JAXMC_WORKERS, else min(cpu_count, 8))
+        workers = default_workers() if not args.workers \
+            else max(1, args.workers)
+        with tel.span("search", workers=workers):
+            kw = dict(log=log, max_states=args.max_states,
+                      progress_every=args.progress_every,
+                      checkpoint_path=args.checkpoint,
+                      checkpoint_every=args.checkpoint_every,
+                      resume_from=args.resume)
+            if workers > 1:
+                # worker-parallel frontier expansion; falls back to the
+                # serial engine (identical results) when the run needs
+                # checkpoint/resume or the platform cannot fork
+                ex = ParallelExplorer(model, workers=workers, **kw)
+            else:
+                ex = Explorer(model, **kw)
             res = ex.run()
     else:
         try:
@@ -149,6 +160,12 @@ def _run_check(args, tel, log, t0) -> int:
                 import jax
                 if platform:
                     jax.config.update("jax_platforms", platform)
+                # persistent XLA compile cache (repeat runs skip the
+                # per-arm compiles): opt-in via --compile-cache /
+                # JAXMC_COMPILE_CACHE
+                from .compile.cache import enable_persistent_cache
+                cache_dir = enable_persistent_cache(
+                    getattr(args, "compile_cache", None))
                 from .tpu.bfs import TpuExplorer
                 if tel.enabled:
                     # force plugin/device init inside the span so a hung
@@ -183,6 +200,8 @@ def _run_check(args, tel, log, t0) -> int:
                                  max_states=args.max_states)
             with tel.span("search"):
                 res = ex.run()
+            from .compile.cache import record_entries_end
+            record_entries_end(cache_dir)
         except ModeError as e:
             print(f"error: {e}", file=sys.stderr)
             _metrics_error(args, tel, str(e))
@@ -285,6 +304,17 @@ def main(argv=None) -> int:
                         "ignores JAX_PLATFORMS, so this uses "
                         "jax.config.update)")
     c.add_argument("--max-states", type=int, default=None)
+    c.add_argument("--workers", type=int, metavar="N", default=None,
+                   help="interp backend: worker processes for parallel "
+                        "frontier expansion (default: JAXMC_WORKERS, "
+                        "else min(cpu_count, 8); 1 = the serial engine; "
+                        "results are bit-identical either way)")
+    c.add_argument("--compile-cache", default=None, metavar="DIR",
+                   help="jax backend: persistent XLA compilation-cache "
+                        "directory — repeat runs skip the per-arm "
+                        "compiles; hit/miss lands in the metrics "
+                        "artifact as compile.persistent_cache_* "
+                        "(env: JAXMC_COMPILE_CACHE)")
     c.add_argument("--no-deadlock", action="store_true",
                    help="disable deadlock checking")
     c.add_argument("--quiet", action="store_true")

@@ -1,0 +1,112 @@
+r"""Persistent XLA compilation-cache wiring (JAXMC_COMPILE_CACHE).
+
+The per-arm XLA compiles have repeatedly eaten the bench deadline
+(BENCH_r03..r05: every device child pays the full compile bill even when
+the previous child compiled the identical programs minutes earlier).
+JAX's persistent compilation cache (`jax_compilation_cache_dir`) makes
+repeat compiles disk hits; this module is the ONE place that enables it
+and exposes its effectiveness as obs counters:
+
+  compile.persistent_cache_hits    (jax monitoring event
+                                    '/jax/compilation_cache/cache_hits')
+  gauge compile.persistent_cache_dir
+  gauge compile.persistent_cache_entries_start / _end
+
+Opt-in only (env JAXMC_COMPILE_CACHE=<dir> or cli --compile-cache):
+XLA:CPU blob reloads written by a DIFFERENT machine/build have been
+observed to hang (tests/conftest.py), so nothing enables it implicitly —
+bench.py opts its children in because they share one box and build.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def cache_dir_from_env() -> Optional[str]:
+    d = os.environ.get("JAXMC_COMPILE_CACHE")
+    return d or None
+
+
+_LISTENER_REGISTERED = False
+
+
+def _count_entries(path: str) -> Optional[int]:
+    try:
+        return sum(1 for n in os.listdir(path)
+                   if not n.endswith(".tmp"))
+    except OSError:
+        return None
+
+
+def enable_persistent_cache(path: Optional[str] = None,
+                            tel=None) -> Optional[str]:
+    """Configure jax's persistent compilation cache at `path` (default:
+    env JAXMC_COMPILE_CACHE) and register a monitoring listener that
+    mirrors cache hits into the active obs telemetry.  Pass `tel` when
+    the caller's recorder is not yet installed process-wide (bench
+    children enable the cache inside their device_init span, before
+    obs.use).  Returns the cache dir when enabled, None when not
+    requested or jax is unavailable.  Never raises: a broken cache setup
+    must not break a check run."""
+    path = path or cache_dir_from_env()
+    if not path:
+        return None
+    try:
+        import jax
+        from .. import obs
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        # cache everything: the per-arm kernels are small but numerous,
+        # and the default min-compile-time floor would skip most of them
+        for knob, val in (("jax_persistent_cache_min_compile_time_secs", 0),
+                          ("jax_persistent_cache_min_entry_size_bytes", -1)):
+            try:
+                jax.config.update(knob, val)
+            except Exception:  # noqa: BLE001 — knob absent on old jax
+                pass
+        if tel is None:
+            tel = obs.current()
+        tel.gauge("compile.persistent_cache_dir", path)
+        n0 = _count_entries(path)
+        if n0 is not None:
+            tel.gauge("compile.persistent_cache_entries_start", n0)
+
+        def _on_event(event: str, **kw) -> None:
+            # route through current() at fire time: the telemetry active
+            # when the compile runs, not when the cache was enabled
+            if "compilation_cache" not in event:
+                return
+            from .. import obs as _obs
+            name = event.rsplit("/", 1)[-1]  # e.g. 'cache_hits'
+            if name.startswith("cache_"):
+                name = name[len("cache_"):]
+            _obs.current().counter(f"compile.persistent_cache_{name}")
+
+        # register exactly once per process: jax.monitoring keeps every
+        # listener, so a second enable call (library user running two
+        # checks) would double-count every cache event
+        global _LISTENER_REGISTERED
+        if not _LISTENER_REGISTERED:
+            try:
+                from jax import monitoring
+                monitoring.register_event_listener(_on_event)
+                _LISTENER_REGISTERED = True
+            except Exception:  # noqa: BLE001 — monitoring API drift
+                pass
+        return path
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def record_entries_end(path: Optional[str], tel=None) -> None:
+    """Stamp the end-of-run entry count (a second identical run shows
+    entries_start == entries_end AND persistent_cache_hits > 0)."""
+    if not path:
+        return
+    from .. import obs
+    n = _count_entries(path)
+    if n is not None:
+        (tel if tel is not None else obs.current()).gauge(
+            "compile.persistent_cache_entries_end", n)

@@ -2544,9 +2544,18 @@ def introspect_kernel(fn: Callable, args, want_cost: bool = True
     (the cost model is absent on some backends; the lowering it needs is
     also the expensive part, so JAXMC_COMPILE_INTROSPECT=0 skips it).
 
-    Returns {jaxpr_eqns} plus {hlo_flops, hlo_bytes} when available."""
+    Returns {jaxpr_eqns} plus {hlo_flops, hlo_bytes} when available;
+    when the persistent compilation cache is active (compile/cache.py)
+    the one-time `compile.persistent_cache_active` gauge records that
+    this run's arm compiles were eligible for disk hits."""
     jx = jax.make_jaxpr(fn)(*args)  # propagates trace-time errors
     out: Dict[str, int] = {"jaxpr_eqns": len(jx.eqns)}
+    try:
+        if jax.config.jax_compilation_cache_dir:
+            from .. import obs
+            obs.current().gauge("compile.persistent_cache_active", True)
+    except AttributeError:  # config knob absent on old jax
+        pass
     if not want_cost or \
             os.environ.get("JAXMC_COMPILE_INTROSPECT") == "0":
         return out

@@ -16,7 +16,8 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..sem.values import EvalError, Fcn, ModelValue, fmt, sort_key
 from ..sem.eval import TLCAssertFailure, eval_expr, _bool
-from ..sem.enumerate import enumerate_init, enumerate_next, label_str
+from ..sem.enumerate import (Walker, enumerate_init, enumerate_next,
+                             label_str)
 from ..sem.modules import Model
 
 
@@ -47,6 +48,20 @@ class CheckResult:
 
 def _state_key(state: Dict[str, Any], vars: Tuple[str, ...]):
     return tuple(state[v] for v in vars)
+
+
+def state_fingerprint(model: Model, canon, view_expr,
+                      vars: Tuple[str, ...], st: Dict[str, Any]):
+    """The ONE dedup fingerprint for the exact engines: the canonical
+    (SYMMETRY-least) state's value tuple, or the VIEW expression's VALUE
+    when the cfg declares one (TLC fingerprints the view, not the state).
+    The serial engine, the parallel engine's parent merge, and the
+    parallel workers must all agree on this — a change here changes all
+    three together (tests/test_parallel.py pins the parity)."""
+    cst = canon(st) if canon is not None else st
+    if view_expr is not None:
+        return ("$view", eval_expr(view_expr, model.ctx(state=cst)))
+    return _state_key(cst, vars)
 
 
 def _apply_perm(v, pd):
@@ -88,6 +103,41 @@ def make_canonicalizer(model: Model):
     return canon
 
 
+def liveness_setup(model: Model, refiners, view_expr):
+    """Temporal-obligation collection + the warning lines both exact
+    engines must emit IDENTICALLY (the parity suite pins warnings
+    byte-for-byte).  Returns (live_obligations, collect_edges,
+    warnings).  collect_obligations also adopts the fairness halves of
+    spec-shaped PROPERTYs (clearing liveness_skipped), so it runs BEFORE
+    the refiner warning pass."""
+    from .liveness import collect_obligations
+    warnings: List[str] = []
+    live_obligations, unsupported, collect_edges = \
+        collect_obligations(model, refiners)
+    for rc in refiners:
+        if rc.liveness_skipped:
+            warnings.append(
+                f"property {rc.name}: refinement checked stepwise; its "
+                f"fairness conjuncts are NOT checked")
+    if unsupported:
+        warnings.append(
+            "temporal properties NOT checked (unsupported form): "
+            + ", ".join(unsupported))
+    if view_expr is not None and live_obligations:
+        # the behavior graph under VIEW links view-collapsed
+        # representatives — liveness verdicts over it would be wrong
+        # (TLC likewise refuses VIEW together with liveness)
+        warnings.append(
+            "temporal properties NOT checked: cfg VIEW collapses "
+            "the behavior graph (TLC also rejects VIEW with "
+            "liveness): "
+            + ", ".join(sorted({ob.prop_name
+                                for ob in live_obligations})))
+        live_obligations = []
+        collect_edges = False
+    return live_obligations, collect_edges, warnings
+
+
 class Explorer:
     def __init__(self, model: Model, log: Callable[[str], None] = None,
                  max_states: Optional[int] = None,
@@ -115,6 +165,8 @@ class Explorer:
 
     def _check_state_preds(self, state) -> Optional[str]:
         """Returns the name of a violated invariant, else None."""
+        if not self.model.invariants:
+            return None  # skip the per-state ctx build entirely
         ctx = self._ctx(state=state)
         for name, expr in self.model.invariants:
             if not _bool(eval_expr(expr, ctx), f"invariant {name}"):
@@ -161,6 +213,14 @@ class Explorer:
         last_progress = time.time()
         last_checkpoint = time.time()
 
+        # checkpoint cost accounting: each write pickles the FULL state
+        # table, so its cost grows with the search — surface it as a
+        # checkpoint.write span (the phase rollup used to hide it as
+        # anonymous search wall) and stretch the interval when a write
+        # gets expensive relative to it (the cheap size/time guard:
+        # never spend more than ~5% of the wall checkpointing)
+        ck_state = {"every": self.checkpoint_every}
+
         def write_checkpoint(queue_head=(), generated_at=None,
                              prints_at=None):
             # TLC-style periodic checkpoint (testout1:10; SURVEY.md §5):
@@ -170,20 +230,30 @@ class Explorer:
             # exactly once and full-run counts stay exact
             import pickle
             import os as _os
-            tmp = self.checkpoint_path + ".tmp"
-            with open(tmp, "wb") as fh:
-                pickle.dump(dict(module=model.module.name, vars=list(vars),
-                                 states=states, parents=parents,
-                                 labels=labels, depth_of=depth_of,
-                                 queue=list(queue_head) + list(queue),
-                                 generated=generated if generated_at is None
-                                 else generated_at,
-                                 diameter=diameter,
-                                 seen_items=list(seen.items()),
-                                 edges=edges if collect_edges else None,
-                                 prints=self.prints if prints_at is None
-                                 else self.prints[:prints_at]), fh)
-            _os.replace(tmp, self.checkpoint_path)
+            t_ck = time.time()
+            with tel.span("checkpoint.write", states=len(states),
+                          queue=len(queue_head) + len(queue)):
+                tmp = self.checkpoint_path + ".tmp"
+                with open(tmp, "wb") as fh:
+                    pickle.dump(dict(module=model.module.name,
+                                     vars=list(vars),
+                                     states=states, parents=parents,
+                                     labels=labels, depth_of=depth_of,
+                                     queue=list(queue_head) + list(queue),
+                                     generated=generated
+                                     if generated_at is None
+                                     else generated_at,
+                                     diameter=diameter,
+                                     seen_items=list(seen.items()),
+                                     edges=edges if collect_edges else None,
+                                     prints=self.prints if prints_at is None
+                                     else self.prints[:prints_at]), fh)
+                _os.replace(tmp, self.checkpoint_path)
+            write_s = time.time() - t_ck
+            if write_s * 20.0 > ck_state["every"]:
+                ck_state["every"] = write_s * 20.0
+                self.log(f"Checkpoint write took {write_s:.1f}s; interval "
+                         f"stretched to {ck_state['every']:.0f}s")
             self.log(f"Checkpointing run to {self.checkpoint_path}")
 
         canon = make_canonicalizer(model)
@@ -198,65 +268,37 @@ class Explorer:
         def add_state(st, parent, label, depth):
             """Returns (sid | None, new). sid None = discarded by
             CONSTRAINT; new is True the first time any state (kept or
-            discarded) is seen."""
-            cst = canon(st) if canon is not None else st
-            if view_expr is not None:
-                # cfg VIEW: dedup by the view expression's VALUE (TLC
-                # fingerprints the view, not the state) — the stored
-                # state/trace is still the real state
-                key = ("$view",
-                       eval_expr(view_expr, model.ctx(state=cst)))
-            else:
-                key = _state_key(cst, vars)
-            sid = seen.get(key)
-            if sid is not None:
+            discarded) is seen.  MIRRORED in engine/parallel.py (its
+            add_state + merge replay): any change to this dedup/discard
+            flow must land there too or the engines' bit-identical
+            parity breaks (tests/test_parallel.py pins it)."""
+            key = state_fingerprint(model, canon, view_expr, vars, st)
+            # single-hash insert: tentatively claim the next sid; a dup
+            # returns the existing mapping without a second key hash (the
+            # fingerprint tuple is hashed once per generated state instead
+            # of once for the probe plus once for the store)
+            nid = len(states)
+            sid = seen.setdefault(key, nid)
+            if sid != nid:
                 return (None if sid == VIOL else sid), False
             if not self._satisfies_constraints(st):
                 seen[key] = VIOL
                 return None, True
-            sid = len(states)
-            seen[key] = sid
             states.append(st)
             parents.append(parent)
             labels.append(label)
             depth_of.append(depth)
-            return sid, True
+            return nid, True
 
         from .refinement import build_refinement_checkers
         refiners, live_only = build_refinement_checkers(model)
-        warnings = []
         # temporal obligations are checked over the behavior graph after
         # the search completes (engine/liveness.py) — collect the full
-        # edge log only when some property needs it.
-        # collect_obligations also adopts the fairness halves of
-        # spec-shaped PROPERTYs (clearing liveness_skipped), so it must
-        # run BEFORE the warning pass below.
-        from .liveness import collect_obligations
-        # 'always' obligations only iterate states — don't pay for the
-        # edge log (RAM + checkpoint size) unless some obligation needs it
-        live_obligations, unsupported, collect_edges = \
-            collect_obligations(model, refiners)
-        for rc in refiners:
-            if rc.liveness_skipped:
-                warnings.append(
-                    f"property {rc.name}: refinement checked stepwise; its "
-                    f"fairness conjuncts are NOT checked")
-        if unsupported:
-            warnings.append(
-                "temporal properties NOT checked (unsupported form): "
-                + ", ".join(unsupported))
-        if view_expr is not None and live_obligations:
-            # the behavior graph under VIEW links view-collapsed
-            # representatives — liveness verdicts over it would be wrong
-            # (TLC likewise refuses VIEW together with liveness)
-            warnings.append(
-                "temporal properties NOT checked: cfg VIEW collapses "
-                "the behavior graph (TLC also rejects VIEW with "
-                "liveness): "
-                + ", ".join(sorted({ob.prop_name
-                                    for ob in live_obligations})))
-            live_obligations = []
-            collect_edges = False
+        # edge log only when some property needs it ('always'
+        # obligations only iterate states; don't pay the RAM +
+        # checkpoint size otherwise)
+        live_obligations, collect_edges, warnings = \
+            liveness_setup(model, refiners, view_expr)
         edges: List[Tuple[int, int]] = []
 
         # per-level BFS telemetry: record level d when its last state has
@@ -384,6 +426,10 @@ class Explorer:
                  f"{len(queue)} states left on queue.")
 
         # ---- BFS ----
+        # one reusable walker for the whole search: the action AST is
+        # split (call-by-name decisions, substituted bodies) once per run
+        # instead of once per state (sem/enumerate.py Walker)
+        next_walker = Walker("next", vars)
         while queue:
             sid = queue.popleft()
             st = states[sid]
@@ -398,7 +444,7 @@ class Explorer:
             prints_at_pop = len(self.prints)
             try:
                 for succ, label in enumerate_next(model.next, base_ctx, vars,
-                                                  st):
+                                                  st, walker=next_walker):
                     succ_count += 1
                     generated += 1
                     lv["generated"] += 1
@@ -454,7 +500,7 @@ class Explorer:
                          f"{len(states)} distinct states found, "
                          f"{len(queue)} states left on queue.")
             if self.checkpoint_path and \
-                    now - last_checkpoint >= self.checkpoint_every:
+                    now - last_checkpoint >= ck_state["every"]:
                 last_checkpoint = now
                 write_checkpoint()
 

@@ -1,0 +1,612 @@
+r"""Parallel exact BFS engine: worker-pool frontier expansion.
+
+TLC gets its throughput from worker-parallel frontier expansion (Yu,
+Manolios & Lamport, CHARME 1999); jaxmc's exact oracle path was pinned to
+one core. This engine is the same idea adapted to the Python interpreter:
+
+- level-synchronous BFS: the frontier at depth d is split into chunks and
+  farmed to a `multiprocessing` fork pool; workers run the expensive pure
+  work per successor — `enumerate_next`, action/state CONSTRAINTs,
+  SYMMETRY canonicalization / VIEW fingerprints, invariants — against the
+  model they inherited at fork time (no per-task model pickling);
+- the PARENT REPLAYS the merge through the single `seen` dict in exact
+  frontier order at the level barrier, running the byte-level algorithm
+  of the serial engine (engine/explore.py) with the expensive evaluations
+  precomputed.  `generated`/`distinct`/`diameter`, violation traces, and
+  truncation points are therefore BIT-IDENTICAL to the serial engine on
+  every path, including mid-level violations: the replay consumes worker
+  records in the same order the serial loop would have produced them and
+  stops at the same record.
+
+Dedup/merge correctness notes:
+- workers never see the global `seen` set; every successor's fingerprint
+  key rides back with the record and the parent's dedup decides.  A
+  record's constraint/invariant verdicts describe the record's CONCRETE
+  successor and are consulted only when its key is globally new — for a
+  duplicate key the parent uses the stored verdict, exactly like the
+  serial engine (matters under SYMMETRY, where two concrete states share
+  one canonical key);
+- within one chunk, repeats of an already-emitted key are sent as slim
+  (key-only) records to bound pickle volume; chunks merge in submission
+  order, so the full record always precedes its slim repeats.
+
+Known (documented) divergence from serial: `CheckResult.prints` — worker
+expansion collects a state's Print output as one batch, so on violation
+paths prints from the violating state's expansion may include output the
+serial engine would have cut off mid-state; print ORDER within a state
+interleaves invariant-eval prints after expansion prints.  Counts, logs,
+traces and verdicts are unaffected (the CLI does not render prints).
+
+Falls back to the serial engine (identical behavior, a
+`parallel.fallback` telemetry event, no stdout difference) when: workers
+<= 1, the platform has no fork start method, a checkpoint/resume was
+requested (the checkpoint format is owned by the serial engine), or the
+model carries stepwise refinement properties (their checkers are
+evaluated edge-at-a-time in the parent today).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..sem.eval import TLCAssertFailure, eval_expr
+from ..sem.enumerate import Walker, enumerate_init, enumerate_next, label_str
+from ..sem.modules import Model, satisfies_constraints
+from ..sem.values import EvalError
+from .explore import (CheckResult, Explorer, Violation, _state_key,
+                      make_canonicalizer, state_fingerprint)
+
+# worker-side pure-verdict / sent-key cache cap. Each entry holds full
+# state tuples, and EVERY worker keeps its own copy — an over-generous
+# cap would multiply resident memory by the worker count on models that
+# barely fit in RAM serially. 256k entries retains most of the dup-reuse
+# win (dups cluster within/between adjacent levels)
+_CACHE_CAP = 1 << 18
+
+
+def default_workers() -> int:
+    """`JAXMC_WORKERS` if set, else min(os.cpu_count(), 8)."""
+    env = os.environ.get("JAXMC_WORKERS")
+    if env:
+        try:
+            return max(1, int(env))
+        except ValueError:
+            pass
+    return max(1, min(os.cpu_count() or 1, 8))
+
+
+def fork_available() -> bool:
+    import multiprocessing
+    return "fork" in multiprocessing.get_all_start_methods()
+
+
+# ---------------------------------------------------------------- worker
+
+class _WorkerState:
+    """Everything a worker needs, built in the parent and inherited over
+    fork (copy-on-write; nothing here is pickled)."""
+
+    __slots__ = ("model", "vars", "walker", "base_ctx", "canon",
+                 "view_expr", "prints", "verdicts", "sent", "memo_sent",
+                 "key_is_concrete")
+
+    def __init__(self, model: Model):
+        self.model = model
+        self.vars = model.vars
+        self.walker = Walker("next", model.vars)
+        self.prints: List[Any] = []
+        self.base_ctx = model.ctx(on_print=self.prints.append)
+        self.canon = make_canonicalizer(model)
+        self.view_expr = getattr(model, "view", None)
+        # without SYMMETRY/VIEW the fingerprint IS the concrete value
+        # tuple, so a full record need not carry the state twice
+        self.key_is_concrete = self.canon is None and self.view_expr is None
+        # concrete-state-key -> (fingerprint key, cons_ok, inv, inv_prints)
+        # — all pure functions of the concrete successor, so caching them
+        # per worker cuts repeat verdicts to ~distinct-per-worker instead
+        # of per-generated
+        self.verdicts: Dict[tuple, tuple] = {}
+        # fingerprints this worker has already emitted a full record for
+        # (worker lifetime: a worker's chunks merge in its processing
+        # order, so the full record always precedes its slim repeats) —
+        # the main IPC-volume cut: repeat successors ship as key-only
+        self.sent: set = set()
+        # delta baseline = the PRE-FORK memo counters: workers inherit the
+        # parent's store, and a (0, 0) baseline would re-add the parent's
+        # own pre-fork hits/misses once per worker at the first chunk
+        self.memo_sent = model._memo.stats() if model._memo is not None \
+            else (0, 0)
+
+    def fingerprint(self, st: Dict[str, Any]):
+        return state_fingerprint(self.model, self.canon, self.view_expr,
+                                 self.vars, st)
+
+    def check_invariants(self, st) -> Tuple[Any, List[Any]]:
+        """(None | ("inv", name) | ("assert", msg), prints)."""
+        model = self.model
+        if not model.invariants:
+            return None, ()
+        inv_prints: List[Any] = []
+        ctx = model.ctx(state=st, on_print=inv_prints.append)
+        from ..sem.eval import _bool
+        try:
+            for name, expr in model.invariants:
+                if not _bool(eval_expr(expr, ctx), f"invariant {name}"):
+                    return ("inv", name), inv_prints
+        except TLCAssertFailure as ex:
+            return ("assert", str(ex.out)), inv_prints
+        return None, inv_prints
+
+    def verdict(self, succ: Dict[str, Any]):
+        ck = _state_key(succ, self.vars)
+        try:
+            hit = self.verdicts.get(ck)
+        except TypeError:  # unhashable value (cannot happen for states,
+            hit = None     # but never let the cache break a run)
+            ck = None
+        if hit is not None:
+            return hit
+        # without SYMMETRY/VIEW the fingerprint IS the concrete key —
+        # don't build the same tuple twice on the miss path
+        key = ck if ck is not None and self.key_is_concrete \
+            else self.fingerprint(succ)
+        cons_ok = satisfies_constraints(self.model, succ)
+        if cons_ok:
+            inv, inv_prints = self.check_invariants(succ)
+        else:
+            inv, inv_prints = None, ()  # discarded states are never checked
+        out = (key, cons_ok, inv, list(inv_prints) if inv_prints else ())
+        if ck is not None:
+            if len(self.verdicts) >= _CACHE_CAP:
+                self.verdicts.clear()
+            self.verdicts[ck] = out
+        return out
+
+
+_W: Optional[_WorkerState] = None
+
+
+def _init_worker(state: _WorkerState) -> None:
+    global _W
+    _W = state
+
+
+def _expand_chunk(chunk):
+    """Expand a chunk of (sid, value-tuple) pairs.  Returns
+    (wall_s, memo_delta, per-state records); each per-state record is
+    (sid, n_succ, assert_msg, error_msg, state_prints,
+    successor-records) with successor records one of:
+      ("x",)                                action-constraint filtered
+      ("s", key)                            repeat of a key this worker
+                                            already sent a full record for
+                                            (merges strictly earlier)
+      ("d", key)                            CONSTRAINT-discard (if new)
+      ("f", key, label, inv, prints)        kept successor; the state IS
+                                            the key values (no SYM/VIEW)
+      ("F", vals, key, label, inv, prints)  kept successor under SYM/VIEW
+                                            (concrete values + canonical
+                                            fingerprint)
+    """
+    w = _W
+    t0 = time.perf_counter()
+    model = w.model
+    vars = w.vars
+    sent = w.sent
+    out = []
+    for sid, vals in chunk:
+        st = dict(zip(vars, vals))
+        recs: List[tuple] = []
+        n_succ = 0
+        assert_msg = None
+        error_msg = None
+        p0 = len(w.prints)
+        it = enumerate_next(model.next, w.base_ctx, vars, st,
+                            walker=w.walker)
+        while True:
+            try:
+                succ, label = next(it)
+            except StopIteration:
+                break
+            except TLCAssertFailure as ex:
+                # raised while ENUMERATING the next successor: nothing
+                # was counted for it yet (matches the serial loop)
+                assert_msg = str(ex.out)
+                break
+            except EvalError as ex:
+                # an eval error must not vaporize this chunk's earlier
+                # records (a violation recorded before it would be lost
+                # and the run would crash where serial reports the
+                # violation): capture per state, parent re-raises at the
+                # serial engine's crash point
+                error_msg = str(ex)
+                break
+            n_succ += 1
+            try:
+                if model.action_constraints and \
+                        not _action_constraints_ok(w, st, succ):
+                    recs.append(("x",))
+                    continue
+                key, cons_ok, inv, inv_prints = w.verdict(succ)
+            except TLCAssertFailure as ex:
+                # Assert inside an action constraint, CONSTRAINT, or
+                # VIEW fingerprint eval: the serial engine has already
+                # counted this successor (generated++ precedes the
+                # raising eval), so emit a counted-only record before
+                # reporting the assert
+                recs.append(("x",))
+                assert_msg = str(ex.out)
+                break
+            except EvalError as ex:
+                recs.append(("x",))  # counted before the eval raised
+                error_msg = str(ex)
+                break
+            if key in sent:
+                recs.append(("s", key))
+                continue
+            if len(sent) >= _CACHE_CAP:
+                sent.clear()  # re-emitting full records is safe
+            sent.add(key)
+            if not cons_ok:
+                recs.append(("d", key))
+            elif w.key_is_concrete:
+                recs.append(("f", key, label_str(label), inv,
+                             inv_prints))
+            else:
+                recs.append(("F",
+                             tuple(succ[v] for v in vars), key,
+                             label_str(label), inv, inv_prints))
+        state_prints = w.prints[p0:]
+        del w.prints[p0:]
+        out.append((sid, n_succ, assert_msg, error_msg, state_prints,
+                    recs))
+    mst = model._memo
+    dh = dm = 0
+    if mst is not None:
+        h, m = mst.stats()
+        h0, m0 = w.memo_sent
+        dh, dm = h - h0, m - m0
+        w.memo_sent = (h, m)
+    return (time.perf_counter() - t0, (dh, dm), out)
+
+
+def _action_constraints_ok(w: _WorkerState, st, succ) -> bool:
+    from ..sem.eval import _bool
+    ctx = w.model.ctx(state=st, primes=succ, on_print=w.prints.append)
+    for name, expr in w.model.action_constraints:
+        if not _bool(eval_expr(expr, ctx), f"action constraint {name}"):
+            return False
+    return True
+
+
+# ---------------------------------------------------------------- engine
+
+class ParallelExplorer(Explorer):
+    """Worker-parallel exact BFS with serial-identical results.
+
+    `workers` defaults to JAXMC_WORKERS, else min(os.cpu_count(), 8);
+    `chunk` (frontier states per worker task) defaults to an adaptive
+    split targeting ~4 tasks per worker per level, capped so task pickles
+    stay small (env JAXMC_PARALLEL_CHUNK pins it)."""
+
+    def __init__(self, model: Model, workers: Optional[int] = None,
+                 chunk: Optional[int] = None, **kw):
+        super().__init__(model, **kw)
+        self.workers = default_workers() if workers is None \
+            else max(1, int(workers))
+        if chunk is None:
+            env = os.environ.get("JAXMC_PARALLEL_CHUNK")
+            chunk = int(env) if env else None
+        self.chunk = chunk
+
+    # -- engine selection ------------------------------------------------
+    def _fallback_reason(self, refiners) -> Optional[str]:
+        if self.workers <= 1:
+            return "workers<=1"
+        if not fork_available():
+            return "no fork start method on this platform"
+        if self.resume_from or self.checkpoint_path:
+            return "checkpoint/resume requested (serial-engine format)"
+        if refiners:
+            return "stepwise refinement properties"
+        return None
+
+    def run(self) -> CheckResult:
+        from .. import obs
+        from .refinement import build_refinement_checkers
+        refiners, _ = build_refinement_checkers(self.model)
+        reason = self._fallback_reason(refiners)
+        if reason is not None:
+            tel = obs.current()
+            tel.event("parallel.fallback", reason=reason)
+            tel.gauge("parallel.fallback_reason", reason)
+            return Explorer.run(self)
+        return self._run_parallel()
+
+    def _chunks(self, frontier: List[int]):
+        n = len(frontier)
+        size = self.chunk
+        if size is None:
+            size = max(1, min(256, -(-n // (self.workers * 4))))
+        return [frontier[i:i + size] for i in range(0, n, size)]
+
+    # -- the parallel search --------------------------------------------
+    def _run_parallel(self) -> CheckResult:
+        import multiprocessing
+        from .. import obs
+        model = self.model
+        vars = model.vars
+        t0 = time.time()
+        tel = obs.current()
+        base_ctx = self._ctx()
+
+        seen: Dict[tuple, int] = {}
+        states: List[Dict[str, Any]] = []
+        parents: List[Optional[int]] = []
+        labels: List[str] = []
+        depth_of: List[int] = []
+        generated = 0
+        diameter = 0
+        last_progress = time.time()
+
+        canon = make_canonicalizer(model)
+        VIOL = -1  # same discard sentinel as the serial engine
+        view_expr = getattr(model, "view", None)
+
+        def add_state(st, parent, label, depth):
+            # same flow as the serial engine's add_state (only init
+            # states pass through here; successors merge via worker
+            # records above)
+            key = state_fingerprint(model, canon, view_expr, vars, st)
+            nid = len(states)
+            sid = seen.setdefault(key, nid)
+            if sid != nid:
+                return (None if sid == VIOL else sid), False
+            if not self._satisfies_constraints(st):
+                seen[key] = VIOL
+                return None, True
+            states.append(st)
+            parents.append(parent)
+            labels.append(label)
+            depth_of.append(depth)
+            return nid, True
+
+        # refiners are [] here (non-empty fell back to serial), so the
+        # shared setup emits exactly the serial engine's warning lines
+        from .explore import liveness_setup
+        live_obligations, collect_edges, warnings = \
+            liveness_setup(model, [], view_expr)
+        edges: List[Tuple[int, int]] = []
+
+        lv = {"depth": 0, "frontier": 0, "generated": 0, "new": 0,
+              "t0": time.time(), "chunk_wall": 0.0, "merge_wall": 0.0}
+
+        def flush_level(queue_len):
+            if lv["frontier"] == 0 and lv["generated"] == 0:
+                return
+            tel.level(lv["depth"], frontier=lv["frontier"],
+                      generated=lv["generated"], new=lv["new"],
+                      distinct=len(states), seen=len(seen),
+                      queue=queue_len,
+                      wall_s=round(time.time() - lv["t0"], 6),
+                      workers=self.workers,
+                      chunk_wall_s=round(lv["chunk_wall"], 6),
+                      merge_wall_s=round(lv["merge_wall"], 6))
+            lv.update(frontier=0, generated=0, new=0, t0=time.time(),
+                      chunk_wall=0.0, merge_wall=0.0)
+
+        def result(ok, violation=None, truncated=False, queue_len=0):
+            if truncated and live_obligations:
+                warnings.append("temporal properties NOT checked: the "
+                                "search was truncated (behavior graph "
+                                "incomplete)")
+            flush_level(queue_len)
+            mst = model._memo
+            if mst is not None:
+                tel.gauge("memo.hits", mst.hits)
+                tel.gauge("memo.misses", mst.misses)
+            tel.gauge("fingerprint.occupancy", len(seen))
+            tel.gauge("parallel.workers", self.workers)
+            return CheckResult(ok=ok, distinct=len(states),
+                               generated=generated, diameter=diameter,
+                               violation=violation,
+                               wall_s=time.time() - t0,
+                               prints=self.prints, truncated=truncated,
+                               warnings=warnings)
+
+        # ---- initial states (serial, exactly as the serial engine) ----
+        try:
+            inits = enumerate_init(model.init, base_ctx, vars)
+        except TLCAssertFailure as ex:
+            return result(False, Violation("assert", "Init", [],
+                                           str(ex.out)))
+        frontier: List[int] = []
+        init_count = 0
+        for st in inits:
+            sid, new = add_state(st, None, "Initial predicate", 0)
+            if not new:
+                continue
+            generated += 1
+            if sid is None:
+                continue  # discarded by CONSTRAINT
+            init_count += 1
+            bad = self._check_state_preds(st)
+            if bad is not None:
+                return result(False, Violation(
+                    "invariant", bad,
+                    self._trace_to(sid, parents, states, labels)))
+            frontier.append(sid)
+        self.log(f"Finished computing initial states: {init_count} "
+                 f"distinct state{'s' if init_count != 1 else ''} "
+                 f"generated.")
+
+        d0 = depth_of[frontier[0]] if frontier else 0
+        self.log(f"Progress({d0}): {generated} states generated, "
+                 f"{len(states)} distinct states found, "
+                 f"{len(frontier)} states left on queue.")
+
+        # ---- the level-synchronous pool loop ----
+        mp = multiprocessing.get_context("fork")
+        wstate = _WorkerState(model)
+        # the parent can run the worker body inline (global worker state
+        # in this process too): frontiers smaller than the fan-out are
+        # expanded without the per-level IPC barrier — same records, same
+        # replay, zero round-trip latency on shallow/narrow levels
+        _init_worker(wstate)
+        inline_below = self.workers * 4
+        n_chunks_total = 0
+        pool = None
+        try:
+            depth = d0
+            while frontier:
+                lv["depth"] = depth
+                next_frontier: List[int] = []
+                chunks = self._chunks(frontier)
+                n_chunks_total += len(chunks)
+                payloads = [[(sid,
+                              tuple(states[sid][v] for v in vars))
+                             for sid in c] for c in chunks]
+                remaining = len(frontier)
+                if len(frontier) < inline_below:
+                    # parent-inline expansion: memo deltas are already in
+                    # the parent store, so they are NOT re-merged below
+                    results = (_expand_chunk(p) for p in payloads)
+                    inline = True
+                else:
+                    if pool is None:
+                        # lazy fork: a model whose every level stays
+                        # under the fan-out never pays the pool at all.
+                        # Workers forked now inherit the parent's inline
+                        # wstate (its `sent` keys were all merged, so
+                        # slim repeats stay resolvable); re-baseline the
+                        # memo counters at the fork point
+                        if model._memo is not None:
+                            wstate.memo_sent = model._memo.stats()
+                        pool = mp.Pool(self.workers,
+                                       initializer=_init_worker,
+                                       initargs=(wstate,))
+                    results = pool.imap(_expand_chunk, payloads)
+                    inline = False
+                for chunk_wall, memo_delta, chunk_out in results:
+                    lv["chunk_wall"] += chunk_wall
+                    mst = model._memo
+                    if mst is not None and not inline:
+                        mst.merge_stats(*memo_delta)
+                    m0 = time.perf_counter()
+                    for (sid, n_succ, assert_msg, error_msg,
+                         state_prints, recs) in chunk_out:
+                        remaining -= 1
+                        lv["frontier"] += 1
+                        diameter = max(diameter, depth)
+                        self.prints.extend(state_prints)
+                        for rec in recs:
+                            generated += 1
+                            lv["generated"] += 1
+                            kind = rec[0]
+                            if kind == "x":
+                                continue
+                            if kind == "s":
+                                ex_sid = seen[rec[1]]
+                                if ex_sid != VIOL and collect_edges:
+                                    edges.append((sid, ex_sid))
+                                continue
+                            key = rec[2] if kind == "F" else rec[1]
+                            ex_sid = seen.get(key)
+                            if ex_sid is not None:
+                                # duplicate fingerprint: the stored
+                                # verdict wins (serial dedup-first order)
+                                if ex_sid != VIOL and collect_edges:
+                                    edges.append((sid, ex_sid))
+                                continue
+                            if kind == "d":
+                                seen[key] = VIOL
+                                continue
+                            if kind == "f":
+                                _, _, label, inv, inv_prints = rec
+                                succ = dict(zip(vars, key))
+                            else:
+                                _, vals, _, label, inv, inv_prints = rec
+                                succ = dict(zip(vars, vals))
+                            nid = len(states)
+                            seen[key] = nid
+                            states.append(succ)
+                            parents.append(sid)
+                            labels.append(label)
+                            depth_of.append(depth + 1)
+                            if collect_edges:
+                                edges.append((sid, nid))
+                            lv["new"] += 1
+                            self.prints.extend(inv_prints)
+                            if inv is not None:
+                                if inv[0] == "inv":
+                                    return result(False, Violation(
+                                        "invariant", inv[1],
+                                        self._trace_to(nid, parents,
+                                                       states, labels)))
+                                trace = self._trace_to(sid, parents,
+                                                       states, labels)
+                                return result(False, Violation(
+                                    "assert", "Assert", trace, inv[1]))
+                            next_frontier.append(nid)
+                            if self.max_states and \
+                                    len(states) >= self.max_states:
+                                self.log("-- state limit reached, "
+                                         "search truncated")
+                                return result(
+                                    True, truncated=True,
+                                    queue_len=remaining
+                                    + len(next_frontier))
+                        if assert_msg is not None:
+                            trace = self._trace_to(sid, parents, states,
+                                                   labels)
+                            return result(False, Violation(
+                                "assert", "Assert", trace, assert_msg))
+                        if error_msg is not None:
+                            # the serial engine's crash point: the eval
+                            # error surfaced expanding THIS state, after
+                            # its earlier successors were processed
+                            raise EvalError(error_msg)
+                        if n_succ == 0 and model.check_deadlock:
+                            return result(False, Violation(
+                                "deadlock", "deadlock",
+                                self._trace_to(sid, parents, states,
+                                               labels)))
+                        now = time.time()
+                        if now - last_progress >= self.progress_every:
+                            last_progress = now
+                            self.log(
+                                f"Progress({depth}): {generated} states "
+                                f"generated, {len(states)} distinct "
+                                f"states found, "
+                                f"{remaining + len(next_frontier)} "
+                                f"states left on queue.")
+                    lv["merge_wall"] += time.perf_counter() - m0
+                flush_level(len(next_frontier))
+                frontier = next_frontier
+                depth += 1
+        finally:
+            if pool is not None:
+                pool.terminate()
+                pool.join()
+            # in the finally: a truncated or violating run's early
+            # return must still record its chunk count
+            tel.counter("parallel.chunks", n_chunks_total)
+
+        # ---- temporal properties over the completed behavior graph ----
+        if live_obligations:
+            from .liveness import LivenessChecker
+            lc = LivenessChecker(model, states, edges, parents, labels)
+            bad, live_warns = lc.check(live_obligations)
+            warnings.extend(live_warns)
+            if bad is not None:
+                pname, trace, msg = bad
+                return result(False, Violation("property", pname, trace,
+                                               msg))
+
+        self.log(f"Model checking completed. No error has been found.")
+        self.log(f"{generated} states generated, {len(states)} distinct "
+                 f"states found, 0 states left on queue.")
+        self.log(f"The depth of the complete state graph search is "
+                 f"{diameter + 1}.")
+        return result(True)

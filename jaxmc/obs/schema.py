@@ -43,6 +43,21 @@ jaxmc.metrics/2 artifact minus the new optional surface, so readers and
       `compile.cache_hits` / `compile.cache_misses`;
     - watchdog counters `watchdog.heartbeats` / `watchdog.stalls` and
       the `watchdog.max_stall_s` high-water gauge.
+
+  (PR 3, still jaxmc.metrics/2 — all additive/optional:)
+    - parallel exact engine (engine/parallel.py): level records may
+      carry `workers`, `chunk_wall_s` (summed worker expansion wall for
+      the level) and `merge_wall_s` (parent-side merge wall); gauges
+      `parallel.workers` / `parallel.fallback_reason`, counter
+      `parallel.chunks`, trace event `parallel.fallback {reason}`;
+    - persistent XLA compile cache (compile/cache.py): counters
+      `compile.persistent_cache_hits` (and any other
+      /jax/compilation_cache/* monitoring events, same naming), gauges
+      `compile.persistent_cache_dir`,
+      `compile.persistent_cache_entries_start` / `_end`,
+      `compile.persistent_cache_active`;
+    - checkpoint cost: phase `checkpoint.write` (span attrs: states,
+      queue) — checkpoint wall no longer hides inside `search`.
 """
 
 from __future__ import annotations

@@ -26,8 +26,21 @@ from .eval import (Ctx, OpClosure, BuiltinOp, UnassignedPrime, _arg_value,
                    make_let_defs)
 
 
+_OP_PLAN_CAP = 1 << 16  # entries; cleared beyond (LET-heavy specs mint
+# fresh closures per evaluation, so an id-keyed cache must be bounded)
+
+
 class Walker:
-    """mode 'init': assign unprimed variables; mode 'next': assign primes."""
+    """mode 'init': assign unprimed variables; mode 'next': assign primes.
+
+    A Walker is reusable across states (engine hot loop): the expansion
+    plan for each operator application — call-by-name vs call-by-value,
+    and the substituted body for the call-by-name case — depends only on
+    the application node and the resolved closure, so it is decided ONCE
+    per run and cached, instead of re-running the contains_prime /
+    primes_params AST scans and the subst() tree rebuild on every state
+    (the dominant per-state cost the profiler showed on transfer_scaled).
+    """
 
     def __init__(self, mode: str, vars: Tuple[str, ...], state=None):
         assert mode in ("init", "next")
@@ -35,6 +48,11 @@ class Walker:
         self.vars = set(vars)
         self.var_order = tuple(vars)
         self.state = state  # fixed pre-state in next mode
+        # (id(app-node), id(closure)) -> ("cbn", substituted-body) |
+        # ("call", None); _plan_pins keeps the keyed objects alive so a
+        # gc'd closure's id is never reused against a stale plan
+        self._op_plan = {}
+        self._plan_pins = []
 
     def _ctx(self, base: Ctx, partial: Dict[str, Any]) -> Ctx:
         if self.mode == "init":
@@ -55,11 +73,43 @@ class Walker:
             return e.name
         return None
 
+    def _op_expand_plan(self, e: A.OpApp, target: OpClosure):
+        """The once-per-run expansion decision for `target` applied at
+        node `e`: call-by-name (with the substituted body, built once)
+        when an argument or the body primes a parameter, else plain
+        call-by-value. Both inputs are immutable, so the plan is a pure
+        function of (node, closure)."""
+        ck = (id(e), id(target))
+        plan = self._op_plan.get(ck)
+        if plan is None:
+            from ..front.subst import (contains_prime, primes_params,
+                                       subst)
+            if (any(contains_prime(a) for a in e.args)
+                    or primes_params(target.body, target.params)) \
+                    and target.defs is None:
+                # call-by-name: an argument carries a primed variable
+                # (Lose(msgQ) assigning q', Send(..., memInt') through an
+                # operator constant) — substitute argument ASTs so the
+                # assignment target survives into the body
+                plan = ("cbn", subst(target.body,
+                                     dict(zip(target.params, e.args))))
+            else:
+                plan = ("call", None)
+            if len(self._op_plan) >= _OP_PLAN_CAP:
+                self._op_plan.clear()
+                self._plan_pins.clear()
+            self._op_plan[ck] = plan
+            self._plan_pins.append((e, target))
+        return plan
+
     def walk(self, e: A.Node, ctx: Ctx, partial: Dict[str, Any],
              label) -> Iterator[Tuple[Dict[str, Any], Any]]:
-        """Yield (complete-or-partial assignment, action label) pairs."""
-        ectx = self._ctx(ctx, partial)
+        """Yield (complete-or-partial assignment, action label) pairs.
 
+        The evaluation context (ectx) is built lazily per branch: the
+        structural branches (conjunction, disjunction, operator
+        expansion, UNCHANGED) never evaluate an expression, and they are
+        the bulk of the walk calls."""
         if isinstance(e, A.OpApp):
             name = e.name
             if name == "/\\":
@@ -74,6 +124,7 @@ class Walker:
                 tgt = self._target(e.args[0], ctx)
                 if tgt is not None:
                     label = _freeze(label)
+                    ectx = self._ctx(ctx, partial)
                     if tgt in partial:
                         # second assignment acts as an equality filter
                         rhs = eval_expr(e.args[1], ectx)
@@ -89,7 +140,7 @@ class Walker:
                 tgt = self._target(e.args[0], ctx)
                 if tgt is not None:
                     label = _freeze(label)
-                    sval = eval_expr(e.args[1], ectx)
+                    sval = eval_expr(e.args[1], self._ctx(ctx, partial))
                     if tgt in partial:
                         if in_set(partial[tgt], sval):
                             yield partial, label
@@ -113,22 +164,16 @@ class Walker:
             # user-defined operator application → expand as action
             target = ctx.bound[name] if name in ctx.bound else ctx.defs.get(name)
             if isinstance(target, OpClosure):
-                from ..front.subst import (contains_prime, primes_params,
-                                           subst)
-                if (any(contains_prime(a) for a in e.args)
-                        or primes_params(target.body, target.params)) \
-                        and target.defs is None:
-                    # call-by-name: an argument carries a primed variable
-                    # (Lose(msgQ) assigning q', Send(..., memInt') through an
-                    # operator constant) — substitute argument ASTs so the
-                    # assignment target survives into the body
-                    body = subst(target.body,
-                                 dict(zip(target.params, e.args)))
+                plan = self._op_plan.get((id(e), id(target)))
+                if plan is None:
+                    plan = self._op_expand_plan(e, target)
+                if plan[0] == "cbn":
                     new_label = label
                     if label is None or not label[2]:
                         new_label = (name, (), False)
-                    yield from self.walk(body, ctx, partial, new_label)
+                    yield from self.walk(plan[1], ctx, partial, new_label)
                     return
+                ectx = self._ctx(ctx, partial)
                 args = [_arg_value(a, ectx) for a in e.args]
                 inner = ctx
                 if target.defs is not None:
@@ -161,6 +206,7 @@ class Walker:
 
         elif isinstance(e, A.Quant):
             if e.kind == "E":
+                ectx = self._ctx(ctx, partial)
                 for b in iter_binders(e.binders, ectx, eval_expr):
                     yield from self.walk(e.body, ctx.with_bound(b),
                                          dict(partial), label)
@@ -168,11 +214,13 @@ class Walker:
             # \A as guard (fall through)
 
         elif isinstance(e, A.If):
-            c = _bool(eval_expr(e.cond, ectx), "IF condition")
+            c = _bool(eval_expr(e.cond, self._ctx(ctx, partial)),
+                      "IF condition")
             yield from self.walk(e.then if c else e.els, ctx, partial, label)
             return
 
         elif isinstance(e, A.Case):
+            ectx = self._ctx(ctx, partial)
             for g, b in e.arms:
                 if _bool(eval_expr(g, ectx), "CASE guard"):
                     yield from self.walk(b, ctx, partial, label)
@@ -183,7 +231,7 @@ class Walker:
             raise EvalError("CASE: no guard matched")
 
         elif isinstance(e, A.Let):
-            new = make_let_defs(e.defs, ectx)
+            new = make_let_defs(e.defs, self._ctx(ctx, partial))
             inner = ctx.with_defs(new)
             for v in new.values():
                 if isinstance(v, OpClosure):
@@ -218,7 +266,7 @@ class Walker:
 
         # default: boolean guard
         label = _freeze(label)
-        v = eval_expr(e, ectx)
+        v = eval_expr(e, self._ctx(ctx, partial))
         if _bool(v, "action conjunct"):
             yield partial, label
 
@@ -280,10 +328,18 @@ def enumerate_init(init: A.Node, base_ctx: Ctx,
 
 
 def enumerate_next(next_expr: A.Node, base_ctx: Ctx, vars: Tuple[str, ...],
-                   state: Dict[str, Any]):
-    """Yield (successor-state dict, label) for every enabled instance."""
-    w = Walker("next", vars, state)
-    for partial, label in w.walk(next_expr, base_ctx, {}, None):
+                   state: Dict[str, Any], walker: Optional[Walker] = None):
+    """Yield (successor-state dict, label) for every enabled instance.
+
+    Pass a reusable `walker` (Walker("next", vars)) when enumerating many
+    states of one run: its per-run expansion-plan cache then amortizes the
+    action-AST split across the whole search instead of redoing it per
+    state (the engines' hot loop does this; one-shot callers like ENABLED
+    get a fresh walker)."""
+    if walker is None:
+        walker = Walker("next", vars)
+    walker.state = state
+    for partial, label in walker.walk(next_expr, base_ctx, {}, None):
         missing = [v for v in vars if v not in partial]
         if missing:
             raise EvalError(

@@ -216,6 +216,30 @@ def _ev_bool(e, ctx):
     return e.val
 
 
+# stdlib/memo are import cycles with this module; resolve them once on
+# first use instead of re-running the import machinery on the hot path
+# (the `from .stdlib import BUILTIN_OPS` in _resolve showed up as ~250k
+# importlib calls per 40k generated states)
+_BUILTIN_OPS = None
+_memo_key = None
+
+
+def _get_builtin_ops():
+    global _BUILTIN_OPS
+    if _BUILTIN_OPS is None:
+        from .stdlib import BUILTIN_OPS
+        _BUILTIN_OPS = BUILTIN_OPS
+    return _BUILTIN_OPS
+
+
+def _get_memo_key():
+    global _memo_key
+    if _memo_key is None:
+        from .memo import memo_key
+        _memo_key = memo_key
+    return _memo_key
+
+
 def _resolve(name: str, ctx: Ctx):
     if name in ctx.bound:
         return ctx.bound[name]
@@ -225,9 +249,9 @@ def _resolve(name: str, ctx: Ctx):
         return ctx.state[name]
     if name in ctx.defs:
         return ctx.defs[name]
-    from .stdlib import BUILTIN_OPS  # late import to avoid cycle
-    if name in BUILTIN_OPS:
-        return BuiltinOp(name, BUILTIN_OPS[name])
+    ops = _BUILTIN_OPS if _BUILTIN_OPS is not None else _get_builtin_ops()
+    if name in ops:
+        return BuiltinOp(name, ops[name])
     raise EvalError(f"unknown identifier {name}")
 
 
@@ -242,7 +266,8 @@ def _force(v, ctx, name=""):
         store = ctx.memo
         if store is not None and v.stable and not v.bound \
                 and v.defs is None:
-            from .memo import memo_key  # late import (module cycle)
+            memo_key = _memo_key if _memo_key is not None \
+                else _get_memo_key()
             key = memo_key(store, v, ctx.defs, ctx)
             if key is not None:
                 hit = store.vals.get(key, _MISS)
@@ -320,7 +345,8 @@ def apply_op(opv, args: List[Any], ctx: Ctx):
         store = ctx.memo
         if store is not None and opv.stable and not opv.bound and args \
                 and opv.defs is None:
-            from .memo import memo_key  # late import (module cycle)
+            memo_key = _memo_key if _memo_key is not None \
+                else _get_memo_key()
             key = memo_key(store, opv, ctx.defs, ctx, tuple(args))
             if key is not None:
                 hit = store.vals.get(key, _MISS)
@@ -415,8 +441,8 @@ def _ev_opapp(e: A.OpApp, ctx: Ctx):
     if target is not None and not e.args:
         return _force(target, ctx, name)
 
-    from .stdlib import BUILTIN_OPS  # late import to avoid cycle
-    b = BUILTIN_OPS.get(name)
+    ops = _BUILTIN_OPS if _BUILTIN_OPS is not None else _get_builtin_ops()
+    b = ops.get(name)
     if b is not None:
         args = [_arg_value(a, ctx) for a in e.args]
         return b(args, ctx)

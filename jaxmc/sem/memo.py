@@ -75,6 +75,17 @@ class MemoStore:
             self.vals.clear()
         self.vals[key] = val
 
+    def stats(self) -> Tuple[int, int]:
+        """(hits, misses) — the unit the parallel engine merges: each
+        worker's forked store counts independently, and the parent folds
+        the per-chunk deltas back so the end-of-run memo gauges cover
+        the whole run, not just the parent's share."""
+        return self.hits, self.misses
+
+    def merge_stats(self, hits: int, misses: int) -> None:
+        self.hits += hits
+        self.misses += misses
+
 
 def analyze_closure(clo, defs: Dict[str, Any], vars) -> Optional[
         Tuple[Tuple[str, ...], Tuple[str, ...]]]:

@@ -223,6 +223,8 @@ def satisfies_constraints(model: "Model", state) -> bool:
     """Does `state` satisfy every cfg CONSTRAINT? The ONE implementation —
     the engine, the device backends, and layout sampling must agree on
     which states the search keeps (TLC discard semantics)."""
+    if not model.constraints:
+        return True  # skip the per-state ctx build entirely
     from .eval import _bool
     ctx = model.ctx(state=state)
     for name, expr in model.constraints:

@@ -1,0 +1,31 @@
+---------------------------- MODULE constoy ----------------------------
+(* cfg CONSTRAINT-discard fixture (TLC semantics, Specifying Systems
+   §14): states violating the CONSTRAINT are fingerprinted so they are
+   never re-processed, but they are not counted distinct, not
+   invariant-checked, and not explored.  Two counters race so discards
+   happen on multiple frontier chunks at once — the parity suite pins
+   the parallel engine to the serial engine's exact generated/distinct
+   split on the discard path. *)
+EXTENDS Naturals, TLC
+
+VARIABLES a, b
+
+Init == a = 0 /\ b = 0
+
+IncA == a' = a + 1 /\ b' = b
+
+IncB == b' = b + 1 /\ a' = a
+
+Next == IncA \/ IncB
+
+Spec == Init /\ [][Next]_<<a, b>>
+
+Bound == a + b <= 5
+
+\* a CONSTRAINT whose evaluation itself raises (TLC Assert): the engines
+\* must report the assert with identical counts — the successor that
+\* triggers the constraint eval is already counted as generated
+AssertBound == Assert(a + b <= 4, "constraint assert tripped")
+
+TypeInv == a >= 0 /\ b >= 0
+=========================================================================

@@ -1,0 +1,26 @@
+---------------------------- MODULE viewtoy ----------------------------
+(* cfg VIEW fixture: `noise` churns independently of `x`, and the view
+   collapses states to the value of `x` alone — TLC fingerprints the
+   VIEW's VALUE, not the state (ConfigFileGrammar.tla:8-11), so the
+   reachable count is |range of x| = 5 even though the full state space
+   is 15.  Used by the serial-vs-parallel parity suite: the parallel
+   engine's workers compute the view fingerprint and the parent's merge
+   must dedup on it exactly like the serial engine. *)
+EXTENDS Naturals
+
+VARIABLES x, noise
+
+Init == x = 0 /\ noise = 0
+
+Incr == x' = (x + 1) % 5 /\ noise' = (noise + x) % 3
+
+Jitter == x' = x /\ noise' = (noise + 1) % 3
+
+Next == Incr \/ Jitter
+
+Spec == Init /\ [][Next]_<<x, noise>>
+
+V == x
+
+TypeInv == x \in 0..4
+=========================================================================
