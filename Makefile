@@ -22,6 +22,14 @@ check-corpus:
 test:
 	$(PY) -m pytest tests/ -q
 
+# fault-injection smoke suite (ISSUE 4): every chaos-marked test — the
+# JAXMC_FAULTS harness killing pool workers, corrupting checkpoints,
+# failing device init, SIGKILLing whole runs mid-level — on the CPU
+# backend. The heavyweight kill/resume legs are additionally marked
+# `slow`, so they run here but stay out of tier-1 timing.
+chaos:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m chaos
+
 bench:
 	$(PY) bench.py
 
@@ -63,4 +71,4 @@ native:
 	mkdir -p native/build
 	g++ -O2 -shared -fPIC -std=c++17 -pthread native/fps_store.cc -o native/build/libjaxmc_fps.so
 
-.PHONY: all check check-corpus test bench bench-check bench-check-reset native
+.PHONY: all check check-corpus test chaos bench bench-check bench-check-reset native

@@ -321,16 +321,63 @@ _RESULTS = _Results()
 _PROCS = []        # live child Popens, killed at exit
 _PROCS_LOCK = threading.Lock()
 _STOPPING = threading.Event()  # set by main() before the kill loop
+# per-tag child fate for the orchestration block (ISSUE 4): a child that
+# died on a signal used to surface only as an opaque partial line — now
+# the artifact of record says what killed it and whether a retry saved it
+_CHILD_FATE = {}
+_CHILD_FATE_LOCK = threading.Lock()
+
+
+def _note_fate(tag: str, fate: str, retries: int) -> None:
+    with _CHILD_FATE_LOCK:
+        _CHILD_FATE[tag] = {"fate": fate, "retries": retries}
 
 
 def _run_child(env_extra: dict, timeout_s: float, tag: str):
     """Run bench.py as a child with env markers; return its JSON line or
     None. Registers the Popen so main() can kill stragglers at exit.
     Each attempt is a parent-side span (outcome in the attrs), so the
-    emitted line's phase rollup says where the deadline budget went."""
-    if timeout_s <= 5 or _STOPPING.is_set():
-        _log(f"{tag}: skipped (no time left)")
-        return None
+    emitted line's phase rollup says where the deadline budget went.
+    A child that DIES ON A SIGNAL (OOM kill, a crashed accelerator
+    runtime) is retried with backoff (JAXMC_BENCH_CHILD_RETRIES, default
+    1) — signal deaths are the transient class; a nonzero exit is a
+    deterministic failure and is not retried."""
+    retries = int(os.environ.get("JAXMC_BENCH_CHILD_RETRIES", "1"))
+    for attempt in range(retries + 1):
+        if timeout_s <= 5 or _remaining() <= 5 or _STOPPING.is_set():
+            _log(f"{tag}: skipped (no time left)")
+            with _CHILD_FATE_LOCK:
+                prev = _CHILD_FATE.get(tag)
+            # never clobber the real cause of death: a signal-killed
+            # child whose retry window expired keeps its signal fate
+            if prev and prev["fate"] not in ("ok", "skipped"):
+                _note_fate(tag, f"{prev['fate']} (retry skipped: no "
+                                f"time left)", attempt)
+            else:
+                _note_fate(tag, "skipped", attempt)
+            return None
+        line, fate = _run_child_once(env_extra, min(timeout_s,
+                                                    _remaining()), tag)
+        if line is not None:
+            _note_fate(tag, "ok", attempt)
+            return line
+        _note_fate(tag, fate, attempt)
+        if not fate.startswith("signal"):
+            return None  # deterministic failure: retrying cannot help
+        if attempt >= retries:
+            _log(f"{tag}: child kept dying on a signal ({fate}); "
+                 f"giving up after {attempt + 1} attempts")
+            return None
+        backoff = min(5.0, 1.0 * (2 ** attempt), _remaining())
+        _log(f"{tag}: child died on a signal ({fate}); retrying in "
+             f"{backoff:.0f}s ({attempt + 1}/{retries})")
+        time.sleep(max(0.0, backoff))
+    return None
+
+
+def _run_child_once(env_extra: dict, timeout_s: float, tag: str):
+    """(json_line | None, fate) for one child attempt; fate is "ok",
+    "timeout", "rc=N", "signal=-N" or "no-json"."""
     env = dict(os.environ, **env_extra)
     with _PROCS_LOCK:
         # check-and-spawn under the lock: a worker racing main()'s kill
@@ -338,7 +385,7 @@ def _run_child(env_extra: dict, timeout_s: float, tag: str):
         # parent's exit would orphan on this 1-core box
         if _STOPPING.is_set():
             _log(f"{tag}: skipped (shutting down)")
-            return None
+            return None, "skipped"
         p = subprocess.Popen([sys.executable, os.path.abspath(__file__)],
                              stdout=subprocess.PIPE,
                              stderr=subprocess.PIPE, text=True, env=env)
@@ -352,7 +399,7 @@ def _run_child(env_extra: dict, timeout_s: float, tag: str):
             p.communicate()
             _log(f"{tag}: timed out after {timeout_s:.0f}s")
             span.attrs["outcome"] = "timeout"
-            return None
+            return None, "timeout"
         finally:
             with _PROCS_LOCK:
                 if p in _PROCS:
@@ -360,13 +407,16 @@ def _run_child(env_extra: dict, timeout_s: float, tag: str):
     sys.stderr.write(err or "")
     if p.returncode != 0:
         _log(f"{tag}: child rc={p.returncode}")
-        return None
+        _TEL.counter("bench.child_signal_deaths" if p.returncode < 0
+                     else "bench.child_failures")
+        return None, (f"signal={p.returncode}" if p.returncode < 0
+                      else f"rc={p.returncode}")
     for line in (out or "").splitlines():
         line = line.strip()
         if line.startswith("{"):
-            return line
+            return line, "ok"
     _log(f"{tag}: child produced no JSON line")
-    return None
+    return None, "no-json"
 
 
 def probe_tpu_once(timeout_s: float) -> tuple:
@@ -591,10 +641,17 @@ def main():
     # open=True partials for work still in flight at emit time — the
     # record that says where the deadline budget went even when the
     # device path never produced a line
+    with _CHILD_FATE_LOCK:
+        child_fate = {t: dict(f) for t, f in _CHILD_FATE.items()}
     orch = {"deadline_s": budget,
             "spent_s": round(budget - _remaining(), 1),
             "probe_skipped": _PROBE_SKIPPED,
             "compile_cache": os.environ.get("JAXMC_COMPILE_CACHE"),
+            # per-child fate + retry count (ISSUE 4): a signal-killed
+            # child names its signal here instead of an opaque partial
+            "child_retries": sum(f["retries"]
+                                 for f in child_fate.values()),
+            "child_fate": child_fate,
             "phases": _TEL.phase_list(),
             "env": obs.environment_meta()}
     if line is None:

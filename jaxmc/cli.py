@@ -106,6 +106,114 @@ def cmd_check(args) -> int:
         tel.close()
 
 
+def _device_init(args, tel) -> str:
+    """Device/plugin init with bounded retries + backoff
+    (JAXMC_DEVICE_RETRIES, default 2): a flaky accelerator tunnel gets
+    more than one chance before the run demotes to CPU.  ImportError
+    (jax not in the build) stays terminal — retrying cannot install a
+    wheel.  Returns the persistent compile-cache dir (or None)."""
+    from . import faults
+    retries = int(os.environ.get("JAXMC_DEVICE_RETRIES", "2"))
+    for attempt in range(retries + 1):
+        try:
+            platform = getattr(args, "platform", None)
+            with tel.span("device_init",
+                          platform=platform or "default",
+                          attempt=attempt):
+                import jax
+                faults.inject("device_init_fail")
+                if platform:
+                    jax.config.update("jax_platforms", platform)
+                # persistent XLA compile cache (repeat runs skip the
+                # per-arm compiles): opt-in via --compile-cache /
+                # JAXMC_COMPILE_CACHE
+                from .compile.cache import enable_persistent_cache
+                cache_dir = enable_persistent_cache(
+                    getattr(args, "compile_cache", None))
+                if tel.enabled:
+                    # force plugin/device init inside the span so a hung
+                    # tunnel is attributed to device_init, not compile
+                    tel.gauge("device.platform",
+                              jax.devices()[0].platform)
+                    tel.gauge("device.count", len(jax.devices()))
+                    # re-stamp the env fingerprint now that jax is
+                    # initialized: platform/device_count become real
+                    from . import obs
+                    tel.set_meta(env=obs.environment_meta())
+                else:
+                    jax.devices()  # init failures must surface HERE
+            return cache_dir
+        except (faults.FaultInjected, RuntimeError, OSError,
+                ConnectionError) as ex:
+            if attempt >= retries:
+                raise
+            tel.counter("device.init_retries")
+            print(f"warning: device init failed ({ex}); retrying "
+                  f"({attempt + 1}/{retries})", file=sys.stderr)
+            time.sleep(min(0.2 * (2 ** attempt), 5.0))
+
+
+def _run_device_check(args, tel, log, model, cache_dir):
+    from .compile.vspec import Bounds
+    from .tpu.bfs import TpuExplorer
+    bounds = Bounds(seq_cap=args.seq_cap, grow_cap=args.grow_cap,
+                    kv_cap=args.kv_cap)
+    with tel.span("engine_build"):
+        ex = TpuExplorer(model, log=log, bounds=bounds,
+                         store_trace=not args.no_trace,
+                         progress_every=args.progress_every,
+                         host_seen=args.host_seen,
+                         chunk=args.chunk,
+                         resident=args.resident,
+                         sample_cfg=tuple(args.sample),
+                         checkpoint_path=args.checkpoint,
+                         checkpoint_every=args.checkpoint_every,
+                         resume_from=args.resume,
+                         max_states=args.max_states)
+    with tel.span("search"):
+        res = ex.run()
+    from .compile.cache import record_entries_end
+    record_entries_end(cache_dir)
+    return res
+
+
+def _demote_to_cpu(args, tel, log, model, err):
+    """Terminal device failure -> the parallel CPU engine, resuming from
+    the device run's host snapshot (`<checkpoint>.host`, written at
+    level barriers by tpu/bfs.py) when one exists.  The demotion is
+    machine-readable: `device.demoted` gauge + event (flagged by
+    `python -m jaxmc.obs diff`) and a result warning on stdout."""
+    from .engine.parallel import ParallelExplorer, default_workers
+    reason = f"{type(err).__name__}: {err}"
+    print(f"warning: device backend failed terminally ({reason}); "
+          f"falling back to the parallel CPU engine", file=sys.stderr)
+    tel.event("device.demoted", reason=reason)
+    tel.gauge("device.demoted", reason[:200])
+    tel.counter("device.demotions")
+    snap = (args.checkpoint + ".host") if args.checkpoint else None
+    resume = snap if snap and os.path.exists(snap) else None
+    if snap and not resume:
+        print("warning: no host snapshot exists yet - the CPU engine "
+              "restarts from scratch", file=sys.stderr)
+    if resume:
+        print(f"resuming from host snapshot {resume}", file=sys.stderr)
+    workers = default_workers() if not args.workers \
+        else max(1, args.workers)
+    with tel.span("search_fallback", workers=workers):
+        res = ParallelExplorer(model, workers=workers, log=log,
+                               max_states=args.max_states,
+                               progress_every=args.progress_every,
+                               checkpoint_path=snap,
+                               checkpoint_every=args.checkpoint_every,
+                               resume_from=resume).run()
+    res.warnings.append(
+        f"device backend failed ({reason}); the run completed on the "
+        f"parallel CPU engine"
+        + (", resumed from the last host snapshot" if resume
+           else ", restarted from scratch"))
+    return res
+
+
 def _metrics_error(args, tel, error: str) -> None:
     if args.metrics_out:
         tel.write_metrics(args.metrics_out,
@@ -145,63 +253,27 @@ def _run_check(args, tel, log, t0) -> int:
                       checkpoint_every=args.checkpoint_every,
                       resume_from=args.resume)
             if workers > 1:
-                # worker-parallel frontier expansion; falls back to the
-                # serial engine (identical results) when the run needs
-                # checkpoint/resume or the platform cannot fork
+                # worker-parallel frontier expansion (crash-safe:
+                # checkpoints natively, survives worker deaths); falls
+                # back to the serial engine (identical results) only for
+                # stepwise refinement or when the platform cannot fork
                 ex = ParallelExplorer(model, workers=workers, **kw)
             else:
                 ex = Explorer(model, **kw)
             res = ex.run()
     else:
+        from . import faults
+        from .compile.vspec import CompileError, ModeError
+        from .engine.ckpt import CkptError
+        faults.ensure_shared_state()  # one budget for run + fallback
         try:
-            platform = getattr(args, "platform", None)
-            with tel.span("device_init",
-                          platform=platform or "default"):
-                import jax
-                if platform:
-                    jax.config.update("jax_platforms", platform)
-                # persistent XLA compile cache (repeat runs skip the
-                # per-arm compiles): opt-in via --compile-cache /
-                # JAXMC_COMPILE_CACHE
-                from .compile.cache import enable_persistent_cache
-                cache_dir = enable_persistent_cache(
-                    getattr(args, "compile_cache", None))
-                from .tpu.bfs import TpuExplorer
-                if tel.enabled:
-                    # force plugin/device init inside the span so a hung
-                    # tunnel is attributed to device_init, not compile
-                    tel.gauge("device.platform",
-                              jax.devices()[0].platform)
-                    tel.gauge("device.count", len(jax.devices()))
-                    # re-stamp the env fingerprint now that jax is
-                    # initialized: platform/device_count become real
-                    from . import obs
-                    tel.set_meta(env=obs.environment_meta())
+            cache_dir = _device_init(args, tel)
+            res = _run_device_check(args, tel, log, model, cache_dir)
         except ImportError as e:
             print(f"error: the jax backend is not available in this build "
                   f"({e})", file=sys.stderr)
             _metrics_error(args, tel, f"jax unavailable: {e}")
             return 2
-        from .compile.vspec import Bounds, CompileError, ModeError
-        bounds = Bounds(seq_cap=args.seq_cap, grow_cap=args.grow_cap,
-                        kv_cap=args.kv_cap)
-        try:
-            with tel.span("engine_build"):
-                ex = TpuExplorer(model, log=log, bounds=bounds,
-                                 store_trace=not args.no_trace,
-                                 progress_every=args.progress_every,
-                                 host_seen=args.host_seen,
-                                 chunk=args.chunk,
-                                 resident=args.resident,
-                                 sample_cfg=tuple(args.sample),
-                                 checkpoint_path=args.checkpoint,
-                                 checkpoint_every=args.checkpoint_every,
-                                 resume_from=args.resume,
-                                 max_states=args.max_states)
-            with tel.span("search"):
-                res = ex.run()
-            from .compile.cache import record_entries_end
-            record_entries_end(cache_dir)
         except ModeError as e:
             print(f"error: {e}", file=sys.stderr)
             _metrics_error(args, tel, str(e))
@@ -212,6 +284,20 @@ def _run_check(args, tel, log, t0) -> int:
                   f"--backend interp", file=sys.stderr)
             _metrics_error(args, tel, str(e))
             return 2
+        except CkptError:
+            raise  # main() maps checkpoint defects to exit 2
+        except (faults.FaultInjected, RuntimeError, OSError, MemoryError,
+                ConnectionError) as e:
+            # TERMINAL device failure (init retries exhausted, the XLA
+            # runtime died mid-search, the tunnel dropped): fall back to
+            # the parallel CPU engine RESUMING from the last host
+            # snapshot instead of exiting with hours of progress lost.
+            # Spec-compatibility refusals (ModeError/CompileError) and
+            # semantic errors (EvalError) are handled above/elsewhere —
+            # the interp would hit those identically, so no fallback.
+            if args.no_device_fallback:
+                raise
+            res = _demote_to_cpu(args, tel, log, model, e)
     wall = time.time() - t0
     print(f"{res.generated} states generated, {res.distinct} distinct states "
           f"found ({res.generated / max(res.wall_s, 1e-9):.0f} states/sec, "
@@ -317,6 +403,11 @@ def main(argv=None) -> int:
                         "(env: JAXMC_COMPILE_CACHE)")
     c.add_argument("--no-deadlock", action="store_true",
                    help="disable deadlock checking")
+    c.add_argument("--no-device-fallback", action="store_true",
+                   help="jax backend: exit on a terminal device failure "
+                        "instead of falling back to the parallel CPU "
+                        "engine (which resumes from the last host "
+                        "snapshot when --checkpoint is set)")
     c.add_argument("--quiet", action="store_true")
     c.add_argument("--progress-every", type=float, default=30.0)
     c.add_argument("--seq-cap", type=int, default=Bounds.seq_cap,
@@ -409,8 +500,16 @@ def main(argv=None) -> int:
     s.set_defaults(fn=cmd_sweep)
 
     args = ap.parse_args(argv)
+    from .engine.ckpt import CkptError  # no jax dependency
     try:
         return args.fn(args)
+    except CkptError as e:
+        # the checkpoint exit-code contract: every resume defect (bad
+        # path, module mismatch, truncation, checksum failure) is ONE
+        # actionable line on stderr and exit 2 — never a traceback,
+        # never a silently-wrong resume
+        print(f"error: {e}", file=sys.stderr)
+        return 2
     except FileNotFoundError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2

@@ -227,34 +227,23 @@ class Explorer:
             # the full search state, resumable with --resume. A state whose
             # expansion is in flight is re-queued at the head with
             # `generated` rolled back to its pop, so resume re-expands it
-            # exactly once and full-run counts stay exact
-            import pickle
-            import os as _os
-            t_ck = time.time()
-            with tel.span("checkpoint.write", states=len(states),
-                          queue=len(queue_head) + len(queue)):
-                tmp = self.checkpoint_path + ".tmp"
-                with open(tmp, "wb") as fh:
-                    pickle.dump(dict(module=model.module.name,
-                                     vars=list(vars),
-                                     states=states, parents=parents,
-                                     labels=labels, depth_of=depth_of,
-                                     queue=list(queue_head) + list(queue),
-                                     generated=generated
-                                     if generated_at is None
-                                     else generated_at,
-                                     diameter=diameter,
-                                     seen_items=list(seen.items()),
-                                     edges=edges if collect_edges else None,
-                                     prints=self.prints if prints_at is None
-                                     else self.prints[:prints_at]), fh)
-                _os.replace(tmp, self.checkpoint_path)
-            write_s = time.time() - t_ck
-            if write_s * 20.0 > ck_state["every"]:
-                ck_state["every"] = write_s * 20.0
-                self.log(f"Checkpoint write took {write_s:.1f}s; interval "
-                         f"stretched to {ck_state['every']:.0f}s")
-            self.log(f"Checkpointing run to {self.checkpoint_path}")
+            # exactly once and full-run counts stay exact. Written through
+            # engine/ckpt.py (checksum + schema header): a clipped or
+            # bit-rotted file is refused at resume, never half-trusted
+            from . import ckpt as _ckpt
+            payload = _ckpt.interp_payload(
+                model, vars, states, parents, labels, depth_of,
+                list(queue_head) + list(queue),
+                generated if generated_at is None else generated_at,
+                diameter, seen, edges, collect_edges,
+                self.prints if prints_at is None
+                else self.prints[:prints_at])
+            _ckpt.write_periodic(
+                self.checkpoint_path, "interp",
+                {"module": model.module.name, "engine": "serial"},
+                payload, tel, self.log, ck_state,
+                span_attrs={"states": len(states),
+                            "queue": len(queue_head) + len(queue)})
 
         canon = make_canonicalizer(model)
 
@@ -336,22 +325,17 @@ class Explorer:
 
         # ---- resume from a checkpoint ----
         if self.resume_from:
-            import pickle
-            try:
-                with open(self.resume_from, "rb") as fh:
-                    ck = pickle.load(fh)
-                if not isinstance(ck, dict) or "states" not in ck:
-                    raise ValueError("not a jaxmc checkpoint")
-            except (pickle.UnpicklingError, ValueError, EOFError) as ex:
-                raise EvalError(
-                    f"cannot resume: {self.resume_from} is not a valid "
-                    f"jaxmc checkpoint ({ex})")
-            if ck.get("module") != model.module.name or \
-                    ck.get("vars") != list(vars):
-                raise EvalError(
-                    f"cannot resume: checkpoint is for module "
-                    f"{ck.get('module')!r} with variables "
-                    f"{ck.get('vars')}, not {model.module.name!r}")
+            # integrity (checksum/truncation/format) and module/vars
+            # validation live in engine/ckpt.py; every defect is a
+            # CkptError (exit 2 at the CLI), never a traceback or a
+            # silently-wrong resume.
+            # dedup keys must be symmetry-canonical, matching add_state.
+            # seen_items stores (key, sid-or-VIOL) directly so resume is a
+            # linear dict fill — no re-canonicalization, and discarded
+            # (constraint-violating) fingerprints survive the checkpoint.
+            from .ckpt import load_interp_checkpoint
+            ck = load_interp_checkpoint(self.resume_from, model, vars,
+                                        collect_edges)
             self.prints.extend(ck.get("prints", []))
             states.extend(ck["states"])
             parents.extend(ck["parents"])
@@ -360,28 +344,9 @@ class Explorer:
             queue.extend(ck["queue"])
             generated = ck["generated"]
             diameter = ck["diameter"]
-            # dedup keys must be symmetry-canonical, matching add_state.
-            # seen_items stores (key, sid-or-VIOL) directly so resume is a
-            # linear dict fill — no re-canonicalization, and discarded
-            # (constraint-violating) fingerprints survive the checkpoint.
-            # Checkpoints without seen_items predate this format (their
-            # pickled values also carry stale per-process hashes) — reject
-            items = ck.get("seen_items")
-            if items is None:
-                raise EvalError(
-                    f"cannot resume: {self.resume_from} was written by an "
-                    f"incompatible jaxmc version (no seen_items)")
-            seen.update(items)
+            seen.update(ck["seen_items"])
             if collect_edges:
-                # liveness needs the FULL edge log; a checkpoint written
-                # without one cannot support temporal checking
-                ck_edges = ck.get("edges")
-                if ck_edges is None:
-                    raise EvalError(
-                        "cannot resume with temporal properties: the "
-                        "checkpoint has no edge log (it was written "
-                        "without PROPERTY obligations)")
-                edges.extend(ck_edges)
+                edges.extend(ck["edges"])
             self.log(f"Resumed from {self.resume_from}: {len(states)} "
                      f"distinct states, {len(queue)} on queue.")
 
@@ -437,6 +402,13 @@ class Explorer:
             if depth > lv["depth"]:
                 flush_level()
                 lv["depth"] = depth
+                # chaos harness: simulated hard crash entering a level
+                # (the kill/resume parity suite SIGKILLs here and pins
+                # the resumed counts bit-identical to an uninterrupted
+                # run). No-op unless JAXMC_FAULTS configures run_kill.
+                from .. import faults
+                faults.kill_self("run_kill", level=depth,
+                                 engine="serial")
             lv["frontier"] += 1
             diameter = max(diameter, depth)
             succ_count = 0

@@ -37,12 +37,37 @@ serial engine would have cut off mid-state; print ORDER within a state
 interleaves invariant-eval prints after expansion prints.  Counts, logs,
 traces and verdicts are unaffected (the CLI does not render prints).
 
+Crash safety (ISSUE 4): the engine owns its worker pool (`_WorkerPool`,
+a context-managed set of fork processes around two queues) instead of
+`multiprocessing.Pool`, BECAUSE Pool loses the task a dead worker held
+and wedges the imap iterator.  Workers announce each chunk before
+expanding it, so when a worker dies (OOM kill, fault injection) the
+parent knows exactly which chunks were in flight: it drains completed
+results, tears the pool down, respawns it (shrunk after repeat deaths),
+and requeues the unmerged chunks with a bounded per-chunk retry budget
+(JAXMC_PARALLEL_RETRIES, default 2) and backoff.  A chunk that raises a
+transient error is retried INLINE in the parent at its merge point —
+chunks are pure, and the parent replay keeps the slim-record invariant.
+Only when a chunk's retries are exhausted (or the pool cannot respawn)
+does the run degrade to serial expansion for the remainder, recorded as
+the `parallel.degraded` gauge/event.  Counts stay bit-identical to the
+serial engine through every recovery: chunks always MERGE in submission
+order, and re-executed chunks produce the same records (full records
+where the dead worker would have sent slim repeats — the parent dedup
+treats both identically).
+
+Checkpoints (ISSUE 4): written at level barriers through engine/ckpt.py
+in the SAME payload format as the serial engine, so either engine
+resumes the other's checkpoint; a state-limit truncation checkpoints
+mid-level with the in-flight state requeued at the head, exactly like
+the serial engine.  The PR-3 "checkpoint requested -> serial fallback"
+is gone.
+
 Falls back to the serial engine (identical behavior, a
 `parallel.fallback` telemetry event, no stdout difference) when: workers
-<= 1, the platform has no fork start method, a checkpoint/resume was
-requested (the checkpoint format is owned by the serial engine), or the
-model carries stepwise refinement properties (their checkers are
-evaluated edge-at-a-time in the parent today).
+<= 1, the platform has no fork start method, or the model carries
+stepwise refinement properties (their checkers are evaluated
+edge-at-a-time in the parent today).
 """
 
 from __future__ import annotations
@@ -280,6 +305,155 @@ def _action_constraints_ok(w: _WorkerState, st, succ) -> bool:
     return True
 
 
+def _worker_main(task_q, result_q) -> None:
+    """Pool worker loop.  The model/walker state (_W) is inherited over
+    fork.  Each chunk is ANNOUNCED before expansion ("start" message)
+    so the parent can attribute a dead pid to the chunk it held; every
+    escape from a chunk is reported as a "fail" message, never fatal —
+    the parent decides retry vs degrade.  The worker_kill/chunk_error
+    fault sites live here and ONLY here: the parent-inline path must
+    never kill or fail the run's only process."""
+    from .. import faults
+    while True:
+        task = task_q.get()
+        if task is None:
+            return
+        idx, depth, chunk = task
+        result_q.put(("start", idx, os.getpid()))
+        try:
+            faults.kill_self("worker_kill", level=depth)
+            faults.inject("chunk_error", level=depth)
+            out = _expand_chunk(chunk)
+        except BaseException as ex:  # noqa: BLE001 — report, keep serving
+            result_q.put(("fail", idx, os.getpid(),
+                          f"{type(ex).__name__}: {ex}"))
+            continue
+        result_q.put(("done", idx, os.getpid(), out))
+
+
+class _WorkerPool:
+    """A context-managed fork pool with observable worker liveness.
+
+    `multiprocessing.Pool` silently replaces a dead worker and never
+    redelivers the task it held; this pool instead exposes exit codes
+    (`dead()`), hands the parent every buffered result (`drain()`), and
+    guarantees teardown — `shutdown()` is idempotent, runs from the
+    engine's `finally`, and leaves no orphan processes behind even when
+    the engine raises before or during a level (the PR-3
+    `pool.terminate()` error path could leak the pool)."""
+
+    def __init__(self, mp_ctx, size: int, wstate: _WorkerState):
+        import collections
+        import threading
+        # delta baseline: re-read the memo counters at THIS fork point so
+        # worker deltas never re-add the parent's own pre-fork hits
+        if wstate.model._memo is not None:
+            wstate.memo_sent = wstate.model._memo.stats()
+        _init_worker(wstate)  # forked children inherit via the global
+        self.size = size
+        self.task_q = mp_ctx.Queue()
+        self.result_q = mp_ctx.Queue()
+        self.procs: List[Any] = []
+        # The parent NEVER touches result_q directly: a worker SIGKILLed
+        # mid-put can leave a truncated length-prefixed frame in the
+        # pipe, and Queue.get's recv would then block PAST any timeout
+        # (mp timeouts only cover the readability poll).  A daemon
+        # reader thread absorbs that risk: it alone may wedge on the
+        # torn frame; the parent reads from the thread-fed buffer with a
+        # real timeout, still sees the dead worker via exit codes, and
+        # abandons the thread at shutdown.
+        self._buf = collections.deque()
+        self._cv = threading.Condition()
+        self._stop = False
+        self._reader = threading.Thread(target=self._read_loop,
+                                        daemon=True)
+        try:
+            for _ in range(size):
+                p = mp_ctx.Process(target=_worker_main,
+                                   args=(self.task_q, self.result_q),
+                                   daemon=True)
+                p.start()
+                self.procs.append(p)
+            self._reader.start()
+        except BaseException:
+            self.shutdown()
+            raise
+
+    def _read_loop(self) -> None:
+        import queue as _q
+        while not self._stop:
+            try:
+                msg = self.result_q.get(timeout=0.2)
+            except _q.Empty:
+                continue
+            except (EOFError, OSError):
+                return  # queue closed under us (shutdown)
+            except Exception:  # noqa: BLE001 — a torn frame's unpickle
+                continue       # error must not kill the reader
+            with self._cv:
+                self._buf.append(msg)
+                self._cv.notify()
+
+    def __enter__(self) -> "_WorkerPool":
+        return self
+
+    def __exit__(self, *a) -> bool:
+        self.shutdown()
+        return False
+
+    def submit(self, task) -> None:
+        self.task_q.put(task)
+
+    def get(self, timeout: float):
+        import queue as _q
+        with self._cv:
+            if not self._buf:
+                self._cv.wait(timeout)
+            if not self._buf:
+                raise _q.Empty()
+            return self._buf.popleft()
+
+    def drain(self) -> List[tuple]:
+        """Everything currently buffered (salvaged before a teardown so
+        completed chunks are never re-executed).  Gives the reader
+        thread a short grace window to flush messages already in the
+        pipe from still-healthy workers."""
+        time.sleep(0.1)
+        with self._cv:
+            out = list(self._buf)
+            self._buf.clear()
+        return out
+
+    def dead(self) -> List[Any]:
+        return [p for p in self.procs if p.exitcode is not None]
+
+    def shutdown(self) -> None:
+        self._stop = True  # reader thread is a daemon: abandoned if it
+        # is wedged on a torn frame, joined-by-exit otherwise
+        for p in self.procs:
+            if p.exitcode is None:
+                try:
+                    p.terminate()
+                except OSError:
+                    pass
+        deadline = time.time() + 5.0
+        for p in self.procs:
+            p.join(max(0.1, deadline - time.time()))
+            if p.exitcode is None:
+                try:  # a worker ignoring SIGTERM gets SIGKILL
+                    p.kill()
+                    p.join(1.0)
+                except OSError:
+                    pass
+        for q in (self.task_q, self.result_q):
+            try:
+                q.close()
+                q.cancel_join_thread()  # never hang exit on a feeder
+            except OSError:
+                pass
+        self.procs = []
+
+
 # ---------------------------------------------------------------- engine
 
 class ParallelExplorer(Explorer):
@@ -299,15 +473,22 @@ class ParallelExplorer(Explorer):
             env = os.environ.get("JAXMC_PARALLEL_CHUNK")
             chunk = int(env) if env else None
         self.chunk = chunk
+        # crash-safe pool state (owned by _run_parallel; kept here so
+        # teardown/telemetry accessors are safe on the fallback path)
+        self._pool: Optional[_WorkerPool] = None
+        self._pool_size = self.workers
+        self._respawns = 0
+        self._degraded: Optional[str] = None
 
     # -- engine selection ------------------------------------------------
     def _fallback_reason(self, refiners) -> Optional[str]:
+        # NOTE: checkpoint/resume no longer falls back (ISSUE 4): the
+        # engine checkpoints at level barriers through engine/ckpt.py in
+        # the serial engine's own payload format
         if self.workers <= 1:
             return "workers<=1"
         if not fork_available():
             return "no fork start method on this platform"
-        if self.resume_from or self.checkpoint_path:
-            return "checkpoint/resume requested (serial-engine format)"
         if refiners:
             return "stepwise refinement properties"
         return None
@@ -331,10 +512,142 @@ class ParallelExplorer(Explorer):
             size = max(1, min(256, -(-n // (self.workers * 4))))
         return [frontier[i:i + size] for i in range(0, n, size)]
 
+    # -- crash-safe pool plumbing ----------------------------------------
+    def _ensure_pool(self) -> None:
+        """Fork the worker pool (lazily, and again after a death).
+        Workers inherit the parent's inline worker state — its `sent`
+        keys were all merged into `seen`, so slim repeats from any
+        worker stay resolvable."""
+        if self._pool is None:
+            self._pool = _WorkerPool(self._mp, self._pool_size,
+                                     self._wstate)
+
+    def _note_degraded(self, tel, reason: str) -> None:
+        """Record the one-way degrade to serial expansion (telemetry +
+        log); expansion correctness is unchanged — the inline path runs
+        the same records through the same merge."""
+        if self._degraded is None:
+            self._degraded = reason
+            tel.gauge("parallel.degraded", reason)
+            tel.event("parallel.degraded", reason=reason)
+            tel.counter("parallel.degradations")
+            self.log(f"-- parallel: degrading to serial expansion "
+                     f"({reason})")
+
+    def _level_results(self, payloads, depth, tel, max_retries):
+        """Yield (chunk_wall, memo_delta, records) for every chunk of
+        one level IN SUBMISSION ORDER, surviving worker deaths and
+        transient chunk errors.
+
+        Recovery rules (all exact — chunks are pure functions):
+        - a chunk whose worker DIED is requeued to a respawned pool,
+          with a per-chunk retry budget and backoff between respawns;
+          repeat deaths shrink the pool (half, floor 1) on the theory
+          that the box cannot hold the full worker count;
+        - a chunk that raised a TRANSIENT error is re-executed inline
+          in the parent at its merge point (the parent's worker state
+          keeps the slim-record invariant: every key it has emitted is
+          already merged);
+        - when a chunk's budget is exhausted, or the pool cannot be
+          respawned, the level (and the rest of the run) degrades to
+          inline expansion — `parallel.degraded` telemetry, counts
+          unchanged."""
+        import queue as _queue
+        n = len(payloads)
+        done: Dict[int, tuple] = {}
+        must_inline: set = set()
+        retries: Dict[int, int] = {}
+        in_flight: Dict[int, int] = {}  # pid -> chunk idx
+        yielded = 0
+        self._ensure_pool()
+        for i, p in enumerate(payloads):
+            self._pool.submit((i, depth, p))
+
+        def absorb(msg):
+            kind = msg[0]
+            if kind == "start":
+                in_flight[msg[2]] = msg[1]
+            elif kind == "done":
+                done[msg[1]] = msg[3]
+                in_flight.pop(msg[2], None)
+            elif kind == "fail":
+                idx = msg[1]
+                in_flight.pop(msg[2], None)
+                retries[idx] = retries.get(idx, 0) + 1
+                tel.counter("parallel.chunk_retries")
+                tel.event("parallel.chunk_error", level=depth, chunk=idx,
+                          error=msg[3], retry=retries[idx])
+                must_inline.add(idx)
+
+        while yielded < n:
+            if yielded in done:
+                yield done.pop(yielded)
+                yielded += 1
+                continue
+            if yielded in must_inline or self._pool is None:
+                # bounded retry, replayed in the parent at the merge
+                # point; memo deltas land in the parent store directly,
+                # so the consumer must not re-merge them
+                must_inline.discard(yielded)
+                wall, _delta, out = _expand_chunk(payloads[yielded])
+                yield (wall, (0, 0), out)
+                yielded += 1
+                continue
+            try:
+                absorb(self._pool.get(0.25))
+                continue
+            except _queue.Empty:
+                pass
+            dead = self._pool.dead()
+            if not dead:
+                continue
+            # ---- a worker died (OOM kill, crash, injected fault) ----
+            dead_pids = [p.pid for p in dead]
+            for msg in self._pool.drain():  # salvage completed chunks
+                absorb(msg)
+            lost = sorted(idx for pid, idx in in_flight.items()
+                          if pid in dead_pids and idx not in done)
+            tel.counter("parallel.worker_deaths", len(dead))
+            tel.event("parallel.worker_death", level=depth,
+                      pids=dead_pids, lost_chunks=lost)
+            for idx in lost:
+                retries[idx] = retries.get(idx, 0) + 1
+            in_flight.clear()
+            self._pool.shutdown()
+            self._pool = None
+            exhausted = sorted(i for i, r in retries.items()
+                               if r > max_retries and i >= yielded
+                               and i not in done)
+            if exhausted:
+                self._note_degraded(
+                    tel, f"chunk retry budget exhausted after repeated "
+                         f"worker deaths (level {depth}, chunks "
+                         f"{exhausted})")
+                continue  # pool stays down -> the loop expands inline
+            # bounded backoff, then respawn — shrunk after repeat
+            # deaths: a box that keeps killing N workers may hold N/2
+            self._respawns += 1
+            if self._respawns > 1:
+                self._pool_size = max(1, self._pool_size // 2)
+            time.sleep(min(0.05 * (2 ** (self._respawns - 1)), 2.0))
+            tel.counter("parallel.respawns")
+            tel.gauge("parallel.pool_size", self._pool_size)
+            try:
+                self._ensure_pool()
+            except OSError as ex:
+                self._note_degraded(tel, f"pool respawn failed: {ex}")
+                continue
+            todo = [i for i in range(yielded, n)
+                    if i not in done and i not in must_inline]
+            tel.counter("parallel.requeues", len(todo))
+            for i in todo:
+                self._pool.submit((i, depth, payloads[i]))
+
     # -- the parallel search --------------------------------------------
     def _run_parallel(self) -> CheckResult:
         import multiprocessing
-        from .. import obs
+        from .. import faults, obs
+        from . import ckpt as _ckpt
         model = self.model
         vars = model.vars
         t0 = time.time()
@@ -415,78 +728,129 @@ class ParallelExplorer(Explorer):
                                prints=self.prints, truncated=truncated,
                                warnings=warnings)
 
-        # ---- initial states (serial, exactly as the serial engine) ----
-        try:
-            inits = enumerate_init(model.init, base_ctx, vars)
-        except TLCAssertFailure as ex:
-            return result(False, Violation("assert", "Init", [],
-                                           str(ex.out)))
+        # checkpoint plumbing: level-barrier (and truncation) writes in
+        # the serial engine's payload format, with the serial engine's
+        # adaptive interval stretch (write cost capped at ~5% of wall)
+        ck_state = {"every": self.checkpoint_every,
+                    "last": time.time()}
+
+        def write_checkpoint(queue, generated_at, prints_at=None):
+            payload = _ckpt.interp_payload(
+                model, vars, states, parents, labels, depth_of,
+                queue, generated_at, diameter, seen, edges,
+                collect_edges,
+                self.prints if prints_at is None
+                else self.prints[:prints_at])
+            _ckpt.write_periodic(
+                self.checkpoint_path, "interp",
+                {"module": model.module.name, "engine": "parallel"},
+                payload, tel, self.log, ck_state,
+                span_attrs={"states": len(states), "queue": len(queue)})
+
+        # ---- initial states, or resume (exactly as the serial engine) --
         frontier: List[int] = []
-        init_count = 0
-        for st in inits:
-            sid, new = add_state(st, None, "Initial predicate", 0)
-            if not new:
-                continue
-            generated += 1
-            if sid is None:
-                continue  # discarded by CONSTRAINT
-            init_count += 1
-            bad = self._check_state_preds(st)
-            if bad is not None:
-                return result(False, Violation(
-                    "invariant", bad,
-                    self._trace_to(sid, parents, states, labels)))
-            frontier.append(sid)
-        self.log(f"Finished computing initial states: {init_count} "
-                 f"distinct state{'s' if init_count != 1 else ''} "
-                 f"generated.")
+        carry: List[int] = []  # resumed queue states one level deeper
+        if self.resume_from:
+            # same loader + validations as the serial engine: integrity
+            # defects surface as CkptError (exit 2), never a traceback
+            ck = _ckpt.load_interp_checkpoint(self.resume_from, model,
+                                              vars, collect_edges)
+            self.prints.extend(ck.get("prints", []))
+            states.extend(ck["states"])
+            parents.extend(ck["parents"])
+            labels.extend(ck["labels"])
+            depth_of.extend(ck["depth_of"])
+            generated = ck["generated"]
+            diameter = ck["diameter"]
+            seen.update(ck["seen_items"])
+            if collect_edges:
+                edges.extend(ck["edges"])
+            q = list(ck["queue"])
+            if q:
+                # the queue spans at most two adjacent depths (BFS
+                # invariant): replay the depth-d prefix as this level's
+                # frontier and keep the depth-d+1 suffix AHEAD of this
+                # level's discoveries — the serial engine's exact pop
+                # order, so resumed counts stay bit-identical
+                rd = depth_of[q[0]]
+                frontier = [s for s in q if depth_of[s] == rd]
+                carry = [s for s in q if depth_of[s] != rd]
+            self.log(f"Resumed from {self.resume_from}: {len(states)} "
+                     f"distinct states, {len(q)} on queue.")
+        else:
+            try:
+                inits = enumerate_init(model.init, base_ctx, vars)
+            except TLCAssertFailure as ex:
+                return result(False, Violation("assert", "Init", [],
+                                               str(ex.out)))
+            init_count = 0
+            for st in inits:
+                sid, new = add_state(st, None, "Initial predicate", 0)
+                if not new:
+                    continue
+                generated += 1
+                if sid is None:
+                    continue  # discarded by CONSTRAINT
+                init_count += 1
+                bad = self._check_state_preds(st)
+                if bad is not None:
+                    return result(False, Violation(
+                        "invariant", bad,
+                        self._trace_to(sid, parents, states, labels)))
+                frontier.append(sid)
+            self.log(f"Finished computing initial states: {init_count} "
+                     f"distinct state{'s' if init_count != 1 else ''} "
+                     f"generated.")
 
         d0 = depth_of[frontier[0]] if frontier else 0
         self.log(f"Progress({d0}): {generated} states generated, "
                  f"{len(states)} distinct states found, "
-                 f"{len(frontier)} states left on queue.")
+                 f"{len(frontier) + len(carry)} states left on queue.")
 
         # ---- the level-synchronous pool loop ----
-        mp = multiprocessing.get_context("fork")
+        self._mp = multiprocessing.get_context("fork")
         wstate = _WorkerState(model)
         # the parent can run the worker body inline (global worker state
         # in this process too): frontiers smaller than the fan-out are
         # expanded without the per-level IPC barrier — same records, same
-        # replay, zero round-trip latency on shallow/narrow levels
+        # replay, zero round-trip latency on shallow/narrow levels.
+        # Chaos faults targeting pool workers force the pool ON so a
+        # tiny model still exercises the crash path the fault asks for.
         _init_worker(wstate)
-        inline_below = self.workers * 4
+        self._wstate = wstate
+        self._pool = None
+        self._pool_size = self.workers
+        self._respawns = 0
+        self._degraded = None
+        faults.ensure_shared_state()  # one fault budget for all forks
+        inline_below = 0 if faults.targets("worker_kill", "chunk_error") \
+            else self.workers * 4
+        max_retries = int(os.environ.get("JAXMC_PARALLEL_RETRIES", "2"))
         n_chunks_total = 0
-        pool = None
         try:
             depth = d0
-            while frontier:
+            while frontier or carry:
                 lv["depth"] = depth
-                next_frontier: List[int] = []
+                # resumed depth+1 queue states stay AHEAD of this
+                # level's discoveries (serial pop order)
+                next_frontier: List[int] = carry
+                carry = []
                 chunks = self._chunks(frontier)
                 n_chunks_total += len(chunks)
                 payloads = [[(sid,
                               tuple(states[sid][v] for v in vars))
                              for sid in c] for c in chunks]
                 remaining = len(frontier)
-                if len(frontier) < inline_below:
+                fpos = -1  # index of the merging state in frontier order
+                if self._degraded is not None or \
+                        len(frontier) < inline_below:
                     # parent-inline expansion: memo deltas are already in
                     # the parent store, so they are NOT re-merged below
                     results = (_expand_chunk(p) for p in payloads)
                     inline = True
                 else:
-                    if pool is None:
-                        # lazy fork: a model whose every level stays
-                        # under the fan-out never pays the pool at all.
-                        # Workers forked now inherit the parent's inline
-                        # wstate (its `sent` keys were all merged, so
-                        # slim repeats stay resolvable); re-baseline the
-                        # memo counters at the fork point
-                        if model._memo is not None:
-                            wstate.memo_sent = model._memo.stats()
-                        pool = mp.Pool(self.workers,
-                                       initializer=_init_worker,
-                                       initargs=(wstate,))
-                    results = pool.imap(_expand_chunk, payloads)
+                    results = self._level_results(payloads, depth, tel,
+                                                  max_retries)
                     inline = False
                 for chunk_wall, memo_delta, chunk_out in results:
                     lv["chunk_wall"] += chunk_wall
@@ -497,8 +861,14 @@ class ParallelExplorer(Explorer):
                     for (sid, n_succ, assert_msg, error_msg,
                          state_prints, recs) in chunk_out:
                         remaining -= 1
+                        fpos += 1
                         lv["frontier"] += 1
                         diameter = max(diameter, depth)
+                        # truncation-checkpoint snapshots: roll back to
+                        # this state's merge start so resume re-expands
+                        # it exactly once (the serial engine's rule)
+                        gen_at_state = generated
+                        prints_at_state = len(self.prints)
                         self.prints.extend(state_prints)
                         for rec in recs:
                             generated += 1
@@ -553,6 +923,15 @@ class ParallelExplorer(Explorer):
                                     len(states) >= self.max_states:
                                 self.log("-- state limit reached, "
                                          "search truncated")
+                                if self.checkpoint_path:
+                                    # mid-level write: the in-flight
+                                    # state re-queued at the head with
+                                    # generated/prints rolled back to
+                                    # its merge start (serial rule)
+                                    write_checkpoint(
+                                        [sid] + frontier[fpos + 1:]
+                                        + next_frontier,
+                                        gen_at_state, prints_at_state)
                                 return result(
                                     True, truncated=True,
                                     queue_len=remaining
@@ -585,10 +964,18 @@ class ParallelExplorer(Explorer):
                 flush_level(len(next_frontier))
                 frontier = next_frontier
                 depth += 1
+                # ---- level barrier: checkpoint + chaos kill site ----
+                now = time.time()
+                if self.checkpoint_path and \
+                        now - ck_state["last"] >= ck_state["every"]:
+                    ck_state["last"] = now
+                    write_checkpoint(list(frontier), generated)
+                faults.kill_self("run_kill", level=depth,
+                                 engine="parallel")
         finally:
-            if pool is not None:
-                pool.terminate()
-                pool.join()
+            if self._pool is not None:
+                self._pool.shutdown()
+                self._pool = None
             # in the finally: a truncated or violating run's early
             # return must still record its chunk count
             tel.counter("parallel.chunks", n_chunks_total)

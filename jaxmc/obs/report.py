@@ -103,6 +103,9 @@ def _from_metrics(s: Dict[str, Any], path: str, label: str
         "backend": backend or "?",
         "platform": plat_key,
         "rank": _RANK.get(plat_key, 1),
+        # a terminal device failure that completed on the CPU fallback
+        # (cli.py _demote_to_cpu); find_regressions flags its appearance
+        "demoted": s.get("gauges", {}).get("device.demoted"),
         "mode": s.get("gauges", {}).get("expand.mode"),
         "wall_s": s.get("wall_s"),
         "phases": {p["name"]: p["wall_s"] for p in s.get("phases", [])},
@@ -249,6 +252,14 @@ def find_regressions(prev: Dict[str, Any], cur: Dict[str, Any],
         flags.append(
             f"REGRESS backend demotion {step}: {prev['platform']} -> "
             f"{cur['platform']}")
+    if cur.get("demoted") and not prev.get("demoted"):
+        # the run finished (counts are exact via the CPU fallback) but
+        # the device path died mid-run — a reliability regression even
+        # when the rates happen to survive
+        flags.append(
+            f"REGRESS device demotion {step}: device backend failed "
+            f"terminally, run completed on the CPU fallback "
+            f"({cur['demoted']})")
     for name in sorted(set(prev["phases"]) & set(cur["phases"])):
         pw, cw = prev["phases"][name], cur["phases"][name]
         pd = _pct(cw, pw)

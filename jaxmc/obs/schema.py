@@ -58,6 +58,29 @@ jaxmc.metrics/2 artifact minus the new optional surface, so readers and
       `compile.persistent_cache_active`;
     - checkpoint cost: phase `checkpoint.write` (span attrs: states,
       queue) — checkpoint wall no longer hides inside `search`.
+
+  (PR 4, still jaxmc.metrics/2 — all additive/optional; the
+   fault-tolerance surface:)
+    - crash-safe parallel engine (engine/parallel.py): counters
+      `parallel.worker_deaths` / `parallel.respawns` /
+      `parallel.requeues` / `parallel.chunk_retries` /
+      `parallel.degradations`, gauges `parallel.degraded` (the reason
+      string — present ONLY when the run fell back to serial expansion
+      after exhausting its retry budget) and `parallel.pool_size`
+      (post-shrink worker count), trace events `parallel.worker_death
+      {level, pids, lost_chunks}` / `parallel.chunk_error {level,
+      chunk, error, retry}` / `parallel.degraded {reason}`;
+    - device retry/demotion (cli.py): counters `device.init_retries` /
+      `device.demotions` / `compile.retries`, gauge `device.demoted`
+      (the terminal failure reason — `python -m jaxmc.obs diff` raises
+      a REGRESS flag when it appears between runs), trace event
+      `device.demoted {reason}`, phase `search_fallback`;
+    - checkpoint integrity (engine/ckpt.py): phase
+      `checkpoint.host_snapshot` + counter `checkpoint.host_snapshots`
+      (the device path's CPU-resumable `<checkpoint>.host` snapshot);
+    - fault harness (jaxmc/faults.py): counter `faults.injected`,
+      trace event `fault.injected {site, ...ctx}` — present only when
+      JAXMC_FAULTS is set (chaos runs / `make chaos`).
 """
 
 from __future__ import annotations

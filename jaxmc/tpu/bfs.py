@@ -288,32 +288,64 @@ class TpuExplorer:
         arm_costs: Dict[str, Dict[str, int]] = {}
         zero_row = jnp.zeros((self.layout.width,), jnp.int32)
         zero_slot = jnp.zeros((), jnp.int32)
+        # transient per-arm compile failures (a flaky device link mid-
+        # lowering, injected compile_fail faults) get a bounded retry
+        # with backoff before the failure escapes to cli.py's demotion
+        # path; REAL CompileErrors are deterministic and still demote
+        # the arm to the interpreter immediately, as before
+        compile_retries = int(os.environ.get("JAXMC_COMPILE_RETRIES",
+                                             "2"))
+        from .. import faults as _faults
         for ai, arm in enumerate(self.arms):
             try:
-                # the span covers grounding + kernel build + the forced
-                # abstract trace — the per-arm compile cost the bench
-                # forensics need (BENCH_r05: nothing said whether compile
-                # or BFS ate the deadline)
-                with tel.span("compile_arm",
-                              arm=arm.label or "Next") as asp:
-                    gas = ground_arm(model, arm,
-                                     dyn_slots=self.bounds.kv_cap)
-                    cas = []
-                    for ga in gas:
-                        ca = compile_action2(self.kc, ga)
-                        if tel.enabled:
-                            # the introspection trace IS the forced
-                            # abstract trace (same lazy CompileError/
-                            # RecursionError surface as eval_shape) —
-                            # one trace per kernel either way
-                            info = introspect_kernel(
-                                ca.fn, (zero_row, zero_slot)
-                                if ca.n_slots else (zero_row,))
+                for attempt in range(compile_retries + 1):
+                    # per-ATTEMPT introspection buffer: the rollup
+                    # (arm_costs + the *_total counters) commits only
+                    # when the attempt succeeds, so a retried arm never
+                    # double-counts the kernels introspected before the
+                    # transient failure (the per-attempt span still
+                    # carries its own attrs — that is honest span data)
+                    att_costs: Dict[str, int] = {}
+                    try:
+                        # the span covers grounding + kernel build + the
+                        # forced abstract trace — the per-arm compile
+                        # cost the bench forensics need (BENCH_r05:
+                        # nothing said whether compile or BFS ate the
+                        # deadline)
+                        with tel.span("compile_arm",
+                                      arm=arm.label or "Next") as asp:
+                            _faults.inject("compile_fail",
+                                           arm=arm.label or "Next")
+                            gas = ground_arm(model, arm,
+                                             dyn_slots=self.bounds.kv_cap)
+                            cas = []
+                            for ga in gas:
+                                ca = compile_action2(self.kc, ga)
+                                if tel.enabled:
+                                    # the introspection trace IS the
+                                    # forced abstract trace (same lazy
+                                    # CompileError/RecursionError
+                                    # surface as eval_shape) — one
+                                    # trace per kernel either way
+                                    info = introspect_kernel(
+                                        ca.fn, (zero_row, zero_slot)
+                                        if ca.n_slots else (zero_row,))
+                                    for k, v in info.items():
+                                        att_costs[k] = \
+                                            att_costs.get(k, 0) + v
+                                        asp.attrs[k] = \
+                                            asp.attrs.get(k, 0) + v
+                                elif ca.n_slots:
+                                    jax.eval_shape(ca.fn, row_spec,
+                                                   slot_spec)
+                                else:
+                                    jax.eval_shape(ca.fn, row_spec)
+                                cas.append(ca)
+                        if att_costs:
                             acc = arm_costs.setdefault(
                                 arm.label or "Next", {})
-                            for k, v in info.items():
+                            for k, v in att_costs.items():
                                 acc[k] = acc.get(k, 0) + v
-                                asp.attrs[k] = asp.attrs.get(k, 0) + v
                                 tel.counter(
                                     {"jaxpr_eqns":
                                      "compile.jaxpr_eqns_total",
@@ -321,11 +353,19 @@ class TpuExplorer:
                                      "compile.hlo_flops_total",
                                      "hlo_bytes":
                                      "compile.hlo_bytes_total"}[k], v)
-                        elif ca.n_slots:
-                            jax.eval_shape(ca.fn, row_spec, slot_spec)
-                        else:
-                            jax.eval_shape(ca.fn, row_spec)
-                        cas.append(ca)
+                        break
+                    except RecursionError:
+                        raise  # deterministic (RuntimeError subclass)
+                    except (_faults.FaultInjected, OSError,
+                            RuntimeError) as ex:
+                        if attempt >= compile_retries:
+                            raise
+                        tel.counter("compile.retries")
+                        self.log(f"-- compile_arm "
+                                 f"{arm.label or 'Next'}: transient "
+                                 f"failure ({ex}); retrying "
+                                 f"({attempt + 1}/{compile_retries})")
+                        time.sleep(min(0.1 * (2 ** attempt), 2.0))
             except CompileError as e:
                 self.fb_arms.append((arm, str(e)))
                 continue
@@ -1392,43 +1432,44 @@ class TpuExplorer:
         return hashlib.sha256(desc.encode()).hexdigest()
 
     def _write_ck(self, mode: str, **state) -> None:
-        import pickle
-        import os as _os
-        payload = dict(kind="jaxmc-device-ck", version=1, mode=mode,
-                       module=self.model.module.name,
+        # checksummed + schema-versioned container (engine/ckpt.py):
+        # resume refuses truncated/corrupt/mismatched files with a
+        # one-line CkptError instead of unpickling garbage
+        from ..engine import ckpt as _ckpt
+        payload = dict(mode=mode, module=self.model.module.name,
                        vars=list(self.model.vars),
                        layout_sig=self._layout_sig(), **state)
-        tmp = self.checkpoint_path + ".tmp"
-        with open(tmp, "wb") as fh:
-            pickle.dump(payload, fh)
-        _os.replace(tmp, self.checkpoint_path)
+        try:
+            with obs.current().span("checkpoint.write", mode=mode):
+                _ckpt.write_checkpoint(
+                    self.checkpoint_path, "device",
+                    {"module": self.model.module.name, "mode": mode},
+                    payload)
+        except _ckpt.CkptError as ex:
+            # a failed periodic write must not kill the search: keep
+            # running on the previous checkpoint
+            obs.current().counter("checkpoint.write_failures")
+            self.log(f"WARNING: checkpoint write failed ({ex}); the run "
+                     f"continues on the previous checkpoint")
+            return
         self.log(f"Checkpointing run to {self.checkpoint_path}")
 
     def _load_ck(self, mode: str) -> dict:
-        import pickle
-        try:
-            with open(self.resume_from, "rb") as fh:
-                ck = pickle.load(fh)
-            if not isinstance(ck, dict) or \
-                    ck.get("kind") != "jaxmc-device-ck":
-                raise ValueError("not a jaxmc device checkpoint")
-        except (pickle.UnpicklingError, ValueError, EOFError) as ex:
-            raise ValueError(
-                f"cannot resume: {self.resume_from} is not a valid jaxmc "
-                f"device checkpoint ({ex})")
+        from ..engine.ckpt import CkptError, load_checkpoint
+        _, ck = load_checkpoint(self.resume_from, kind="device")
         if ck.get("module") != self.model.module.name or \
                 ck.get("vars") != list(self.model.vars):
-            raise ValueError(
+            raise CkptError(
                 f"cannot resume: checkpoint is for module "
                 f"{ck.get('module')!r} with variables {ck.get('vars')}, "
                 f"not {self.model.module.name!r}")
         if ck.get("mode") != mode:
-            raise ValueError(
+            raise CkptError(
                 f"cannot resume: checkpoint was written by the "
                 f"{ck.get('mode')!r} device mode, this run uses {mode!r} "
                 f"(re-run with the matching flags)")
         if ck.get("layout_sig") != self._layout_sig():
-            raise ValueError(
+            raise CkptError(
                 "cannot resume: the lane layout differs from the "
                 "checkpoint's (different --seq-cap/--grow-cap/--kv-cap "
                 "or a changed model?)")
@@ -1475,6 +1516,90 @@ class TpuExplorer:
             trace_levels=trace_levels if self.store_trace else None,
             frontier_maps=frontier_maps if self.store_trace else None,
             graph=graph, frontier_sids=frontier_sids)
+
+    def _write_host_snapshot(self, trace_levels, frontier_maps, graph,
+                             depth, generated) -> None:
+        """Demotion snapshot: an INTERP-format checkpoint (engine/ckpt.py
+        payload, `<checkpoint>.host`) rebuilt from the host-side trace
+        levels, so when the device path dies terminally the parallel CPU
+        engine resumes from the last level barrier instead of restarting
+        from scratch (cli.py owns the fallback).
+
+        Exactness: every kept state of every level is decoded and
+        re-fingerprinted with the interp's own state_fingerprint, so the
+        resumed dedup set is exact.  Constraint-DISCARDED fingerprints
+        are not reconstructible from rows the device never kept — their
+        absence is count-equivalent: the resumed engine re-generates and
+        re-discards such a state on first contact, exactly what the
+        serial engine counts.  Skipped (with one log line) when traces
+        are off (--no-trace), in resident mode (no host rows), or when
+        cfg SYMMETRY ran UNREDUCED on the device (the interp would
+        reduce, so the carried counts would not be comparable)."""
+        if not self.store_trace or not self.checkpoint_path:
+            return
+        if self.model.symmetry is not None and self.canon_fn is None:
+            if not getattr(self, "_host_snap_skip_logged", False):
+                self._host_snap_skip_logged = True
+                self.log("-- no host snapshot: SYMMETRY ran unreduced on "
+                         "the device (interp counts would differ)")
+            return
+        from ..engine import ckpt as _ckpt
+        from ..engine.explore import make_canonicalizer, state_fingerprint
+        model = self.model
+        vars = model.vars
+        canon = make_canonicalizer(model)
+        view_expr = getattr(model, "view", None)  # None on device paths
+        states: List[Dict[str, Any]] = []
+        parents: List[Optional[int]] = []
+        labels: List[str] = []
+        depth_of: List[int] = []
+        seen: Dict[Any, int] = {}
+        level_sids: List[List[int]] = []
+        for lvl, (rows, prov, par_div) in enumerate(trace_levels):
+            sids: List[int] = []
+            for ridx in frontier_maps[lvl]:
+                ridx = int(ridx)
+                st = self.layout.decode(np.asarray(rows[ridx]))
+                sid = len(states)
+                if prov is None:
+                    parents.append(None)
+                    labels.append("Initial predicate")
+                else:
+                    p = int(prov[ridx])
+                    a, pf = p // par_div, p % par_div
+                    parents.append(level_sids[lvl - 1][pf])
+                    labels.append(self.labels_flat[a])
+                states.append(st)
+                depth_of.append(lvl)
+                key = state_fingerprint(model, canon, view_expr, vars, st)
+                # an fp128 collision may have collapsed two interp-
+                # distinct states device-side; keep the first sid — the
+                # resumed run stays exact going forward
+                seen.setdefault(key, sid)
+                sids.append(sid)
+            level_sids.append(sids)
+        collect_edges = graph is not None and graph.collect_edges
+        payload = _ckpt.interp_payload(
+            model, vars, states, parents, labels, depth_of,
+            level_sids[-1] if level_sids else [], generated,
+            max(depth - 1, 0), seen,
+            graph.edges if collect_edges else None, collect_edges, [])
+        snap = self.checkpoint_path + ".host"
+        try:
+            with obs.current().span("checkpoint.host_snapshot",
+                                    states=len(states)):
+                _ckpt.write_checkpoint(
+                    snap, "interp",
+                    {"module": model.module.name,
+                     "engine": "device-snapshot"},
+                    payload)
+        except _ckpt.CkptError as ex:
+            obs.current().counter("checkpoint.write_failures")
+            self.log(f"WARNING: host snapshot write failed ({ex}); the "
+                     f"run continues on the previous snapshot")
+            return
+        obs.current().counter("checkpoint.host_snapshots")
+        self.log(f"Host snapshot (CPU-resumable) written to {snap}")
 
     def _run_resident(self) -> CheckResult:
         t0 = time.time()
@@ -1588,6 +1713,11 @@ class TpuExplorer:
                  f"{fcount} states left on queue.")
         last_progress = last_ck = time.time()
         while True:
+            # chaos sites: crash / device failure between dispatches
+            # (the only host-attention points resident mode has)
+            from .. import faults
+            faults.kill_self("run_kill", level=depth, engine="resident")
+            faults.inject("device_run_fail", level=depth)
             ck_key = (caps["SC"], caps["FCap"], caps["AccCap"],
                       caps["VC"], CH)
             fresh_compile = ck_key not in self._res_cache
@@ -1774,6 +1904,11 @@ class TpuExplorer:
         last_progress = last_ck = time.time()
         hstep = self._get_hstep(CH)
         while len(frontier_np) > 0:
+            # chaos sites: simulated hard crash / terminal device failure
+            # entering a level (no-ops unless JAXMC_FAULTS names them)
+            from .. import faults
+            faults.kill_self("run_kill", level=depth, engine="host_seen")
+            faults.inject("device_run_fail", level=depth)
             L = len(frontier_np)
             lvl_t0 = time.time()
             lvl_gen0 = generated
@@ -2035,6 +2170,8 @@ class TpuExplorer:
                     **self._ck_state_kwargs(distinct, generated, depth,
                                             trace_levels, frontier_maps,
                                             graph, frontier_sids))
+                self._write_host_snapshot(trace_levels, frontier_maps,
+                                          graph, depth, generated)
             if now - last_progress >= self.progress_every:
                 last_progress = now
                 self.log(f"Progress({depth}): {generated} generated, "
@@ -2422,6 +2559,11 @@ class TpuExplorer:
                  f"{fcount} states left on queue.")
         last_progress = last_ck = time.time()
         while fcount > 0:
+            # chaos sites (see _run_host_seen): crash / device failure
+            # entering a level
+            from .. import faults
+            faults.kill_self("run_kill", level=depth, engine="level")
+            faults.inject("device_run_fail", level=depth)
             lvl_t0 = time.time()
             C = self.A * FC
             if seen_count + C > SC:
@@ -2546,6 +2688,8 @@ class TpuExplorer:
                     **self._ck_state_kwargs(distinct, generated, depth,
                                             trace_levels, frontier_maps,
                                             graph, frontier_sids))
+                self._write_host_snapshot(trace_levels, frontier_maps,
+                                          graph, depth, generated)
             if now - last_progress >= self.progress_every:
                 last_progress = now
                 self.log(f"Progress({depth}): {generated} states generated, "

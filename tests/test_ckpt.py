@@ -1,0 +1,204 @@
+r"""engine/ckpt.py — checkpoint integrity + the CLI exit-code contract.
+
+ISSUE 4 acceptance: a truncated or checksum-corrupted checkpoint is
+rejected with a clear one-line error (exit 2), never a traceback or a
+silently-wrong resume.  Each Explorer resume defect (missing path,
+module mismatch, corruption, legacy format) has its message pinned
+here, through both the library surface (CkptError) and the CLI.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from jaxmc.engine.ckpt import (CkptError, load_checkpoint,
+                               load_interp_checkpoint, read_header,
+                               write_checkpoint)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SPECS = os.path.join(REPO, "specs")
+
+
+def _payload():
+    return {"module": "toy", "vars": ["x"], "states": [{"x": 1}],
+            "seen_items": [((1,), 0)], "numbers": list(range(100))}
+
+
+class TestContainer:
+    def test_roundtrip(self, tmp_path):
+        p = str(tmp_path / "c.ck")
+        n = write_checkpoint(p, "interp", {"module": "toy"}, _payload())
+        assert n == os.path.getsize(p)
+        header, payload = load_checkpoint(p, kind="interp")
+        assert header["kind"] == "interp"
+        assert header["meta"] == {"module": "toy"}
+        assert payload == _payload()
+        assert read_header(p)["payload_bytes"] == \
+            header["payload_bytes"]
+
+    def test_missing_file(self, tmp_path):
+        with pytest.raises(CkptError, match="no checkpoint at"):
+            load_checkpoint(str(tmp_path / "absent.ck"))
+
+    def test_truncated_rejected(self, tmp_path):
+        p = str(tmp_path / "c.ck")
+        write_checkpoint(p, "interp", {}, _payload())
+        size = os.path.getsize(p)
+        with open(p, "r+b") as fh:
+            fh.truncate(size - size // 3)
+        with pytest.raises(CkptError, match="truncated"):
+            load_checkpoint(p)
+
+    def test_bitflip_rejected(self, tmp_path):
+        p = str(tmp_path / "c.ck")
+        write_checkpoint(p, "interp", {}, _payload())
+        size = os.path.getsize(p)
+        with open(p, "r+b") as fh:
+            fh.seek(size - 10)
+            b = fh.read(1)
+            fh.seek(-1, os.SEEK_CUR)
+            fh.write(bytes([b[0] ^ 0xFF]))
+        with pytest.raises(CkptError, match="integrity check"):
+            load_checkpoint(p)
+
+    def test_garbage_rejected(self, tmp_path):
+        p = str(tmp_path / "c.ck")
+        with open(p, "wb") as fh:
+            fh.write(b"this is not a checkpoint at all" * 4)
+        with pytest.raises(CkptError, match="not a jaxmc checkpoint"):
+            load_checkpoint(p)
+
+    def test_legacy_raw_pickle_rejected(self, tmp_path):
+        # pre-ISSUE-4 checkpoints were bare pickles: refuse with a
+        # version message, don't unpickle blind
+        import pickle
+        p = str(tmp_path / "old.ck")
+        with open(p, "wb") as fh:
+            pickle.dump({"states": [], "seen_items": []}, fh)
+        with pytest.raises(CkptError, match="not a jaxmc checkpoint"):
+            load_checkpoint(p)
+
+    def test_kind_mismatch_rejected(self, tmp_path):
+        p = str(tmp_path / "c.ck")
+        write_checkpoint(p, "device", {}, _payload())
+        with pytest.raises(CkptError,
+                           match="'device' engine, this run expects "
+                                 "'interp'"):
+            load_checkpoint(p, kind="interp")
+
+    def test_atomic_write_keeps_previous_on_damage(self, tmp_path):
+        # the tmp+rename protocol: a second write that fails must not
+        # destroy the first checkpoint
+        p = str(tmp_path / "c.ck")
+        write_checkpoint(p, "interp", {}, {"v": 1})
+        _, payload = load_checkpoint(p)
+        assert payload == {"v": 1}
+        class Unpicklable:
+            def __reduce__(self):
+                raise RuntimeError("nope")
+        with pytest.raises(Exception):
+            write_checkpoint(p, "interp", {}, {"v": Unpicklable()})
+        _, payload = load_checkpoint(p)
+        assert payload == {"v": 1}  # previous checkpoint intact
+
+
+class TestNonFatalPeriodicWrites:
+    def test_failed_checkpoint_write_does_not_kill_the_search(self):
+        # the write-side contract: disk trouble mid-run logs a warning
+        # and keeps searching on the previous checkpoint — a robustness
+        # PR must never ADD a way to lose hours of progress
+        from jaxmc import obs
+        from jaxmc.front.cfg import parse_cfg
+        from jaxmc.sem.modules import Loader, bind_model
+        from jaxmc.engine.explore import Explorer
+        with open(os.path.join(SPECS, "constoy.cfg")) as fh:
+            cfg = parse_cfg(fh.read())
+        m = bind_model(
+            Loader([SPECS]).load_path(os.path.join(SPECS,
+                                                   "constoy.tla")), cfg)
+        tel = obs.Telemetry()
+        logs = []
+        with obs.use(tel):
+            r = Explorer(m, log=logs.append,
+                         checkpoint_path="/nonexistent-dir/x/ck.bin",
+                         checkpoint_every=0.0).run()
+        assert r.ok and (r.generated, r.distinct) == (43, 21)
+        assert any("checkpoint write failed" in l for l in logs)
+        assert tel.counters.get("checkpoint.write_failures", 0) > 0
+
+
+class TestExplorerResumeContract:
+    """Satellite: Explorer resume errors route through the CLI as exit
+    2 with a one-line remedy — path, module mismatch, corruption."""
+
+    def _write_ck(self, tmp_path, quiet=True):
+        ck = str(tmp_path / "run.ck")
+        r = subprocess.run(
+            [sys.executable, "-m", "jaxmc", "check",
+             os.path.join(SPECS, "constoy.tla"), "--max-states", "10",
+             "--checkpoint", ck, "--checkpoint-every", "0", "--quiet"],
+            capture_output=True, text=True, cwd=REPO,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        assert r.returncode == 0, r.stderr
+        assert os.path.exists(ck)
+        return ck
+
+    def _resume(self, spec, ck):
+        return subprocess.run(
+            [sys.executable, "-m", "jaxmc", "check",
+             os.path.join(SPECS, spec), "--resume", ck, "--quiet"],
+            capture_output=True, text=True, cwd=REPO,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+
+    def test_missing_path_exit_2(self, tmp_path):
+        r = self._resume("constoy.tla", str(tmp_path / "nope.ck"))
+        assert r.returncode == 2
+        assert "no checkpoint at" in r.stderr
+        assert "Traceback" not in r.stderr
+        assert r.stderr.count("\n") <= 2  # one actionable line
+
+    def test_module_mismatch_exit_2(self, tmp_path):
+        ck = self._write_ck(tmp_path)
+        r = self._resume("viewtoy.tla", ck)
+        assert r.returncode == 2
+        assert "is for module 'constoy'" in r.stderr
+        assert "not 'viewtoy'" in r.stderr
+        assert "Traceback" not in r.stderr
+
+    def test_corruption_exit_2(self, tmp_path):
+        ck = self._write_ck(tmp_path)
+        size = os.path.getsize(ck)
+        with open(ck, "r+b") as fh:
+            fh.truncate(size // 2)
+        r = self._resume("constoy.tla", ck)
+        assert r.returncode == 2
+        assert "truncated" in r.stderr
+        assert "Traceback" not in r.stderr
+
+    def test_checksum_corruption_exit_2(self, tmp_path):
+        ck = self._write_ck(tmp_path)
+        size = os.path.getsize(ck)
+        with open(ck, "r+b") as fh:
+            fh.seek(size - 8)
+            fh.write(b"\x00" * 8)
+        r = self._resume("constoy.tla", ck)
+        assert r.returncode == 2
+        assert "integrity check" in r.stderr
+        assert "Traceback" not in r.stderr
+
+    def test_library_surface_module_mismatch(self, tmp_path):
+        from jaxmc.front.cfg import parse_cfg
+        from jaxmc.sem.modules import Loader, bind_model
+        with open(os.path.join(SPECS, "viewtoy.cfg")) as fh:
+            cfg = parse_cfg(fh.read())
+        model = bind_model(
+            Loader([SPECS]).load_path(os.path.join(SPECS, "viewtoy.tla")),
+            cfg)
+        ck = str(tmp_path / "other.ck")
+        write_checkpoint(ck, "interp", {}, {
+            "module": "constoy", "vars": ["a", "b"], "states": [],
+            "seen_items": []})
+        with pytest.raises(CkptError, match="is for module 'constoy'"):
+            load_interp_checkpoint(ck, model, model.vars, False)

@@ -1,0 +1,126 @@
+r"""jaxmc/faults.py — the deterministic fault-injection registry.
+
+Fast unit coverage (tier-1): grammar, context matchers, the
+cross-process `n=` budget, file corruption, and the inject/raise path.
+The end-to-end chaos scenarios (killed workers, corrupted checkpoints,
+device demotion) live in tests/test_chaos.py.
+"""
+
+import os
+
+import pytest
+
+from jaxmc import faults
+from jaxmc.faults import FaultInjected, parse_faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry(monkeypatch, tmp_path):
+    monkeypatch.delenv("JAXMC_FAULTS", raising=False)
+    monkeypatch.setenv("JAXMC_FAULTS_STATE", str(tmp_path / "state"))
+    os.makedirs(str(tmp_path / "state"), exist_ok=True)
+    faults._CACHE = None
+    yield
+    faults._CACHE = None
+
+
+def test_parse_grammar():
+    specs = parse_faults(
+        "worker_kill:level=2,chunk_error:p=0.5:n=3, ckpt_corrupt ,"
+        "device_init_fail:n=2:mode=flip")
+    assert [s.site for s in specs] == [
+        "worker_kill", "chunk_error", "ckpt_corrupt", "device_init_fail"]
+    assert specs[0].match == {"level": "2"}
+    assert specs[1].n == 3
+    assert specs[2].n == 1  # default: fire once
+    assert specs[3].mode == "flip"
+
+
+def test_parse_malformed_entries_skipped():
+    assert parse_faults(",,:,=x,") == [] or \
+        all(s.site for s in parse_faults(",,:,=x,"))
+    assert parse_faults("") == []
+
+
+def test_inactive_without_env():
+    assert not faults.active()
+    assert faults.fire("worker_kill", level=2) is None
+
+
+def test_context_matcher_and_budget(monkeypatch):
+    monkeypatch.setenv("JAXMC_FAULTS", "chunk_error:level=3:n=2")
+    assert faults.fire("chunk_error", level=1) is None  # wrong level
+    assert faults.fire("other_site", level=3) is None   # wrong site
+    assert faults.fire("chunk_error", level=3) is not None
+    assert faults.fire("chunk_error", level=3) is not None
+    assert faults.fire("chunk_error", level=3) is None  # budget spent
+
+
+def test_matcher_on_missing_ctx_key_never_fires(monkeypatch):
+    # a typo'd matcher must DISABLE the fault, not fire it everywhere
+    monkeypatch.setenv("JAXMC_FAULTS", "chunk_error:levle=3")
+    assert faults.fire("chunk_error", level=3) is None
+
+
+def test_targets(monkeypatch):
+    monkeypatch.setenv("JAXMC_FAULTS", "worker_kill:level=2")
+    assert faults.targets("worker_kill", "chunk_error")
+    assert not faults.targets("ckpt_corrupt")
+
+
+def test_inject_raises_and_counts(monkeypatch):
+    from jaxmc import obs
+    monkeypatch.setenv("JAXMC_FAULTS", "device_init_fail")
+    tel = obs.Telemetry()
+    with obs.use(tel):
+        with pytest.raises(FaultInjected, match="device_init_fail"):
+            faults.inject("device_init_fail")
+        faults.inject("device_init_fail")  # budget spent: no raise
+    assert tel.counters.get("faults.injected") == 1
+
+
+def test_corrupt_file_truncates(monkeypatch, tmp_path):
+    monkeypatch.setenv("JAXMC_FAULTS", "ckpt_corrupt")
+    p = str(tmp_path / "f.bin")
+    with open(p, "wb") as fh:
+        fh.write(b"x" * 1000)
+    assert faults.corrupt_file("ckpt_corrupt", p)
+    assert os.path.getsize(p) == 500
+    # budget spent: the second write survives
+    with open(p, "wb") as fh:
+        fh.write(b"y" * 1000)
+    assert not faults.corrupt_file("ckpt_corrupt", p)
+    assert os.path.getsize(p) == 1000
+
+
+def test_corrupt_file_flip_mode(monkeypatch, tmp_path):
+    monkeypatch.setenv("JAXMC_FAULTS", "ckpt_corrupt:mode=flip")
+    p = str(tmp_path / "f.bin")
+    payload = b"a" * 1000
+    with open(p, "wb") as fh:
+        fh.write(payload)
+    assert faults.corrupt_file("ckpt_corrupt", p)
+    assert os.path.getsize(p) == 1000  # same size ...
+    with open(p, "rb") as fh:
+        assert fh.read() != payload    # ... different content
+
+
+def test_budget_shared_across_forks(monkeypatch):
+    # the n=1 budget must be spent ONCE across parent + forked children
+    # (the parallel engine's respawned workers share it the same way)
+    monkeypatch.setenv("JAXMC_FAULTS", "chunk_error")
+    faults.ensure_shared_state()
+    import multiprocessing
+    ctx = multiprocessing.get_context("fork")
+    q = ctx.Queue()
+
+    def child(q):
+        q.put(faults.fire("chunk_error") is not None)
+
+    procs = [ctx.Process(target=child, args=(q,)) for _ in range(4)]
+    for p in procs:
+        p.start()
+    fired = [q.get(timeout=10) for _ in procs]
+    for p in procs:
+        p.join(5)
+    assert sum(fired) == 1, fired
