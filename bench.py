@@ -19,6 +19,18 @@ literature-sourced, NOT measured — clearly labeled there). Backend
 count-equivalence for the bench model is pinned in
 tests/test_kernel2.py::test_raft_3s_bench_whole_run_equivalence.
 
+Since ISSUE 5 the full rung measures STEADY-STATE throughput: the timed
+run resumes a warm checkpoint (committed artifact, a previous round's
+probe-dir copy, or self-provisioned in-child — see _warm_start), so XLA
+compile, capacity training and the BFS ramp sit OUTSIDE the measured
+window; the compile wall is reported separately in the phases and the
+orchestration block's compile_excluded_from_window rollup.  Every child
+also enables the GUARDED persistent compile cache by default
+(jaxmc/compile/cache.py) — repeat compiles across children and rounds
+are disk hits, and a wedged cache degrades to cold compilation.
+`make bench-warm` (JAXMC_BENCH_CHILD=warmgen) regenerates the warm
+artifacts offline.
+
 Constitutionally unable to produce nothing (VERDICT r3 #1): everything
 races in parallel against a hard internal deadline
 (JAXMC_BENCH_DEADLINE seconds, default 480):
@@ -95,6 +107,30 @@ _MODEL_DELTAS = {
 }
 _RUNG_CFG = {"full": CFG_FULL, "quick": CFG_QUICK}
 
+# ---- steady-state warm start (ISSUE 5) ----------------------------------
+# The full rung measures STEADY-STATE expansion only: the timed run
+# RESUMES a warm checkpoint (first ~WARM_STATES distinct states, resident
+# device format via engine/ckpt.py) so XLA compile, capacity-bucket
+# training and the BFS ramp all happen before the measured window opens.
+# Source priority for the warm checkpoint:
+#   1. JAXMC_BENCH_WARM_CKPT / the committed repo artifact (make
+#      bench-warm regenerates it);
+#   2. the probe-dir copy left by a previous bench round on this box;
+#   3. self-provisioned inside the child: full warm-up pass (compiles +
+#      trains caps exactly like the r02 flow), then a cheap prefix
+#      replay writes the checkpoint the timed run resumes.
+# A stale checkpoint (changed lane layout, different jaxmc build) is
+# REFUSED by the integrity checks and the child falls back to
+# self-provisioning — the warm start can never corrupt the measurement.
+WARM_STATES = int(os.environ.get("JAXMC_BENCH_WARM_STATES", "20000"))
+_WARM_CK_COMMITTED = os.environ.get(
+    "JAXMC_BENCH_WARM_CKPT", os.path.join(_REPO, "ck_mcraft3s_bench_warm.ck"))
+# steady-state lane capacities for the bench model (max-merged over the
+# platform defaults in tpu/bfs.py): every cap growth is a full XLA
+# recompile, so the warm-up compile should cover the whole run
+_BENCH_RES_CAPS = {"SC": 1 << 18, "FCap": 1 << 16,
+                   "AccCap": 1 << 17, "VC": 1 << 13}
+
 _DEADLINE = None  # absolute time.time() deadline, set in main()
 _PROBE_SKIPPED = False  # verify probe skipped on a DOWN oracle verdict
 
@@ -108,6 +144,84 @@ def _remaining():
 
 
 # ---------------------------------------------------------------- children
+
+def _warm_start(tel, ex):
+    """Point `ex` at a warm checkpoint so its NEXT run() measures
+    steady-state expansion only.  Tries the committed artifact, then the
+    probe-dir copy from a previous round, then self-provisions (full
+    warm-up pass + a cheap prefix replay that writes the checkpoint).
+    Returns (steady, r_warm): `steady` is the bookkeeping dict, or None
+    when every path failed — the caller falls back to the r02 two-pass
+    replay flow; `r_warm` is the completed full warm-up pass when the
+    self-provision path ran one (the caller must NOT re-warm — a third
+    full pass is exactly the deadline-blowout class this layer kills).
+    NOTHING here may corrupt the measurement: a stale/foreign checkpoint
+    is refused by the engine/ckpt.py integrity + layout checks and we
+    move down the ladder."""
+    from jaxmc.engine.ckpt import load_checkpoint
+    scratch = os.path.join(_PROBE_DIR, "jaxmc_bench_warm_full.ck")
+    for src, path in (("committed", _WARM_CK_COMMITTED),
+                      ("probe-dir", scratch)):
+        if not os.path.exists(path) or not os.path.getsize(path):
+            continue
+        try:
+            _, ck = load_checkpoint(path, kind="device")
+            ex.resume_from = path
+            # any bound <= the checkpoint's distinct truncates right
+            # after the first resumed level: the warm-up compiles the
+            # program (at the checkpoint's trained caps) and touches the
+            # device, then stops
+            ex.max_states = max(1, int(ck["distinct"]))
+            with tel.span("warmup_run", warm_source=src):
+                rw = ex.run()
+            ex.max_states = None
+            assert rw.ok, "warm-up resume failed"
+            _log(f"warm start: resuming {src} checkpoint {path} "
+                 f"({ck['distinct']} distinct, depth {ck['depth']})")
+            return {"source": src, "path": path,
+                    "resumed_generated": int(ck["generated"]),
+                    "resumed_distinct": int(ck["distinct"]),
+                    "resumed_depth": int(ck["depth"])}, None
+        except Exception as e:  # noqa: BLE001 — degrade, never corrupt
+            _log(f"warm checkpoint {path} unusable ({e}); trying the "
+                 f"next warm source")
+            ex.resume_from = None
+            ex.max_states = None
+    # self-provision: the r02 flow's warm-up pass (compiles + trains the
+    # capacity buckets), then a prefix replay through the already-jitted
+    # program writes the checkpoint the timed run resumes — so even a
+    # cold box pays ~1.3 full passes instead of 2, and the NEXT round
+    # finds the checkpoint in the probe dir
+    rw = None
+    try:
+        with tel.span("warmup_run", warm_source="self-provision"):
+            rw = ex.run()
+        assert rw.ok, "bench workload must pass"
+        with tel.span("warm_ckpt_build", warm_states=WARM_STATES):
+            ex.max_states = WARM_STATES
+            ex.checkpoint_path = scratch
+            ex.checkpoint_every = 1e9  # the truncation write only
+            rp = ex.run()
+            ex.max_states = None
+            assert rp.truncated, "prefix replay should truncate"
+        _, ck = load_checkpoint(scratch, kind="device")
+        ex.resume_from = scratch
+        _log(f"warm start: self-provisioned checkpoint at {scratch} "
+             f"({ck['distinct']} distinct, depth {ck['depth']})")
+        return {"source": "self-provisioned", "path": scratch,
+                "resumed_generated": int(ck["generated"]),
+                "resumed_distinct": int(ck["distinct"]),
+                "resumed_depth": int(ck["depth"])}, rw
+    except Exception as e:  # noqa: BLE001
+        # hand back the COMPLETED warm-up pass (when one ran): the
+        # two-pass fallback must reuse it, never pay a third full pass
+        _log(f"warm-start self-provision failed ({e}); falling back to "
+             f"the two-pass replay flow")
+        ex.resume_from = None
+        ex.max_states = None
+        ex.checkpoint_path = None
+        return None, (rw if rw is not None and rw.ok else None)
+
 
 def child_bench(platform_pin: str, rung: str):
     """The measured bench body. Runs in a child process with the platform
@@ -124,15 +238,16 @@ def child_bench(platform_pin: str, rung: str):
         # must fail this child loudly (parent falls back), never silently
         # measure on CPU while claiming the TPU slot
         jax.config.update("jax_platforms", platform_pin)
-        # persistent XLA compile cache (parent sets JAXMC_COMPILE_CACHE
-        # for every child): the SECOND child compiling the same arms hits
-        # disk instead of re-paying the XLA bill that has been eating the
-        # bench deadline since r03 — hits land in the line's counters
-        from jaxmc.compile.cache import enable_persistent_cache
+        # persistent XLA compile cache, ON BY DEFAULT and GUARDED
+        # (ISSUE 5): the SECOND child compiling the same arms hits disk
+        # instead of re-paying the XLA bill that has been eating the
+        # bench deadline since r03 — and a wedged/corrupt/foreign cache
+        # degrades to cold compilation instead of hanging the child.
+        from jaxmc.compile.cache import enable_guarded_cache
         # tel passed explicitly: obs.use(tel) is entered further down,
         # so obs.current() here would be the no-op NullTelemetry and the
         # cache-dir/entries_start gauges would vanish from the artifact
-        cache_dir = enable_persistent_cache(tel=tel)
+        cache_dir = enable_guarded_cache(tel=tel)
         devs = jax.devices()
     assert devs[0].platform == platform_pin, \
         f"pinned {platform_pin} but got {devs[0].platform}"
@@ -152,9 +267,15 @@ def child_bench(platform_pin: str, rung: str):
 
     # resident device mode: the whole BFS (frontier, fingerprint set,
     # level loop) runs inside one jitted while_loop on the accelerator —
-    # the tunnel's ~160ms round-trip would otherwise dominate. The
-    # warm-up run compiles the jit cache AND trains the capacity buckets,
-    # so the timed run replays with zero recompiles.
+    # the tunnel's ~160ms round-trip would otherwise dominate.
+    #
+    # STEADY-STATE measurement (ISSUE 5, full rung): the timed run
+    # RESUMES a warm checkpoint, so XLA compile, capacity training and
+    # the BFS ramp are all OUTSIDE the measured window — the states/sec
+    # line covers steady-state expansion only, and the compile wall is
+    # reported separately (phases here + the parent's orchestration
+    # block). The quick rung keeps the r02 two-pass replay flow (its
+    # model is seconds-small; a warm layer would measure noise).
     #
     # Child-side phase breakdown: the spans ride the JSON line out, so
     # the artifact of record says how the child's own wall time split
@@ -164,17 +285,40 @@ def child_bench(platform_pin: str, rung: str):
     with obs.use(tel):
         with tel.span("engine_build"):
             ex = TpuExplorer(load_model(), store_trace=False,
-                             resident=True)
-        with tel.span("warmup_run"):
-            r_warm = ex.run()
-        assert r_warm.ok, "bench workload must pass"
-        tel.reset_levels("timed run replay")
+                             resident=True,
+                             res_caps=_BENCH_RES_CAPS
+                             if rung == "full" else None)
+        steady, r_warm = (_warm_start(tel, ex) if rung == "full"
+                          else (None, None))
+        if steady is None and r_warm is None:
+            with tel.span("warmup_run"):
+                r_warm = ex.run()
+            assert r_warm.ok, "bench workload must pass"
+        tel.reset_levels("timed run")
         t0 = time.time()
         with tel.span("timed_run"):
             r = ex.run()
         jax_wall = time.time() - t0
-        assert r.ok and r.distinct == r_warm.distinct
-        jax_rate = r.generated / jax_wall
+        assert r.ok and not r.truncated
+        if steady is None:
+            assert r.distinct == r_warm.distinct
+            window_gen = r.generated
+        else:
+            window_gen = r.generated - steady["resumed_generated"]
+            # the resumed totals must be EXACTLY the cold-run totals —
+            # the warm start must never shift the measured workload
+            from jaxmc.corpus import case_for_cfg
+            pin = case_for_cfg(os.path.basename(cfg_path))
+            if pin is not None and pin.distinct is not None:
+                assert (r.distinct, r.generated) == \
+                    (pin.distinct, pin.generated), \
+                    (f"warm resume produced {r.distinct}/{r.generated}, "
+                     f"manifest pins {pin.distinct}/{pin.generated}")
+        jax_rate = window_gen / jax_wall
+        # cap growths recompile INSIDE the window — report them (zero
+        # when the warm start did its job)
+        window_recompiles = sum(1 for lrec in tel.levels
+                                if lrec.get("fresh_compile"))
 
         # interpreter baseline on a capped prefix of the same workload
         # (the interp rate is flat in search depth; full run measured at
@@ -186,6 +330,16 @@ def child_bench(platform_pin: str, rung: str):
         record_entries_end(cache_dir)
 
     wd.stop()
+    window_note = (
+        f"STEADY-STATE window: resumed warm checkpoint "
+        f"({steady['source']}) at depth {steady['resumed_depth']}/"
+        f"{steady['resumed_distinct']} distinct; the value covers the "
+        f"{window_gen} states generated AFTER resume; XLA compile + "
+        f"warm-up wall excluded (reported in phases/orchestration); "
+        f"{window_recompiles} in-window recompiles"
+        if steady is not None else
+        "replay window: full-space re-run after an identical warm-up "
+        "pass (compile excluded via the jit cache)")
     out = {
         "phases": tel.phase_list(),
         "counters": dict(tel.counters),
@@ -195,6 +349,7 @@ def child_bench(platform_pin: str, rung: str):
             f"{os.path.basename(cfg_path)}: "
             f"{r.generated} generated / {r.distinct} distinct, COMPLETED, "
             f"platform={devs[0].platform}, device-resident BFS); "
+            f"{window_note}; "
             f"model deltas: {_MODEL_DELTAS[rung]}; "
             f"vs_baseline = speedup over the exact Python interpreter on "
             f"the same model (capped at {INTERP_CAP} distinct); "
@@ -206,6 +361,11 @@ def child_bench(platform_pin: str, rung: str):
         "vs_baseline": round(jax_rate / interp_rate, 3),
         "vs_tlc_estimate": round(jax_rate / TLC_EST_STATES_PER_SEC, 3),
     }
+    if steady is not None:
+        out["steady_state"] = dict(steady,
+                                   window_generated=window_gen,
+                                   window_wall_s=round(jax_wall, 3),
+                                   window_recompiles=window_recompiles)
     print(json.dumps(out), flush=True)
 
 
@@ -286,6 +446,96 @@ def child_emergency():
         "vs_tlc_estimate": round(rate / TLC_EST_STATES_PER_SEC, 3),
     }
     print(json.dumps(out), flush=True)
+
+
+def child_warmgen():
+    """`make bench-warm` (JAXMC_BENCH_CHILD=warmgen): (re)generate the
+    resumable warm artifacts, deadline-free.
+
+    1. ck_mcraft3s_bench_warm.ck — resident-format warm checkpoint of
+       the MCraft_3s_bench rung: a full caps-training pass first (so the
+       checkpoint records the run's FINAL lane capacities and a resumed
+       bench compiles exactly once), then a cheap prefix replay through
+       the already-jitted program writes the first ~WARM_STATES distinct
+       states at a level boundary.  Every future full-rung bench child
+       resumes this file; commit it when the box can build it.
+    2. ck_mcraft3s.ck — a genuinely RESUMABLE interp-format checkpoint
+       of the BASELINE model of record (MCraft_3s — never explored to
+       completion anywhere, VERDICT r5 #2), replacing the stale
+       round-3 stub.  Continue it with:
+         python -m jaxmc check specs/MCraft.tla --cfg specs/MCraft_3s.cfg \
+             -I /root/reference/examples --resume ck_mcraft3s.ck \
+             --checkpoint ck_mcraft3s.ck
+    """
+    import jax
+    jax.config.update("jax_platforms",
+                      os.environ.get("JAXMC_PLATFORM", "cpu"))
+    tel = obs.Telemetry()
+    with obs.use(tel):
+        from jaxmc.compile.cache import enable_guarded_cache
+        enable_guarded_cache(tel=tel)
+        from jaxmc.sem.modules import Loader, bind_model
+        from jaxmc.front.cfg import parse_cfg
+        from jaxmc.tpu.bfs import TpuExplorer
+        from jaxmc.engine.explore import Explorer
+
+        def load(spec, cfg_path):
+            ldr = Loader([os.path.join(_REPO, "specs"),
+                          "/root/reference/examples"])
+            with open(cfg_path) as fh:
+                return bind_model(ldr.load_path(spec),
+                                  parse_cfg(fh.read()))
+
+        _log("bench-warm 1/2: MCraft_3s_bench resident warm checkpoint "
+             "(full caps-training pass, then the prefix replay)")
+        with tel.span("warmgen_bench"):
+            ex = TpuExplorer(load(SPEC, CFG_FULL), store_trace=False,
+                             resident=True, res_caps=_BENCH_RES_CAPS)
+            r = ex.run()
+            assert r.ok and not r.truncated, "bench workload must pass"
+            ex.max_states = WARM_STATES
+            ex.checkpoint_path = _WARM_CK_COMMITTED
+            ex.checkpoint_every = 1e9  # the truncation write only
+            rp = ex.run()
+            assert rp.truncated, "prefix replay should truncate"
+        _log(f"wrote {_WARM_CK_COMMITTED} ({rp.distinct} distinct, "
+             f"depth {rp.diameter}; full run: {r.generated} generated / "
+             f"{r.distinct} distinct)")
+
+        _log("bench-warm 2/2: MCraft_3s model-of-record interp "
+             "checkpoint (resumable; replaces the stale stub)")
+        ck3s = os.path.join(_REPO, "ck_mcraft3s.ck")
+        n = int(os.environ.get("JAXMC_WARM_3S_STATES", "20000"))
+        with tel.span("warmgen_3s", max_states=n):
+            model = load(os.path.join(_REPO, "specs", "MCraft.tla"),
+                         os.path.join(_REPO, "specs", "MCraft_3s.cfg"))
+            kw = dict(max_states=n, checkpoint_path=ck3s,
+                      checkpoint_every=1e9)
+            if os.path.exists(ck3s):
+                # already partially explored: EXTEND the run by another
+                # n distinct states instead of restarting — repeated
+                # bench-warm invocations walk the model of record
+                # toward completion
+                try:
+                    from jaxmc.engine.ckpt import load_checkpoint
+                    _, ckp = load_checkpoint(ck3s, kind="interp")
+                    kw["max_states"] = len(ckp["states"]) + n
+                    r3 = Explorer(model, resume_from=ck3s, **kw).run()
+                except Exception as e:  # noqa: BLE001 — stale stub
+                    _log(f"existing {ck3s} not resumable ({e}); "
+                         f"regenerating from scratch")
+                    kw["max_states"] = n
+                    r3 = Explorer(model, **kw).run()
+            else:
+                r3 = Explorer(model, **kw).run()
+        _log(f"wrote {ck3s} ({r3.distinct} distinct / {r3.generated} "
+             f"generated, truncated={r3.truncated})")
+    print(json.dumps({"metric": "bench-warm artifacts written",
+                      "bench_warm_ckpt": _WARM_CK_COMMITTED,
+                      "bench_warm_distinct": rp.distinct,
+                      "mcraft3s_ckpt": ck3s,
+                      "mcraft3s_distinct": r3.distinct,
+                      "phases": tel.phase_list()}), flush=True)
 
 
 # ------------------------------------------------------------------ parent
@@ -587,18 +837,26 @@ def main():
     if pin == "emergency":
         child_emergency()
         return
+    if pin == "warmgen":
+        child_warmgen()
+        return
     if pin:
         child_bench(pin, os.environ.get("JAXMC_BENCH_RUNG", "full"))
         return
 
     budget = float(os.environ.get("JAXMC_BENCH_DEADLINE", "480"))
     _DEADLINE = time.time() + budget
-    # every device child shares one persistent XLA compile cache (same
-    # box, same build — the cross-build reload hazard in tests/conftest
-    # does not apply): the quick rung's compiles prepay the full rung's,
-    # and the NEXT bench round starts warm
-    os.environ.setdefault("JAXMC_COMPILE_CACHE",
-                          os.path.join(_PROBE_DIR, "jaxmc_xla_cache"))
+    # every device child shares one GUARDED persistent XLA compile cache
+    # (children call enable_guarded_cache, defaulting to
+    # cache.default_cache_dir() — derived from JAXMC_PROBE_DIR like the
+    # probe artifacts): the quick rung's compiles prepay the full
+    # rung's, and the NEXT bench round starts warm. Stamp the resolved
+    # dir into the env so the orchestration block discloses ONE path and
+    # the children agree with it.
+    from jaxmc.compile.cache import cache_disabled_by_env, \
+        default_cache_dir
+    if not cache_disabled_by_env():
+        os.environ.setdefault("JAXMC_COMPILE_CACHE", default_cache_dir())
     _TEL = obs.Telemetry(meta={"command": "bench",
                                "deadline_s": budget})
     # NO parent watchdog: the parent's only telemetry is one child:* span
@@ -667,6 +925,17 @@ def main():
     _log(f"emitting {key[0]}/{key[1]} line")
     try:
         rec = json.loads(line)
+        # compile wall OUTSIDE the measured window, rolled up from the
+        # winning child's own phase spans (ISSUE 5): the steady-state
+        # states/sec claim and the one-time compile cost are SEPARATE
+        # numbers in the artifact of record
+        excl = {p["name"]: p["wall_s"] for p in rec.get("phases", [])
+                if p.get("name") in ("device_init", "engine_build",
+                                     "warmup_run", "warm_ckpt_build",
+                                     "interp_baseline")}
+        if excl:
+            orch["compile_excluded_from_window"] = {
+                "phases": excl, "total_s": round(sum(excl.values()), 1)}
         rec["orchestration"] = orch
         line = json.dumps(rec)
     except ValueError:

@@ -126,10 +126,15 @@ def _device_init(args, tel) -> str:
                     jax.config.update("jax_platforms", platform)
                 # persistent XLA compile cache (repeat runs skip the
                 # per-arm compiles): opt-in via --compile-cache /
-                # JAXMC_COMPILE_CACHE
-                from .compile.cache import enable_persistent_cache
-                cache_dir = enable_persistent_cache(
-                    getattr(args, "compile_cache", None))
+                # JAXMC_COMPILE_CACHE, but GUARDED (ISSUE 5): a wedged,
+                # corrupt or foreign-build cache degrades to cold
+                # compilation instead of hanging the run
+                from .compile.cache import (cache_dir_from_env,
+                                            enable_guarded_cache)
+                _cache_req = getattr(args, "compile_cache", None) \
+                    or cache_dir_from_env()
+                cache_dir = enable_guarded_cache(_cache_req, tel=tel) \
+                    if _cache_req else None
                 if tel.enabled:
                     # force plugin/device init inside the span so a hung
                     # tunnel is attributed to device_init, not compile

@@ -11,22 +11,96 @@ and exposes its effectiveness as obs counters:
                                     '/jax/compilation_cache/cache_hits')
   gauge compile.persistent_cache_dir
   gauge compile.persistent_cache_entries_start / _end
+  gauge compile.persistent_cache_guard   ("ok[...]" | "cold-fallback:..")
+  counter compile.persistent_cache_fallbacks / _quarantines
 
-Opt-in only (env JAXMC_COMPILE_CACHE=<dir> or cli --compile-cache):
-XLA:CPU blob reloads written by a DIFFERENT machine/build have been
-observed to hang (tests/conftest.py), so nothing enables it implicitly —
-bench.py opts its children in because they share one box and build.
+Two entry points:
+
+  enable_persistent_cache  the RAW enabler (PR 3).  Opt-in only: point
+                           it at a dir and it trusts the dir.
+  enable_guarded_cache     the DEFAULT for bench.py children and sweep
+                           subprocesses (ISSUE 5).  Same cache, wrapped
+                           in the guard battery below, because XLA:CPU
+                           blob reloads written by a DIFFERENT
+                           machine/build have been observed to HANG
+                           (tests/conftest.py) — a shared default cache
+                           must never be able to wedge a run.
+
+The guard battery (every step fails COLD, never broken — a cache
+problem degrades to cold compilation, it cannot fail or hang the run):
+
+  1. flock scope: every user holds a SHARED flock on `<dir>.lock` for
+     the life of the process; quarantining (steps 2/4) requires a
+     NON-BLOCKING EXCLUSIVE upgrade.  If another live process holds the
+     lock, the guard skips the quarantine and falls back cold for this
+     process only — it never yanks a directory under a reader.
+  2. build fingerprint: `<dir>/jaxmc.cache.meta.json` records
+     {python, jax, machine}.  A mismatch is exactly the cross-build
+     reload-hang class — the whole dir is quarantined (renamed aside to
+     `<dir>.quarantined.<ts>`) and a fresh one started.
+  3. corruption scan: zero-length `*-cache` entries and stale `*.tmp`
+     writer droppings are moved into `<dir>/.quarantine/` (jax looks
+     entries up by exact filename, so the subdir is invisible to it)
+     and the cache continues — one bad entry never disables the cache.
+  4. health probe: a SUBPROCESS jits a trivial program against the dir
+     under a hard timeout (JAXMC_CACHE_GUARD_TIMEOUT, default 60 s).  A
+     wedge or crash quarantines the dir and falls back cold.  The probe
+     result is stamped (`<dir>/jaxmc.cache.probe.ok`) so a round of
+     sweep children pays for it ONCE, not per case
+     (JAXMC_CACHE_PROBE=0 skips it entirely).
+
+Fault sites (jaxmc/faults.py, chaos suite): `cache_hang` wedges the
+health probe, `cache_corrupt` zero-truncates one entry before the scan,
+`cache_lock` simulates a held exclusive lock.  tests/test_cache_guard.py
+pins that each one degrades to cold compilation with the run intact.
+
+JAXMC_COMPILE_CACHE=0|off|none disables the cache outright (both entry
+points); any other value is the cache dir.
 """
 
 from __future__ import annotations
 
+import json
 import os
-from typing import Optional
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Optional, Tuple
+
+_OFF_VALUES = ("0", "off", "none", "disabled")
+
+# the process-lifetime shared flock fd (step 1); module global so the
+# lock lives exactly as long as the process uses the cache
+_LOCK_FD: Optional[int] = None
+
+_META_NAME = "jaxmc.cache.meta.json"
+_PROBE_STAMP = "jaxmc.cache.probe.ok"
+_PROBE_FRESH_S = 3600.0  # one probe per dir per hour, not per process
 
 
 def cache_dir_from_env() -> Optional[str]:
     d = os.environ.get("JAXMC_COMPILE_CACHE")
-    return d or None
+    if d is None or d.strip().lower() in _OFF_VALUES or not d.strip():
+        return None
+    return d
+
+
+def cache_disabled_by_env() -> bool:
+    """True when JAXMC_COMPILE_CACHE explicitly opts OUT (0/off/none) —
+    the default-on call sites (bench children, sweep subprocesses)
+    honor it; an unset env var is not an opt-out there."""
+    d = os.environ.get("JAXMC_COMPILE_CACHE")
+    return d is not None and d.strip().lower() in _OFF_VALUES
+
+
+def default_cache_dir() -> str:
+    """The box-wide default dir for the default-on call sites: shared
+    across bench children, sweep subprocesses and rounds on one box
+    (JAXMC_PROBE_DIR keeps parallel harnesses apart, same as the bench
+    probe artifacts)."""
+    base = os.environ.get("JAXMC_PROBE_DIR", tempfile.gettempdir())
+    return os.path.join(base, "jaxmc_xla_cache")
 
 
 _LISTENER_REGISTERED = False
@@ -35,9 +109,260 @@ _LISTENER_REGISTERED = False
 def _count_entries(path: str) -> Optional[int]:
     try:
         return sum(1 for n in os.listdir(path)
-                   if not n.endswith(".tmp"))
+                   if not n.endswith(".tmp")
+                   and n not in (_META_NAME, _PROBE_STAMP, ".quarantine"))
     except OSError:
         return None
+
+
+def _fingerprint() -> dict:
+    """The build identity whose mismatch marks a foreign cache (the
+    cross-build reload-hang class). jax import only — no device init."""
+    import platform
+    fp = {"python": platform.python_version(),
+          "machine": platform.machine()}
+    try:
+        import jax
+        fp["jax"] = jax.__version__
+    except Exception:  # noqa: BLE001 — fingerprint must never raise
+        fp["jax"] = "unavailable"
+    return fp
+
+
+def _flock(fd: int, exclusive: bool) -> bool:
+    """Non-blocking flock; False on contention or any failure."""
+    try:
+        import fcntl
+        fcntl.flock(fd, (fcntl.LOCK_EX if exclusive else fcntl.LOCK_SH)
+                    | fcntl.LOCK_NB)
+        return True
+    except OSError:
+        return False
+
+
+def _quarantine_dir(path: str) -> Optional[str]:
+    """Rename the whole cache dir aside; returns the new path or None."""
+    dst = f"{path}.quarantined.{int(time.time())}.{os.getpid()}"
+    try:
+        os.rename(path, dst)
+        os.makedirs(path, exist_ok=True)
+        return dst
+    except OSError:
+        return None
+
+
+def _guard(path: str, timeout_s: float, tel) -> Tuple[bool, str]:
+    """Run the guard battery over `path`. Returns (enable?, detail).
+    Mutates module state only to park the shared flock fd."""
+    from .. import faults
+    global _LOCK_FD
+    os.makedirs(path, exist_ok=True)
+
+    # -- step 1: the flock scope ------------------------------------
+    lock_path = path.rstrip("/") + ".lock"
+    fd = os.open(lock_path, os.O_CREAT | os.O_RDWR, 0o644)
+    if faults.fire("cache_lock") is not None or not _flock(fd, False):
+        # someone holds the exclusive lock (a quarantine in flight):
+        # this process compiles cold rather than racing the rename
+        os.close(fd)
+        return False, "lock contention on the cache writer lock"
+
+    def _upgrade_exclusive() -> bool:
+        return _flock(fd, True)
+
+    def _downgrade_shared() -> None:
+        _flock(fd, False)
+
+    notes = []
+
+    # -- step 2: build fingerprint ----------------------------------
+    meta_path = os.path.join(path, _META_NAME)
+    fp = _fingerprint()
+    stale = None
+    try:
+        with open(meta_path) as fh:
+            old = json.load(fh)
+        if old != fp:
+            stale = f"cache written by another build ({old})"
+    except FileNotFoundError:
+        pass
+    except (OSError, ValueError):
+        stale = "unreadable cache fingerprint"
+    if stale:
+        if not _upgrade_exclusive():
+            os.close(fd)
+            return False, (f"{stale} and still in use by another "
+                           f"process — compiling cold")
+        q = _quarantine_dir(path)
+        if q is None:
+            # the rename failed (permissions, a concurrent re-create):
+            # the foreign dir is STILL there, and it is exactly the
+            # reload-hang class — never enable over it, compile cold
+            os.close(fd)
+            return False, (f"{stale} and the quarantine rename failed "
+                           f"— compiling cold")
+        tel.counter("compile.persistent_cache_quarantines")
+        notes.append(f"quarantined stale dir -> {q}")
+        _downgrade_shared()
+    if not os.path.exists(meta_path):
+        try:
+            tmp = meta_path + f".tmp.{os.getpid()}"
+            with open(tmp, "w") as fh:
+                json.dump(fp, fh)
+            os.replace(tmp, meta_path)
+        except OSError:
+            pass  # another process won the race; theirs matches or
+            # the next enable quarantines
+
+    # -- step 3: corruption scan ------------------------------------
+    # chaos site: damage one entry right before the scan so the test
+    # harness can pin "detected, quarantined, run continues"
+    if faults.fire("cache_corrupt") is not None:
+        victims = [n for n in os.listdir(path) if n.endswith("-cache")]
+        victim = os.path.join(
+            path, victims[0] if victims else "poisoned-entry-cache")
+        try:
+            with open(victim, "w"):
+                pass  # zero-truncate (or create empty): detectably bad
+        except OSError:
+            pass
+    qdir = os.path.join(path, ".quarantine")
+    bad = 0
+    try:
+        now = time.time()
+        for name in os.listdir(path):
+            if name in (_META_NAME, _PROBE_STAMP, ".quarantine"):
+                continue
+            p = os.path.join(path, name)
+            if not os.path.isfile(p):
+                continue
+            try:
+                st = os.stat(p)
+            except OSError:
+                continue
+            is_bad = (name.endswith("-cache") and st.st_size == 0) or \
+                (name.endswith(".tmp") and now - st.st_mtime > 3600)
+            if is_bad:
+                try:
+                    os.makedirs(qdir, exist_ok=True)
+                    os.rename(p, os.path.join(qdir, name))
+                    bad += 1
+                except OSError:
+                    pass
+    except OSError:
+        pass
+    if bad:
+        tel.counter("compile.persistent_cache_quarantines", bad)
+        notes.append(f"quarantined {bad} corrupt entr"
+                     f"{'y' if bad == 1 else 'ies'}")
+
+    # -- step 4: health probe under a hard timeout ------------------
+    if os.environ.get("JAXMC_CACHE_PROBE", "1") != "0":
+        stamp = os.path.join(path, _PROBE_STAMP)
+        fresh = False
+        try:
+            fresh = time.time() - os.path.getmtime(stamp) < _PROBE_FRESH_S
+        except OSError:
+            pass
+        if not fresh:
+            ok, why = _health_probe(path, timeout_s)
+            if not ok:
+                if _upgrade_exclusive():
+                    q = _quarantine_dir(path)
+                    tel.counter("compile.persistent_cache_quarantines")
+                    why += f"; dir quarantined -> {q}"
+                    _downgrade_shared()
+                os.close(fd)
+                return False, f"health probe failed ({why})"
+            try:
+                with open(stamp, "w") as fh:
+                    fh.write(str(time.time()))
+            except OSError:
+                pass
+            notes.append("probed ok")
+
+    _LOCK_FD = fd  # park the shared lock for the process lifetime
+    return True, "; ".join(notes) if notes else "ok"
+
+
+def _health_probe(path: str, timeout_s: float) -> Tuple[bool, str]:
+    """Jit one trivial program against the cache dir in a SUBPROCESS so
+    a wedged blob reload (the known failure class) hits OUR timeout, not
+    the run's deadline. The `cache_hang` fault site wedges the child."""
+    repo = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    code = (
+        "import os, sys, time\n"
+        "sys.path.insert(0, " + repr(repo) + ")\n"
+        "from jaxmc import faults\n"
+        "if faults.fire('cache_hang') is not None:\n"
+        "    time.sleep(3600)  # the simulated wedge\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "jax.config.update('jax_compilation_cache_dir', " + repr(path) +
+        ")\n"
+        "import jax.numpy as jnp\n"
+        "jax.jit(lambda x: x * 2 + 1)(jnp.arange(3)).block_until_ready()"
+        "\n")
+    try:
+        p = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True,
+                           timeout=timeout_s,
+                           env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    except subprocess.TimeoutExpired:
+        return False, f"wedged past {timeout_s:.0f}s"
+    except OSError as ex:
+        return False, f"probe could not run: {ex}"
+    if p.returncode != 0:
+        tail = (p.stderr or "").strip().splitlines()[-1:] or ["?"]
+        return False, f"probe rc={p.returncode}: {tail[0][:120]}"
+    return True, "ok"
+
+
+def enable_guarded_cache(path: Optional[str] = None, tel=None,
+                         timeout_s: Optional[float] = None
+                         ) -> Optional[str]:
+    """The DEFAULT-ON entry (bench children, sweep subprocesses): run
+    the guard battery, then enable the cache.  Returns the cache dir
+    when enabled, None on opt-out or cold fallback.  NEVER raises and
+    never hangs: every guard defect degrades to cold compilation."""
+    from .. import obs
+    if tel is None:
+        tel = obs.current()
+    # the env opt-out governs the DEFAULT-ON call sites only: an
+    # explicit `path` (cli --compile-cache DIR) is a direct request and
+    # overrides a box-wide JAXMC_COMPILE_CACHE=off
+    if path is None and cache_disabled_by_env():
+        tel.gauge("compile.persistent_cache_guard",
+                  "disabled:JAXMC_COMPILE_CACHE opt-out")
+        return None
+    path = path or cache_dir_from_env() or default_cache_dir()
+    if timeout_s is None:
+        timeout_s = float(os.environ.get("JAXMC_CACHE_GUARD_TIMEOUT",
+                                         "60"))
+    try:
+        ok, detail = _guard(path, timeout_s, tel)
+    except Exception as ex:  # noqa: BLE001 — guard bugs degrade cold
+        ok, detail = False, f"guard error: {type(ex).__name__}: {ex}"
+    if not ok:
+        tel.gauge("compile.persistent_cache_guard",
+                  f"cold-fallback:{detail}")
+        tel.counter("compile.persistent_cache_fallbacks")
+        return None
+    d = enable_persistent_cache(path, tel=tel)
+    if d is None:
+        # the guard battery passed but the raw enabler could not turn
+        # the cache on (jax unavailable/config failure): the verdict
+        # gauge must say COLD, not "ok" — an artifact claiming an
+        # enabled cache with zero hits would misattribute the compile
+        tel.gauge("compile.persistent_cache_guard",
+                  "cold-fallback:enable failed (jax unavailable or "
+                  "cache config rejected)")
+        tel.counter("compile.persistent_cache_fallbacks")
+        return None
+    tel.gauge("compile.persistent_cache_guard",
+              f"ok ({detail})" if detail != "ok" else "ok")
+    return d
 
 
 def enable_persistent_cache(path: Optional[str] = None,
@@ -49,7 +374,8 @@ def enable_persistent_cache(path: Optional[str] = None,
     children enable the cache inside their device_init span, before
     obs.use).  Returns the cache dir when enabled, None when not
     requested or jax is unavailable.  Never raises: a broken cache setup
-    must not break a check run."""
+    must not break a check run.  This is the RAW enabler — default-on
+    call sites go through enable_guarded_cache."""
     path = path or cache_dir_from_env()
     if not path:
         return None
@@ -110,3 +436,14 @@ def record_entries_end(path: Optional[str], tel=None) -> None:
     if n is not None:
         (tel if tel is not None else obs.current()).gauge(
             "compile.persistent_cache_entries_end", n)
+
+
+def release_lock_for_tests() -> None:
+    """Drop the parked shared flock so tests can exercise contention."""
+    global _LOCK_FD
+    if _LOCK_FD is not None:
+        try:
+            os.close(_LOCK_FD)
+        except OSError:
+            pass
+        _LOCK_FD = None

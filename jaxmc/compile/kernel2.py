@@ -163,6 +163,18 @@ class KernelCtx:
         self.iset_cap = max([bounds.seq_cap] +
                             [s.cap for s in layout.specs.values()
                              if s.kind == "seq"])
+        # per-operator unroll depth (ISSUE 5): a RECURSIVE operator on
+        # symbolic arguments unrolls forever at trace time. Catching it
+        # as a Python RecursionError loses the culprit's name; this
+        # counter trips FIRST and raises a CompileError that NAMES the
+        # recursing operator — the per-arm demotion reason table then
+        # says "Serializable diverges", not just "RecursionError".
+        # Same-name re-entry 64 deep is legitimate only for concrete
+        # (terminating) recursion far larger than any corpus model uses
+        # (JAXMC_OP_UNROLL_LIMIT raises it).
+        self.op_depth: Dict[str, int] = {}
+        self.op_unroll_limit = int(
+            os.environ.get("JAXMC_OP_UNROLL_LIMIT", "64"))
 
 
 class Frame:
@@ -206,25 +218,32 @@ class Frame:
                      self.overflow, self.strict, _land(self.guard, g),
                      self.demo, self.memo)
 
-    def flag_overflow(self, cond):
+    def flag_overflow(self, cond, why=None):
         """A genuine capacity/spec overflow: a value outgrew its lanes
         (the fix is a larger --seq-cap/--kv-cap/--grow-cap)."""
         cond = _land(self.guard, _npbool(cond))
         if self.strict and cond is not False:
-            raise CompileError("uncompilable subterm in a predicate "
-                               "(no overflow recovery in invariants)")
+            raise CompileError(
+                "uncompilable subterm in a predicate (no overflow "
+                "recovery in invariants)"
+                + (f": {why}" if why else ""))
         self.overflow[0] = _lor(self.overflow[0], cond)
 
-    def flag_demoted(self, cond):
+    def flag_demoted(self, cond, why=None):
         """A compile-limitation recovery (an `except CompileError` site):
         the compiled guard/value deviates from TLC unless the run aborts
         when cond holds. Kept in a separate cell so the hybrid engine can
         demote the arm to exact interpreter enumeration and restart,
-        instead of reporting a spurious capacity overflow."""
+        instead of reporting a spurious capacity overflow.  `why` (the
+        recovered CompileError's message) survives into the strict-mode
+        refusal so a demoted PREDICATE's reason still names the real
+        culprit (e.g. which recursive operator diverged)."""
         cond = _land(self.guard, _npbool(cond))
         if self.strict and cond is not False:
-            raise CompileError("uncompilable subterm in a predicate "
-                               "(no overflow recovery in invariants)")
+            raise CompileError(
+                "uncompilable subterm in a predicate (no overflow "
+                "recovery in invariants)"
+                + (f": {why}" if why else ""))
         cell = self.demo if self.demo is not None else self.overflow
         cell[0] = _lor(cell[0], cond)
 
@@ -924,6 +943,8 @@ def set_union(a, b, fr: Frame):
         el = a if isinstance(a, Elems) else b
         try:
             mask = _to_mask_set(other, fr)
+        except UnrollLimitError:
+            raise
         except CompileError:
             items = list(_elements(a, fr)) + list(_elements(b, fr))
             return Elems(items)
@@ -1405,13 +1426,17 @@ def _sym_eval2_inner(e: A.Node, fr: Frame):
         # failing branch would have been taken — exactness preserved
         try:
             a = sym_eval2(e.then, fr)
-        except CompileError:
-            fr.flag_demoted(c)
+        except UnrollLimitError:
+            raise
+        except CompileError as ex:
+            fr.flag_demoted(c, why=str(ex))
             return sym_eval2(e.els, fr)
         try:
             b = sym_eval2(e.els, fr)
-        except CompileError:
-            fr.flag_demoted(_lnot(c))
+        except UnrollLimitError:
+            raise
+        except CompileError as ex:
+            fr.flag_demoted(_lnot(c), why=str(ex))
             return a
         return _merge_values(c, a, b, fr)
     if t is A.Case:
@@ -1668,11 +1693,13 @@ def _sym_fndef(e: A.FnDef, fr: Frame) -> SymV:
             try:
                 v = _lift(sym_eval2(e.body,
                                     fr.with_bound(b).with_guard(gb)), fr)
-            except CompileError:
+            except UnrollLimitError:
+                raise
+            except CompileError as ex:
                 # body uncompilable for this universe member (q[j+1] past
                 # the sequence capacity for dead j): zeros, and abort the
                 # run if the member is ever actually in the set
-                fr.flag_demoted(gb)
+                fr.flag_demoted(gb, why=str(ex))
                 if vals:
                     v = SymV(vals[0][1].spec, _zeros(vals[0][1].spec.width))
                 else:
@@ -1860,10 +1887,12 @@ def _sym_opapp2(e: A.OpApp, fr: Frame):
             return mk_bool(False)
         try:
             b = as_bool(sym_eval2(e.args[1], fr), fr)
-        except CompileError:
+        except UnrollLimitError:
+            raise
+        except CompileError as ex:
             if a is True:
                 raise
-            fr.flag_demoted(a)
+            fr.flag_demoted(a, why=str(ex))
             return mk_bool(False)
         return mk_bool(_land(a, b))
     if name == "\\/":
@@ -1872,10 +1901,12 @@ def _sym_opapp2(e: A.OpApp, fr: Frame):
             return mk_bool(True)
         try:
             b = as_bool(sym_eval2(e.args[1], fr), fr)
-        except CompileError:
+        except UnrollLimitError:
+            raise
+        except CompileError as ex:
             if a is False:
                 raise
-            fr.flag_demoted(_lnot(a))
+            fr.flag_demoted(_lnot(a), why=str(ex))
             return mk_bool(a)
         return mk_bool(_lor(a, b))
     if name == "~":
@@ -2082,16 +2113,55 @@ def _sym_opapp2(e: A.OpApp, fr: Frame):
     if isinstance(d, tuple) and d and d[0] == "$op":
         od, captured = d[1], d[2]
         args = [sym_eval2(a, fr) for a in e.args]
-        return sym_eval2(od.body, fr.with_bound(
-            {**captured, **dict(zip(od.params, args))}))
+        with _op_unroll(kc, name):
+            return sym_eval2(od.body, fr.with_bound(
+                {**captured, **dict(zip(od.params, args))}))
     if isinstance(d, OpClosure):
         args = [sym_eval2(a, fr) for a in e.args]
-        return sym_eval2(d.body, fr.with_bound(dict(zip(d.params, args))))
+        with _op_unroll(kc, name):
+            return sym_eval2(d.body,
+                             fr.with_bound(dict(zip(d.params, args))))
     if d is not None and not e.args:
         if isinstance(d, (SymV, frozenset, Fcn, Elems)):
             return d
         return _static_const(d, fr)
     raise CompileError(f"cannot compile operator {name}")
+
+
+class UnrollLimitError(CompileError):
+    """A RECURSIVE operator exceeded the compile-time unroll limit.
+    Deliberately NON-RECOVERABLE: the `except CompileError` recovery
+    sites re-raise it, because recovering would retry the sibling
+    branch of every unroll frame — exponential recursion (Fib) would
+    turn one failed trace into ~2^limit recovery attempts.  The arm (or
+    predicate) demotes whole, with the operator's name in the reason."""
+
+
+class _op_unroll:
+    """Same-name re-entry counter around user-operator expansion: trips
+    BEFORE Python's recursion limit so a diverging RECURSIVE operator
+    demotes with its NAME in the CompileError (the per-arm demotion
+    reason table) instead of an anonymous RecursionError."""
+    __slots__ = ("kc", "name")
+
+    def __init__(self, kc: KernelCtx, name: str):
+        self.kc = kc
+        self.name = name
+        depth = kc.op_depth.get(name, 0)
+        if depth >= kc.op_unroll_limit:
+            raise UnrollLimitError(
+                f"recursive operator {name} exceeds the compile-time "
+                f"unroll limit ({kc.op_unroll_limit}; raise with "
+                f"JAXMC_OP_UNROLL_LIMIT) — its expansion diverges on "
+                f"symbolic arguments")
+        kc.op_depth[name] = depth + 1
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.kc.op_depth[self.name] -= 1
+        return False
 
 
 def _flatten_conj(e):
@@ -2376,12 +2446,14 @@ def compile_action2(kc: KernelCtx, ga) -> CompiledAction2:
                 try:
                     val = _lift(sym_eval2(rhs, frv), frv)
                     val = coerce(val, layout.specs[var], frv)
-                except CompileError:
+                except UnrollLimitError:
+                    raise
+                except CompileError as ex:
                     if enabled is True:
                         raise
                     # uncompilable only along paths the guards exclude:
                     # demotion-abort if the action is ever enabled
-                    frv.flag_demoted(enabled)
+                    frv.flag_demoted(enabled, why=str(ex))
                     val = SymV(layout.specs[var],
                                [0] * layout.specs[var].width)
                 if var in primes:
@@ -2400,13 +2472,15 @@ def compile_action2(kc: KernelCtx, ga) -> CompiledAction2:
                 continue
             try:
                 g = as_bool(sym_eval2(expr, fr), fr)
+            except UnrollLimitError:
+                raise
             except CompileError as gex:
                 if enabled is True:
                     raise
                 # demoted conjunct: False + abort-if-reached, recorded so
                 # the hybrid engine can prefer interp enumeration of the
                 # whole arm over an abort-guarded under-approximation
-                fr.flag_demoted(enabled)
+                fr.flag_demoted(enabled, why=str(gex))
                 if not any(r == str(gex) for r in demoted_guards):
                     demoted_guards.append(str(gex))
                 g = False

@@ -44,6 +44,17 @@ class Case:
     # pins on the jax backend; "skip" = known outside the compilable
     # subset (recursion/CHOOSE-heavy — the interp remains its checker)
     jax: str = "skip"
+    # the pinned EXPANSION MODE (ISSUE 5): "compiled" | "hybrid" |
+    # "interp-arms" as observed in SWEEP_JAX_r05. A case that SLIDES
+    # toward the interpreter (compiled -> hybrid/interp-arms, hybrid ->
+    # interp-arms) FAILS the sweep — a silent demotion is a perf
+    # regression, not a pass. Cases pinned "interp-arms" skip kernel
+    # construction entirely (TpuExplorer pin_interp_arms): building
+    # kernels the engine immediately demotes burned 245s of the r05
+    # sweep (213s on MCInnerSerial alone). JAXMC_MODE_PIN=0 lifts the
+    # pins for one sweep — the diagnosis mode that builds everything
+    # and logs each arm's demotion reason.
+    mode: Optional[str] = None
     # lane-capacity floors the default sampler under-observes for this
     # model (e.g. MCInnerSequential's opQ outgrows the sampled max):
     # passed to the device backend as Bounds(seq_cap=..., ...)
@@ -79,91 +90,104 @@ class Case:
 # the golden testout2 run; see tests/test_corpus.py).
 CASES: List[Case] = [
     # -- top level + tutorial variants
-    Case("pcal_intro.tla", distinct=3800, generated=5850, jax="yes"),
+    Case("pcal_intro.tla", distinct=3800, generated=5850, jax="yes",
+         mode="compiled"),
     Case("specs/pcal_intro_buggy.tla", root="repo", cfg="",
-         expect="violation:assert", jax="yes"),
+         expect="violation:assert", jax="yes", mode="compiled"),
     Case("atomic_add.tla", cfg="", distinct=5, generated=7,
-         no_deadlock=True, jax="yes"),
+         no_deadlock=True, jax="yes", mode="compiled"),
     # -- Paxos chain
     Case("examples/Paxos/MCConsensus.tla", distinct=4, generated=7,
-         no_deadlock=True, jax="yes"),
+         no_deadlock=True, jax="yes", mode="compiled"),
     Case("examples/Paxos/MCVoting.tla", distinct=77, generated=406,
-         no_deadlock=True, jax="yes"),
+         no_deadlock=True, jax="yes", mode="compiled"),
     Case("examples/Paxos/MCPaxos.tla", distinct=25, generated=82,
-         jax="yes"),
+         jax="yes", mode="compiled"),
     # -- Specifying Systems chapters
     Case(f"{SS}/SimpleMath/SimpleMath.tla", expect="assumes"),
     Case(f"{SS}/HourClock/HourClock.tla", distinct=12, generated=24,
-         jax="yes"),
+         jax="yes", mode="compiled"),
     Case(f"{SS}/HourClock/HourClock2.tla", distinct=12, generated=24,
-         jax="yes"),
+         jax="yes", mode="compiled"),
     Case(f"{SS}/AsynchronousInterface/AsynchInterface.tla",
-         distinct=12, generated=30, jax="yes"),
+         distinct=12, generated=30, jax="yes", mode="hybrid"),
     Case(f"{SS}/AsynchronousInterface/Channel.tla",
-         distinct=12, generated=30, jax="yes"),
+         distinct=12, generated=30, jax="yes", mode="compiled"),
     Case(f"{SS}/AsynchronousInterface/PrintValues.tla", expect="assumes"),
     Case(f"{SS}/FIFO/MCInnerFIFO.tla", distinct=3864, generated=9660,
-         jax="yes"),
+         jax="yes", mode="compiled"),
     Case(f"{SS}/CachingMemory/MCInternalMemory.tla",
-         distinct=4408, generated=21400, jax="yes"),
+         distinct=4408, generated=21400, jax="yes", mode="hybrid"),
     Case(f"{SS}/CachingMemory/MCWriteThroughCache.tla",
-         distinct=5196, generated=28170, jax="yes"),
+         distinct=5196, generated=28170, jax="yes", mode="hybrid"),
     Case(f"{SS}/Liveness/LiveHourClock.tla", distinct=12, generated=24,
-         jax="yes"),
+         jax="yes", mode="compiled"),
     Case(f"{SS}/Liveness/MCLiveInternalMemory.tla",
-         distinct=4408, generated=21400, jax="yes"),
+         distinct=4408, generated=21400, jax="yes", mode="hybrid"),
     Case(f"{SS}/Liveness/MCLiveWriteThroughCache.tla",
-         distinct=5196, generated=28170, jax="yes"),
+         distinct=5196, generated=28170, jax="yes", mode="hybrid"),
     # ErrorTemporal is EXPECTED to fail (MCRealTimeHourClock.tla:43)
     Case(f"{SS}/RealTime/MCRealTimeHourClock.tla",
          expect="violation:property", distinct=216, generated=696,
-         jax="yes"),
+         jax="yes", mode="interp-arms"),
     Case(f"{SS}/TLC/ABCorrectness.tla", distinct=20, generated=36,
-         jax="yes"),
+         jax="yes", mode="compiled"),
     Case(f"{SS}/TLC/MCAlternatingBit.tla", distinct=240, generated=1392,
-         jax="yes"),
+         jax="yes", mode="compiled"),
     Case(f"{SS}/AdvancedExamples/MCInnerSequential.tla",
-         distinct=3528, generated=24368, jax="yes", seq_cap=8),
+         distinct=3528, generated=24368, jax="yes", seq_cap=8,
+         mode="compiled"),
     # the golden testout2 model (6181/195, diameter 5 — TLC 1.57: 22h).
     # testout1 (the 17h log) is a SECOND run of this SAME model: both
     # logs open "4 distinct initial states" and climb to 195 distinct at
     # diameter 5; testout1 was cut off at 6032 generated with 2 states
     # on queue (no final-totals line), consistent with this 6181 final —
     # so this pin covers BOTH golden logs
+    # interp-arms PINNED (ISSUE 5): the r05 sweep burned 213s building
+    # 13 kernels that all demoted (the recursion in Serializable/
+    # opOrder reaches every arm through the inlined response guards).
+    # The pin skips kernel construction outright; run a sweep with
+    # JAXMC_MODE_PIN=0 to rebuild everything and log each arm's
+    # demotion reason (the path to compiling the mechanical
+    # request/response arms while recursion stays demoted)
     Case(f"{SS}/AdvancedExamples/MCInnerSerial.tla",
-         distinct=195, generated=6181, jax="yes"),
+         distinct=195, generated=6181, jax="yes", mode="interp-arms"),
     # the shipped alternative model (Proc={p1}, DataInvariant only):
     # matches NEITHER golden log (they both record 4 init states; this
     # model has 2) — counts below are this repo's cross-backend pin,
     # closing the last unswept reference cfg (21/21)
     Case(f"{SS}/AdvancedExamples/MCInnerSerial.tla",
          cfg=f"{SS}/AdvancedExamples/MCInnerSerial.cfg.alt",
-         distinct=9, generated=47, jax="yes"),
+         distinct=9, generated=47, jax="yes", mode="interp-arms"),
     # -- repo MC shims for the cfg-less reference specs
     Case("specs/transfer_scaled.tla", root="repo",
          cfg="specs/transfer_scaled.cfg",
-         distinct=153701, generated=311153, slow=True, jax="yes"),
+         distinct=153701, generated=311153, slow=True, jax="yes",
+         mode="compiled"),
     Case("specs/MCraftMicro.tla", root="repo",
          cfg="specs/MCraft_micro.cfg", includes=("examples",),
-         distinct=694, generated=6185, jax="yes"),
+         distinct=694, generated=6185, jax="yes", mode="compiled"),
+    # mode=compiled proven by the BENCH_r02 resident-mode completion
+    # (resident refuses hybrid/interp-arms outright)
     Case("specs/MCraftMicro.tla", root="repo",
          cfg="specs/MCraft_3s_bench.cfg", includes=("examples",),
-         distinct=76654, generated=1138651, slow=True, jax="yes"),
+         distinct=76654, generated=1138651, slow=True, jax="yes",
+         mode="compiled"),
     Case("specs/MCtextbookSI.tla", root="repo",
          cfg="specs/MCtextbookSI_small.cfg", includes=("examples",),
-         distinct=569, generated=945, jax="yes"),
+         distinct=569, generated=945, jax="yes", mode="interp-arms"),
     # SI is EXPECTED non-serializable (textbookSnapshotIsolation.tla:91-96)
     Case("specs/MCtextbookSI.tla", root="repo",
          cfg="specs/MCtextbookSI_skew.cfg", includes=("examples",),
          expect="violation:invariant", slow=True),
     Case("specs/MCserializableSI.tla", root="repo",
          cfg="specs/MCserializableSI_small.cfg", includes=("examples",),
-         distinct=569, generated=945, jax="yes"),
+         distinct=569, generated=945, jax="yes", mode="interp-arms"),
     # fast-CI seeded write-skew: SI MUST reach a non-serializable history
     # (textbookSnapshotIsolation.tla:91-96; VERDICT r2 weak #3)
     Case("specs/MCtextbookSI.tla", root="repo",
          cfg="specs/MCtextbookSI_skew_fast.cfg", includes=("examples",),
-         expect="violation:invariant", jax="yes"),
+         expect="violation:invariant", jax="yes", mode="interp-arms"),
     # SSI at its documented envelope floor (2 keys x 3 txns, seeded):
     # serializability HOLDS while write skew is attempted and aborted
     Case("specs/MCserializableSI.tla", root="repo",
@@ -172,8 +196,42 @@ CASES: List[Case] = [
     # device SYMMETRY toys (orbit-canonical counts; deadlock expected
     # when every process exhausts its turns)
     Case("specs/symtoy.tla", root="repo", cfg="specs/symtoy.cfg",
-         no_deadlock=True, distinct=22, generated=33, jax="yes"),
+         no_deadlock=True, distinct=22, generated=33, jax="yes",
+         mode="compiled"),
+    # ISSUE 5 disclosure fixtures (repo-local, no reference needed):
+    # identity-group SYMMETRY must say sym=identity, never claim an
+    # UNREDUCED-FALLBACK divergence...
+    Case("specs/symid.tla", root="repo", cfg="specs/symid.cfg",
+         distinct=4, generated=4, jax="yes", mode="compiled"),
+    # ...and an arm whose unguarded SUBSET-of-symbolic-set assignment
+    # demotes AT BUILD TIME with a NAMED per-arm reason — the
+    # repo-local representative of the hybrid class, pinning the
+    # mode-slide failure path
+    Case("specs/interparm_toy.tla", root="repo",
+         cfg="specs/interparm_toy.cfg", distinct=19, generated=29,
+         jax="yes", mode="hybrid"),
 ]
+
+# mode-slide severity order: a case may only move LEFT (toward
+# "compiled") without failing its pin
+_MODE_ORDER = {"compiled": 0, "hybrid": 1, "interp-arms": 2}
+
+
+def mode_pins_enabled() -> bool:
+    """The JAXMC_MODE_PIN=0 escape hatch: one sweep with every pin
+    lifted builds every kernel again and logs per-arm demotion reasons
+    — the diagnosis pass for un-demoting arms."""
+    return os.environ.get("JAXMC_MODE_PIN", "1") != "0"
+
+
+def case_for_cfg(cfg_basename: str) -> Optional[Case]:
+    """Manifest lookup by cfg basename (bench.py uses it to assert the
+    full rung's resumed counts against the pinned totals)."""
+    for c in CASES:
+        p = c.cfg_path()
+        if p and os.path.basename(p) == cfg_basename:
+            return c
+    return None
 
 
 def run_case(case: Case, backend: str = "interp"):
@@ -225,13 +283,21 @@ def run_case(case: Case, backend: str = "interp"):
             b.grow_cap = case.grow_cap
         if case.kv_cap:
             b.kv_cap = case.kv_cap
+        pin = case.mode if mode_pins_enabled() else None
+        if pin is not None and pin not in _MODE_ORDER:
+            # a typo'd pin must not silently disable enforcement (every
+            # real mode would read as an "improvement" against it)
+            return "fail", (f"manifest defect: unknown mode pin {pin!r} "
+                            f"(expected one of "
+                            f"{sorted(_MODE_ORDER)})"), None, None
         try:
             # instrument compile cost (VERDICT r3 weak #3): construction
             # = grounding + kernel build + forced abstract tracing;
             # the run then adds the XLA compiles proper
             t_c0 = time.time()
             ex = TpuExplorer(model, store_trace=False, bounds=b,
-                             host_seen=native_store.is_available())
+                             host_seen=native_store.is_available(),
+                             pin_interp_arms=(pin == "interp-arms"))
             build_s = time.time() - t_c0
             # honest per-case execution-mode disclosure (VERDICT r4
             # weak #3/#6): how much of the EXPANSION hot loop actually
@@ -245,12 +311,23 @@ def run_case(case: Case, backend: str = "interp"):
                 mode = "hybrid"
             else:
                 mode = "interp-arms"  # device does hashing/dedup only
+            # symmetry disclosure, three-way (ISSUE 5 satellite):
+            # build_canon2 returns None BY DESIGN for identity groups
+            # (symmetry2.py) — no reduction exists to diverge from, so
+            # sym=identity; only a genuine CompileError fallback
+            # (ex._sym_fallback) claims divergence. MCPaxos's line used
+            # to report a divergence that does not exist.
             sym_note = ""
             if model.symmetry is not None:
-                sym_note = (", sym=device-reduced"
-                            if ex.canon_fn is not None
-                            else ", sym=UNREDUCED-FALLBACK (counts "
-                                 "diverge from TLC's reduced ones)")
+                if ex.canon_fn is not None:
+                    sym_note = ", sym=device-reduced"
+                elif ex._sym_fallback:
+                    sym_note = (", sym=UNREDUCED-FALLBACK (counts "
+                                "diverge from TLC's reduced ones)")
+                else:
+                    sym_note = (", sym=identity (every declared "
+                                "permutation is the identity; counts "
+                                "match TLC)")
             note = (f" [build {build_s:.1f}s, mode={mode}, "
                     f"A={ex.A} compiled instances, "
                     f"{n_arms - n_fb}/{n_arms} arms compiled, "
@@ -258,7 +335,29 @@ def run_case(case: Case, backend: str = "interp"):
                     + (f", {n_fb} arms interp-demoted"
                        if ex.fb_arms else "")
                     + (f", {len(ex.fb_invs)} invs interp-demoted"
-                       if ex.fb_invs else "") + sym_note + "]")
+                       if ex.fb_invs else "") + sym_note
+                    + (" [mode pinned]" if pin == "interp-arms" else "")
+                    + "]")
+            # per-arm demotion reason table (VERDICT r5 #4): name each
+            # demoted arm and why — the evidence needed to un-demote
+            # mechanical arms — instead of only a count
+            if ex.fb_arms and pin != "interp-arms":
+                reasons = "; ".join(
+                    f"{a.label or 'Next'}: {reason[:100]}"
+                    for a, reason in ex.fb_arms[:8])
+                more = len(ex.fb_arms) - 8
+                note += (f" [demoted arms: {reasons}"
+                         + (f"; +{more} more" if more > 0 else "") + "]")
+            # mode-pin enforcement BEFORE the run: a slide toward the
+            # interpreter fails fast — no point paying the search for a
+            # case whose compile coverage already regressed
+            if pin is not None and mode != pin:
+                if _MODE_ORDER.get(mode, 3) > _MODE_ORDER.get(pin, 3):
+                    return "fail", (
+                        f"REGRESSION: expansion mode slid from pinned "
+                        f"'{pin}' to '{mode}'{note}"), None, mode
+                note += (f" [mode improved vs pinned '{pin}' — update "
+                         f"the manifest]")
             r = ex.run()
         except (CompileError, ModeError) as ex:
             if isinstance(ex, ModeError) and "hybrid" in str(ex) \
@@ -305,11 +404,31 @@ def _run_case_isolated(idx: int, backend: str, timeout_s: float):
     import json
     import subprocess
     import sys
+    cache_line = ""
+    if backend == "jax":
+        # persistent compile cache ON BY DEFAULT for sweep children
+        # (ISSUE 5): repeat sweeps — and the repeat-spec pairs inside
+        # one sweep (MCInternalMemory/MCLiveInternalMemory, the two
+        # WriteThroughCache models) — reload their XLA programs from
+        # disk instead of recompiling. enable_guarded_cache honors the
+        # JAXMC_COMPILE_CACHE=off opt-out and degrades COLD on a
+        # wedged/corrupt/foreign cache; the health probe is paid once
+        # per cache dir per hour, not per case. The guard verdict rides
+        # a JAXMC_CACHE_GUARD stdout line so a cold fallback is VISIBLE
+        # in the sweep log instead of vanishing into NullTelemetry.
+        cache_line = (
+            "from jaxmc import obs as _obs\n"
+            "from jaxmc.compile.cache import enable_guarded_cache\n"
+            "_ct = _obs.Telemetry()\n"
+            "enable_guarded_cache(tel=_ct)\n"
+            "print('JAXMC_CACHE_GUARD ' + str(_ct.gauges.get("
+            "'compile.persistent_cache_guard')))\n")
     code = (
         "import json, sys\n"
         "import jax\n"
         f"jax.config.update('jax_platforms', "
         f"{os.environ.get('JAXMC_SWEEP_PLATFORM', 'cpu')!r})\n"
+        + cache_line +
         "from jaxmc.corpus import CASES, run_case\n"
         f"s, d, _, md = run_case(CASES[{idx}], backend={backend!r})\n"
         "print('JAXMC_CASE ' + json.dumps([s, d, md]))\n")
@@ -325,10 +444,18 @@ def _run_case_isolated(idx: int, backend: str, timeout_s: float):
             return "fail", (f"REGRESSION: pinned into the jax compile-set "
                             f"but timed out after {timeout_s:.0f}s"), None
         return "skip", f"timed out after {timeout_s:.0f}s (compile?)", None
+    guard_note = ""
+    for line in (p.stdout or "").splitlines():
+        if line.startswith("JAXMC_CACHE_GUARD ") and \
+                "cold-fallback" in line:
+            # a guard cold-fallback must be visible in the sweep log,
+            # not silent: the whole-sweep wall-time win depends on it
+            guard_note = (" [compile cache COLD: "
+                          + line[len("JAXMC_CACHE_GUARD "):][:120] + "]")
     for line in (p.stdout or "").splitlines():
         if line.startswith("JAXMC_CASE "):
             s, d, md = json.loads(line[len("JAXMC_CASE "):])
-            return s, d, md
+            return s, d + guard_note, md
     tail = (p.stderr or "").strip().splitlines()[-1:] or ["no output"]
     return "fail", f"CRASH rc={p.returncode}: {tail[0][:160]}", None
 
@@ -390,6 +517,8 @@ def sweep(backend: str = "interp", include_slow: bool = False,
         plat_note = (", platform="
                      f"{os.environ.get('JAXMC_SWEEP_PLATFORM', 'cpu')}"
                      " [JAXMC_SWEEP_PLATFORM]")
+    if backend == "jax" and not mode_pins_enabled():
+        plat_note += ", MODE PINS LIFTED [JAXMC_MODE_PIN=0]"
     mode_note = ""
     if backend == "jax" and sum(modes.values()):
         # the honest coverage split (VERDICT r4 weak #3): "passes on the

@@ -44,6 +44,21 @@ Sites wired in this PR:
     device_run_fail   the device search loop raises entering a level
                       (cli.py demotes to the parallel CPU engine)
 
+Persistent-compile-cache guard sites (ISSUE 5, jaxmc/compile/cache.py —
+each must degrade to COLD compilation with the run intact, pinned by
+tests/test_cache_guard.py):
+
+    cache_hang        the cache health-probe subprocess wedges (the
+                      known cross-build blob-reload hang): the guard's
+                      timeout fires, the dir is quarantined, the run
+                      compiles cold
+    cache_corrupt     one cache entry is zero-truncated before the
+                      corruption scan: the entry is quarantined into
+                      <dir>/.quarantine and the cache stays enabled
+    cache_lock        the guard's flock acquisition reports contention
+                      (another process mid-quarantine): cold fallback
+                      for this process only
+
 Cross-process accounting: the first registry to activate creates a
 state directory and exports it as JAXMC_FAULTS_STATE, so forked pool
 workers AND subprocess children share one `n=` budget (the latch is an

@@ -81,6 +81,39 @@ jaxmc.metrics/2 artifact minus the new optional surface, so readers and
     - fault harness (jaxmc/faults.py): counter `faults.injected`,
       trace event `fault.injected {site, ...ctx}` — present only when
       JAXMC_FAULTS is set (chaos runs / `make chaos`).
+
+  (PR 5, still jaxmc.metrics/2 — all additive/optional; the
+   compile-amortization surface:)
+    - guarded persistent compile cache (compile/cache.py): gauge
+      `compile.persistent_cache_guard` — "ok" / "ok (<notes>)" when the
+      cache enabled (notes name quarantined entries / a fresh probe),
+      "cold-fallback:<reason>" when the guard degraded the run to cold
+      compilation (wedged probe, corrupt dir, lock contention, foreign
+      build), "disabled:..." on explicit opt-out; counters
+      `compile.persistent_cache_fallbacks` and
+      `compile.persistent_cache_quarantines`.  The existing
+      `compile.persistent_cache_hits` counter is the CROSS-PROCESS
+      proof: >0 means this process reloaded a program some other
+      process compiled.
+    - steady-state bench window (bench.py full rung): the emitted line
+      gains a `steady_state` block {source, path, resumed_generated,
+      resumed_distinct, resumed_depth, window_generated, window_wall_s,
+      window_recompiles}; the parent's orchestration block gains
+      `compile_excluded_from_window` {phases: {name -> wall_s},
+      total_s} — the one-time compile bill, separated from the
+      steady-state states/sec claim.  New child phase spans
+      `warmup_run {warm_source}` and `warm_ckpt_build {warm_states}`;
+      bench-warm runs emit `warmgen_bench` / `warmgen_3s` spans.
+    - expansion-mode pins (corpus.py): sweep case records/details note
+      `[mode pinned]` for manifest-pinned interp-arms cases (kernel
+      construction skipped) and carry a per-arm demotion reason table
+      (`[demoted arms: <label>: <reason>; ...]`) whenever arms demote
+      unpinned; a pinned case that slides toward the interpreter is a
+      FAIL with detail "REGRESSION: expansion mode slid ...".
+    - symmetry disclosure is three-way: `sym=device-reduced`,
+      `sym=identity` (identity permutation group — no divergence), or
+      `sym=UNREDUCED-FALLBACK (...)` (a genuine CompileError fallback;
+      the only case where counts diverge from TLC's reduced ones).
 """
 
 from __future__ import annotations

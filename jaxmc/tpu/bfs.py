@@ -223,7 +223,9 @@ class TpuExplorer:
                  checkpoint_every: float = 600.0,
                  resume_from: Optional[str] = None,
                  extra_samples: Optional[List[Dict[str, Any]]] = None,
-                 relayouts_left: int = 3):
+                 relayouts_left: int = 3,
+                 pin_interp_arms: bool = False,
+                 res_caps: Optional[Dict[str, int]] = None):
         self.model = model
         # same funnel as cli.py: silent on stdout by default, but the
         # strings still mirror into the telemetry trace
@@ -248,6 +250,13 @@ class TpuExplorer:
         # only after relayouts_left attempts.
         self.extra_samples = list(extra_samples or [])
         self.relayouts_left = relayouts_left
+        # expansion-mode pin (ISSUE 5): the corpus manifest knows this
+        # model's arms ALL demote to the interpreter — skip grounding +
+        # kernel construction + forced tracing entirely instead of
+        # paying minutes of futile XLA work (MCInnerSerial burned 213s
+        # building 13 kernels it then demoted, SWEEP_JAX_r05).
+        self.pin_interp_arms = pin_interp_arms
+        self._res_caps_hint = dict(res_caps) if res_caps else None
         self._last_frontier_np: Optional[np.ndarray] = None
 
         tel = obs.current()
@@ -296,7 +305,12 @@ class TpuExplorer:
         compile_retries = int(os.environ.get("JAXMC_COMPILE_RETRIES",
                                              "2"))
         from .. import faults as _faults
-        for ai, arm in enumerate(self.arms):
+        if self.pin_interp_arms:
+            self.fb_arms = [(arm, "pinned interp-arms (corpus "
+                                  "manifest): kernel construction "
+                                  "skipped") for arm in self.arms]
+        for ai, arm in enumerate(
+                () if self.pin_interp_arms else self.arms):
             try:
                 for attempt in range(compile_retries + 1):
                     # per-ATTEMPT introspection buffer: the rollup
@@ -384,6 +398,13 @@ class TpuExplorer:
             # machine-readable per-arm compile-cost map (schema v2):
             # {arm label -> {jaxpr_eqns, hlo_flops?, hlo_bytes?}}
             tel.gauge("compile.arm_cost", arm_costs)
+        # per-arm demotion reasons (ISSUE 5 / VERDICT r5 #4): the sweep
+        # log used to say only "13 arms interp-demoted" — name each arm
+        # and WHY, so a mechanical arm wrongly demoted (vs a genuinely
+        # recursive one) is visible instead of folded into a count
+        for _arm, _reason in self.fb_arms:
+            self.log(f"-- arm {_arm.label or 'Next'}: interp-demoted "
+                     f"({_reason})")
         # kernels that compiled only by DEMOTING a guard conjunct (False
         # + abort flag) under-approximate behind a runtime abort. Most
         # demotions never fire (raft's Receive reads fields of message
@@ -415,6 +436,16 @@ class TpuExplorer:
                 self.canon_fn = build_canon2(model, self.layout)
             except CompileError as e:
                 self._sym_fallback = str(e)
+        # identity-group disclosure (ISSUE 5 satellite): build_canon2
+        # returns None BY DESIGN when every declared permutation is the
+        # identity — no reduction exists to diverge from, so no
+        # UNREDUCED-FALLBACK warning belongs here (the interp's
+        # make_canonicalizer returns None for the same group, so counts
+        # match TLC exactly). Only a genuine CompileError fallback
+        # (self._sym_fallback) reports divergence.
+        self.sym_identity = (model.symmetry is not None
+                             and self.canon_fn is None
+                             and self._sym_fallback is None)
         # predicates likewise force-traced; uncompilable ones demote to
         # host-side interpreter evaluation over decoded rows (hybrid).
         # A TRACE-TIME BUDGET (JAXMC_PRED_TRACE_BUDGET seconds, default
@@ -701,7 +732,11 @@ class TpuExplorer:
         return Violation("property", rc.name, trace, self._refine_msg(rc))
 
     def _symmetry_warnings(self) -> List[str]:
-        if self.model.symmetry is None or self.canon_fn is not None:
+        if self.model.symmetry is None or self.canon_fn is not None \
+                or self.sym_identity:
+            # identity groups have no reduction to fall back FROM:
+            # counts match the (equally unreduced) TLC/interp search,
+            # so warning of divergence would be wrong in kind
             return []
         return [SYMMETRY_WARNING + (f" ({self._sym_fallback})"
                                     if self._sym_fallback else "")]
@@ -1537,7 +1572,10 @@ class TpuExplorer:
         reduce, so the carried counts would not be comparable)."""
         if not self.store_trace or not self.checkpoint_path:
             return
-        if self.model.symmetry is not None and self.canon_fn is None:
+        if self.model.symmetry is not None and self.canon_fn is None \
+                and not self.sym_identity:
+            # identity groups excepted: the interp reduces them to the
+            # same (unreduced) partition, so the snapshot stays exact
             if not getattr(self, "_host_snap_skip_logged", False):
                 self._host_snap_skip_logged = True
                 self.log("-- no host snapshot: SYMMETRY ran unreduced on "
@@ -1633,6 +1671,16 @@ class TpuExplorer:
             "AccCap": 1 << 17, "VC": 1 << 14} if on_accel else {
             "SC": _pow2_at_least(max(4 * n_init, 1), lo=1 << 15),
             "FCap": CH, "AccCap": 1 << 15, "VC": 1 << 13})
+        if self._res_caps is None and self._res_caps_hint:
+            # caller-supplied steady-state caps (bench.py knows the
+            # bench model's final sizes): max-merged over the platform
+            # defaults so the ONE warm-up compile covers the whole run —
+            # every later cap growth is a full XLA recompile inside
+            # somebody's measured window
+            for kk, vv in self._res_caps_hint.items():
+                if kk in caps:
+                    caps[kk] = max(caps[kk],
+                                   _pow2_at_least(int(vv), lo=1))
         caps["FCap"] = max(caps["FCap"], _pow2_at_least(max(n_init, 1),
                                                         lo=CH))
         # VC can never usefully exceed the dense candidate-grid size
@@ -1813,6 +1861,20 @@ class TpuExplorer:
                                        depth - 1, t0, warnings)
             elif stat == ST_TRUNC:
                 self.log("-- state limit reached, search truncated")
+                if self.checkpoint_path:
+                    # a truncated resident run is RESUMABLE (ISSUE 5):
+                    # truncation lands on a level boundary inside the
+                    # device loop, so this is exactly the periodic-
+                    # checkpoint state — the warm-start bench resumes it
+                    # for a steady-state window, and a resumed run's
+                    # final counts are bit-identical to an unbounded
+                    # cold run (tests/test_warm_bench.py pins it)
+                    self._write_ck(
+                        "resident", caps=dict(caps),
+                        seen=np.asarray(seen[:seen_count]),
+                        frontier=np.asarray(frontier[:fcount]),
+                        distinct=distinct, generated=generated,
+                        depth=depth)
                 return self._mk_result(True, distinct, generated, depth,
                                        t0, warnings, None, truncated=True)
             elif stat == ST_OVF_LANES:

@@ -1,0 +1,20 @@
+------------------------- MODULE interparm_toy -------------------------
+(* Mode-pin enforcement fixture (ISSUE 5): Pick's FIRST item assigns
+   `Cardinality(SUBSET s)` with no guard before it — SUBSET of a
+   symbolic (state-dependent) set is outside the kernel compiler's
+   subset and, with the action statically enabled, there is no
+   guard-demotion recovery to hide behind: the arm demotes AT BUILD
+   TIME and the model is hybrid BY CONSTRUCTION.  The repo-local
+   representative of the demoted-arm class, used to pin the sweep's
+   mode-slide failure path and the per-arm demotion reason table
+   without needing the reference tree. *)
+EXTENDS Naturals, FiniteSets
+VARIABLES x, s
+
+Init == x = 0 /\ s = {}
+Bump == x < 4 /\ x' = x + 1 /\ s' = s \cup {x}
+Pick == x' = Cardinality(SUBSET s) /\ s' = s
+Next == Bump \/ Pick
+Spec == Init /\ [][Next]_<<x, s>>
+TypeInv == x \in 0..16 /\ s \subseteq 0..3
+=========================================================================

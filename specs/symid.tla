@@ -1,0 +1,19 @@
+---------------------------- MODULE symid ----------------------------
+(* Identity-symmetry disclosure fixture (ISSUE 5 satellite): SYMMETRY
+   over a SINGLETON model-value set declares only the identity
+   permutation. build_canon2 (compile/symmetry2.py) and the interp's
+   make_canonicalizer return None BY DESIGN here — there is no
+   reduction to fall back FROM, so the backends must report
+   sym=identity (NOT UNREDUCED-FALLBACK) and emit no divergence
+   warning. MCPaxos's sweep line had exactly this shape. *)
+EXTENDS Naturals, TLC
+CONSTANTS Q
+VARIABLES n
+
+Perms == Permutations(Q)
+
+Init == n = 0
+Next == n < 3 /\ n' = n + 1
+Spec == Init /\ [][Next]_n
+TypeInv == n \in 0..3
+=======================================================================

@@ -1,0 +1,209 @@
+r"""The guarded persistent compile cache (ISSUE 5, jaxmc/compile/cache.py).
+
+The contract under test: a persistent-cache problem — wedged blob
+reload, corrupt entry, foreign build, lock contention — must NEVER
+wedge or fail a run.  Every guard defect degrades to cold compilation
+(enable returns None, the run proceeds uncached), and the good path
+proves cross-process cache hits in `compile.persistent_cache_hits`.
+Fault sites: cache_hang / cache_corrupt / cache_lock (jaxmc/faults.py).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from jaxmc import faults, obs
+from jaxmc.compile import cache
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch, tmp_path):
+    """Every test gets an isolated cache dir, a clean fault registry,
+    and no parked flock from a previous test."""
+    monkeypatch.delenv("JAXMC_FAULTS", raising=False)
+    monkeypatch.delenv("JAXMC_COMPILE_CACHE", raising=False)
+    monkeypatch.setenv("JAXMC_CACHE_PROBE", "0")  # probe-needing tests
+    # opt back in explicitly — jax-import subprocesses are expensive
+    faults.reset_for_tests()
+    cache.release_lock_for_tests()
+    yield
+    faults.reset_for_tests()
+    cache.release_lock_for_tests()
+
+
+def _dir(tmp_path):
+    return str(tmp_path / "xla_cache")
+
+
+def test_guard_enables_and_fingerprints(tmp_path):
+    tel = obs.Telemetry()
+    d = cache.enable_guarded_cache(_dir(tmp_path), tel=tel)
+    assert d == _dir(tmp_path)
+    # the build-fingerprint sentinel exists and matches this build
+    meta = json.load(open(os.path.join(d, "jaxmc.cache.meta.json")))
+    assert meta["python"] and meta["jax"]
+    assert tel.gauges["compile.persistent_cache_guard"].startswith("ok")
+
+
+def test_env_opt_out_disables_defaults_not_explicit_requests(
+        monkeypatch, tmp_path):
+    # JAXMC_COMPILE_CACHE=off governs the DEFAULT-ON call sites (bench
+    # children, sweep subprocesses — they pass no path)...
+    monkeypatch.setenv("JAXMC_COMPILE_CACHE", "off")
+    tel = obs.Telemetry()
+    assert cache.enable_guarded_cache(tel=tel) is None
+    assert tel.gauges["compile.persistent_cache_guard"].startswith(
+        "disabled")
+    # ...but an EXPLICIT path (cli --compile-cache DIR) is a direct
+    # request and overrides the box-wide opt-out
+    tel2 = obs.Telemetry()
+    assert cache.enable_guarded_cache(_dir(tmp_path), tel=tel2) == \
+        _dir(tmp_path)
+    assert tel2.gauges["compile.persistent_cache_guard"].startswith("ok")
+
+
+@pytest.mark.chaos
+def test_hang_fault_quarantines_and_falls_back_cold(monkeypatch,
+                                                    tmp_path):
+    # the known failure class: a blob reload that never returns. The
+    # probe child wedges (cache_hang), OUR timeout fires, the dir is
+    # quarantined, and the caller gets the cold path — never a hang.
+    monkeypatch.setenv("JAXMC_CACHE_PROBE", "1")
+    monkeypatch.setenv("JAXMC_FAULTS", "cache_hang")
+    faults.reset_for_tests()
+    tel = obs.Telemetry()
+    d = _dir(tmp_path)
+    assert cache.enable_guarded_cache(d, tel=tel, timeout_s=6) is None
+    g = tel.gauges["compile.persistent_cache_guard"]
+    assert g.startswith("cold-fallback:") and "probe" in g
+    assert tel.counters["compile.persistent_cache_fallbacks"] == 1
+    assert any(n.startswith("xla_cache.quarantined.")
+               for n in os.listdir(tmp_path))
+    # the run is intact: a compile still works, just uncached
+    import jax
+    import jax.numpy as jnp
+    assert int(jax.jit(lambda x: x + 1)(jnp.int32(1))) == 2
+
+
+@pytest.mark.chaos
+def test_corrupt_entry_quarantined_cache_continues(monkeypatch,
+                                                   tmp_path):
+    # one corrupt entry must never disable the whole cache: the scan
+    # quarantines it into <dir>/.quarantine and the cache enables
+    d = _dir(tmp_path)
+    os.makedirs(d)
+    with open(os.path.join(d, "jit_f-deadbeef-cache"), "wb") as fh:
+        fh.write(b"x" * 64)
+    monkeypatch.setenv("JAXMC_FAULTS", "cache_corrupt")
+    faults.reset_for_tests()
+    tel = obs.Telemetry()
+    assert cache.enable_guarded_cache(d, tel=tel) == d
+    assert tel.counters["compile.persistent_cache_quarantines"] >= 1
+    assert os.listdir(os.path.join(d, ".quarantine")) == \
+        ["jit_f-deadbeef-cache"]
+    assert "quarantined 1 corrupt entry" in \
+        tel.gauges["compile.persistent_cache_guard"]
+
+
+@pytest.mark.chaos
+def test_lock_fault_falls_back_cold(monkeypatch, tmp_path):
+    monkeypatch.setenv("JAXMC_FAULTS", "cache_lock")
+    faults.reset_for_tests()
+    tel = obs.Telemetry()
+    assert cache.enable_guarded_cache(_dir(tmp_path), tel=tel) is None
+    assert "lock contention" in \
+        tel.gauges["compile.persistent_cache_guard"]
+
+
+def test_real_lock_contention_falls_back_cold(tmp_path):
+    # a REAL exclusive flock held elsewhere (a quarantine in flight):
+    # this process must not race the rename — cold fallback
+    import fcntl
+    d = _dir(tmp_path)
+    os.makedirs(d)
+    fd = os.open(d + ".lock", os.O_CREAT | os.O_RDWR)
+    try:
+        fcntl.flock(fd, fcntl.LOCK_EX)
+        tel = obs.Telemetry()
+        assert cache.enable_guarded_cache(d, tel=tel) is None
+        assert "lock contention" in \
+            tel.gauges["compile.persistent_cache_guard"]
+    finally:
+        os.close(fd)
+
+
+def test_foreign_build_fingerprint_quarantines_dir(tmp_path):
+    # a cache written by another build is exactly the reload-hang class:
+    # the whole dir is swapped aside BEFORE jax ever reads a blob
+    d = _dir(tmp_path)
+    os.makedirs(d)
+    with open(os.path.join(d, "jaxmc.cache.meta.json"), "w") as fh:
+        json.dump({"python": "0.0.0", "jax": "0.0.0",
+                   "machine": "vax"}, fh)
+    with open(os.path.join(d, "jit_old-cache"), "wb") as fh:
+        fh.write(b"foreign blob")
+    tel = obs.Telemetry()
+    assert cache.enable_guarded_cache(d, tel=tel) == d
+    assert tel.counters["compile.persistent_cache_quarantines"] >= 1
+    assert not os.path.exists(os.path.join(d, "jit_old-cache"))
+    quarantined = [n for n in os.listdir(tmp_path)
+                   if n.startswith("xla_cache.quarantined.")]
+    assert quarantined, "foreign dir should be parked aside"
+    # the fresh dir carries THIS build's fingerprint
+    meta = json.load(open(os.path.join(d, "jaxmc.cache.meta.json")))
+    assert meta["machine"] != "vax"
+
+
+def test_failed_foreign_quarantine_falls_back_cold(monkeypatch,
+                                                   tmp_path):
+    # if the quarantine rename itself fails, the foreign-build dir is
+    # STILL on disk — the guard must compile cold, never enable over
+    # the very dir it diagnosed as the reload-hang class
+    d = _dir(tmp_path)
+    os.makedirs(d)
+    with open(os.path.join(d, "jaxmc.cache.meta.json"), "w") as fh:
+        json.dump({"python": "0.0.0", "jax": "0.0.0",
+                   "machine": "vax"}, fh)
+    monkeypatch.setattr(cache, "_quarantine_dir", lambda p: None)
+    tel = obs.Telemetry()
+    assert cache.enable_guarded_cache(d, tel=tel) is None
+    g = tel.gauges["compile.persistent_cache_guard"]
+    assert g.startswith("cold-fallback:") and "quarantine rename" in g
+
+
+@pytest.mark.chaos
+def test_cross_process_hits_visible(tmp_path):
+    # the tentpole's proof obligation: process B reloads what process A
+    # compiled, visible in compile.persistent_cache_hits
+    d = _dir(tmp_path)
+    code = (
+        "import os, sys, json\n"
+        f"sys.path.insert(0, {REPO!r})\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from jaxmc import obs\n"
+        "from jaxmc.compile.cache import enable_guarded_cache\n"
+        "tel = obs.Telemetry()\n"
+        f"assert enable_guarded_cache({d!r}, tel=tel)\n"
+        "import jax.numpy as jnp\n"
+        "with obs.use(tel):\n"
+        "    jax.jit(lambda x: x * 3 + 7)(jnp.arange(5))"
+        ".block_until_ready()\n"
+        "print('HITS', tel.counters.get("
+        "'compile.persistent_cache_hits', 0))\n")
+    outs = []
+    for _ in range(2):
+        p = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True,
+            text=True, timeout=240,
+            env=dict(os.environ, JAX_PLATFORMS="cpu",
+                     JAXMC_CACHE_PROBE="0"))
+        assert p.returncode == 0, p.stderr[-800:]
+        outs.append(int(p.stdout.split("HITS")[1].strip()))
+    assert outs[0] == 0, "first process must compile cold"
+    assert outs[1] > 0, "second process must hit the persistent cache"

@@ -66,6 +66,102 @@ def test_consensus_deadlocks_like_tlc_default():
     assert not r.ok and r.violation.kind == "deadlock"
 
 
+class TestModePins:
+    """Expansion-mode pinning (ISSUE 5) — repo-local models only, so
+    this class runs without the reference tree."""
+
+    @staticmethod
+    def _case(spec):
+        return next(c for c in CASES if c.spec == spec)
+
+    @staticmethod
+    def _needs_native_store():
+        from jaxmc import native_store
+        if not native_store.is_available():
+            pytest.skip("interp-arms pins need the native host store")
+
+    def test_pinned_interp_arms_skips_kernel_construction(self):
+        # the r05 sweep's 213s lesson: a pinned interp-arms case must
+        # not ground/compile/trace a single kernel — and still produce
+        # the pinned counts through the hybrid engine
+        import dataclasses
+        self._needs_native_store()
+        case = dataclasses.replace(self._case("specs/symtoy.tla"),
+                                   mode="interp-arms")
+        status, detail, r, mode = run_case(case, backend="jax")
+        assert status == "pass", detail
+        assert mode == "interp-arms" and "[mode pinned]" in detail
+        assert "0/4 arms compiled" in detail
+
+    def test_mode_slide_toward_interp_fails(self):
+        # interparm_toy is hybrid BY CONSTRUCTION (unguarded
+        # SUBSET-of-symbolic-set assignment): pinning it "compiled"
+        # must FAIL the sweep, fast, without running the search
+        import dataclasses
+        self._needs_native_store()
+        case = dataclasses.replace(
+            self._case("specs/interparm_toy.tla"), mode="compiled")
+        status, detail, r, mode = run_case(case, backend="jax")
+        assert status == "fail" and "REGRESSION" in detail \
+            and "slid" in detail
+        assert r is None, "a slid case must fail before the search runs"
+
+    def test_demoted_arm_reasons_named_in_detail(self):
+        # the per-arm demotion reason table (VERDICT r5 #4): the demoted
+        # arm is NAMED with its reason, not folded into a count
+        self._needs_native_store()
+        status, detail, _r, mode = run_case(
+            self._case("specs/interparm_toy.tla"), backend="jax")
+        assert status == "pass", detail
+        assert mode == "hybrid"
+        assert "demoted arms: Pick: SUBSET of symbolic set" in detail
+
+    def test_mode_improvement_passes_with_manifest_note(self):
+        import dataclasses
+        case = dataclasses.replace(self._case("specs/symtoy.tla"),
+                                   mode="hybrid")
+        status, detail, _r, mode = run_case(case, backend="jax")
+        assert status == "pass" and mode == "compiled"
+        assert "update the manifest" in detail
+
+    def test_pin_escape_hatch_lifts_enforcement(self, monkeypatch):
+        # JAXMC_MODE_PIN=0: the diagnosis sweep builds everything again
+        import dataclasses
+        monkeypatch.setenv("JAXMC_MODE_PIN", "0")
+        case = dataclasses.replace(self._case("specs/symtoy.tla"),
+                                   mode="interp-arms")
+        status, detail, _r, mode = run_case(case, backend="jax")
+        assert status == "pass" and mode == "compiled"
+        assert "[mode pinned]" not in detail
+
+
+class TestSymmetryDisclosure:
+    """sym=identity vs sym=UNREDUCED-FALLBACK (ISSUE 5 satellite): an
+    identity permutation group has no reduction to diverge from — only
+    a genuine CompileError fallback may claim divergence."""
+
+    def test_identity_group_reports_identity(self):
+        case = next(c for c in CASES if c.spec == "specs/symid.tla")
+        status, detail, r, _m = run_case(case, backend="jax")
+        assert status == "pass", detail
+        assert "sym=identity" in detail
+        assert "UNREDUCED" not in detail
+        assert not any("SYMMETRY" in w for w in r.warnings), \
+            "identity groups must not emit the divergence warning"
+
+    def test_forced_fallback_still_warns(self, monkeypatch):
+        # a REAL canonicalizer fallback (group over the unroll limit)
+        # keeps the honest divergence disclosure
+        import dataclasses
+        monkeypatch.setenv("JAXMC_SYM_GROUP_LIMIT", "0")
+        case = dataclasses.replace(
+            next(c for c in CASES if c.spec == "specs/symtoy.tla"),
+            distinct=None, generated=None, mode=None)
+        status, detail, _r, _m = run_case(case, backend="jax")
+        assert status == "pass", detail
+        assert "sym=UNREDUCED-FALLBACK" in detail
+
+
 def test_raft_explores():
     # raft with the BASELINE.json 3-server model explores correctly on the
     # interpreter (bounded prefix; full run is the TPU-backend target)

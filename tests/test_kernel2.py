@@ -204,3 +204,43 @@ def test_raft_3s_bench_whole_run_equivalence():
                      host_seen=native_store.is_available()).run()
     assert rj.ok
     assert (rj.generated, rj.distinct) == (1138651, 76654)
+
+
+def test_recursive_operator_demotes_predicate_with_named_reason(tmp_path):
+    # ISSUE 5: a diverging RECURSIVE operator used to surface as an
+    # anonymous RecursionError; the kernel2 unroll counter now trips
+    # first and the demotion reason NAMES the operator. Invariants are
+    # strict frames (no guard-demotion recovery), so the predicate must
+    # land in fb_invs with that reason — while the non-recursive action
+    # arm still compiles.
+    from jaxmc.tpu.bfs import TpuExplorer
+    from jaxmc import native_store
+    if not native_store.is_available():
+        pytest.skip("hybrid (demoted invariant) needs the native store")
+    (tmp_path / "rec.tla").write_text(
+        "---------------- MODULE rec ----------------\n"
+        "EXTENDS Naturals\n"
+        "VARIABLES x\n"
+        "RECURSIVE Depth(_)\n"
+        "Depth(k) == IF k <= 0 THEN 0 ELSE 1 + Depth(k - 1)\n"
+        "Init == x = 0\n"
+        "Next == x < 4 /\\ x' = x + 1\n"
+        "Spec == Init /\\ [][Next]_x\n"
+        "RecInv == Depth(x) <= 4\n"
+        "=============================================\n")
+    cfg = parse_cfg("SPECIFICATION Spec\nINVARIANT RecInv\n"
+                    "CHECK_DEADLOCK FALSE\n")
+    model = bind_model(
+        Loader([str(tmp_path)]).load_path(str(tmp_path / "rec.tla")),
+        cfg)
+    ex = TpuExplorer(model, store_trace=False,
+                     host_seen=native_store.is_available())
+    assert not ex.fb_arms, "the plain arm must stay compiled"
+    assert len(ex.fb_invs) == 1
+    nm, _e, reason = ex.fb_invs[0]
+    assert nm == "RecInv"
+    assert "recursive operator Depth exceeds the compile-time unroll " \
+           "limit" in reason
+    # and the hybrid run still produces exact counts
+    r = ex.run()
+    assert r.ok and (r.generated, r.distinct) == (5, 5)
