@@ -113,6 +113,10 @@ pin-si-env:
 # `make bench-check-reset` discards the baselines.
 BENCH_CHECK_SPEC ?= specs/transfer_scaled.tla
 BENCH_CHECK_DIR  ?= /tmp
+# repo-local kernel-vs-interp rungs (ISSUE 6): the three feature axes —
+# plain wide search, cfg VIEW, cfg SYMMETRY — at bench scale
+KERNELBENCH_RUNGS ?= specs/transfer_scaled.tla specs/viewtoy_scaled.tla \
+                     specs/symtoy_scaled.tla
 bench-check:
 	JAX_PLATFORMS=cpu $(PY) -m jaxmc check $(BENCH_CHECK_SPEC) \
 	    --workers 1 --max-states 20000 --quiet \
@@ -143,6 +147,17 @@ bench-check:
 	    cp $$cur $$base; \
 	    echo "$$leg baseline saved -> $$base"; \
 	  fi; \
+	done
+	# kernel-vs-interp leg (ISSUE 6): on every repo-local rung the
+	# cpu-XLA kernel (steady state: one warm-up excluded) must meet or
+	# exceed the serial interpreter's states/sec, with bit-identical
+	# counts; jaxmc.kernelbench writes the two artifacts and gates them
+	# through `python -m jaxmc.obs diff --fail-on-regress` ([interp,
+	# kernel] order — a slower kernel raises the REGRESS flag)
+	@for spec in $(KERNELBENCH_RUNGS); do \
+	  echo "== kernel-vs-interp leg: $$spec =="; \
+	  JAX_PLATFORMS=cpu $(PY) -m jaxmc.kernelbench $$spec \
+	      --out-dir $(BENCH_CHECK_DIR) || exit 1; \
 	done
 
 bench-check-reset:

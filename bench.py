@@ -64,6 +64,7 @@ import subprocess
 import sys
 import threading
 import time
+from typing import Optional
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 _REPO = os.path.dirname(os.path.abspath(__file__))
@@ -284,10 +285,21 @@ def child_bench(platform_pin: str, rung: str):
     # baseline.
     with obs.use(tel):
         with tel.span("engine_build"):
+            # steady-state caps: the corpus manifest's committed
+            # res_caps record for this cfg (ISSUE 6), falling back to
+            # the full-rung constants; the engine max-merges the
+            # PERSISTED capacity profile on top (compile/cache.py), so
+            # a second run starts at the learned caps and
+            # window_recompiles reads 0
+            from jaxmc.corpus import case_for_cfg
+            _case = case_for_cfg(os.path.basename(cfg_path))
+            _caps = dict(_case.res_caps) if _case is not None \
+                and _case.res_caps else (
+                dict(_BENCH_RES_CAPS) if rung == "full" else None)
+            if _caps:
+                _caps.pop("chunk", None)
             ex = TpuExplorer(load_model(), store_trace=False,
-                             resident=True,
-                             res_caps=_BENCH_RES_CAPS
-                             if rung == "full" else None)
+                             resident=True, res_caps=_caps)
         steady, r_warm = (_warm_start(tel, ex) if rung == "full"
                           else (None, None))
         if steady is None and r_warm is None:
@@ -831,8 +843,29 @@ def _run_profile_tpu(timeout_s: float):
         _log(f"profile_tpu.py failed to run: {ex}")
 
 
+def _reference_missing() -> Optional[str]:
+    """Named skip reason when the raft bench workload cannot load here
+    (ISSUE 6 satellite): every bench rung EXTENDS the reference
+    raft.tla, so a container without the reference tree must SKIP with
+    a parseable line instead of failing five minutes in."""
+    ref = os.environ.get("JAXMC_REFERENCE", "/root/reference")
+    if os.path.exists(os.path.join(ref, "examples", "raft.tla")):
+        return None
+    return (f"reference corpus not mounted at {ref} (driver environment "
+            f"only; set JAXMC_REFERENCE) — the bench rungs EXTEND its "
+            f"raft.tla")
+
+
 def main():
     global _DEADLINE, _TEL
+    skip = _reference_missing()
+    if skip is not None:
+        _log(f"SKIP: {skip}")
+        print(json.dumps({
+            "metric": f"bench SKIPPED: {skip}", "value": None,
+            "unit": "states/sec", "vs_baseline": None,
+            "skip_reason": skip}), flush=True)
+        return
     pin = os.environ.get("JAXMC_BENCH_CHILD")
     if pin == "emergency":
         child_emergency()

@@ -438,6 +438,130 @@ def record_entries_end(path: Optional[str], tel=None) -> None:
             "compile.persistent_cache_entries_end", n)
 
 
+# ---------------------------------------------------------------------
+# Learned per-spec CAPACITY PROFILES (ISSUE 6).
+#
+# The resident engine's capacity buckets (SC/FCap/AccCap/VC) are learned
+# by overflow-growth — and every growth is a full XLA recompile of the
+# whole while_loop program, potentially inside somebody's measured
+# window.  A capacity profile persists the caps a completed resident run
+# ended with, keyed by (module, layout signature), NEXT TO the compile
+# cache: the next run on the same spec starts at the learned caps, its
+# one warm-up compile covers the whole run, and `window_recompiles`
+# reads 0 in the steady-state bench.
+#
+# Safety: a profile is a pure PERFORMANCE hint — wrong caps can only
+# cost a recompile (the engine's overflow-growth path still works), so a
+# stale/foreign profile is IGNORED with a named reason, never trusted
+# into a crash.  Validation: schema, module name, layout signature (it
+# covers the lane plan, so a packing change invalidates profiles), and
+# sane positive-int caps.  JAXMC_CAP_PROFILE=0 disables load AND save.
+
+_PROFILE_SCHEMA = "jaxmc.capacity-profile/1"
+_PROFILE_CAP_KEYS = ("SC", "FCap", "AccCap", "VC")
+
+
+def profiles_enabled() -> bool:
+    return os.environ.get("JAXMC_CAP_PROFILE", "1").strip().lower() \
+        not in _OFF_VALUES
+
+
+def profile_dir() -> str:
+    d = os.environ.get("JAXMC_PROFILE_STORE")
+    if d:
+        return d
+    return (cache_dir_from_env() or default_cache_dir()) + ".profiles"
+
+
+def profile_path(module: str, layout_sig: str) -> str:
+    safe = "".join(ch if ch.isalnum() or ch in "._-" else "_"
+                   for ch in module)[:80]
+    return os.path.join(profile_dir(), f"{safe}.{layout_sig[:16]}.json")
+
+
+def load_capacity_profile(module: str, layout_sig: str, tel=None
+                          ) -> Optional[dict]:
+    """The validated caps dict, or None with a NAMED degrade reason in
+    the `profile.status` gauge (absent / unreadable / foreign schema /
+    module mismatch / stale layout / bad caps).  Never raises."""
+    from .. import obs
+    tel = tel if tel is not None else obs.current()
+    if not profiles_enabled():
+        tel.gauge("profile.status", "disabled:JAXMC_CAP_PROFILE")
+        return None
+    path = profile_path(module, layout_sig)
+
+    def _no(reason: str) -> None:
+        tel.gauge("profile.status", f"degraded:{reason}")
+        tel.counter("profile.degrades")
+
+    try:
+        with open(path, encoding="utf-8") as fh:
+            p = json.load(fh)
+    except FileNotFoundError:
+        tel.gauge("profile.status", "absent")
+        return None
+    except (OSError, ValueError) as ex:
+        _no(f"unreadable profile ({type(ex).__name__})")
+        return None
+    if not isinstance(p, dict) or p.get("schema") != _PROFILE_SCHEMA:
+        _no(f"foreign schema {p.get('schema') if isinstance(p, dict) else type(p).__name__!r}")
+        return None
+    if p.get("module") != module:
+        _no(f"module mismatch ({p.get('module')!r})")
+        return None
+    if p.get("layout_sig") != layout_sig:
+        # the one expected staleness class: the model/bounds/pack plan
+        # changed since the profile was learned
+        _no("stale layout signature (model, caps or packing changed)")
+        return None
+    caps = p.get("caps")
+    if not isinstance(caps, dict) or not all(
+            isinstance(caps.get(k), int) and 0 < caps[k] < (1 << 31)
+            for k in _PROFILE_CAP_KEYS):
+        _no("malformed caps")
+        return None
+    tel.gauge("profile.status", "loaded")
+    tel.counter("profile.hits")
+    return {k: int(caps[k]) for k in _PROFILE_CAP_KEYS}
+
+
+def save_capacity_profile(module: str, layout_sig: str,
+                          caps: dict, tel=None, **extra) -> Optional[str]:
+    """Persist the caps a completed resident run ended with (atomic
+    write; max-merged over any existing valid profile so alternating
+    workloads never thrash each other downward).  Never raises."""
+    from .. import obs
+    tel = tel if tel is not None else obs.current()
+    if not profiles_enabled():
+        return None
+    try:
+        prev = load_capacity_profile(module, layout_sig,
+                                     tel=obs.NullTelemetry())
+        merged = {k: int(caps[k]) for k in _PROFILE_CAP_KEYS
+                  if isinstance(caps.get(k), int)}
+        if len(merged) != len(_PROFILE_CAP_KEYS):
+            return None
+        if prev:
+            for k in _PROFILE_CAP_KEYS:
+                merged[k] = max(merged[k], prev[k])
+        d = profile_dir()
+        os.makedirs(d, exist_ok=True)
+        path = profile_path(module, layout_sig)
+        tmp = path + f".tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump({"schema": _PROFILE_SCHEMA, "module": module,
+                       "layout_sig": layout_sig, "caps": merged,
+                       "build": _fingerprint(), "saved_at": time.time(),
+                       **extra}, fh)
+        os.replace(tmp, path)
+        tel.gauge("profile.status", "saved")
+        tel.counter("profile.saves")
+        return path
+    except Exception:  # noqa: BLE001 — a profile is a hint, never a crash
+        return None
+
+
 def release_lock_for_tests() -> None:
     """Drop the parked shared flock so tests can exercise contention."""
     global _LOCK_FD

@@ -1276,10 +1276,14 @@ _CMP = {"<", ">", "<=", ">=", "=<", "\\leq", "\\geq"}
 
 # action-kernel overflow codes (the `ov` output of CompiledAction2.fn):
 # 0 = none; OV_CAPACITY = a value outgrew its lanes (fix: raise caps);
+# OV_PACK = a value escaped its packed lane's profiled bit range (fix:
+# deepen sampling or JAXMC_PACK=0 — raised by the ENGINES' pack step,
+# compile/pack.py, never by a kernel);
 # OV_DEMOTED = an `except CompileError` recovery fired (fix: the hybrid
 # engine demotes the arm to the interpreter and restarts)
 OV_CAPACITY = 1
 OV_DEMOTED = 2
+OV_PACK = 3
 
 
 class Elems:
@@ -2215,7 +2219,13 @@ def _generic_in(x, s, fr: Frame):
 # ---------------------------------------------------------------------------
 
 class Layout2:
-    """vspec-based state layout (replaces compile.ground.StateLayout)."""
+    """vspec-based state layout (replaces compile.ground.StateLayout).
+
+    Carries the bit-packed LanePlan (compile/pack.py) alongside the
+    unpacked lane specs: kernels compute on unpacked lanes, while the
+    engines store frontier/seen/trace rows packed.  A Layout2 built
+    outside build_layout2 (tests) lazily defaults to the identity plan
+    (packed == unpacked)."""
 
     def __init__(self, vars: Tuple[str, ...], specs: Dict[str, VS],
                  uni: EnumUniverse):
@@ -2228,6 +2238,22 @@ class Layout2:
         for v in vars:
             self.offsets[v] = off
             off += specs[v].width
+        self._plan = None
+
+    @property
+    def plan(self):
+        if self._plan is None:
+            from .pack import identity_plan
+            self._plan = identity_plan(self.width)
+        return self._plan
+
+    @plan.setter
+    def plan(self, p):
+        self._plan = p
+
+    @property
+    def packed_width(self) -> int:
+        return self.plan.packed_width
 
     def encode(self, state: Dict[str, Any]):
         import numpy as np
@@ -2243,6 +2269,28 @@ class Layout2:
         for v in self.vars:
             st[v], i = vs_decode(row, i, self.specs[v], self.uni)
         return st
+
+    # ---- packed-row boundary helpers (engine storage format) ----
+
+    def pack_np(self, rows):
+        import numpy as np
+        rows = np.asarray(rows, np.int32)
+        if rows.ndim == 1:
+            return self.plan.pack_np(rows[None, :])[0]
+        return self.plan.pack_np(rows)
+
+    def unpack_np(self, packed):
+        import numpy as np
+        packed = np.asarray(packed, np.int32)
+        if packed.ndim == 1:
+            return self.plan.unpack_np(packed[None, :])[0]
+        return self.plan.unpack_np(packed)
+
+    def encode_packed(self, state: Dict[str, Any]):
+        return self.pack_np(self.encode(state))
+
+    def decode_packed(self, packed_row) -> Dict[str, Any]:
+        return self.decode(self.unpack_np(packed_row))
 
 
 def build_layout2(model: Model, sampled_states: List[Dict[str, Any]],
@@ -2268,9 +2316,26 @@ def build_layout2(model: Model, sampled_states: List[Dict[str, Any]],
             sp = s2 if sp is None else vs_merge(sp, s2)
         specs[var] = apply_bounds(sp, bounds)
     lay = Layout2(tuple(model.vars), specs, uni)
+    # bit-packed lane plan (ISSUE 6): structural bounds + observed int
+    # ranges over the encoded sample rows decide per-lane bit widths
+    from .pack import build_lane_plan
+    sample_rows = []
+    for st in sampled_states:
+        try:
+            sample_rows.append(lay.encode(st))
+        except (CompileError, EvalError):
+            # a sampled state the merged layout cannot encode would have
+            # failed the search anyway; the plan just profiles without it
+            continue
+    lay.plan = build_lane_plan(lay, sample_rows)
     tel = obs.current()
     tel.gauge("layout.enum_universe", len(uni.values))
     tel.gauge("layout.samples", len(sampled_states))
+    tel.gauge("layout.packed_width_lanes", lay.plan.packed_width)
+    tel.gauge("layout.bits_per_state", lay.plan.bits_per_state)
+    tel.gauge("layout.pack_ratio",
+              round(lay.plan.packed_width / max(lay.width, 1), 4))
+    tel.gauge("layout.pack_guarded_lanes", lay.plan.guarded_lanes)
     return lay
 
 
@@ -2647,6 +2712,36 @@ def introspect_kernel(fn: Callable, args, want_cost: bool = True
     except Exception:  # noqa: BLE001 — cost model absent on some backends
         pass
     return out
+
+
+def compile_value2(kc: KernelCtx, expr: A.Node) -> Callable:
+    """Compile an expression to its encoded VALUE lanes: fn(row) -> 1-D
+    i32 lane array.  Used for cfg VIEW (ISSUE 6): the engines key their
+    dedup on the view's value lanes instead of the state row, matching
+    TLC's fingerprint-the-view semantics.  Strict frame like predicates:
+    an uncompilable view raises CompileError at trace time (the interp
+    backend remains the checker)."""
+    layout = kc.layout
+
+    def fn(row):
+        state = {}
+        off = 0
+        for v in layout.vars:
+            sp = layout.specs[v]
+            state[v] = SymV(sp, row[off:off + sp.width])
+            off += sp.width
+        fr = Frame(kc, {}, state, {}, [False], strict=True, memo={})
+        val = _lift(sym_eval2(expr, fr), fr)
+        lanes = val.lanes
+        if isinstance(lanes, np.ndarray):
+            # a row-independent view (constant value): still a valid
+            # partition — every state shares one key
+            return jnp.asarray(lanes.astype(np.int32))
+        lanes = jnp.asarray(lanes)
+        return lanes.astype(jnp.int32) if lanes.dtype != jnp.int32 \
+            else lanes
+
+    return fn
 
 
 def compile_predicate2(kc: KernelCtx, expr: A.Node) -> Callable:

@@ -61,6 +61,12 @@ class Case:
     seq_cap: Optional[int] = None
     grow_cap: Optional[int] = None
     kv_cap: Optional[int] = None
+    # steady-state RESIDENT capacity buckets for this case (ISSUE 6):
+    # the manifest-recorded floor for {SC, FCap, AccCap, VC} so a bench
+    # or kernelbench run compiles ONCE and never grows mid-window.  The
+    # persisted capacity profile (compile/cache.py) max-merges over
+    # this; the manifest value is the committed, review-able record.
+    res_caps: Optional[dict] = None
 
     def spec_path(self) -> str:
         base = REFERENCE if self.root == "ref" else REPO
@@ -163,16 +169,26 @@ CASES: List[Case] = [
     Case("specs/transfer_scaled.tla", root="repo",
          cfg="specs/transfer_scaled.cfg",
          distinct=153701, generated=311153, slow=True, jax="yes",
-         mode="compiled"),
+         mode="compiled",
+         # kernelbench rung (ISSUE 6): steady resident buckets so the
+         # warm-up compile covers the whole run
+         res_caps={"SC": 1 << 18, "FCap": 1 << 16, "AccCap": 1 << 17,
+                   "VC": 1 << 13, "chunk": 2048}),
     Case("specs/MCraftMicro.tla", root="repo",
          cfg="specs/MCraft_micro.cfg", includes=("examples",),
-         distinct=694, generated=6185, jax="yes", mode="compiled"),
+         distinct=694, generated=6185, jax="yes", mode="compiled",
+         res_caps={"SC": 1 << 12, "FCap": 1 << 9, "AccCap": 1 << 12,
+                   "VC": 1 << 11, "chunk": 256}),
     # mode=compiled proven by the BENCH_r02 resident-mode completion
     # (resident refuses hybrid/interp-arms outright)
     Case("specs/MCraftMicro.tla", root="repo",
          cfg="specs/MCraft_3s_bench.cfg", includes=("examples",),
          distinct=76654, generated=1138651, slow=True, jax="yes",
-         mode="compiled"),
+         mode="compiled",
+         # the bench.py full rung's steady caps (one warm-up compile
+         # covers the run; the persisted profile max-merges over this)
+         res_caps={"SC": 1 << 18, "FCap": 1 << 16, "AccCap": 1 << 17,
+                   "VC": 1 << 13}),
     Case("specs/MCtextbookSI.tla", root="repo",
          cfg="specs/MCtextbookSI_small.cfg", includes=("examples",),
          distinct=569, generated=945, jax="yes", mode="interp-arms"),
@@ -193,11 +209,39 @@ CASES: List[Case] = [
     Case("specs/MCserializableSI.tla", root="repo",
          cfg="specs/MCserializableSI_env.cfg", includes=("examples",),
          slow=True),
+    # VIEW/CONSTRAINT parity fixtures (PR 3), now first-class manifest
+    # cases: cfg VIEW compiles on the jax backend since ISSUE 6 (dedup
+    # keys on the compiled view's value lanes), and both serve as
+    # kernelbench rungs with committed res_caps records
+    Case("specs/viewtoy.tla", root="repo", cfg="specs/viewtoy.cfg",
+         distinct=5, generated=11, jax="yes", mode="compiled",
+         res_caps={"SC": 256, "FCap": 64, "AccCap": 128, "VC": 64,
+                   "chunk": 64}),
+    Case("specs/constoy.tla", root="repo", cfg="specs/constoy.cfg",
+         distinct=21, generated=43, jax="yes", mode="compiled",
+         res_caps={"SC": 256, "FCap": 64, "AccCap": 128, "VC": 64,
+                   "chunk": 64}),
+    # bench-scale kernelbench rungs (ISSUE 6): wide-shallow variants of
+    # the VIEW/SYMMETRY fixtures sized so states/sec measures
+    # throughput; `make bench-check`'s kernel-vs-interp leg gates the
+    # cpu-XLA kernel against the serial interpreter on each
+    Case("specs/viewtoy_scaled.tla", root="repo",
+         cfg="specs/viewtoy_scaled.cfg",
+         distinct=18432, generated=239617, jax="yes", mode="compiled",
+         res_caps={"SC": 1 << 15, "FCap": 1 << 12, "AccCap": 1 << 15,
+                   "VC": 1 << 13, "chunk": 1024}),
+    Case("specs/symtoy_scaled.tla", root="repo",
+         cfg="specs/symtoy_scaled.cfg", no_deadlock=True,
+         distinct=10725, generated=65365, jax="yes", mode="compiled",
+         res_caps={"SC": 1 << 15, "FCap": 1 << 12, "AccCap": 1 << 14,
+                   "VC": 1 << 13, "chunk": 1024}),
     # device SYMMETRY toys (orbit-canonical counts; deadlock expected
     # when every process exhausts its turns)
     Case("specs/symtoy.tla", root="repo", cfg="specs/symtoy.cfg",
          no_deadlock=True, distinct=22, generated=33, jax="yes",
-         mode="compiled"),
+         mode="compiled",
+         res_caps={"SC": 256, "FCap": 64, "AccCap": 128, "VC": 64,
+                   "chunk": 64}),
     # ISSUE 5 disclosure fixtures (repo-local, no reference needed):
     # identity-group SYMMETRY must say sym=identity, never claim an
     # UNREDUCED-FALLBACK divergence...

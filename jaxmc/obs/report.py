@@ -215,7 +215,10 @@ def cmd_report(args, out=sys.stdout) -> int:
               "compile.hlo_flops_total", "watchdog.stalls"):
         if k in c:
             hl.append(f"{k}={c[k]}")
-    for k in ("expand.mode", "fingerprint.occupancy",
+    for k in ("expand.mode", "dedup.mode", "layout.width_lanes",
+              "layout.packed_width_lanes", "layout.bits_per_state",
+              "device.donation", "profile.status",
+              "fingerprint.occupancy",
               "device.mem_high_water_bytes", "watchdog.max_stall_s"):
         if k in g:
             hl.append(f"{k}={g[k]}")

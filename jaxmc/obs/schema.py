@@ -114,6 +114,31 @@ jaxmc.metrics/2 artifact minus the new optional surface, so readers and
       `sym=identity` (identity permutation group — no divergence), or
       `sym=UNREDUCED-FALLBACK (...)` (a genuine CompileError fallback;
       the only case where counts diverge from TLC's reduced ones).
+
+  (PR 6, still jaxmc.metrics/2 — all additive/optional; the
+   state-encoding surface:)
+    - bit-packed lane plans (compile/pack.py): gauges
+      `layout.packed_width_lanes` (packed row width, vs the existing
+      `layout.width_lanes`), `layout.bits_per_state`,
+      `layout.pack_ratio` (packed/unpacked width),
+      `layout.pack_guarded_lanes` (observed-range int lanes with a
+      runtime guard), and `dedup.mode` — "exact" | "fp128" with a
+      "-packed" suffix when the key basis is the packed row or
+      "-view" when cfg VIEW keys the dedup;
+    - buffer donation (tpu/bfs.py): gauge `device.donation` (bool —
+      seen/frontier donated into the jitted steps; off on XLA:CPU by
+      default, JAXMC_DONATE forces);
+    - capacity profiles (compile/cache.py): gauge `profile.status` —
+      "loaded" / "saved" / "absent" / "disabled:..." /
+      "degraded:<named reason>" (stale layout signature, foreign
+      schema, module mismatch, unreadable, malformed caps — a degraded
+      profile falls back to the overflow-growth path, never a crash);
+      counters `profile.hits` / `profile.saves` / `profile.degrades`;
+    - kernelbench artifacts (jaxmc/kernelbench.py): ordinary
+      jaxmc.metrics/2 summaries whose `result.wall_s` is the
+      min-of-repeats steady wall (warm-up excluded), gauge
+      `kernelbench.note` carries the measurement methodology; the
+      kernel-vs-interp leg feeds them to `obs diff --fail-on-regress`.
 """
 
 from __future__ import annotations

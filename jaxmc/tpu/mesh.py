@@ -51,7 +51,7 @@ from .. import obs
 from ..sem.modules import Model
 from ..engine.explore import CheckResult, Violation
 from ..compile.vspec import ModeError
-from ..compile.kernel2 import OV_DEMOTED
+from ..compile.kernel2 import OV_DEMOTED, OV_PACK
 from .bfs import (SENTINEL, TpuExplorer, _LiveGraph, _pow2_at_least,
                   filter_init_states, fingerprint128)
 
@@ -121,6 +121,8 @@ class MeshExplorer(TpuExplorer):
         if key in self._mesh_step_cache:
             return self._mesh_step_cache[key]
         A, W, K, D = self.A, self.W, self.K, self.D
+        PW = self.PW
+        plan = self.plan
         inv_fns = self.inv_fns
         con_fns = self.constraint_fns
         keys_of = self._keys_of
@@ -137,12 +139,12 @@ class MeshExplorer(TpuExplorer):
         # peer (D*B)
         G = D * C
         R = D * B if a2a else G
-        Pw = K + W + 1  # a2a payload: [keys | row | global-src-index]
+        Pw = K + PW + 1  # a2a payload: [keys | packed row | src-index]
 
-        def device_step(seen_keys, frontier, fcount):
-            # per-device blocks: seen_keys [SC,K], frontier [FC,W], [1]
+        def device_step(seen_keys, frontier_p, fcount):
+            # per-device blocks: seen_keys [SC,K], frontier [FC,PW], [1]
             seen_keys = seen_keys.reshape(SC, K)
-            frontier = frontier.reshape(FC, W)
+            frontier = plan.unpack_rows(frontier_p.reshape(FC, PW))
             me = lax.axis_index("d")
             fvalid = jnp.arange(FC) < fcount[0]
             en, aok, ov, succ = expand(frontier)
@@ -166,10 +168,15 @@ class MeshExplorer(TpuExplorer):
             dead_slot = jnp.argmax(dead).astype(jnp.int32)
             gen_local = jnp.sum(valid)
 
-            cand = succ.reshape(C, W)
+            cand_u = succ.reshape(C, W)
             cvalid = valid.reshape(C)
-            cand = jnp.where(cvalid[:, None], cand, SENTINEL)
-            ckeys = keys_of(cand, cvalid)                 # [C, K]
+            cand_u = jnp.where(cvalid[:, None], cand_u, SENTINEL)
+            ckeys, cand, pack_ovf = keys_of(cand_u, cvalid)  # [C, K/PW]
+            # pack-guard overflow joins the overflow channel (OV_PACK);
+            # kernel codes (OV_DEMOTED) keep priority
+            overflow = jnp.where(
+                overflow != 0, overflow,
+                jnp.where(pack_ovf, OV_PACK, 0).astype(jnp.int32))
 
             invalid_key = jnp.concatenate(
                 [jnp.ones(1, jnp.int32),
@@ -209,8 +216,8 @@ class MeshExplorer(TpuExplorer):
                     buckets[:D * B].reshape(D, B, Pw), "d",
                     split_axis=0, concat_axis=0).reshape(R, Pw)
                 gkeys = recv[:, :K]
-                gcand = recv[:, K:K + W]
-                gsrc = recv[:, K + W]
+                gcand = recv[:, K:K + PW]
+                gsrc = recv[:, K + PW]
                 gvalid = gkeys[:, 0] == 0
                 # routed rows are mine by construction; invalid slots
                 # keep the sorts-last key shape
@@ -218,7 +225,7 @@ class MeshExplorer(TpuExplorer):
             else:
                 # ICI exchange: gather all candidates + keys, keep my
                 # range
-                gcand = lax.all_gather(cand, "d", tiled=True)  # [G, W]
+                gcand = lax.all_gather(cand, "d", tiled=True)  # [G, PW]
                 gkeys = lax.all_gather(ckeys, "d", tiled=True)  # [G, K]
                 gsrc = jnp.arange(R, dtype=jnp.int32)
                 gvalid = gkeys[:, 0] == 0     # explicit validity lane
@@ -272,13 +279,16 @@ class MeshExplorer(TpuExplorer):
             # constraints FIRST: violating states stay fingerprinted in
             # the seen shard but are discarded — not distinct, not
             # checked, not explored (TLC semantics, testout2:265)
+            new_rows_u = plan.unpack_rows(new_rows) \
+                if (con_fns or inv_fns) else new_rows
             explore = nvalid
             for nm, f in con_fns:
-                explore = explore & jax.vmap(f)(new_rows)
+                explore = explore & jax.vmap(f)(new_rows_u)
             idx4 = jnp.arange(R, dtype=jnp.int32)
             ops4 = ((1 - explore.astype(jnp.int32)), idx4)
             comp4 = lax.sort(ops4, num_keys=1, is_stable=True)
             front_rows = jnp.take(new_rows, comp4[1], axis=0)
+            front_rows_u = jnp.take(new_rows_u, comp4[1], axis=0)
             # provenance follows the same two compactions
             front_src = jnp.take(new_src, comp4[1])
             front_count = jnp.sum(explore)
@@ -288,7 +298,7 @@ class MeshExplorer(TpuExplorer):
             inv_which = jnp.int32(_BIG)
             inv_slot = jnp.int32(-1)
             for i, (nm, f) in enumerate(inv_fns):
-                bad = frontvalid & ~jax.vmap(f)(front_rows)
+                bad = frontvalid & ~jax.vmap(f)(front_rows_u)
                 anyb = jnp.any(bad)
                 hit = anyb & (inv_which == _BIG)
                 inv_which = jnp.where(hit, jnp.int32(i), inv_which)
@@ -326,7 +336,7 @@ class MeshExplorer(TpuExplorer):
                 # traces via the process-allgather protocol
                 # (multihost.py, VERDICT r4 #7)
                 return (seen2.reshape(1, SC, K), seen_count2.reshape(1),
-                        front_rows[:out_cap].reshape(1, out_cap, W),
+                        front_rows[:out_cap].reshape(1, out_cap, PW),
                         front_count.reshape(1),
                         tot_gen.reshape(1), tot_new.reshape(1),
                         any_ovf.reshape(1), tot_front.reshape(1),
@@ -338,7 +348,7 @@ class MeshExplorer(TpuExplorer):
                         assert_bad.reshape(1), asrt_a.reshape(1),
                         asrt_f.reshape(1))
             out = (seen2.reshape(1, SC, K), seen_count2.reshape(1),
-                   front_rows.reshape(1, R, W), front_count.reshape(1),
+                   front_rows.reshape(1, R, PW), front_count.reshape(1),
                    front_src.reshape(1, R),
                    tot_gen.reshape(1), tot_new.reshape(1),
                    dead_local.reshape(1), dead_slot.reshape(1),
@@ -352,9 +362,10 @@ class MeshExplorer(TpuExplorer):
                 # gather mode: identical on every device (host reads
                 # device 0); a2a: each device holds its own bucket.
                 exp_all = gvalid
+                gcand_u = plan.unpack_rows(gcand)
                 for nm, f in con_fns:
-                    exp_all = exp_all & jax.vmap(f)(gcand)
-                out = out + (gcand.reshape(1, R, W),
+                    exp_all = exp_all & jax.vmap(f)(gcand_u)
+                out = out + (gcand.reshape(1, R, PW),
                              exp_all.reshape(1, R),
                              gsrc.reshape(1, R))
             return out
@@ -373,42 +384,42 @@ class MeshExplorer(TpuExplorer):
         return step
 
     def _init_shards(self, init_rows: np.ndarray, explored_idx,
-                     D: int, SC: int, FC: int):
+                     D: int, SC: int, FC: int,
+                     keys=None, packed=None, owner=None):
         """Host-side initial shard construction shared by the
         single-controller run() and the multi-host loop
         (tpu/multihost.py): per-owner frontier fill and lexsorted seen
         keys with the validity-lane-1 empty-slot convention. One layout
         rule, so host and device dedup can never diverge. Returns
         (seen [D,SC,K], frontier [D,FC,W], fcount [D]) as numpy."""
-        W, K = self.W, self.K
-        owner = self._owner_of(init_rows)
+        K = self.K
+        if keys is None:
+            keys, packed, povf = self._host_keys(init_rows)
+            if povf:
+                from ..compile.vspec import CompileError
+                raise CompileError(self._pack_ovf_msg())
+            owner = self._owner_from_keys(keys)
         exp = np.zeros(len(init_rows), bool)
         exp[np.asarray(explored_idx, int)] = True
-        frontier = np.full((D, FC, W), SENTINEL, np.int32)
+        frontier = np.full((D, FC, self.PW), SENTINEL, np.int32)
         seen = np.full((D, SC, K), SENTINEL, np.int32)
         seen[:, :, 0] = 1  # empty slots: validity lane 1
         fcount = np.zeros((D,), np.int32)
         for d in range(D):
-            p = init_rows[(owner == d) & exp]
+            p = packed[(owner == d) & exp]
             frontier[d, :len(p)] = p
-            sp = init_rows[owner == d]
-            if len(sp):
-                k = np.asarray(self._keys_of(
-                    jnp.asarray(sp), jnp.ones(len(sp), bool)))
+            k = keys[owner == d]
+            if len(k):
                 order = np.lexsort(tuple(k[:, i]
                                          for i in reversed(range(K))))
-                seen[d, :len(sp)] = k[order]
+                seen[d, :len(k)] = k[order]
             fcount[d] = len(p)
         return seen, frontier, fcount
 
-    def _owner_of(self, rows: np.ndarray) -> np.ndarray:
-        """Host-side owner routing — the SAME fingerprint the device keys
-        use (lane 1 of _keys_of == fingerprint128 word 0), so host and
-        device can never disagree on ownership."""
-        if not len(rows):
-            return np.zeros(0, np.int64)
-        fp = np.asarray(fingerprint128(jnp.asarray(rows)))
-        return (fp[:, 0].astype(np.uint32) % np.uint32(self.D)) \
+    def _owner_from_keys(self, keys: np.ndarray) -> np.ndarray:
+        """THE ownership formula (keys lane 1 mod D) — one definition
+        for every host path; device_step mirrors it in jnp."""
+        return (keys[:, 1].astype(np.uint32) % np.uint32(self.D)) \
             .astype(np.int64)
 
     # ---- trace reconstruction (host side) ----
@@ -427,7 +438,7 @@ class MeshExplorer(TpuExplorer):
         d, i = dev, slot
         for lvl in range(depth, -1, -1):
             rows, src, FC = self._levels[lvl]
-            st = self.layout.decode(np.asarray(rows[d][i]))
+            st = self.layout.decode_packed(np.asarray(rows[d][i]))
             if lvl == 0:
                 out.append((st, "Initial predicate"))
             else:
@@ -464,9 +475,9 @@ class MeshExplorer(TpuExplorer):
             self._ref_pair_cache.add(key)
             pst = parents.get((d_src, f))
             if pst is None:
-                pst = self.layout.decode(frontier_np[d_src, f])
+                pst = self.layout.decode_packed(frontier_np[d_src, f])
                 parents[(d_src, f)] = pst
-            sst = self.layout.decode(ecand[c])
+            sst = self.layout.decode_packed(ecand[c])
             for rc in self.refiners:
                 if not rc.check_edge(pst, sst):
                     trace = self._mesh_trace_to(
@@ -558,7 +569,12 @@ class MeshExplorer(TpuExplorer):
             self.log(f"Resuming mesh run at depth {depth} "
                      f"({distinct} distinct states)")
         else:
-            owner = self._owner_of(init_rows)
+            init_keys, init_packed, init_povf = \
+                self._host_keys(init_rows)
+            if init_povf:
+                from ..compile.vspec import CompileError
+                raise CompileError(self._pack_ovf_msg())
+            owner = self._owner_from_keys(init_keys)
             per_dev = [init_rows[(owner == d) & explored_mask]
                        for d in range(D)]
             FC = _pow2_at_least(
@@ -566,10 +582,11 @@ class MeshExplorer(TpuExplorer):
             SC = _pow2_at_least(4 * FC, lo=256)
             explored_idx = np.nonzero(explored_mask)[0]
             seen, frontier, fcount = self._init_shards(
-                init_rows, explored_idx, D, SC, FC)
+                init_rows, explored_idx, D, SC, FC,
+                keys=init_keys, packed=init_packed, owner=owner)
             if self.live_obligations:
                 graph = _LiveGraph(self.labels_flat, self.collect_edges)
-                graph.add_inits(init_rows, explored_idx)
+                graph.add_inits(init_packed, explored_idx)
                 # (d, slot) -> behavior-graph state id, flat [D*FC]
                 fsids = np.full(D * FC, -1, np.int64)
                 for d in range(D):
@@ -626,6 +643,8 @@ class MeshExplorer(TpuExplorer):
                            "host_seen mode, which demotes the arm to "
                            "the interpreter and restarts — raising "
                            "caps cannot help")
+                elif ovc == OV_PACK:
+                    msg = self._pack_ovf_msg()
                 else:
                     msg = ("a container exceeded its lane capacity "
                            f"({self._caps_note()}); counts would no "
@@ -659,7 +678,7 @@ class MeshExplorer(TpuExplorer):
                 # gather mode replicates it on every device (read device
                 # 0); a2a routes disjoint buckets (concatenate all)
                 if self.exchange == "a2a":
-                    ecand = np.asarray(outs[17]).reshape(-1, W)
+                    ecand = np.asarray(outs[17]).reshape(-1, self.PW)
                     eexp = np.asarray(outs[18]).reshape(-1)
                     esrc = np.asarray(outs[19]).reshape(-1)
                 else:
@@ -727,7 +746,7 @@ class MeshExplorer(TpuExplorer):
                             + d_src * expanding_FC + f)
                 new_sids = graph.add_level(
                     np.asarray(flat_rows) if flat_rows
-                    else np.zeros((0, W), np.int32),
+                    else np.zeros((0, self.PW), np.int32),
                     np.asarray(flat_prov, np.int64),
                     D * expanding_FC, fsids)
                 if graph.collect_edges and ecand is not None:
@@ -760,7 +779,7 @@ class MeshExplorer(TpuExplorer):
             if max_front > FC:
                 FC = _pow2_at_least(max_front, FC)
                 k = min(front_rows_np.shape[1], FC)
-                nf = np.full((D, FC, W), SENTINEL, np.int32)
+                nf = np.full((D, FC, self.PW), SENTINEL, np.int32)
                 nf[:, :k] = front_rows_np[:, :k]
                 frontier = jnp.asarray(nf)
             else:

@@ -169,7 +169,7 @@ def run_multihost_child(process_id: int, num_processes: int,
         C = me.A * FC
         for lv in range(lvl, -1, -1):
             rows, src = full[lv]
-            st = me.layout.decode(np.asarray(rows[d][i]))
+            st = me.layout.decode_packed(np.asarray(rows[d][i]))
             if lv == 0:
                 out.append((st, "Initial predicate"))
             else:
@@ -198,12 +198,17 @@ def run_multihost_child(process_id: int, num_processes: int,
          assert_bad, asrt_a, asrt_f) = outs[12:]
         ovc = _local_scalar(any_ovf)  # 0 = none, else max kernel2.OV_*
         if ovc:
-            from ..compile.kernel2 import OV_DEMOTED
+            from ..compile.kernel2 import OV_DEMOTED, OV_PACK
             if ovc == OV_DEMOTED:
                 raise RuntimeError(
                     "a demoted compile-recovery fired in the multi-host "
                     "run (kernel under-approximates here): run the "
                     "host_seen mode — raising caps cannot help")
+            if ovc == OV_PACK:
+                raise RuntimeError(
+                    "a value escaped its bit-packed lane's profiled "
+                    "range in the multi-host run: deepen sampling or "
+                    "rerun with JAXMC_PACK=0")
             raise RuntimeError("kernel capacity overflow in the "
                                "multi-host run")
         if _local_scalar(fixed_ovf):

@@ -1,0 +1,35 @@
+---------------------------- MODULE symtoy_scaled ----------------------------
+(* The symtoy SYMMETRY fixture at BENCH scale (ISSUE 6): same shape —
+   processes grab a token, `owner`/`used`/`turns` exercise the enum,
+   set-membership and function-block canonicalization transforms — with
+   a cfg-tunable process count and turn bound so the symmetry-reduced
+   space is thousands of states.  The kernel-vs-interp bench leg runs
+   this rung; the tiny symtoy stays the parity fixture. *)
+EXTENDS Naturals, FiniteSets, TLC
+
+CONSTANTS P, None, MaxTurns, K
+
+VARIABLES owner, used, turns
+
+Perms == Permutations(P)
+
+Init == owner = None /\ used = {} /\ turns = [p \in P |-> 0]
+
+Grab(p, k) == /\ turns[p] + k =< MaxTurns
+              /\ owner' = p
+              /\ used' = used \cup {p}
+              /\ turns' = [turns EXCEPT ![p] = @ + k]
+
+Release == /\ owner /= None
+           /\ owner' = None
+           /\ UNCHANGED <<used, turns>>
+
+Next == \/ owner = None /\ \E p \in P, k \in 1..K : Grab(p, k)
+        \/ Release
+
+Spec == Init /\ [][Next]_<<owner, used, turns>>
+
+TypeInv == /\ owner \in P \cup {None}
+           /\ used \subseteq P
+           /\ \A p \in P : turns[p] \in 0..MaxTurns
+=============================================================================

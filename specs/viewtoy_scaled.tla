@@ -1,0 +1,37 @@
+---------------------------- MODULE viewtoy_scaled ----------------------------
+(* The viewtoy VIEW fixture at BENCH scale (ISSUE 6): same shape - noise
+   churns under a cfg VIEW that collapses part of the state - but with
+   two counters advancing by a SET of step sizes, so the view-reduced
+   space is tens of thousands of states reached across a WIDE, SHALLOW
+   BFS (frontier in the thousands) and states/sec measures throughput
+   rather than an 11-state run's constant overhead.  The
+   kernel-vs-interp bench leg runs this rung; the tiny viewtoy stays
+   the parity fixture. *)
+EXTENDS Naturals
+
+CONSTANTS N, M, Q, K
+
+VARIABLES x, y, noise
+
+Steps == 1..K
+
+Init == x = 0 /\ y = 0 /\ noise = 0
+
+IncX == \E k \in Steps :
+          x' = (x + k) % N /\ y' = y /\ noise' = (noise + x) % M
+
+IncY == \E k \in Steps :
+          y' = (y + k) % N /\ x' = x /\ noise' = (noise + y) % M
+
+Jitter == x' = x /\ y' = y /\ noise' = (noise + 1) % M
+
+Next == IncX \/ IncY \/ Jitter
+
+Spec == Init /\ [][Next]_<<x, y, noise>>
+
+V == <<x, y, noise \div Q>>
+
+TypeInv == /\ x \in 0..(N - 1)
+           /\ y \in 0..(N - 1)
+           /\ noise \in 0..(M - 1)
+=============================================================================

@@ -22,3 +22,16 @@ except ImportError:
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 REFERENCE = os.environ.get("JAXMC_REFERENCE", "/root/reference")
+
+# The reference spec corpus is mounted in the DRIVER environment only —
+# builder/CI containers run without it (ISSUE 6 satellite).  Tests that
+# load reference specs skip with this named marker instead of failing,
+# so tier-1 is green wherever the repo is checked out.
+HAVE_REFERENCE = os.path.isdir(os.path.join(REFERENCE, "examples"))
+
+import pytest  # noqa: E402
+
+needs_reference = pytest.mark.skipif(
+    not HAVE_REFERENCE,
+    reason=f"needs the reference spec corpus at {REFERENCE} (driver "
+           f"environment only; point JAXMC_REFERENCE at a checkout)")

@@ -15,7 +15,7 @@ from jaxmc.front.parser import parse_module_text, parse_expr_text
 from jaxmc.front.cfg import parse_cfg, CfgModelValue
 from jaxmc.front import tla_ast as A
 
-from conftest import REFERENCE
+from conftest import REFERENCE, needs_reference
 
 # Axiomatic constructions implemented as machine arithmetic, not parsed
 # (/root/reference/examples/SpecifyingSystems/Standard/Naturals.tla:4-16 etc.)
@@ -136,6 +136,7 @@ def test_cfg_statements():
     assert cfg.symmetry == "Sym"
 
 
+@needs_reference
 def test_parse_raft_shape():
     src = open(os.path.join(REFERENCE, "examples/raft.tla")).read()
     m = parse_module_text(src)

@@ -14,7 +14,7 @@ from jaxmc.sem.modules import Loader, bind_model, BASE_IDENTS
 from jaxmc.sem.enumerate import enumerate_init, enumerate_next
 from jaxmc.engine.explore import Explorer, format_trace
 
-from conftest import REFERENCE
+from conftest import REFERENCE, needs_reference
 
 SPECS = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
                      "specs")
@@ -109,12 +109,14 @@ def run_spec(path, cfg=None, **kw):
 
 
 class TestEngine:
+    @needs_reference
     def test_atomic_add(self):
         r = run_spec(os.path.join(REFERENCE, "atomic_add.tla"))
         assert r.ok
         assert r.distinct == 5
         assert r.generated == 7
 
+    @needs_reference
     def test_pcal_intro_fixed_passes(self):
         cfg = parse_cfg(open(os.path.join(REFERENCE, "pcal_intro.cfg")).read())
         r = run_spec(os.path.join(REFERENCE, "pcal_intro.tla"), cfg)
@@ -174,6 +176,7 @@ Next == \\/ x > 0 /\\ y' = y + 1 /\\ x' = x
 
 
 class TestHourClock:
+    @needs_reference
     def test_hourclock(self):
         d = os.path.join(REFERENCE, "examples/SpecifyingSystems/HourClock")
         cfg = parse_cfg(open(os.path.join(d, "HourClock.cfg")).read())
@@ -238,6 +241,7 @@ end algorithm *)
 
 
 class TestRefinement:
+    @needs_reference
     def test_paxos_voting_refinement_checked(self):
         # MCPaxos.cfg PROPERTY VotingSpecBar == V!Spec — the Paxos -> Voting
         # refinement (SURVEY.md §3.4) holds stepwise on every edge
@@ -247,6 +251,7 @@ class TestRefinement:
         assert r.ok
         assert not any("VotingSpecBar" in w for w in r.warnings)
 
+    @needs_reference
     def test_hourclock2_equivalence_checked(self):
         d = os.path.join(REFERENCE, "examples/SpecifyingSystems/HourClock")
         cfg = parse_cfg(open(os.path.join(d, "HourClock2.cfg")).read())
@@ -277,6 +282,7 @@ JumpSpec == HCini /\\ [][Jump]_hr
         assert r.violation.kind == "property"
         assert r.violation.name == "JumpSpec"
 
+    @needs_reference
     def test_liveness_property_checked_with_refinement(self):
         # MCAlternatingBit.cfg checks ABCSpec (refinement, stepwise, plus
         # its ABCFairness half over the behavior graph — r3) and
@@ -288,6 +294,7 @@ JumpSpec == HCini /\\ [][Jump]_hr
         assert r.ok
         assert not any("NOT checked" in w for w in r.warnings), r.warnings
 
+    @needs_reference
     def test_abcspec_fairness_half_violated_without_spec_fairness(self):
         # negative control for the adopted fairness half: under the
         # fairness-free INIT/NEXT spec a behavior may stutter forever
@@ -306,6 +313,7 @@ JumpSpec == HCini /\\ [][Jump]_hr
 
 
 class TestCheckpoint:
+    @needs_reference
     def test_checkpoint_resume_roundtrip(self):
         # truncated run writes a checkpoint; resuming completes with the
         # exact full-run counts (TLC's states/ dir contract, SURVEY.md §5)
@@ -345,6 +353,7 @@ class TestCheckpoint:
         assert r2.ok
         assert r2.distinct == 6   # == the unresumed symmetric run
 
+    @needs_reference
     def test_checkpoint_resume_cross_process(self, tmp_path):
         # checkpoints must survive a process boundary: str/frozenset hashes
         # are per-process, so pickled values must not carry cached hashes,
@@ -381,6 +390,7 @@ class TestSimulate:
                          check_invariants=True)
         assert v is not None and v.kind == "assert"
 
+    @needs_reference
     def test_simulate_clean_spec_passes(self):
         from jaxmc.engine.simulate import random_walks
         cfg = parse_cfg(open(os.path.join(REFERENCE, "pcal_intro.cfg")).read())
@@ -425,6 +435,7 @@ Sym == Permutations(Proc)
         assert r_full.distinct == 9
         assert r_sym.distinct == 6
 
+    @needs_reference
     def test_mcpaxos_symmetry_cfg_unchanged(self):
         # MCPaxos's SYMMETRY over singleton sets is the identity
         d = os.path.join(REFERENCE, "examples/Paxos")
@@ -450,7 +461,8 @@ TypeInv == x \\in 0..2 /\\ noise \\in 0..1
 class TestView:
     """cfg VIEW (ConfigFileGrammar.tla:8-11; VERDICT r2 #8): states
     deduplicate by the view expression's VALUE — implemented on the
-    interp, rejected loudly on the jax backends."""
+    interp and, since ISSUE 6, compiled on the jax backends (the dedup
+    keys on the view's value lanes)."""
 
     def _model(self, tmp_path, with_view):
         spec = tmp_path / "viewtoy.tla"
@@ -469,11 +481,17 @@ class TestView:
         assert r_full.distinct == 6
         assert r_view.distinct == 3
 
-    def test_view_rejected_on_jax_backend(self, tmp_path):
-        from jaxmc.compile.vspec import CompileError
+    def test_view_compiles_on_jax_backend(self, tmp_path):
+        # ISSUE 6: cfg VIEW compiles — the device dedup keys on the
+        # view's value lanes, matching the interp's collapsed counts
         from jaxmc.tpu.bfs import TpuExplorer
-        with pytest.raises(CompileError, match="VIEW"):
-            TpuExplorer(self._model(tmp_path, True))
+        ri = Explorer(self._model(tmp_path, True)).run()
+        ex = TpuExplorer(self._model(tmp_path, True), store_trace=True)
+        assert ex.view_fn is not None
+        r = ex.run()
+        assert (r.generated, r.distinct, r.ok) == \
+            (ri.generated, ri.distinct, ri.ok)
+        assert r.distinct == 3  # one state per value of x
 
     def test_parameterized_view_rejected_at_bind(self, tmp_path):
         # TLC rejects parameterized views at config time; we must too

@@ -14,7 +14,7 @@ import pytest
 from jaxmc.front.cfg import ModelConfig, parse_cfg
 from jaxmc.sem.modules import Loader, bind_model
 
-from conftest import REFERENCE
+from conftest import REFERENCE, needs_reference
 
 SPECS = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
                      "specs")
@@ -32,6 +32,7 @@ def pcal_model():
 
 
 class TestLayout:
+    @needs_reference
     def test_roundtrip(self, pcal_model):
         from jaxmc.compile.vspec import Bounds
         from jaxmc.compile.kernel2 import build_layout2
@@ -44,6 +45,7 @@ class TestLayout:
             back = lay.decode(row)
             assert back == st
 
+    @needs_reference
     def test_grounding_labels(self, pcal_model):
         from jaxmc.compile.ground import ground_actions
         gas = ground_actions(pcal_model)
@@ -53,12 +55,14 @@ class TestLayout:
 
 
 class TestDeviceBFS:
+    @needs_reference
     def test_atomic_add_counts(self):
         from jaxmc.tpu.bfs import TpuExplorer
         model = load(os.path.join(REFERENCE, "atomic_add.tla"))
         r = TpuExplorer(model).run()
         assert r.ok and r.distinct == 5 and r.generated == 7
 
+    @needs_reference
     def test_pcal_intro_matches_interp(self, pcal_model):
         from jaxmc.tpu.bfs import TpuExplorer
         r = TpuExplorer(pcal_model).run()
@@ -120,6 +124,7 @@ def _replay_trace(model, trace):
 
 
 class TestMesh:
+    @needs_reference
     def test_pcal_intro_mesh_counts(self, pcal_model):
         import jax
         from jaxmc.tpu.mesh import MeshExplorer
@@ -129,6 +134,7 @@ class TestMesh:
         assert r.distinct == 3800
         assert r.generated == 5850
 
+    @needs_reference
     def test_atomic_add_mesh(self):
         from jaxmc.tpu.mesh import MeshExplorer
         model = load(os.path.join(REFERENCE, "atomic_add.tla"))
@@ -161,6 +167,7 @@ class TestMesh:
             st["account_total"]
         _replay_trace(model, r.violation.trace)
 
+    @needs_reference
     def test_mesh_checkpoint_resume_exact(self, pcal_model, tmp_path):
         from jaxmc.tpu.mesh import MeshExplorer
         ck = str(tmp_path / "mesh.ck")
@@ -172,6 +179,7 @@ class TestMesh:
         # resumed full-run counts match the direct full run exactly
         assert r2.distinct == 3800 and r2.generated == 5850
 
+    @needs_reference
     def test_mesh_a2a_exchange_counts_and_trace(self, pcal_model):
         # hash-routed all_to_all exchange (SURVEY §2.3 comm rows): same
         # exact counts as the all_gather path, provenance intact through
@@ -186,6 +194,7 @@ class TestMesh:
         assert len(r2.violation.trace) == 6
         _replay_trace(model, r2.violation.trace)
 
+    @needs_reference
     def test_mesh_a2a_bucket_overflow_grows_gamma(self, pcal_model):
         # force a tiny capacity factor: the first level must overflow
         # the per-peer bucket, double gamma (possibly repeatedly), and
@@ -218,6 +227,7 @@ Spec == Init /\\ [][Next]_n
 
 
 class TestGraftEntry:
+    @needs_reference
     def test_entry_compiles(self):
         import sys
         sys.path.insert(0, os.path.dirname(SPECS))
@@ -230,6 +240,7 @@ class TestGraftEntry:
         assert en.shape[1] == args[0].shape[0]
         assert succ.shape[-1] == args[0].shape[1]
 
+    @needs_reference
     def test_dryrun_multichip(self):
         import sys
         sys.path.insert(0, os.path.dirname(SPECS))
@@ -238,6 +249,7 @@ class TestGraftEntry:
 
 
 class TestHostSeen:
+    @needs_reference
     def test_host_seen_exact_counts(self):
         from jaxmc import native_store
         if not native_store.is_available():
@@ -332,6 +344,7 @@ class TestDeviceCheckpoint:
                                           "pcal_intro.cfg")).read())
         return load(os.path.join(REFERENCE, "pcal_intro.tla"), cfg)
 
+    @needs_reference
     def test_level_mode_resume_exact(self, tmp_path):
         from jaxmc.tpu.bfs import TpuExplorer
         ckp = str(tmp_path / "ck.pkl")
@@ -357,6 +370,7 @@ class TestDeviceCheckpoint:
         # the restored trace levels still reconstruct a full trace
         assert len(r2.violation.trace) >= 2
 
+    @needs_reference
     def test_host_seen_resume_exact(self, tmp_path):
         from jaxmc import native_store
         if not native_store.is_available():
@@ -371,6 +385,7 @@ class TestDeviceCheckpoint:
         assert r2.ok
         assert (r2.generated, r2.distinct) == (5850, 3800)
 
+    @needs_reference
     def test_resident_resume_exact(self, tmp_path):
         from jaxmc.tpu.bfs import TpuExplorer
         ckp = str(tmp_path / "ck.pkl")
@@ -387,6 +402,7 @@ class TestDeviceCheckpoint:
         assert r2.ok
         assert (r2.generated, r2.distinct) == (5850, 3800)
 
+    @needs_reference
     def test_resume_mode_mismatch_rejected(self, tmp_path):
         from jaxmc.tpu.bfs import TpuExplorer
         ckp = str(tmp_path / "ck.pkl")
@@ -409,6 +425,7 @@ class TestResident:
             ldr.load_path(os.path.join(SPECS, "MCraftMicro.tla")),
             parse_cfg(open(os.path.join(SPECS, "MCraft_micro.cfg")).read()))
 
+    @needs_reference
     def test_raft_micro_exact_counts_and_truncation(self):
         # flagship workload at the scale that completes (pinned 6185/694
         # in test_kernel2 for interp/host_seen); small chunk exercises
@@ -460,6 +477,7 @@ Spec == Init /\\ [][Next]_x
         assert ri.violation.kind == rr.violation.kind == "deadlock"
         assert ri.diameter == rr.diameter
 
+    @needs_reference
     def test_resident_rejects_host_seen_combo(self):
         # mutually exclusive seen-set homes: must be diagnosed up front,
         # not silently resolved in favor of one mode
@@ -468,6 +486,7 @@ Spec == Init /\\ [][Next]_x
         with pytest.raises(CompileError, match="mutually exclusive"):
             TpuExplorer(self._raft_micro(), resident=True, host_seen=True)
 
+    @needs_reference
     def test_resident_rejects_temporal_models(self):
         from jaxmc.compile.vspec import CompileError
         from jaxmc.tpu.bfs import TpuExplorer
@@ -492,6 +511,7 @@ class TestCorpusOnDevice:
 
     @pytest.mark.parametrize("rel,distinct,generated", CASES,
                              ids=[c[0].split("/")[-1] for c in CASES])
+    @needs_reference
     def test_corpus_model_exact(self, rel, distinct, generated):
         from jaxmc import native_store
         if not native_store.is_available():
@@ -511,6 +531,7 @@ class TestRefinementOnDevice:
     # refinement PROPERTYs check stepwise on the jax backend too (host-
     # side over the streamed candidate edges) — verdict parity with interp
 
+    @needs_reference
     def test_hourclock2_equivalence_checked(self):
         from jaxmc.tpu.bfs import TpuExplorer
         d = os.path.join(REFERENCE, "examples/SpecifyingSystems/HourClock")
@@ -521,6 +542,7 @@ class TestRefinementOnDevice:
         assert r.distinct == 12 and r.generated == 24
         assert not any("HC2" in w for w in r.warnings)
 
+    @needs_reference
     def test_alternating_bit_abcspec_checked(self):
         from jaxmc.tpu.bfs import TpuExplorer
         d = os.path.join(REFERENCE, "examples/SpecifyingSystems/TLC")
@@ -573,6 +595,7 @@ def test_mesh_raft_micro_counts():
     assert r.distinct == 694 and r.generated == 6185
 
 
+@needs_reference
 def test_mesh_innerfifo_counts():
     # mesh-vs-interp equality on a corpus model with constraints and a
     # canonically-sorted container (the fp128-key dedup path)
@@ -592,6 +615,7 @@ class TestHybrid:
     invariants, or constraints demote to the exact interpreter inside
     the host_seen device mode instead of rejecting the whole spec."""
 
+    @needs_reference
     def test_consensus_invariant_fallback_counts(self):
         # MCConsensus's Inv uses IsFiniteSet (uncompilable): the
         # invariant demotes to host evaluation over decoded rows while
@@ -607,6 +631,7 @@ class TestHybrid:
         r = ex.run()
         assert r.ok and (r.generated, r.distinct) == (7, 4)
 
+    @needs_reference
     def test_asynch_interface_action_fallback_counts(self):
         # AsynchInterface's Send leaves val' nondeterministic (val' \in
         # Data): that arm demotes to interpreter enumeration, Rcv stays
@@ -621,6 +646,7 @@ class TestHybrid:
         r = ex.run()
         assert r.ok and (r.generated, r.distinct) == (30, 12)
 
+    @needs_reference
     def test_hybrid_requires_host_seen(self):
         # level mode cannot interleave interpreter work: a spec that
         # needs hybrid execution is rejected with a MODE error (fix is
@@ -857,6 +883,7 @@ class TestMeshRefinementTemporal:
     #9): the host runs the same stepwise/behavior-graph checkers over
     the streamed exchanged-candidate edges; verdicts match interp."""
 
+    @needs_reference
     def test_mesh_hourclock2_refinement_checked(self):
         from jaxmc.tpu.mesh import MeshExplorer
         d = os.path.join(REFERENCE, "examples/SpecifyingSystems/HourClock")

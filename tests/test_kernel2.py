@@ -16,7 +16,7 @@ from jaxmc.sem.modules import Loader, bind_model
 from jaxmc.sem.enumerate import enumerate_init, enumerate_next
 from jaxmc.engine.explore import Explorer
 
-from conftest import REFERENCE
+from conftest import REFERENCE, needs_reference
 
 SPECS = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
                      "specs")
@@ -152,6 +152,7 @@ def _load_micro():
         parse_cfg(open(os.path.join(SPECS, "MCraft_micro.cfg")).read()))
 
 
+@needs_reference
 def test_raft_micro_differential_default():
     # default-selected fast slice of the raft kernel-vs-interp
     # differential (the full sweep on MCraft_tiny is slow-marked above)
@@ -167,6 +168,7 @@ def test_raft_micro_differential_default():
         assert ks == interp_successors(model, st)
 
 
+@needs_reference
 def test_raft_micro_whole_run_equivalence():
     # the BASELINE.json contract at a scale that COMPLETES: identical
     # generated/distinct counts from the interpreter and the jax backend

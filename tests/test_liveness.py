@@ -13,7 +13,10 @@ from jaxmc.front.cfg import parse_cfg
 from jaxmc.sem.modules import Loader, bind_model
 from jaxmc.engine.explore import Explorer
 
-from conftest import REFERENCE
+from conftest import REFERENCE, needs_reference
+
+# every test here loads reference-corpus specs (driver env only)
+pytestmark = [needs_reference]
 
 SS = os.path.join(REFERENCE, "examples/SpecifyingSystems")
 

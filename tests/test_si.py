@@ -18,7 +18,10 @@ from jaxmc.sem.eval import Ctx, eval_expr, _flatten_junction
 from jaxmc.engine.explore import Explorer
 from jaxmc.front.parser import parse_expr_text
 
-from conftest import REFERENCE
+from conftest import REFERENCE, needs_reference
+
+# every test here loads reference-corpus specs (driver env only)
+pytestmark = [needs_reference]
 
 SPECS = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "specs")
