@@ -159,6 +159,26 @@ bench-check:
 	  JAX_PLATFORMS=cpu $(PY) -m jaxmc.kernelbench $$spec \
 	      --out-dir $(BENCH_CHECK_DIR) || exit 1; \
 	done
+	# checking-as-a-service leg (ISSUE 7): the warm second submission
+	# to a live daemon must be a checkpoint-resume with ZERO in-window
+	# recompiles — see serve-check below
+	$(MAKE) serve-check
+
+# checking-as-a-service smoke gate (ISSUE 7): fresh spool, in-process
+# daemon, two identical jax-resident jobs — the second MUST reuse the
+# warm session, resume the first job's final checkpoint, report
+# window_recompiles == 0 and a capacity-profile hit, and its artifact
+# must pass `python -m jaxmc.obs diff --fail-on-regress` against the
+# cold one.  Exit 0 only when every assertion holds.
+serve-check:
+	JAX_PLATFORMS=cpu $(PY) -m jaxmc.serve smoke
+
+# run the checking daemon on a durable spool (jobs/results/checkpoints
+# survive restarts; SIGTERM drains gracefully — see README "Checking
+# as a service")
+SPOOL ?= /tmp/jaxmc_serve
+serve:
+	JAX_PLATFORMS=cpu $(PY) -m jaxmc.serve run --spool $(SPOOL)
 
 bench-check-reset:
 	rm -f $(BENCH_CHECK_DIR)/jaxmc_bench_check_serial.baseline.json \
@@ -172,4 +192,5 @@ native:
 	g++ -O2 -shared -fPIC -std=c++17 -pthread native/fps_store.cc -o native/build/libjaxmc_fps.so
 
 .PHONY: all check check-corpus test chaos bench bench-warm bench-tlc \
-        pin-si-env bench-check bench-check-reset native
+        pin-si-env bench-check bench-check-reset serve serve-check \
+        native

@@ -3,10 +3,18 @@ r"""jaxmc command-line interface.
     python -m jaxmc check SPEC.tla [--cfg F.cfg] [--backend interp|jax]
     python -m jaxmc simulate SPEC.tla [--walks N --depth N --coverage]
     python -m jaxmc info SPEC.tla
+    python -m jaxmc.serve ...       (checking-as-a-service daemon)
 
 Mirrors the reference's `make test` contract (tlc *tla, Makefile:6-7): check a
 spec against its model config, print TLC-style progress and a counterexample
-trace on violation. Exit status 0 = no error, 1 = violation, 2 = usage/error.
+trace on violation. Exit status 0 = no error, 1 = violation, 2 = usage/error,
+143 = drained on SIGTERM (checkpointed, resumable).
+
+Since ISSUE 7 the check flow itself lives in jaxmc/session.py
+(CheckSession: parse -> compile -> explore as resumable stages); this
+module is the thin driver that owns argument parsing, output rendering,
+and the exit-code policy — stdout/stderr and exit codes are
+byte-identical to the pre-session CLI.
 """
 
 from __future__ import annotations
@@ -17,69 +25,8 @@ import sys
 import time
 
 
-def _read_text(path: str) -> str:
-    """Read a cfg/spec file WITHOUT leaking the handle (the old
-    `open(...).read()` pattern relied on refcount finalization)."""
-    with open(path, encoding="utf-8", errors="replace") as fh:
-        return fh.read()
-
-
-def _load_model(spec_path: str, cfg_path, no_deadlock: bool,
-                includes=()):
-    from .front.cfg import parse_cfg, ModelConfig
-    from .sem.modules import Loader, bind_model
-
-    if cfg_path is None:
-        guess = os.path.splitext(spec_path)[0] + ".cfg"
-        if os.path.exists(guess):
-            cfg_path = guess
-    if cfg_path:
-        cfg = parse_cfg(_read_text(cfg_path))
-    else:
-        cfg = ModelConfig(specification="Spec")
-    if no_deadlock:
-        cfg.check_deadlock = False
-    ldr = Loader([os.path.dirname(os.path.abspath(spec_path))] +
-                 list(includes))
-    mod = ldr.load_path(spec_path)
-    return bind_model(mod, cfg)
-
-
-def _check_assumes(spec_path: str, cfg_path, includes=()) -> int:
-    """TLC's "No Behavior Spec" mode: evaluate the module's ASSUMEs as a
-    calculator / unit-test harness (SimpleMath.cfg:4-11, PrintValues.tla —
-    SURVEY.md §4.4)."""
-    from .front.cfg import parse_cfg, ModelConfig
-    from .sem.modules import Loader, bind_model_defs
-    from .sem.eval import Ctx, eval_expr
-    from .sem.values import fmt
-
-    cfg = parse_cfg(_read_text(cfg_path)) if cfg_path else ModelConfig()
-    ldr = Loader([os.path.dirname(os.path.abspath(spec_path))] +
-                 list(includes))
-    mod = ldr.load_path(spec_path)
-    defs = bind_model_defs(mod, cfg)
-    prints = []
-    ctx = Ctx(defs, {}, None, None, (), on_print=lambda v: prints.append(v))
-    failed = 0
-    for a in mod.assumes:
-        v = eval_expr(a.expr, ctx)
-        nm = a.name or "ASSUME"
-        if v is not True:
-            print(f"Assumption {nm} is violated (evaluated to {fmt(v)}).")
-            failed += 1
-    for v in prints:
-        print(fmt(v) if not isinstance(v, str) else v)
-    if failed:
-        return 1
-    print(f"{len(mod.assumes)} assumption"
-          f"{'s' if len(mod.assumes) != 1 else ''} checked. "
-          "No error has been found.")
-    return 0
-
-
 def cmd_check(args) -> int:
-    from . import obs
+    from . import drain, obs
 
     t0 = time.time()
     # telemetry is a PARALLEL channel: stdout stays byte-identical; a
@@ -98,125 +45,18 @@ def cmd_check(args) -> int:
     # level) on stderr and in the trace WHILE it hangs — start() is a
     # no-op on the NullTelemetry, so runs without an artifact pay nothing
     wd = obs.Watchdog(tel).start()
+    # graceful shutdown (ISSUE 7 satellite): SIGTERM requests a
+    # cooperative drain — the engine checkpoints at its next safe
+    # boundary and returns, so the finally below closes spans and joins
+    # the watchdog instead of leaking both; the process exits 143 with
+    # the reason named (jaxmc/drain.py)
+    drain.install()
     try:
         with obs.use(tel):
             return _run_check(args, tel, log, t0)
     finally:
         wd.stop()
         tel.close()
-
-
-def _device_init(args, tel) -> str:
-    """Device/plugin init with bounded retries + backoff
-    (JAXMC_DEVICE_RETRIES, default 2): a flaky accelerator tunnel gets
-    more than one chance before the run demotes to CPU.  ImportError
-    (jax not in the build) stays terminal — retrying cannot install a
-    wheel.  Returns the persistent compile-cache dir (or None)."""
-    from . import faults
-    retries = int(os.environ.get("JAXMC_DEVICE_RETRIES", "2"))
-    for attempt in range(retries + 1):
-        try:
-            platform = getattr(args, "platform", None)
-            with tel.span("device_init",
-                          platform=platform or "default",
-                          attempt=attempt):
-                import jax
-                faults.inject("device_init_fail")
-                if platform:
-                    jax.config.update("jax_platforms", platform)
-                # persistent XLA compile cache (repeat runs skip the
-                # per-arm compiles): opt-in via --compile-cache /
-                # JAXMC_COMPILE_CACHE, but GUARDED (ISSUE 5): a wedged,
-                # corrupt or foreign-build cache degrades to cold
-                # compilation instead of hanging the run
-                from .compile.cache import (cache_dir_from_env,
-                                            enable_guarded_cache)
-                _cache_req = getattr(args, "compile_cache", None) \
-                    or cache_dir_from_env()
-                cache_dir = enable_guarded_cache(_cache_req, tel=tel) \
-                    if _cache_req else None
-                if tel.enabled:
-                    # force plugin/device init inside the span so a hung
-                    # tunnel is attributed to device_init, not compile
-                    tel.gauge("device.platform",
-                              jax.devices()[0].platform)
-                    tel.gauge("device.count", len(jax.devices()))
-                    # re-stamp the env fingerprint now that jax is
-                    # initialized: platform/device_count become real
-                    from . import obs
-                    tel.set_meta(env=obs.environment_meta())
-                else:
-                    jax.devices()  # init failures must surface HERE
-            return cache_dir
-        except (faults.FaultInjected, RuntimeError, OSError,
-                ConnectionError) as ex:
-            if attempt >= retries:
-                raise
-            tel.counter("device.init_retries")
-            print(f"warning: device init failed ({ex}); retrying "
-                  f"({attempt + 1}/{retries})", file=sys.stderr)
-            time.sleep(min(0.2 * (2 ** attempt), 5.0))
-
-
-def _run_device_check(args, tel, log, model, cache_dir):
-    from .compile.vspec import Bounds
-    from .tpu.bfs import TpuExplorer
-    bounds = Bounds(seq_cap=args.seq_cap, grow_cap=args.grow_cap,
-                    kv_cap=args.kv_cap)
-    with tel.span("engine_build"):
-        ex = TpuExplorer(model, log=log, bounds=bounds,
-                         store_trace=not args.no_trace,
-                         progress_every=args.progress_every,
-                         host_seen=args.host_seen,
-                         chunk=args.chunk,
-                         resident=args.resident,
-                         sample_cfg=tuple(args.sample),
-                         checkpoint_path=args.checkpoint,
-                         checkpoint_every=args.checkpoint_every,
-                         resume_from=args.resume,
-                         max_states=args.max_states)
-    with tel.span("search"):
-        res = ex.run()
-    from .compile.cache import record_entries_end
-    record_entries_end(cache_dir)
-    return res
-
-
-def _demote_to_cpu(args, tel, log, model, err):
-    """Terminal device failure -> the parallel CPU engine, resuming from
-    the device run's host snapshot (`<checkpoint>.host`, written at
-    level barriers by tpu/bfs.py) when one exists.  The demotion is
-    machine-readable: `device.demoted` gauge + event (flagged by
-    `python -m jaxmc.obs diff`) and a result warning on stdout."""
-    from .engine.parallel import ParallelExplorer, default_workers
-    reason = f"{type(err).__name__}: {err}"
-    print(f"warning: device backend failed terminally ({reason}); "
-          f"falling back to the parallel CPU engine", file=sys.stderr)
-    tel.event("device.demoted", reason=reason)
-    tel.gauge("device.demoted", reason[:200])
-    tel.counter("device.demotions")
-    snap = (args.checkpoint + ".host") if args.checkpoint else None
-    resume = snap if snap and os.path.exists(snap) else None
-    if snap and not resume:
-        print("warning: no host snapshot exists yet - the CPU engine "
-              "restarts from scratch", file=sys.stderr)
-    if resume:
-        print(f"resuming from host snapshot {resume}", file=sys.stderr)
-    workers = default_workers() if not args.workers \
-        else max(1, args.workers)
-    with tel.span("search_fallback", workers=workers):
-        res = ParallelExplorer(model, workers=workers, log=log,
-                               max_states=args.max_states,
-                               progress_every=args.progress_every,
-                               checkpoint_path=snap,
-                               checkpoint_every=args.checkpoint_every,
-                               resume_from=resume).run()
-    res.warnings.append(
-        f"device backend failed ({reason}); the run completed on the "
-        f"parallel CPU engine"
-        + (", resumed from the last host snapshot" if resume
-           else ", restarted from scratch"))
-    return res
 
 
 def _metrics_error(args, tel, error: str) -> None:
@@ -228,52 +68,29 @@ def _metrics_error(args, tel, error: str) -> None:
 
 
 def _run_check(args, tel, log, t0) -> int:
-    from .engine.explore import Explorer, format_trace
-    from .front.cfg import parse_cfg
+    from .engine.explore import format_trace
+    from .session import CheckSession, SessionConfig
 
-    if args.cfg or os.path.exists(os.path.splitext(args.spec)[0] + ".cfg"):
-        cfgp = args.cfg or os.path.splitext(args.spec)[0] + ".cfg"
-        c = parse_cfg(_read_text(cfgp))
-        if not c.specification and not c.init:
-            rc = _check_assumes(args.spec, cfgp, args.include)
-            if args.metrics_out:
-                tel.write_metrics(args.metrics_out,
-                                  result={"ok": rc == 0, "distinct": 0,
-                                          "generated": 0, "diameter": 0,
-                                          "truncated": False,
-                                          "mode": "assumes"})
-            return rc
-    with tel.span("load", spec=args.spec):
-        model = _load_model(args.spec, args.cfg, args.no_deadlock,
-                            args.include)
+    sess = CheckSession(SessionConfig.from_args(args), tel=tel, log=log)
+    if sess.parse() == "assumes":
+        rc = sess.run_assumes()
+        if args.metrics_out:
+            tel.write_metrics(args.metrics_out,
+                              result={"ok": rc == 0, "distinct": 0,
+                                      "generated": 0, "diameter": 0,
+                                      "truncated": False,
+                                      "mode": "assumes"})
+        return rc
     if args.backend == "interp":
-        from .engine.parallel import ParallelExplorer, default_workers
-        # None or 0 = auto (JAXMC_WORKERS, else min(cpu_count, 8))
-        workers = default_workers() if not args.workers \
-            else max(1, args.workers)
-        with tel.span("search", workers=workers):
-            kw = dict(log=log, max_states=args.max_states,
-                      progress_every=args.progress_every,
-                      checkpoint_path=args.checkpoint,
-                      checkpoint_every=args.checkpoint_every,
-                      resume_from=args.resume)
-            if workers > 1:
-                # worker-parallel frontier expansion (crash-safe:
-                # checkpoints natively, survives worker deaths); falls
-                # back to the serial engine (identical results) only for
-                # stepwise refinement or when the platform cannot fork
-                ex = ParallelExplorer(model, workers=workers, **kw)
-            else:
-                ex = Explorer(model, **kw)
-            res = ex.run()
+        res = sess.explore()
     else:
         from . import faults
         from .compile.vspec import CompileError, ModeError
         from .engine.ckpt import CkptError
         faults.ensure_shared_state()  # one budget for run + fallback
         try:
-            cache_dir = _device_init(args, tel)
-            res = _run_device_check(args, tel, log, model, cache_dir)
+            sess.compile()
+            res = sess.explore()
         except ImportError as e:
             print(f"error: the jax backend is not available in this build "
                   f"({e})", file=sys.stderr)
@@ -302,7 +119,7 @@ def _run_check(args, tel, log, t0) -> int:
             # the interp would hit those identically, so no fallback.
             if args.no_device_fallback:
                 raise
-            res = _demote_to_cpu(args, tel, log, model, e)
+            res = sess.demote_to_cpu(e)
     wall = time.time() - t0
     print(f"{res.generated} states generated, {res.distinct} distinct states "
           f"found ({res.generated / max(res.wall_s, 1e-9):.0f} states/sec, "
@@ -310,7 +127,7 @@ def _run_check(args, tel, log, t0) -> int:
     for w in getattr(res, "warnings", []):
         print(f"Warning: {w}")
     if args.metrics_out:
-        mst = getattr(model, "_memo", None)
+        mst = getattr(sess.model, "_memo", None)
         if mst is not None:
             tel.gauge("memo.hits", mst.hits)
             tel.gauge("memo.misses", mst.misses)
@@ -319,11 +136,25 @@ def _run_check(args, tel, log, t0) -> int:
                   "truncated": bool(getattr(res, "truncated", False)),
                   "wall_s": round(res.wall_s, 6),
                   "warnings": list(getattr(res, "warnings", []))}
+        if getattr(res, "drained", False):
+            result["drained"] = True
         if res.violation is not None:
             result["violation"] = {"kind": res.violation.kind,
                                    "name": res.violation.name}
         tel.write_metrics(args.metrics_out, result=result)
     if res.ok:
+        if getattr(res, "drained", False):
+            # cooperative SIGTERM drain: checkpointed at a safe
+            # boundary, spans closed, resumable — exit 143, never a
+            # silent 0 (the search did NOT complete)
+            from . import drain
+            print("Search DRAINED at a safe boundary - no error found "
+                  "in the explored prefix.")
+            print(f"jaxmc: drained ({drain.reason()})"
+                  + (f"; resume with --resume {args.checkpoint}"
+                     if args.checkpoint else "; no checkpoint was "
+                     "configured"), file=sys.stderr)
+            return drain.DRAIN_EXIT_CODE
         if getattr(res, "truncated", False):
             print("Search TRUNCATED at state limit - no error found in the "
                   "explored prefix.")
@@ -339,9 +170,10 @@ def cmd_simulate(args) -> int:
     the way (engine/simulate.py)."""
     from .engine.simulate import random_walks
     from .engine.explore import format_trace
+    from .session import load_model
 
-    model = _load_model(args.spec, args.cfg, no_deadlock=args.no_deadlock,
-                        includes=args.include)
+    model = load_model(args.spec, args.cfg, no_deadlock=args.no_deadlock,
+                       includes=args.include)
     v = random_walks(model, n_walks=args.walks, depth=args.depth,
                      seed=args.seed, check_invariants=True,
                      coverage_guided=args.coverage,

@@ -40,6 +40,11 @@ class CheckResult:
     prints: List[Any] = field(default_factory=list)
     truncated: bool = False
     warnings: List[str] = field(default_factory=list)
+    # a cooperative drain (jaxmc/drain.py: SIGTERM, serve daemon
+    # shutdown) stopped the search at a safe boundary after writing a
+    # checkpoint; implies truncated=True — the explored prefix is clean
+    # but incomplete, and the run is resumable
+    drained: bool = False
 
     @property
     def states_per_sec(self) -> float:
@@ -145,7 +150,8 @@ class Explorer:
                  trace_parents: bool = True,
                  checkpoint_path: Optional[str] = None,
                  checkpoint_every: float = 600.0,
-                 resume_from: Optional[str] = None):
+                 resume_from: Optional[str] = None,
+                 final_checkpoint: bool = False):
         from .. import obs
         self.model = model
         # default sink: silent on stdout but still mirrored into the
@@ -158,6 +164,12 @@ class Explorer:
         self.checkpoint_path = checkpoint_path
         self.checkpoint_every = checkpoint_every
         self.resume_from = resume_from
+        # write one last checkpoint when the search COMPLETES (empty
+        # queue, full state table): the serve daemon's warm-resume
+        # source — a later identical job resumes it and finishes
+        # instantly with the same counts.  Off by default: `check`
+        # keeps its exact log-line surface
+        self.final_checkpoint = final_checkpoint
         self.prints: List[Any] = []
 
     def _ctx(self, state=None, primes=None):
@@ -306,7 +318,7 @@ class Explorer:
                       wall_s=round(time.time() - lv["t0"], 6))
             lv.update(frontier=0, generated=0, new=0, t0=time.time())
 
-        def result(ok, violation=None, truncated=False):
+        def result(ok, violation=None, truncated=False, drained=False):
             if truncated and live_obligations:
                 warnings.append("temporal properties NOT checked: the "
                                 "search was truncated (behavior graph "
@@ -321,7 +333,26 @@ class Explorer:
                                generated=generated, diameter=diameter,
                                violation=violation, wall_s=time.time() - t0,
                                prints=self.prints, truncated=truncated,
-                               warnings=warnings)
+                               warnings=warnings, drained=drained)
+
+        def drain_out():
+            # cooperative drain (jaxmc/drain.py): checkpoint at this
+            # safe boundary (nothing in flight — the drained state goes
+            # back on the queue untouched) and stop with the named
+            # reason; the caller's finally blocks close spans/watchdog
+            from .. import drain as _drain
+            why = _drain.reason()
+            self.log(f"-- drain requested ({why}): stopping at a safe "
+                     f"boundary")
+            if self.checkpoint_path:
+                write_checkpoint()
+            tel.event("drain", reason=why, engine="serial")
+            warnings.append(
+                f"run drained before completion ({why})"
+                + (f"; resume with --resume {self.checkpoint_path}"
+                   if self.checkpoint_path else "; no checkpoint was "
+                   "configured — progress was discarded"))
+            return result(True, truncated=True, drained=True)
 
         # ---- resume from a checkpoint ----
         if self.resume_from:
@@ -395,7 +426,10 @@ class Explorer:
         # split (call-by-name decisions, substituted bodies) once per run
         # instead of once per state (sem/enumerate.py Walker)
         next_walker = Walker("next", vars)
+        from .. import drain as _drain
         while queue:
+            if _drain.requested():
+                return drain_out()
             sid = queue.popleft()
             st = states[sid]
             depth = depth_of[sid]
@@ -475,6 +509,12 @@ class Explorer:
                     now - last_checkpoint >= ck_state["every"]:
                 last_checkpoint = now
                 write_checkpoint()
+
+        # completed search: persist the FINAL checkpoint when asked (the
+        # serve daemon's warm-resume source — resuming it replays the
+        # stored totals over an empty queue and finishes immediately)
+        if self.checkpoint_path and self.final_checkpoint:
+            write_checkpoint()
 
         # ---- temporal properties over the completed behavior graph ----
         if live_obligations:

@@ -709,7 +709,8 @@ class ParallelExplorer(Explorer):
             lv.update(frontier=0, generated=0, new=0, t0=time.time(),
                       chunk_wall=0.0, merge_wall=0.0)
 
-        def result(ok, violation=None, truncated=False, queue_len=0):
+        def result(ok, violation=None, truncated=False, queue_len=0,
+                   drained=False):
             if truncated and live_obligations:
                 warnings.append("temporal properties NOT checked: the "
                                 "search was truncated (behavior graph "
@@ -726,7 +727,7 @@ class ParallelExplorer(Explorer):
                                violation=violation,
                                wall_s=time.time() - t0,
                                prints=self.prints, truncated=truncated,
-                               warnings=warnings)
+                               warnings=warnings, drained=drained)
 
         # checkpoint plumbing: level-barrier (and truncation) writes in
         # the serial engine's payload format, with the serial engine's
@@ -827,9 +828,31 @@ class ParallelExplorer(Explorer):
             else self.workers * 4
         max_retries = int(os.environ.get("JAXMC_PARALLEL_RETRIES", "2"))
         n_chunks_total = 0
+        from .. import drain as _drain
         try:
             depth = d0
             while frontier or carry:
+                if _drain.requested():
+                    # cooperative drain at the level barrier: the queue
+                    # (this frontier, then the resumed-carry states one
+                    # level deeper) checkpoints untouched — the serial
+                    # engine's own resume split re-derives the depths
+                    why = _drain.reason()
+                    self.log(f"-- drain requested ({why}): stopping at "
+                             f"the level barrier")
+                    if self.checkpoint_path:
+                        write_checkpoint(list(frontier) + list(carry),
+                                         generated)
+                    tel.event("drain", reason=why, engine="parallel")
+                    warnings.append(
+                        f"run drained before completion ({why})"
+                        + (f"; resume with --resume "
+                           f"{self.checkpoint_path}"
+                           if self.checkpoint_path else "; no "
+                           "checkpoint was configured — progress was "
+                           "discarded"))
+                    return result(True, truncated=True, drained=True,
+                                  queue_len=len(frontier) + len(carry))
                 lv["depth"] = depth
                 # resumed depth+1 queue states stay AHEAD of this
                 # level's discoveries (serial pop order)
@@ -979,6 +1002,11 @@ class ParallelExplorer(Explorer):
             # in the finally: a truncated or violating run's early
             # return must still record its chunk count
             tel.counter("parallel.chunks", n_chunks_total)
+
+        # completed search: the FINAL checkpoint (serve warm-resume
+        # source; engine/explore.py documents the contract)
+        if self.checkpoint_path and self.final_checkpoint:
+            write_checkpoint([], generated)
 
         # ---- temporal properties over the completed behavior graph ----
         if live_obligations:

@@ -22,7 +22,7 @@ obs/watchdog.py for live stall diagnosis, and obs/report.py for
 
 from .telemetry import (Logger, NullTelemetry, Telemetry, current,
                         device_mem_high_water, environment_meta,
-                        rss_bytes, use, write_json_atomic)
+                        rss_bytes, use, use_local, write_json_atomic)
 from .schema import (CHECK_KEYS, HEARTBEAT_KEYS, REQUIRED_KEYS,
                      RESULT_KEYS, SCHEMA, SCHEMAS, STALL_KEYS,
                      validate_summary, validate_trace_event)
@@ -30,7 +30,7 @@ from .watchdog import Watchdog
 
 __all__ = ["Logger", "NullTelemetry", "Telemetry", "Watchdog", "current",
            "device_mem_high_water", "environment_meta", "rss_bytes",
-           "use", "write_json_atomic", "SCHEMA", "SCHEMAS",
+           "use", "use_local", "write_json_atomic", "SCHEMA", "SCHEMAS",
            "REQUIRED_KEYS", "CHECK_KEYS", "RESULT_KEYS",
            "HEARTBEAT_KEYS", "STALL_KEYS", "validate_summary",
            "validate_trace_event"]

@@ -104,7 +104,7 @@ def _from_metrics(s: Dict[str, Any], path: str, label: str
         "platform": plat_key,
         "rank": _RANK.get(plat_key, 1),
         # a terminal device failure that completed on the CPU fallback
-        # (cli.py _demote_to_cpu); find_regressions flags its appearance
+        # (session.demote_to_cpu); find_regressions flags its appearance
         "demoted": s.get("gauges", {}).get("device.demoted"),
         "mode": s.get("gauges", {}).get("expand.mode"),
         "wall_s": s.get("wall_s"),

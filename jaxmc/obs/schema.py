@@ -139,6 +139,40 @@ jaxmc.metrics/2 artifact minus the new optional surface, so readers and
       min-of-repeats steady wall (warm-up excluded), gauge
       `kernelbench.note` carries the measurement methodology; the
       kernel-vs-interp leg feeds them to `obs diff --fail-on-regress`.
+
+  (PR 7, still jaxmc.metrics/2 — all additive/optional; the
+   checking-as-a-service surface:)
+    - cooperative drain (jaxmc/drain.py): `result.drained` = true when
+      a SIGTERM/daemon drain stopped the search at a safe boundary
+      (implies `result.truncated`; the run checkpointed and is
+      resumable); trace event `drain {reason, engine}`.
+    - serve fleet telemetry (jaxmc/serve/daemon.py, the daemon's own
+      Telemetry): per-job `job` phase spans (attrs: id, sig, spec,
+      backend, batched), gauges `serve.queue_depth` / `serve.running` /
+      `serve.warm_sessions` / `serve.workers` / `serve.draining`,
+      counters `serve.jobs_submitted` / `serve.jobs_done` /
+      `serve.jobs_failed` / `serve.jobs_drained` / `serve.warm_hits`
+      (a repeat submission answered by a warm session's checkpoint
+      replay) / `serve.cold_runs` / `serve.ckpt_resumes` (cold engine,
+      but resumed a previous daemon life's checkpoint) /
+      `serve.batched_jobs` (queued identical jobs coalesced into one
+      dispatch) / `serve.requeued_on_start`; trace events
+      `serve.drain {reason}` / `serve.job_failed {id, error}`.
+    - serve per-job artifacts (`<spool>/results/<id>.json`): ordinary
+      jaxmc.metrics/2 summaries (meta `command` = "serve.job") plus a
+      top-level `serve` block {sig, warm_engine,
+      resumed_from_checkpoint, window_recompiles (count of
+      `fresh_compile` level records — 0 on a warm hit), profile_hits,
+      persistent_cache_hits, batched_with, job_wall_s}; violating jobs
+      add `result.trace` (the rendered counterexample).
+    - session stage spans (jaxmc/session.py): the `check` flow's
+      existing `load` / `device_init` / `engine_build` / `search` /
+      `search_fallback` phases are now emitted by CheckSession — same
+      names, same meaning, whether the CLI or the serve daemon drives.
+    - fused arm groups (tpu/bfs.py): gauge `expand.fused_groups` — the
+      number of fused expansion jits when a many-instance model splits
+      per arm-group (JAXMC_FUSED_MAX_INSTANCES instances per group)
+      instead of per action.
 """
 
 from __future__ import annotations

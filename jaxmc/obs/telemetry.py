@@ -360,11 +360,20 @@ class Telemetry(NullTelemetry):
 
 _CURRENT: NullTelemetry = NullTelemetry()
 
+# per-thread override (ISSUE 7): the serve daemon runs several check
+# jobs concurrently in worker threads, each with its OWN recorder —
+# a single process-global slot would interleave their spans/levels.
+# current() consults the thread-local first, so engine code needs no
+# plumbing changes; the main-thread CLI keeps using the global `use`.
+_TLS = threading.local()
+
 
 def current() -> NullTelemetry:
-    """The active recorder (a shared no-op unless the CLI/bench installed
-    a real one)."""
-    return _CURRENT
+    """The active recorder: this thread's `use_local` override if one is
+    installed, else the process-wide one (a shared no-op unless the
+    CLI/bench installed a real recorder)."""
+    tel = getattr(_TLS, "tel", None)
+    return tel if tel is not None else _CURRENT
 
 
 class use:
@@ -383,6 +392,27 @@ class use:
     def __exit__(self, *a):
         global _CURRENT
         _CURRENT = self._prev
+        return False
+
+
+class use_local:
+    """Install `tel` as THIS THREAD's recorder for a with-block (wins
+    over the process-wide one inside the block).  The serve daemon's
+    per-job telemetry channel: each worker thread records its job's
+    spans/levels/counters into a private recorder while the daemon's
+    fleet recorder keeps the global view."""
+
+    def __init__(self, tel: NullTelemetry):
+        self.tel = tel
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = getattr(_TLS, "tel", None)
+        _TLS.tel = self.tel
+        return self.tel
+
+    def __exit__(self, *a):
+        _TLS.tel = self._prev
         return False
 
 

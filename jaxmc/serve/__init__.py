@@ -1,0 +1,52 @@
+r"""jaxmc.serve — checking-as-a-service on the resumable session core.
+
+The paper's endgame (ROADMAP item 3): a checker that amortizes its
+expensive artifacts — compiled kernels, capacity profiles, explored
+state — across MANY checks, instead of a CLI that pays the full
+parse -> compile -> ramp bill per invocation.
+
+    python -m jaxmc.serve run --spool DIR [--port N --workers N]
+    python -m jaxmc.serve submit SPEC [--cfg F] [--wait] [--spool DIR]
+    python -m jaxmc.serve status [--spool DIR]
+    python -m jaxmc.serve smoke  [--spool DIR]   # the make serve-check gate
+
+One long-lived daemon (`serve/daemon.py`) over four pillars:
+
+  session core     each job is a jaxmc/session.py CheckSession
+                   (parse -> compile -> explore); the daemon keeps
+                   completed sessions WARM keyed by job signature, so a
+                   repeat submission re-drives the already-built engine
+                   (jit caches intact — zero recompiles) instead of
+                   rebuilding it;
+  durable queue    an on-disk spool (`serve/queue.py`): every job and
+                   result is a JSON file, so a daemon restart loses
+                   nothing — queued jobs re-queue, interrupted jobs
+                   resume from their checkpoints;
+  incremental      every job runs with a checkpoint keyed by its
+  re-checks        signature and writes a FINAL checkpoint on
+                   completion; an identical later job resumes it and
+                   replays the stored verdict (window_recompiles == 0
+                   on a warm daemon, asserted by tests/test_serve.py);
+  graceful drain   SIGTERM requests a cooperative drain (jaxmc/drain.py):
+                   in-flight engines checkpoint at their next safe
+                   boundary, drained jobs re-queue for the next daemon
+                   life, spans close, the watchdog joins — nothing lost,
+                   nothing leaked.
+
+Batching: queued jobs with the SAME signature coalesce into one engine
+dispatch (the leader runs, followers get the same result, counter
+`serve.batched_jobs`); layout-compatible jobs that differ only in
+non-layout options share the warm engine serially.  Obs is the fleet
+dashboard: the daemon's own Telemetry carries per-job spans and the
+queue-depth / warm-hit / batched-jobs gauges, heartbeats come from the
+standard watchdog, and per-job metrics artifacts land in the spool for
+`python -m jaxmc.obs report|diff`.
+
+Protocol (JSON over HTTP on 127.0.0.1, `serve/protocol.py`): the daemon
+trusts its local submitters — spec/cfg are PATHS resolved in the
+daemon's filesystem; there is no auth layer.  Front it with a real
+proxy before exposing it beyond localhost.
+"""
+
+from .queue import JobQueue  # noqa: F401
+from .daemon import ServeDaemon  # noqa: F401

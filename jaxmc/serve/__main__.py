@@ -1,0 +1,218 @@
+r"""`python -m jaxmc.serve` — run the daemon, talk to it, or smoke it.
+
+    run     (default) start the daemon on a spool directory
+    submit  POST a job to a live daemon (discovered via the spool stamp)
+    status  print a live daemon's /status JSON
+    smoke   the `make serve-check` gate: fresh spool, in-process daemon,
+            two identical jobs — the second MUST be a warm
+            checkpoint-resume with zero in-window recompiles and a
+            capacity-profile hit, and the warm artifact must pass
+            `python -m jaxmc.obs diff --fail-on-regress` against the
+            cold one.  Exit 0 only when every assertion holds.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+from typing import List, Optional
+
+
+def cmd_run(args) -> int:
+    from .. import drain
+    from .daemon import ServeDaemon
+    daemon = ServeDaemon(args.spool, host=args.host, port=args.port,
+                         workers=args.workers, trace=args.trace,
+                         metrics_out=args.metrics_out, quiet=args.quiet)
+    daemon.start()
+    # SIGTERM/SIGINT -> cooperative drain: in-flight jobs checkpoint and
+    # park, queued jobs persist in the spool, exit 0 (a drained daemon
+    # is a clean daemon); a second signal hard-exits 143 (drain.py)
+    import signal
+    drain.install(signals=(signal.SIGTERM, signal.SIGINT),
+                  on_request=lambda name: daemon.initiate_drain(
+                      f"signal {name}"))
+    return daemon.serve_forever()
+
+
+def cmd_submit(args) -> int:
+    from .protocol import ServeClient
+    client = ServeClient.from_spool(args.spool)
+    options = json.loads(args.options) if args.options else {}
+    for flag in ("backend", "platform"):
+        v = getattr(args, flag)
+        if v is not None:
+            options[flag] = v
+    if args.resident:
+        options["resident"] = True
+        options.setdefault("no_trace", True)
+    code, job = client.submit(os.path.abspath(args.spec),
+                              os.path.abspath(args.cfg)
+                              if args.cfg else None, options)
+    if code != 200:
+        print(f"error: submit failed ({code}): {job.get('error')}",
+              file=sys.stderr)
+        return 2
+    if not args.wait:
+        print(json.dumps(job, indent=1))
+        return 0
+    job = client.wait(job["id"], timeout=args.timeout)
+    print(json.dumps(job, indent=1))
+    if job.get("status") != "done":
+        return 2
+    return 0 if job.get("ok") else 1
+
+
+def cmd_status(args) -> int:
+    from .protocol import ServeClient
+    client = ServeClient.from_spool(args.spool)
+    code, st = client.status()
+    print(json.dumps(st, indent=1))
+    return 0 if code == 200 else 2
+
+
+def cmd_smoke(args) -> int:
+    """The serve-check gate (Makefile): prove the warm-reuse contract
+    end to end on a repo-local spec, in one process, in seconds."""
+    from .daemon import ServeDaemon
+    from .protocol import ServeClient
+
+    spool = args.spool or tempfile.mkdtemp(prefix="jaxmc_serve_smoke_")
+    # hermetic durable artifacts: the capacity-profile store lives in
+    # the spool so the smoke's profile hits are its own, not a previous
+    # run's (the compile cache stays off — the guarded enable's health
+    # probe costs more than this whole smoke)
+    os.environ.setdefault("JAXMC_PROFILE_STORE",
+                          os.path.join(spool, "profiles"))
+    spec = os.path.abspath(args.spec)
+    options = {"backend": "jax", "platform": "cpu", "resident": True,
+               "no_trace": True}
+
+    daemon = ServeDaemon(spool, workers=1, quiet=False).start()
+    try:
+        client = ServeClient("127.0.0.1", daemon.port)
+
+        def run_one(tag: str):
+            code, job = client.submit(spec, None, options)
+            assert code == 200, f"{tag}: submit failed ({code}): {job}"
+            done = client.wait(job["id"], timeout=args.timeout)
+            assert done["status"] == "done", \
+                f"{tag}: job {done['id']} ended {done['status']!r}: " \
+                f"{done.get('error')}"
+            code, res = client.result(done["id"])
+            assert code == 200, f"{tag}: no result artifact"
+            return done, res
+
+        cold_job, cold = run_one("cold")
+        warm_job, warm = run_one("warm")
+
+        failures: List[str] = []
+        sv = warm.get("serve", {})
+        if not sv.get("resumed_from_checkpoint"):
+            failures.append("warm job did not resume the cold job's "
+                            "checkpoint")
+        if not sv.get("warm_engine"):
+            failures.append("warm job did not reuse the warm session")
+        if sv.get("window_recompiles") != 0:
+            failures.append(f"warm job recompiled in-window "
+                            f"({sv.get('window_recompiles')} times)")
+        if not sv.get("profile_hits"):
+            failures.append("warm job recorded no capacity-profile hit")
+        cr, wr = cold.get("result", {}), warm.get("result", {})
+        if (wr.get("generated"), wr.get("distinct")) != \
+                (cr.get("generated"), cr.get("distinct")):
+            failures.append(
+                f"warm counts {wr.get('generated')}/{wr.get('distinct')}"
+                f" != cold {cr.get('generated')}/{cr.get('distinct')}")
+        # the regression gate: the warm artifact vs the cold one
+        from ..obs.report import main as obs_main
+        cold_path = daemon.q.result_path(cold_job["id"])
+        warm_path = daemon.q.result_path(warm_job["id"])
+        rc = obs_main(["diff", "--fail-on-regress", cold_path,
+                       warm_path])
+        if rc != 0:
+            failures.append("obs diff flagged a cold->warm regression")
+        for f in failures:
+            print(f"serve-check: FAIL: {f}", file=sys.stderr)
+        if not failures:
+            print(f"serve-check: PASS — warm submission resumed the "
+                  f"checkpoint with 0 in-window recompiles "
+                  f"(profile_hits={sv.get('profile_hits')}, "
+                  f"artifacts: {cold_path} {warm_path})")
+        return 1 if failures else 0
+    finally:
+        daemon.shutdown()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    # bare `python -m jaxmc.serve [--flags]` runs the daemon
+    if not argv or argv[0].startswith("-"):
+        argv = ["run"] + argv
+    ap = argparse.ArgumentParser(prog="python -m jaxmc.serve")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    r = sub.add_parser("run", help="start the checking daemon")
+    r.add_argument("--spool", default="/tmp/jaxmc_serve",
+                   help="durable job-queue directory (survives restarts)")
+    r.add_argument("--host", default="127.0.0.1")
+    r.add_argument("--port", type=int, default=0,
+                   help="0 = ephemeral; the bound port lands in "
+                        "<spool>/serve.json")
+    r.add_argument("--workers", type=int, default=2,
+                   help="worker threads (bounded pool)")
+    r.add_argument("--trace", default=None, metavar="FILE",
+                   help="fleet telemetry JSONL (job spans, queue gauges, "
+                        "watchdog heartbeats)")
+    r.add_argument("--metrics-out", default=None, metavar="FILE",
+                   help="fleet metrics artifact written at drain")
+    r.add_argument("--quiet", action="store_true")
+    r.set_defaults(fn=cmd_run)
+
+    s = sub.add_parser("submit", help="submit a job to a live daemon")
+    s.add_argument("spec")
+    s.add_argument("--cfg", default=None)
+    s.add_argument("--spool", default="/tmp/jaxmc_serve")
+    s.add_argument("--backend", choices=("interp", "jax"), default=None)
+    s.add_argument("--platform", default=None)
+    s.add_argument("--resident", action="store_true")
+    s.add_argument("--options", default=None,
+                   help="extra job options as a JSON object")
+    s.add_argument("--wait", action="store_true",
+                   help="poll until the job finishes; exit 0/1 like "
+                        "`jaxmc check`")
+    s.add_argument("--timeout", type=float, default=600.0)
+    s.set_defaults(fn=cmd_submit)
+
+    t = sub.add_parser("status", help="print a live daemon's status")
+    t.add_argument("--spool", default="/tmp/jaxmc_serve")
+    t.set_defaults(fn=cmd_status)
+
+    k = sub.add_parser("smoke",
+                       help="the make serve-check gate: cold+warm "
+                            "submission pair, warm-reuse assertions, "
+                            "obs diff regression gate")
+    k.add_argument("--spool", default=None,
+                   help="default: a fresh temp dir")
+    k.add_argument("--spec", default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))), "specs", "constoy.tla"))
+    k.add_argument("--timeout", type=float, default=300.0)
+    k.set_defaults(fn=cmd_smoke)
+
+    args = ap.parse_args(argv)
+    try:
+        return args.fn(args)
+    except AssertionError as e:
+        print(f"serve: FAIL: {e}", file=sys.stderr)
+        return 1
+    except (OSError, ValueError, TimeoutError) as e:
+        print(f"serve: error: {type(e).__name__}: {e}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,515 @@
+r"""The serve daemon: a bounded worker pool over the durable spool,
+warm CheckSessions, and the fleet telemetry dashboard.
+
+Life of a job (see serve/__init__.py for the system view):
+
+  submit   POST /jobs validates the payload (serve/protocol.py), stamps
+           the job SIGNATURE, persists the record (serve/queue.py) and
+           wakes a worker — 503 once a drain began;
+  batch    the worker that pops a job also claims every QUEUED job with
+           the same signature: one engine run answers all of them (for
+           the resident engine that is literally one batched kernel
+           dispatch sequence), counter `serve.batched_jobs`;
+  warm     a signature seen before reuses its WARM CheckSession — the
+           already-compiled engine — and resumes the signature-keyed
+           checkpoint the previous run finalized: the repeat submission
+           replays the stored verdict with zero in-window recompiles
+           (`serve.warm_hits`); a cold daemon with a spool checkpoint
+           from a previous life still resumes it (`serve.ckpt_resumes`)
+           and re-pays only the compile, which the persistent compile
+           cache + capacity profile make a disk hit;
+  drain    SIGTERM / POST /drain: no new jobs, in-flight engines
+           checkpoint at their next safe boundary (jaxmc/drain.py),
+           their jobs park as `drained` (re-queued by the next daemon
+           life's recover()), workers join, spans close, the watchdog
+           stops, the fleet metrics artifact is written.
+
+Telemetry: the daemon owns one fleet Telemetry (per-job `job` spans,
+queue-depth/warm-hit/batched-jobs gauges, watchdog heartbeats); each
+job ALSO records into a private per-thread recorder (obs.use_local) so
+its own spans/levels/counters land in `<spool>/results/<id>.json` as a
+normal jaxmc.metrics/2 artifact — `python -m jaxmc.obs report/diff`
+works on serve results unchanged.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from .. import drain, obs
+from ..session import CheckSession
+from .protocol import BadJob, build_config, job_signature
+from .queue import JobQueue
+
+
+class ServeDaemon:
+    def __init__(self, spool: str, host: str = "127.0.0.1",
+                 port: int = 0, workers: int = 2,
+                 trace: Optional[str] = None,
+                 metrics_out: Optional[str] = None,
+                 quiet: bool = False,
+                 checkpoint_every: float = 60.0):
+        # a fresh daemon re-arms the drain flag: an in-process restart
+        # (tests, the smoke gate) must not inherit the last life's drain
+        drain.clear()
+        self.q = JobQueue(spool)
+        self.tel = obs.Telemetry(
+            trace_path=trace,
+            meta={"command": "serve", "spool": self.q.root,
+                  "env": obs.environment_meta()})
+        self.log = obs.Logger(self.tel, quiet=quiet)
+        self.wd = obs.Watchdog(self.tel)
+        self.metrics_out = metrics_out
+        self.host = host
+        self.port = port
+        self.n_workers = max(1, int(workers))
+        self.checkpoint_every = checkpoint_every
+        # sig -> {"session": CheckSession, "completed": bool} — the warm
+        # kernel registry; "completed" gates checkpoint-replay reuse.
+        # Mutated ONLY under _cv (status() snapshots under it too), and
+        # each signature additionally serializes its RUNS through
+        # _sig_lock: a CheckSession's engine is single-flight state, so
+        # two same-signature jobs that dodged batching must not drive
+        # it concurrently
+        self.warm: Dict[str, Dict[str, Any]] = {}
+        self._sig_locks: Dict[str, threading.Lock] = {}
+        self._cv = threading.Condition()
+        self._pending: collections.deque = collections.deque()
+        self._running: Dict[str, str] = {}  # jid -> sig
+        self._draining = False
+        self._drain_reason: Optional[str] = None
+        self._workers: List[threading.Thread] = []
+        self._httpd = None
+        self._http_thread: Optional[threading.Thread] = None
+        self._jobs_done = 0
+        self._jobs_failed = 0
+
+    # ---- lifecycle ----------------------------------------------------
+    def start(self) -> "ServeDaemon":
+        requeued = self.q.recover()
+        if requeued:
+            self.log(f"serve: requeued {requeued} interrupted job"
+                     f"{'s' if requeued != 1 else ''} from the spool")
+            self.tel.counter("serve.requeued_on_start", requeued)
+        with self._cv:
+            for job in sorted(self.q.queued(), key=lambda j: j["id"]):
+                self._pending.append(job["id"])
+        self._start_http()
+        self.q.stamp(host=self.host, port=self.port, pid=os.getpid(),
+                     workers=self.n_workers, status="serving")
+        for wi in range(self.n_workers):
+            t = threading.Thread(target=self._worker_loop, args=(wi,),
+                                 name=f"jaxmc-serve-w{wi}", daemon=True)
+            t.start()
+            self._workers.append(t)
+        self.wd.start()
+        self._update_gauges()
+        self.log(f"serve: listening on http://{self.host}:{self.port} "
+                 f"(spool {self.q.root}, {self.n_workers} worker"
+                 f"{'s' if self.n_workers != 1 else ''})")
+        return self
+
+    def _start_http(self) -> None:
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+        daemon = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *a):  # quiet the default stderr
+                pass
+
+            def _json(self, code: int, obj) -> None:
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                try:
+                    n = int(self.headers.get("Content-Length") or 0)
+                    body = json.loads(self.rfile.read(n).decode()) \
+                        if n else {}
+                except (ValueError, OSError):
+                    return self._json(400, {"error": "bad JSON body"})
+                if self.path == "/jobs":
+                    try:
+                        job = daemon.submit(body)
+                    except BadJob as ex:
+                        return self._json(400, {"error": str(ex)})
+                    except RuntimeError as ex:  # draining
+                        return self._json(503, {"error": str(ex)})
+                    return self._json(200, job)
+                if self.path == "/drain":
+                    daemon.initiate_drain("POST /drain")
+                    return self._json(200, {"draining": True})
+                return self._json(404, {"error": f"no route {self.path}"})
+
+            def do_GET(self):
+                if self.path == "/status":
+                    return self._json(200, daemon.status())
+                if self.path == "/jobs":
+                    return self._json(200,
+                                      {"jobs": daemon.q.list_jobs()})
+                if self.path.startswith("/jobs/"):
+                    parts = self.path.split("/")
+                    jid = parts[2] if len(parts) > 2 else ""
+                    if len(parts) == 4 and parts[3] == "result":
+                        res = daemon.q.load_result(jid)
+                        if res is None:
+                            return self._json(
+                                404, {"error": f"no result for {jid}"})
+                        return self._json(200, res)
+                    job = daemon.q.load(jid)
+                    if job is None:
+                        return self._json(404,
+                                          {"error": f"no job {jid}"})
+                    if job.get("status") == "done":
+                        res = daemon.q.load_result(jid)
+                        if res is not None:
+                            job = dict(job, result=res.get("result"),
+                                       serve=res.get("serve"))
+                    return self._json(200, job)
+                return self._json(404, {"error": f"no route {self.path}"})
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever, name="jaxmc-serve-http",
+            daemon=True)
+        self._http_thread.start()
+
+    def serve_forever(self) -> int:
+        """Block until a drain completes; returns the process exit code
+        (0 — a drained daemon is a clean daemon)."""
+        try:
+            while not self._draining:
+                time.sleep(0.2)
+                self._update_gauges()
+        except KeyboardInterrupt:
+            self.initiate_drain("KeyboardInterrupt")
+        self.shutdown()
+        return 0
+
+    def initiate_drain(self, reason: str) -> None:
+        """Begin the graceful drain (idempotent): refuse new jobs, ask
+        every in-flight engine to checkpoint and stop (jaxmc/drain.py),
+        wake idle workers so they exit."""
+        with self._cv:
+            if self._draining:
+                return
+            self._draining = True
+            self._drain_reason = reason
+            self._cv.notify_all()
+        drain.request(f"serve drain: {reason}")
+        self.tel.event("serve.drain", reason=reason)
+        self.log(f"serve: draining ({reason}) — in-flight jobs will "
+                 f"checkpoint and requeue")
+
+    def shutdown(self) -> None:
+        """Complete the drain: join workers (their engines return at
+        the next safe boundary), stop HTTP, persist the fleet metrics,
+        close everything.  No orphan workers, no open spans."""
+        if not self._draining:
+            self.initiate_drain("shutdown()")
+        for t in self._workers:
+            t.join(timeout=120.0)
+        alive = [t.name for t in self._workers if t.is_alive()]
+        if alive:  # never expected: engines poll drain at every level
+            self.log(f"serve: WARNING: workers still alive at shutdown: "
+                     f"{alive}")
+        self._workers = []
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._http_thread is not None:
+            self._http_thread.join(timeout=5.0)
+            self._http_thread = None
+        self.wd.stop()
+        self._update_gauges()
+        self.q.stamp(host=self.host, port=self.port, pid=os.getpid(),
+                     workers=self.n_workers, status="stopped",
+                     drain_reason=self._drain_reason)
+        if self.metrics_out:
+            self.tel.write_metrics(
+                self.metrics_out,
+                result={"ok": True, "distinct": 0, "generated": 0,
+                        "diameter": 0, "truncated": False,
+                        "jobs_done": self._jobs_done,
+                        "jobs_failed": self._jobs_failed,
+                        "drain_reason": self._drain_reason})
+        self.tel.close()
+        # re-arm the process-global drain flag: every engine in this
+        # daemon has returned, and an in-process successor daemon (the
+        # smoke gate, restart tests) must not inherit a stale request
+        drain.clear()
+
+    # ---- submission ---------------------------------------------------
+    def submit(self, payload: Dict[str, Any]) -> Dict[str, Any]:
+        if self._draining:
+            raise RuntimeError("daemon is draining; resubmit to the "
+                               "next daemon life (the spool persists)")
+        cfg = build_config(payload.get("spec"), payload.get("cfg"),
+                           payload.get("options"))
+        sig = job_signature(cfg)
+        job = self.q.new_job(cfg.spec, cfg.cfg, payload.get("options"),
+                             sig)
+        self.tel.counter("serve.jobs_submitted")
+        with self._cv:
+            self._pending.append(job["id"])
+            self._cv.notify()
+        self._update_gauges()
+        return job
+
+    # ---- workers ------------------------------------------------------
+    def _worker_loop(self, wi: int) -> None:
+        while True:
+            with self._cv:
+                while not self._pending and not self._draining:
+                    self._cv.wait(0.5)
+                if self._draining:
+                    return  # queued jobs persist for the next life
+                jid = self._pending.popleft()
+                job = self.q.load(jid)
+                followers: List[Dict[str, Any]] = []
+                if job is not None:
+                    # BATCH: claim every queued job with this signature
+                    # — one engine run answers all of them
+                    rest = []
+                    for other in self._pending:
+                        oj = self.q.load(other)
+                        if oj is not None and \
+                                oj.get("sig") == job["sig"]:
+                            followers.append(oj)
+                        else:
+                            rest.append(other)
+                    self._pending = collections.deque(rest)
+                    self._running[jid] = job["sig"]
+            if job is None:
+                continue
+            try:
+                self._run_batch(job, followers)
+            except Exception as ex:  # noqa: BLE001 — a job failure must
+                # never kill the worker; the defect lands on the job
+                self._fail_job(job, followers,
+                               f"{type(ex).__name__}: {ex}")
+            finally:
+                with self._cv:
+                    self._running.pop(job["id"], None)
+                self._update_gauges()
+
+    def _fail_job(self, job, followers, error: str) -> None:
+        self.tel.counter("serve.jobs_failed", 1 + len(followers))
+        self._jobs_failed += 1 + len(followers)
+        self.tel.event("serve.job_failed", id=job["id"], error=error)
+        self.log(f"serve: job {job['id']} FAILED: {error}")
+        for j in [job] + followers:
+            self.q.mark(j["id"], "failed", error=error,
+                        finished_at=time.time(),
+                        batch_leader=job["id"]
+                        if j is not job else None)
+
+    def _sig_lock(self, sig: str) -> threading.Lock:
+        with self._cv:
+            lk = self._sig_locks.get(sig)
+            if lk is None:
+                lk = self._sig_locks[sig] = threading.Lock()
+            return lk
+
+    def _revalidate_profile(self, sess: CheckSession, job_tel) -> None:
+        """Warm-path consistency check: confirm the DURABLE capacity
+        profile still matches the warm engine's layout before trusting
+        its caps (counts as a profile hit in the job's artifact; a
+        missing/stale profile only means the next cold engine re-learns
+        — the warm engine's own caps stay valid)."""
+        if sess.layout_sig and sess.model is not None:
+            from ..compile.cache import load_capacity_profile
+            load_capacity_profile(sess.model.module.name,
+                                  sess.layout_sig, tel=job_tel)
+
+    def _run_batch(self, job: Dict[str, Any],
+                   followers: List[Dict[str, Any]]) -> None:
+        jid, sig = job["id"], job["sig"]
+        t0 = time.time()
+        cfg = build_config(job["spec"], job.get("cfg"),
+                           job.get("options"))
+        if cfg.backend == "interp" and not cfg.workers:
+            # daemon parallelism comes from the WORKER POOL (several
+            # jobs at once), not per-job fork pools: forking from a
+            # multithreaded daemon risks classic fork+locks hangs, so
+            # interp jobs default to the serial engine unless the
+            # submission explicitly asks for a worker count (note both
+            # None and 0 mean "auto" on the CLI surface — neither may
+            # reach default_workers() here)
+            cfg.workers = 1
+        ck = self.q.ckpt_path(sig)
+        cfg.checkpoint = ck
+        cfg.checkpoint_every = self.checkpoint_every
+        cfg.final_checkpoint = True
+        job_tel = obs.Telemetry(meta={
+            "command": "serve.job", "job": jid, "sig": sig,
+            "backend": cfg.backend, "spec": job["spec"],
+            "cfg": job.get("cfg"), "env": obs.environment_meta()})
+        for j in [job] + followers:
+            self.q.mark(j["id"], "running", started_at=t0,
+                        batch_leader=jid if j is not job else None)
+        if followers:
+            self.tel.counter("serve.batched_jobs", len(followers))
+        self._update_gauges()
+
+        with self._cv:
+            warm = self.warm.get(sig)
+        warm_engine = resumed = False
+        with self._sig_lock(sig), obs.use_local(job_tel), \
+                self.tel.span("job", id=jid, sig=sig, spec=job["spec"],
+                              backend=cfg.backend,
+                              batched=len(followers)):
+            if warm is not None and warm.get("completed") and \
+                    os.path.exists(ck):
+                # WARM: the already-compiled engine replays the
+                # finalized checkpoint — zero recompiles, instant answer
+                warm_engine = resumed = True
+                self.tel.counter("serve.warm_hits")
+                sess = warm["session"]
+                # rebind the session's telemetry channel to THIS job's
+                # recorder (it was constructed with the cold job's, long
+                # closed): the warm artifact must carry its own search
+                # span like any other jaxmc.metrics summary
+                sess.tel = job_tel
+                sess.log = obs.Logger(job_tel, quiet=True)
+                self._revalidate_profile(sess, job_tel)
+                res = sess.explore(resume_from=ck, checkpoint_path=ck,
+                                   final_checkpoint=True)
+            else:
+                self.tel.counter("serve.cold_runs")
+                if os.path.exists(ck):
+                    # a previous daemon life checkpointed this signature
+                    # (periodic, drain, or final): resume incrementally
+                    cfg.resume = ck
+                    resumed = True
+                    self.tel.counter("serve.ckpt_resumes")
+                sess = CheckSession(cfg, tel=job_tel,
+                                    log=obs.Logger(job_tel, quiet=True))
+                if sess.parse() == "assumes":
+                    raise BadJob(
+                        "assumes-mode specs (no behavior spec) are not "
+                        "servable; run them via `python -m jaxmc check`")
+                try:
+                    sess.compile()
+                    res = sess.explore()
+                except (RuntimeError, OSError, MemoryError,
+                        ConnectionError) as ex:
+                    if cfg.backend == "interp":
+                        raise
+                    # the CLI's device->CPU fallback, same policy
+                    # (session.demote_to_cpu is the shared path)
+                    res = sess.demote_to_cpu(ex)
+                with self._cv:
+                    self.warm[sig] = {"session": sess,
+                                      "completed": False}
+
+        drained = bool(getattr(res, "drained", False))
+        completed = res.ok and not res.truncated and not drained
+        with self._cv:
+            if sig in self.warm:
+                # checkpoint-replay reuse only for COMPLETED searches
+                # (the final checkpoint exists exactly then); other
+                # outcomes still keep the warm kernels for the next
+                # submission
+                self.warm[sig]["completed"] = completed or \
+                    self.warm[sig].get("completed", False)
+
+        # the job artifact: a normal jaxmc.metrics/2 summary + the
+        # serve block (obs/schema.py PR-7 notes)
+        window_recompiles = sum(1 for lv in job_tel.levels
+                                if lv.get("fresh_compile"))
+        wall = time.time() - t0
+        result_block: Dict[str, Any] = {
+            "ok": res.ok, "distinct": res.distinct,
+            "generated": res.generated, "diameter": res.diameter,
+            "truncated": bool(res.truncated),
+            "wall_s": round(res.wall_s, 6),
+            "warnings": list(getattr(res, "warnings", []))}
+        if drained:
+            result_block["drained"] = True
+        if res.violation is not None:
+            from ..engine.explore import format_trace
+            result_block["violation"] = {"kind": res.violation.kind,
+                                         "name": res.violation.name}
+            result_block["trace"] = format_trace(res.violation)
+        summary = job_tel.summary(result=result_block)
+        summary["backend"] = cfg.backend
+        summary["spec"] = job["spec"]
+        summary["serve"] = {
+            "sig": sig, "warm_engine": warm_engine,
+            "resumed_from_checkpoint": resumed,
+            "window_recompiles": window_recompiles,
+            "profile_hits": job_tel.counters.get("profile.hits", 0),
+            "persistent_cache_hits": job_tel.counters.get(
+                "compile.persistent_cache_hits", 0),
+            "batched_with": [f["id"] for f in followers],
+            "job_wall_s": round(wall, 6),
+        }
+        job_tel.close()
+
+        status = "drained" if drained else "done"
+        for j in [job] + followers:
+            self.q.save_result(j["id"], summary)
+            self.q.mark(j["id"], status, finished_at=time.time(),
+                        ok=res.ok, distinct=res.distinct,
+                        generated=res.generated,
+                        warm_engine=warm_engine,
+                        resumed_from_checkpoint=resumed,
+                        window_recompiles=window_recompiles,
+                        batch_leader=jid if j is not job else None)
+        if drained:
+            self.tel.counter("serve.jobs_drained", 1 + len(followers))
+            self.log(f"serve: job {jid} drained at a safe boundary "
+                     f"(checkpointed; will resume next life)")
+        else:
+            self.tel.counter("serve.jobs_done", 1 + len(followers))
+            self._jobs_done += 1 + len(followers)
+            self.log(f"serve: job {jid} done in {wall:.2f}s "
+                     f"(ok={res.ok}, {res.distinct} distinct, "
+                     f"warm={warm_engine}, resumed={resumed}, "
+                     f"batched={len(followers)})")
+
+    # ---- introspection ------------------------------------------------
+    def _update_gauges(self) -> None:
+        with self._cv:
+            depth = len(self._pending)
+            running = len(self._running)
+        self.tel.gauge("serve.queue_depth", depth)
+        self.tel.gauge("serve.running", running)
+        self.tel.gauge("serve.warm_sessions", len(self.warm))
+        self.tel.gauge("serve.workers", self.n_workers)
+        self.tel.gauge("serve.draining", self._draining)
+
+    def status(self) -> Dict[str, Any]:
+        self._update_gauges()
+        with self._cv:
+            pending = list(self._pending)
+            running = dict(self._running)
+            warm = {s: w["session"] for s, w in self.warm.items()}
+        return {
+            "spool": self.q.root,
+            "queue_depth": len(pending),
+            "pending": pending,
+            "running": running,
+            "warm_sessions": {
+                s: sess.describe() for s, sess in warm.items()},
+            "workers": self.n_workers,
+            "draining": self._draining,
+            "jobs_done": self._jobs_done,
+            "jobs_failed": self._jobs_failed,
+            "counters": dict(self.tel.counters),
+            "gauges": dict(self.tel.gauges),
+        }

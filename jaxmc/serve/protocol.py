@@ -1,0 +1,173 @@
+r"""The serve job protocol: JSON over HTTP, plus the tiny stdlib client.
+
+Endpoints (all JSON bodies/responses; the daemon binds 127.0.0.1):
+
+  POST /jobs          {spec, cfg?, options?{...check options...}}
+                      -> 200 {id, sig, status}  |  400 bad job
+                      |  503 daemon is draining
+  GET  /jobs          -> {jobs: [job records]}
+  GET  /jobs/<id>     -> job record (+ "result" summary once done)
+  GET  /jobs/<id>/result
+                      -> the job's full jaxmc.metrics/2 artifact
+                         (result block carries ok/counts/violation and
+                         the rendered counterexample trace), 404 before
+                         completion
+  GET  /status        -> {queue_depth, running, warm_sessions, workers,
+                          draining, counters, gauges}
+  POST /drain         -> initiate the graceful drain (same path as
+                         SIGTERM); 200 {draining: true}
+
+A job record: {id, sig, status: queued|running|done|failed|drained,
+submitted_at, started_at?, finished_at?, spec, cfg, options,
+batch_leader?, error?}.
+
+Job SIGNATURES (`job_signature`) hash the spec/cfg CONTENTS plus every
+result-affecting option (session.SessionConfig.job_signature_fields),
+so "identical job" means identical model and identical search — the
+key under which checkpoints persist, warm sessions are reused, and
+queued duplicates batch through one dispatch.  Editing the spec file
+changes the signature and invalidates all of that, by construction.
+
+Options accepted in a submission are the check-surface subset below
+(`OPTION_FIELDS`); checkpoint/resume/telemetry paths are daemon-owned
+and rejected if submitted.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Any, Dict, Optional
+
+from ..session import SessionConfig, default_cfg_path, read_text
+
+# the submission-settable option surface (everything else in
+# SessionConfig is daemon-owned plumbing)
+OPTION_FIELDS = (
+    "backend", "platform", "max_states", "workers", "no_deadlock",
+    "seq_cap", "grow_cap", "kv_cap", "no_trace", "host_seen", "sample",
+    "chunk", "resident", "include", "progress_every", "res_caps",
+)
+
+JOB_STATUSES = ("queued", "running", "done", "failed", "drained")
+
+
+class BadJob(ValueError):
+    """A submission the daemon refuses; the message is the 400 body."""
+
+
+def build_config(spec: str, cfg: Optional[str],
+                 options: Optional[Dict[str, Any]]) -> SessionConfig:
+    """Validate a submission into a SessionConfig (checkpoint fields
+    left for the daemon to fill).  Raises BadJob with the defect."""
+    if not spec or not isinstance(spec, str):
+        raise BadJob("job needs a 'spec' path")
+    if not os.path.isfile(spec):
+        raise BadJob(f"spec not found on the daemon's filesystem: {spec}")
+    if cfg is not None and not os.path.isfile(cfg):
+        raise BadJob(f"cfg not found on the daemon's filesystem: {cfg}")
+    options = dict(options or {})
+    unknown = sorted(set(options) - set(OPTION_FIELDS))
+    if unknown:
+        raise BadJob(f"unknown/forbidden job options: {unknown} "
+                     f"(accepted: {sorted(OPTION_FIELDS)})")
+    kw: Dict[str, Any] = {}
+    for k in OPTION_FIELDS:
+        if k in options and options[k] is not None:
+            kw[k] = options[k]
+    if "sample" in kw:
+        kw["sample"] = tuple(kw["sample"])
+    if "include" in kw:
+        kw["include"] = tuple(kw["include"])
+    try:
+        return SessionConfig(spec=spec, cfg=cfg, **kw)
+    except TypeError as ex:
+        raise BadJob(f"bad job options: {ex}")
+
+
+def job_signature(cfg: SessionConfig) -> str:
+    """The warm-reuse / checkpoint / batching key: spec+cfg CONTENT
+    hashes plus the result-affecting option surface."""
+    effective_cfg = cfg.cfg or default_cfg_path(cfg.spec)
+    ident = dict(cfg.job_signature_fields())
+    ident["spec_sha"] = hashlib.sha256(
+        read_text(cfg.spec).encode()).hexdigest()
+    ident["cfg_sha"] = hashlib.sha256(
+        read_text(effective_cfg).encode()).hexdigest() \
+        if effective_cfg else None
+    blob = json.dumps(ident, sort_keys=True).encode()
+    return hashlib.sha256(blob).hexdigest()[:16]
+
+
+# --------------------------------------------------------------- client
+
+class ServeClient:
+    """Minimal stdlib HTTP client for the daemon (tests, the submit/
+    status subcommands, the make serve-check smoke)."""
+
+    def __init__(self, host: str, port: int, timeout: float = 30.0):
+        self.host = host
+        self.port = port
+        self.timeout = timeout
+
+    @classmethod
+    def from_spool(cls, spool: str, timeout: float = 30.0
+                   ) -> "ServeClient":
+        """Discover a live daemon from its spool's serve.json stamp."""
+        with open(os.path.join(spool, "serve.json"),
+                  encoding="utf-8") as fh:
+            info = json.load(fh)
+        return cls(info["host"], info["port"], timeout)
+
+    def _request(self, method: str, path: str,
+                 body: Optional[Dict[str, Any]] = None):
+        import urllib.request
+        import urllib.error
+        url = f"http://{self.host}:{self.port}{path}"
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            url, data=data, method=method,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req,
+                                        timeout=self.timeout) as resp:
+                return resp.status, json.loads(resp.read().decode())
+        except urllib.error.HTTPError as ex:
+            try:
+                return ex.code, json.loads(ex.read().decode())
+            except Exception:  # noqa: BLE001 — non-JSON error body
+                return ex.code, {"error": str(ex)}
+
+    def submit(self, spec: str, cfg: Optional[str] = None,
+               options: Optional[Dict[str, Any]] = None):
+        return self._request("POST", "/jobs", {
+            "spec": spec, "cfg": cfg, "options": options or {}})
+
+    def job(self, jid: str):
+        return self._request("GET", f"/jobs/{jid}")
+
+    def result(self, jid: str):
+        return self._request("GET", f"/jobs/{jid}/result")
+
+    def status(self):
+        return self._request("GET", "/status")
+
+    def drain(self):
+        return self._request("POST", "/drain")
+
+    def wait(self, jid: str, timeout: float = 300.0,
+             poll_s: float = 0.2) -> Dict[str, Any]:
+        """Poll until the job leaves the queue; returns the final job
+        record.  Raises TimeoutError with the last-seen status."""
+        import time
+        deadline = time.time() + timeout
+        last = {}
+        while time.time() < deadline:
+            code, last = self.job(jid)
+            if code == 200 and last.get("status") in ("done", "failed",
+                                                      "drained"):
+                return last
+            time.sleep(poll_s)
+        raise TimeoutError(
+            f"job {jid} still {last.get('status')!r} after {timeout}s")

@@ -1,0 +1,157 @@
+r"""The serve daemon's durable on-disk job queue (the spool).
+
+Layout under one root directory:
+
+    <spool>/serve.json           live-daemon stamp {host, port, pid, ...}
+    <spool>/jobs/<id>.json       one job record per file (atomic writes)
+    <spool>/results/<id>.json    the job's jaxmc.metrics/2 artifact
+    <spool>/ckpt/<sig>.ck        checkpoints, keyed by job SIGNATURE so
+                                 identical jobs share one resume ladder
+                                 (serve/protocol.py defines signatures)
+
+Durability contract: every mutation is a whole-file atomic write
+(tmp + os.replace, the obs.write_json_atomic pattern), so a SIGKILLed
+daemon leaves a readable spool.  `recover()` runs at daemon start:
+jobs stuck in `running` (the daemon died mid-job) and jobs a drain
+parked as `drained` go back to `queued` — their signature-keyed
+checkpoint (periodic, drain, or final) lets the next run resume
+instead of re-exploring.  Job IDs are monotonic per spool
+(`<spool>/.seq`, under an O_EXCL-free fcntl lock) so queue order
+survives restarts and sorts lexicographically.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from ..obs import write_json_atomic
+
+
+class JobQueue:
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        self.jobs_dir = os.path.join(self.root, "jobs")
+        self.results_dir = os.path.join(self.root, "results")
+        self.ckpt_dir = os.path.join(self.root, "ckpt")
+        for d in (self.jobs_dir, self.results_dir, self.ckpt_dir):
+            os.makedirs(d, exist_ok=True)
+
+    # ---- ids ----------------------------------------------------------
+    def _next_id(self) -> str:
+        """Monotonic job id, crash-safe across daemon restarts: the
+        counter file is read-modify-written under an exclusive flock."""
+        seq_path = os.path.join(self.root, ".seq")
+        fd = os.open(seq_path, os.O_CREAT | os.O_RDWR, 0o644)
+        try:
+            try:
+                import fcntl
+                fcntl.flock(fd, fcntl.LOCK_EX)
+            except (ImportError, OSError):
+                pass  # single-daemon spools stay correct without it
+            raw = os.read(fd, 32)
+            n = int(raw) if raw.strip() else 0
+            n += 1
+            os.lseek(fd, 0, os.SEEK_SET)
+            os.ftruncate(fd, 0)
+            os.write(fd, str(n).encode())
+            return f"j{n:08d}"
+        finally:
+            os.close(fd)
+
+    # ---- job records --------------------------------------------------
+    def job_path(self, jid: str) -> str:
+        return os.path.join(self.jobs_dir, f"{jid}.json")
+
+    def result_path(self, jid: str) -> str:
+        return os.path.join(self.results_dir, f"{jid}.json")
+
+    def ckpt_path(self, sig: str) -> str:
+        return os.path.join(self.ckpt_dir, f"{sig}.ck")
+
+    def new_job(self, spec: str, cfg: Optional[str], options: Dict,
+                sig: str) -> Dict[str, Any]:
+        job = {
+            "id": self._next_id(), "sig": sig, "status": "queued",
+            "submitted_at": time.time(), "spec": spec, "cfg": cfg,
+            "options": dict(options or {}),
+        }
+        self.save(job)
+        return job
+
+    def save(self, job: Dict[str, Any]) -> None:
+        write_json_atomic(self.job_path(job["id"]), job)
+
+    def load(self, jid: str) -> Optional[Dict[str, Any]]:
+        try:
+            with open(self.job_path(jid), encoding="utf-8") as fh:
+                return json.load(fh)
+        except (OSError, ValueError):
+            return None
+
+    def mark(self, jid: str, status: str, **fields) -> Dict[str, Any]:
+        job = self.load(jid) or {"id": jid}
+        job["status"] = status
+        job.update(fields)
+        self.save(job)
+        return job
+
+    def list_jobs(self) -> List[Dict[str, Any]]:
+        out = []
+        try:
+            names = sorted(os.listdir(self.jobs_dir))
+        except OSError:
+            return out
+        for name in names:
+            if not name.endswith(".json"):
+                continue
+            j = self.load(name[:-len(".json")])
+            if j is not None:
+                out.append(j)
+        return out
+
+    def queued(self) -> List[Dict[str, Any]]:
+        return [j for j in self.list_jobs() if j.get("status") == "queued"]
+
+    # ---- results ------------------------------------------------------
+    def save_result(self, jid: str, summary: Dict[str, Any]) -> None:
+        write_json_atomic(self.result_path(jid), summary)
+
+    def load_result(self, jid: str) -> Optional[Dict[str, Any]]:
+        try:
+            with open(self.result_path(jid), encoding="utf-8") as fh:
+                return json.load(fh)
+        except (OSError, ValueError):
+            return None
+
+    # ---- restart recovery ---------------------------------------------
+    def recover(self) -> int:
+        """Re-queue jobs the previous daemon life left in flight:
+        `running` (it died mid-job) and `drained` (it checkpointed and
+        parked them on SIGTERM).  Returns the number re-queued.  The
+        signature-keyed checkpoint, when one exists, makes the re-run
+        incremental rather than from-scratch."""
+        n = 0
+        for job in self.list_jobs():
+            if job.get("status") in ("running", "drained"):
+                note = ("requeued after daemon restart"
+                        if job["status"] == "running"
+                        else "requeued after drain")
+                self.mark(job["id"], "queued", requeue_note=note)
+                n += 1
+        return n
+
+    # ---- the live-daemon stamp ----------------------------------------
+    def stamp(self, **info) -> None:
+        write_json_atomic(os.path.join(self.root, "serve.json"),
+                          dict(info, stamped_at=time.time()))
+
+    def read_stamp(self) -> Optional[Dict[str, Any]]:
+        try:
+            with open(os.path.join(self.root, "serve.json"),
+                      encoding="utf-8") as fh:
+                return json.load(fh)
+        except (OSError, ValueError):
+            return None

@@ -1,0 +1,424 @@
+r"""CheckSession: the reusable check flow as explicit, resumable stages.
+
+ISSUE 7's forcing-function refactor: cli.py's monolithic check flow —
+cfg sniffing, model load, device init, engine construction, search,
+fallback — becomes one object with three named stages,
+
+    parse    cfg + spec  ->  a bound Model (or an ASSUME-mode verdict)
+    compile  Model       ->  a ready engine (device init, kernel build;
+                             carries the layout signature when the jax
+                             backend compiled one)
+    explore  engine      ->  CheckResult (re-runnable: warm re-checks
+                             override resume/checkpoint per run)
+
+so the CLI `check` command (a thin driver with byte-identical output),
+the serve daemon (`python -m jaxmc.serve`, which holds sessions WARM and
+answers repeat submissions from their checkpoints), and tests all drive
+the same code.  A session carries exactly the state the daemon needs to
+amortize: the parsed model, the built engine (whose jit caches are the
+expensive warm artifact), the layout signature (the durable-artifact
+key: compile cache entries and capacity profiles are keyed by
+(module, layout_sig)), and the checkpoint handle.  Telemetry rides the
+session: every stage reports spans into the recorder the session was
+built with (obs.current() at construction unless one is passed).
+
+Stage errors propagate as the same exceptions the CLI always mapped
+(ModeError/CompileError/CkptError/ImportError/device failures) — the
+DRIVER owns the policy (cli.py prints + exit codes; the serve daemon
+marks the job failed; `demote_to_cpu` implements the shared device->CPU
+fallback either driver can invoke).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+from . import obs
+from .compile.vspec import Bounds
+
+
+def read_text(path: str) -> str:
+    """Read a cfg/spec file WITHOUT leaking the handle (the old
+    `open(...).read()` pattern relied on refcount finalization)."""
+    with open(path, encoding="utf-8", errors="replace") as fh:
+        return fh.read()
+
+
+def default_cfg_path(spec_path: str) -> Optional[str]:
+    guess = os.path.splitext(spec_path)[0] + ".cfg"
+    return guess if os.path.exists(guess) else None
+
+
+def load_model(spec_path: str, cfg_path, no_deadlock: bool,
+               includes=()):
+    from .front.cfg import parse_cfg, ModelConfig
+    from .sem.modules import Loader, bind_model
+
+    if cfg_path is None:
+        cfg_path = default_cfg_path(spec_path)
+    if cfg_path:
+        cfg = parse_cfg(read_text(cfg_path))
+    else:
+        cfg = ModelConfig(specification="Spec")
+    if no_deadlock:
+        cfg.check_deadlock = False
+    ldr = Loader([os.path.dirname(os.path.abspath(spec_path))] +
+                 list(includes))
+    mod = ldr.load_path(spec_path)
+    return bind_model(mod, cfg)
+
+
+_SENTINEL = object()  # "keep the configured value" for explore overrides
+
+
+@dataclass
+class SessionConfig:
+    """Everything a check run is parameterized by — field names and
+    defaults mirror the `check` CLI exactly (argparse populates the same
+    surface), plus the serve-only knobs at the bottom."""
+
+    spec: str
+    cfg: Optional[str] = None
+    include: Tuple[str, ...] = ()
+    backend: str = "interp"
+    platform: Optional[str] = None
+    max_states: Optional[int] = None
+    workers: Optional[int] = None
+    compile_cache: Optional[str] = None
+    no_deadlock: bool = False
+    no_device_fallback: bool = False
+    progress_every: float = 30.0
+    seq_cap: int = Bounds.seq_cap
+    grow_cap: int = Bounds.grow_cap
+    kv_cap: int = Bounds.kv_cap
+    no_trace: bool = False
+    host_seen: bool = False
+    sample: Tuple[int, int, int] = (800, 40, 60)
+    chunk: int = 2048
+    resident: bool = False
+    checkpoint: Optional[str] = None
+    checkpoint_every: float = 600.0
+    resume: Optional[str] = None
+    # serve-only knobs (no CLI flags):
+    final_checkpoint: bool = False  # checkpoint COMPLETED runs too —
+    # the daemon's warm-resume source
+    res_caps: Optional[Dict[str, int]] = None
+
+    @classmethod
+    def from_args(cls, args) -> "SessionConfig":
+        """Build from an argparse Namespace (the `check` subcommand's);
+        unknown session-only fields keep their defaults."""
+        import dataclasses
+        kw = {}
+        for f in dataclasses.fields(cls):
+            if hasattr(args, f.name):
+                kw[f.name] = getattr(args, f.name)
+        kw["include"] = tuple(getattr(args, "include", ()) or ())
+        kw["sample"] = tuple(getattr(args, "sample", (800, 40, 60)))
+        return cls(**kw)
+
+    def job_signature_fields(self) -> Dict[str, Any]:
+        """The option surface that makes two submissions 'the same job'
+        for warm reuse: anything that changes the search's RESULT or its
+        layout/kernels.  Checkpoint/resume paths, telemetry, and pacing
+        knobs (progress_every, checkpoint_every) are excluded — they
+        change the run's plumbing, not its answer."""
+        return {
+            "spec": self.spec, "cfg": self.cfg,
+            "include": list(self.include), "backend": self.backend,
+            "platform": self.platform, "max_states": self.max_states,
+            "no_deadlock": self.no_deadlock,
+            "seq_cap": self.seq_cap, "grow_cap": self.grow_cap,
+            "kv_cap": self.kv_cap, "no_trace": self.no_trace,
+            "host_seen": self.host_seen, "sample": list(self.sample),
+            "chunk": self.chunk, "resident": self.resident,
+        }
+
+
+class CheckSession:
+    """One check as three resumable stages over one model/engine pair.
+
+    Stage order is enforced (compile needs parse's model, explore needs
+    compile's engine); each stage is idempotent — calling it again when
+    already complete is a no-op, so a driver can `ensure()` its way to
+    any stage.  `explore` alone is deliberately RE-runnable with
+    per-run overrides: the serve daemon re-drives a warm session's
+    engine with `resume_from=<previous job's final checkpoint>` and the
+    search replays the stored verdict without recompiling anything."""
+
+    def __init__(self, cfg: SessionConfig, tel=None, log=None):
+        self.cfg = cfg
+        self.tel = tel if tel is not None else obs.current()
+        self.log = log if log is not None else obs.Logger(quiet=True)
+        self.stage: Optional[str] = None  # last COMPLETED stage
+        self.kind: Optional[str] = None   # "model" | "assumes"
+        self.model = None
+        self.engine = None
+        self.cache_dir: Optional[str] = None  # persistent compile cache
+        self.layout_sig: Optional[str] = None
+        self.result = None
+        self.explore_count = 0
+
+    # ---- stage: parse -------------------------------------------------
+    def parse(self) -> str:
+        """Load cfg+spec.  Returns the session kind: "model" (a bound
+        Model ready to compile) or "assumes" (TLC's No-Behavior-Spec
+        calculator mode — drive it with run_assumes())."""
+        if self.stage is not None:
+            return self.kind
+        cfg = self.cfg
+        cfgp = cfg.cfg or default_cfg_path(cfg.spec)
+        self.cfg_path = cfgp
+        if cfgp:
+            from .front.cfg import parse_cfg
+            c = parse_cfg(read_text(cfgp))
+            if not c.specification and not c.init:
+                self.kind = "assumes"
+                self.stage = "parse"
+                return self.kind
+        with self.tel.span("load", spec=cfg.spec):
+            self.model = load_model(cfg.spec, cfg.cfg, cfg.no_deadlock,
+                                    cfg.include)
+        self.kind = "model"
+        self.stage = "parse"
+        return self.kind
+
+    def run_assumes(self) -> int:
+        """TLC's "No Behavior Spec" mode: evaluate the module's ASSUMEs
+        as a calculator / unit-test harness (SimpleMath.cfg:4-11,
+        PrintValues.tla — SURVEY.md §4.4).  Prints the verdict lines
+        (the CLI contract); returns the exit code."""
+        assert self.kind == "assumes", "run_assumes needs an assumes session"
+        from .front.cfg import parse_cfg, ModelConfig
+        from .sem.modules import Loader, bind_model_defs
+        from .sem.eval import Ctx, eval_expr
+        from .sem.values import fmt
+
+        cfg = self.cfg
+        mcfg = parse_cfg(read_text(self.cfg_path)) if self.cfg_path \
+            else ModelConfig()
+        ldr = Loader([os.path.dirname(os.path.abspath(cfg.spec))] +
+                     list(cfg.include))
+        mod = ldr.load_path(cfg.spec)
+        defs = bind_model_defs(mod, mcfg)
+        prints = []
+        ctx = Ctx(defs, {}, None, None, (),
+                  on_print=lambda v: prints.append(v))
+        failed = 0
+        for a in mod.assumes:
+            v = eval_expr(a.expr, ctx)
+            nm = a.name or "ASSUME"
+            if v is not True:
+                print(f"Assumption {nm} is violated (evaluated to "
+                      f"{fmt(v)}).")
+                failed += 1
+        for v in prints:
+            print(fmt(v) if not isinstance(v, str) else v)
+        if failed:
+            return 1
+        print(f"{len(mod.assumes)} assumption"
+              f"{'s' if len(mod.assumes) != 1 else ''} checked. "
+              "No error has been found.")
+        return 0
+
+    # ---- stage: compile -----------------------------------------------
+    def device_init(self) -> Optional[str]:
+        """Device/plugin init with bounded retries + backoff
+        (JAXMC_DEVICE_RETRIES, default 2): a flaky accelerator tunnel
+        gets more than one chance before the run demotes to CPU.
+        ImportError (jax not in the build) stays terminal — retrying
+        cannot install a wheel.  Returns the persistent compile-cache
+        dir (or None)."""
+        from . import faults
+        cfg, tel = self.cfg, self.tel
+        retries = int(os.environ.get("JAXMC_DEVICE_RETRIES", "2"))
+        for attempt in range(retries + 1):
+            try:
+                with tel.span("device_init",
+                              platform=cfg.platform or "default",
+                              attempt=attempt):
+                    import jax
+                    faults.inject("device_init_fail")
+                    if cfg.platform:
+                        jax.config.update("jax_platforms", cfg.platform)
+                    # persistent XLA compile cache (repeat runs skip the
+                    # per-arm compiles): opt-in via --compile-cache /
+                    # JAXMC_COMPILE_CACHE, but GUARDED (ISSUE 5): a
+                    # wedged, corrupt or foreign-build cache degrades to
+                    # cold compilation instead of hanging the run
+                    from .compile.cache import (cache_dir_from_env,
+                                                enable_guarded_cache)
+                    _cache_req = cfg.compile_cache or cache_dir_from_env()
+                    cache_dir = enable_guarded_cache(_cache_req, tel=tel) \
+                        if _cache_req else None
+                    if tel.enabled:
+                        # force plugin/device init inside the span so a
+                        # hung tunnel is attributed to device_init, not
+                        # compile
+                        tel.gauge("device.platform",
+                                  jax.devices()[0].platform)
+                        tel.gauge("device.count", len(jax.devices()))
+                        # re-stamp the env fingerprint now that jax is
+                        # initialized: platform/device_count become real
+                        tel.set_meta(env=obs.environment_meta())
+                    else:
+                        jax.devices()  # init failures must surface HERE
+                return cache_dir
+            except (faults.FaultInjected, RuntimeError, OSError,
+                    ConnectionError) as ex:
+                if attempt >= retries:
+                    raise
+                tel.counter("device.init_retries")
+                print(f"warning: device init failed ({ex}); retrying "
+                      f"({attempt + 1}/{retries})", file=sys.stderr)
+                time.sleep(min(0.2 * (2 ** attempt), 5.0))
+
+    def compile(self) -> "CheckSession":
+        """Build the engine for the configured backend.  For the jax
+        backend this is the expensive stage (device init, layout
+        sampling, per-arm kernel construction) and the one whose product
+        the serve daemon keeps warm; it also stamps `layout_sig`, the
+        key under which compile-cache entries and capacity profiles
+        persist.  Raises what engine construction raises (ModeError /
+        CompileError / device failures) — the driver owns the policy."""
+        if self.stage in ("compile", "explore"):
+            return self
+        if self.stage != "parse":
+            self.parse()
+        assert self.kind == "model", "assumes sessions have no engine"
+        cfg = self.cfg
+        if cfg.backend == "interp":
+            from .engine.parallel import ParallelExplorer, default_workers
+            # None or 0 = auto (JAXMC_WORKERS, else min(cpu_count, 8))
+            self.workers = default_workers() if not cfg.workers \
+                else max(1, cfg.workers)
+            kw = dict(log=self.log, max_states=cfg.max_states,
+                      progress_every=cfg.progress_every,
+                      checkpoint_path=cfg.checkpoint,
+                      checkpoint_every=cfg.checkpoint_every,
+                      resume_from=cfg.resume,
+                      final_checkpoint=cfg.final_checkpoint)
+            if self.workers > 1:
+                # worker-parallel frontier expansion (crash-safe:
+                # checkpoints natively, survives worker deaths); falls
+                # back to the serial engine (identical results) only for
+                # stepwise refinement or when the platform cannot fork
+                self.engine = ParallelExplorer(self.model,
+                                               workers=self.workers, **kw)
+            else:
+                from .engine.explore import Explorer
+                self.engine = Explorer(self.model, **kw)
+        else:
+            self.cache_dir = self.device_init()
+            from .tpu.bfs import TpuExplorer
+            bounds = Bounds(seq_cap=cfg.seq_cap, grow_cap=cfg.grow_cap,
+                            kv_cap=cfg.kv_cap)
+            with self.tel.span("engine_build"):
+                self.engine = TpuExplorer(
+                    self.model, log=self.log, bounds=bounds,
+                    store_trace=not cfg.no_trace,
+                    progress_every=cfg.progress_every,
+                    host_seen=cfg.host_seen,
+                    chunk=cfg.chunk,
+                    resident=cfg.resident,
+                    sample_cfg=tuple(cfg.sample),
+                    checkpoint_path=cfg.checkpoint,
+                    checkpoint_every=cfg.checkpoint_every,
+                    resume_from=cfg.resume,
+                    max_states=cfg.max_states,
+                    res_caps=cfg.res_caps,
+                    final_checkpoint=cfg.final_checkpoint)
+            self.layout_sig = self.engine._layout_sig()
+        self.stage = "compile"
+        return self
+
+    # ---- stage: explore -----------------------------------------------
+    def explore(self, resume_from=_SENTINEL, checkpoint_path=_SENTINEL,
+                final_checkpoint=_SENTINEL):
+        """Run (or RE-run) the search.  Overrides apply to this run only
+        in spirit — they are set on the engine, whose run() reads them
+        fresh each call — and are how a warm session answers a repeat
+        submission: explore(resume_from=last_final_checkpoint) replays
+        the completed search's verdict through the already-compiled
+        kernels.  Returns (and stores) the CheckResult."""
+        if self.stage is None or self.stage == "parse":
+            self.compile()
+        ex = self.engine
+        if resume_from is not _SENTINEL:
+            ex.resume_from = resume_from
+        if checkpoint_path is not _SENTINEL:
+            ex.checkpoint_path = checkpoint_path
+        if final_checkpoint is not _SENTINEL:
+            ex.final_checkpoint = final_checkpoint
+        self.explore_count += 1
+        if self.cfg.backend == "interp":
+            with self.tel.span("search", workers=self.workers):
+                self.result = ex.run()
+        else:
+            with self.tel.span("search"):
+                self.result = ex.run()
+            from .compile.cache import record_entries_end
+            record_entries_end(self.cache_dir)
+        self.stage = "explore"
+        return self.result
+
+    # ---- shared device->CPU fallback ----------------------------------
+    def demote_to_cpu(self, err) -> Any:
+        """Terminal device failure -> the parallel CPU engine, resuming
+        from the device run's host snapshot (`<checkpoint>.host`,
+        written at level barriers by tpu/bfs.py) when one exists.  The
+        demotion is machine-readable: `device.demoted` gauge + event
+        (flagged by `python -m jaxmc.obs diff`) and a result warning on
+        stdout."""
+        from .engine.parallel import ParallelExplorer, default_workers
+        cfg, tel = self.cfg, self.tel
+        reason = f"{type(err).__name__}: {err}"
+        print(f"warning: device backend failed terminally ({reason}); "
+              f"falling back to the parallel CPU engine", file=sys.stderr)
+        tel.event("device.demoted", reason=reason)
+        tel.gauge("device.demoted", reason[:200])
+        tel.counter("device.demotions")
+        snap = (cfg.checkpoint + ".host") if cfg.checkpoint else None
+        resume = snap if snap and os.path.exists(snap) else None
+        if snap and not resume:
+            print("warning: no host snapshot exists yet - the CPU engine "
+                  "restarts from scratch", file=sys.stderr)
+        if resume:
+            print(f"resuming from host snapshot {resume}", file=sys.stderr)
+        workers = default_workers() if not cfg.workers \
+            else max(1, cfg.workers)
+        with tel.span("search_fallback", workers=workers):
+            res = ParallelExplorer(
+                self.model, workers=workers, log=self.log,
+                max_states=cfg.max_states,
+                progress_every=cfg.progress_every,
+                checkpoint_path=snap,
+                checkpoint_every=cfg.checkpoint_every,
+                resume_from=resume,
+                final_checkpoint=cfg.final_checkpoint).run()
+        res.warnings.append(
+            f"device backend failed ({reason}); the run completed on the "
+            f"parallel CPU engine"
+            + (", resumed from the last host snapshot" if resume
+               else ", restarted from scratch"))
+        self.result = res
+        return res
+
+    # ---- introspection -------------------------------------------------
+    def describe(self) -> Dict[str, Any]:
+        """The session's resumable identity (serve status endpoint)."""
+        return {
+            "stage": self.stage,
+            "kind": self.kind,
+            "backend": self.cfg.backend,
+            "spec": self.cfg.spec,
+            "module": self.model.module.name if self.model is not None
+            else None,
+            "layout_sig": self.layout_sig,
+            "checkpoint": self.cfg.checkpoint,
+            "explore_count": self.explore_count,
+        }

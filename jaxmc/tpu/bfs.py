@@ -228,8 +228,14 @@ class TpuExplorer:
                  relayouts_left: int = 3,
                  pin_interp_arms: bool = False,
                  res_caps: Optional[Dict[str, int]] = None,
-                 cap_profile: bool = True):
+                 cap_profile: bool = True,
+                 final_checkpoint: bool = False):
         self.model = model
+        # persist a checkpoint when the search COMPLETES (not just on
+        # truncation): the serve daemon's warm-resume source — an
+        # identical later job resumes it, replays the stored totals
+        # over an empty frontier, and finishes in one dispatch
+        self.final_checkpoint = final_checkpoint
         # same funnel as cli.py: silent on stdout by default, but the
         # strings still mirror into the telemetry trace
         self.log = log if log is not None else obs.Logger(quiet=True)
@@ -612,6 +618,7 @@ class TpuExplorer:
         tel.gauge("device.donation", bool(self.donate))
         self._step_cache: Dict[Tuple[int, int], Callable] = {}
         self._hstep_cache: Dict[int, Callable] = {}
+        self._hstep_group_jits: Dict[int, List[Callable]] = {}
         self._newcheck_cache: Dict[int, Callable] = {}
         self._res_cache: Dict[Tuple[int, ...], Callable] = {}
         self._hostkeys_cache: Dict[int, Callable] = {}
@@ -1097,8 +1104,18 @@ class TpuExplorer:
             self._hstep_cache[FC] = hstep
             return hstep
 
-        # per-action jits (cached on the CompiledAction2 objects, keyed
-        # by FC) + one small combine jit independent of A.
+        # ARM-GROUP fused jits (ISSUE 7 satellite, lifting the ROADMAP
+        # item-2 remainder): the old fallback compiled one jit PER
+        # ACTION (A dispatches + A host round-trips per chunk — pure
+        # overhead, the r04 inversion's constant factor writ large on
+        # many-instance models).  Instead, partition the compiled
+        # actions into groups of <= fused_max INSTANCES and fuse each
+        # group into ONE jit: XLA:CPU's superlinear fused-compile cost
+        # stays bounded by the group size while the dispatch count
+        # drops from A to ceil(A/fused_max).  Candidate order is
+        # preserved (groups are contiguous in self.compiled order and
+        # concatenate in order), so counts and traces stay identical
+        # to both the per-action and the fully-fused paths.
         #
         # Predicates are NOT evaluated per candidate here: the engine
         # only consults inv_ok/explore on NEW rows (a handful per level)
@@ -1138,33 +1155,12 @@ class TpuExplorer:
                 return out
             frontier = unpack_j(frontier_p)
             ens, aoks, ovs, succs = [], [], [], []
-            for ca in acts:
-                key = ("hjit", FC)
-                jf = ca.__dict__.get(key)
-                if jf is not None:
-                    obs.current().counter("compile.cache_hits")
-                else:
-                    obs.current().counter("compile.cache_misses")
-                    if ca.n_slots:
-                        jf = jax.jit(jax.vmap(
-                            jax.vmap(ca.fn, in_axes=(0, None)),
-                            in_axes=(None, 0)))
-                    else:
-                        jf = jax.jit(jax.vmap(ca.fn))
-                    ca.__dict__[key] = jf
-                if ca.n_slots:
-                    slots = jnp.arange(ca.n_slots, dtype=jnp.int32)
-                    en, aok, ov, succ = jf(frontier, slots)
-                    ens.append(np.asarray(en))
-                    aoks.append(np.asarray(aok))
-                    ovs.append(np.asarray(ov))
-                    succs.append(np.asarray(succ).reshape(-1, W))
-                else:
-                    en, aok, ov, succ = jf(frontier)
-                    ens.append(np.asarray(en)[None, :])
-                    aoks.append(np.asarray(aok)[None, :])
-                    ovs.append(np.asarray(ov)[None, :])
-                    succs.append(np.asarray(succ))
+            for jf in self._hstep_groups(fused_max):
+                en, aok, ov, succ = jf(frontier)  # [a_g, FC(, W)]
+                ens.append(np.asarray(en))
+                aoks.append(np.asarray(aok))
+                ovs.append(np.asarray(ov))
+                succs.append(np.asarray(succ).reshape(-1, W))
             en = np.concatenate(ens)          # [A, FC]
             aok = np.concatenate(aoks)
             ov = np.concatenate(ovs)
@@ -1190,6 +1186,64 @@ class TpuExplorer:
 
         self._hstep_cache[FC] = hstep
         return hstep
+
+    def _hstep_groups(self, fused_max: int) -> List[Callable]:
+        """The arm-group fused expansion jits for the many-instance
+        host_seen path: contiguous groups of compiled actions, each
+        holding at most `fused_max` kernel INSTANCES (a single action
+        whose slot fan-out alone exceeds the cap gets its own group —
+        the cap bounds the fused-compile blowup, and one slotted kernel
+        is a single program regardless of its slot count).  One jit per
+        group; instance order matches self.compiled flattening, so the
+        candidate stream is identical to the per-action and fully-fused
+        paths."""
+        cached = self._hstep_group_jits.get(fused_max)
+        if cached is not None:
+            obs.current().counter("compile.cache_hits")
+            return cached
+        obs.current().counter("compile.cache_misses")
+        groups: List[List[Any]] = []
+        cur: List[Any] = []
+        cur_w = 0
+        for ca in self.compiled:
+            w = max(1, ca.n_slots)
+            if cur and cur_w + w > fused_max:
+                groups.append(cur)
+                cur, cur_w = [], 0
+            cur.append(ca)
+            cur_w += w
+        if cur:
+            groups.append(cur)
+
+        def _mk(subset):
+            def gexpand(frontier):
+                ens, aoks, ovs, succs = [], [], [], []
+                for ca in subset:
+                    if ca.n_slots:
+                        slots = jnp.arange(ca.n_slots, dtype=jnp.int32)
+                        en, aok, ov, succ = jax.vmap(
+                            jax.vmap(ca.fn, in_axes=(0, None)),
+                            in_axes=(None, 0))(frontier, slots)
+                        for si in range(ca.n_slots):
+                            ens.append(en[si])
+                            aoks.append(aok[si])
+                            ovs.append(ov[si])
+                            succs.append(succ[si])
+                    else:
+                        en, aok, ov, succ = jax.vmap(ca.fn)(frontier)
+                        ens.append(en)
+                        aoks.append(aok)
+                        ovs.append(ov)
+                        succs.append(succ)
+                return (jnp.stack(ens), jnp.stack(aoks),
+                        jnp.stack(ovs), jnp.stack(succs))
+
+            return jax.jit(gexpand)
+
+        jits = [_mk(g) for g in groups]
+        obs.current().gauge("expand.fused_groups", len(jits))
+        self._hstep_group_jits[fused_max] = jits
+        return jits
 
     def _check_new_rows(self, rows_np, skip_cons=False):
         """Compiled invariant (+ constraint unless skip_cons — the edge
@@ -2012,6 +2066,28 @@ class TpuExplorer:
             depth = ck["depth"]
             self.log(f"Resumed from {self.resume_from}: {distinct} "
                      f"distinct states, {fcount} on queue.")
+            if fcount == 0:
+                # a COMPLETED-run checkpoint (final_checkpoint, the
+                # serve daemon's warm-resume source): nothing left to
+                # explore — replay the stored verdict with ZERO kernel
+                # dispatches (and therefore zero window recompiles)
+                self.log("Model checking completed. No error has been "
+                         "found.")
+                self.log(f"{generated} states generated, {distinct} "
+                         f"distinct states found, 0 states left on "
+                         f"queue.")
+                self.log(f"The depth of the complete state graph search "
+                         f"is {depth}.")
+                if self.checkpoint_path and self.final_checkpoint and \
+                        self.checkpoint_path != self.resume_from:
+                    self._write_ck(
+                        "resident", caps=dict(caps),
+                        seen=np.asarray(seen[:seen_count]),
+                        frontier=np.zeros((0, self.PW), np.int32),
+                        distinct=distinct, generated=generated,
+                        depth=depth)
+                return self._mk_result(True, distinct, generated,
+                                       depth - 1, t0, warnings)
 
         max_states = jnp.int32(self.max_states or 0)
         gen_lo = int(np.int32(np.uint32(generated & 0xFFFFFFFF)))
@@ -2033,6 +2109,17 @@ class TpuExplorer:
             from .. import faults
             faults.kill_self("run_kill", level=depth, engine="resident")
             faults.inject("device_run_fail", level=depth)
+            if self._drain_requested(warnings, "resident"):
+                if self.checkpoint_path:
+                    self._write_ck(
+                        "resident", caps=dict(caps),
+                        seen=np.asarray(seen[:seen_count]),
+                        frontier=np.asarray(frontier[:fcount]),
+                        distinct=distinct, generated=generated,
+                        depth=depth)
+                return self._mk_result(True, distinct, generated, depth,
+                                       t0, warnings, None,
+                                       truncated=True, drained=True)
             ck_key = (caps["SC"], caps["FCap"], caps["AccCap"],
                       caps["VC"], CH)
             fresh_compile = ck_key not in self._res_cache
@@ -2130,6 +2217,16 @@ class TpuExplorer:
                 self.log(f"The depth of the complete state graph search "
                          f"is {depth}.")
                 self._save_caps_profile(caps)
+                if self.checkpoint_path and self.final_checkpoint:
+                    # COMPLETED-run checkpoint (serve warm resume): an
+                    # empty frontier over the full seen set — resuming
+                    # it replays the stored totals in one dispatch
+                    self._write_ck(
+                        "resident", caps=dict(caps),
+                        seen=np.asarray(seen[:seen_count]),
+                        frontier=np.zeros((0, self.PW), np.int32),
+                        distinct=distinct, generated=generated,
+                        depth=depth)
                 return self._mk_result(True, distinct, generated,
                                        depth - 1, t0, warnings)
             elif stat == ST_TRUNC:
@@ -2250,6 +2347,20 @@ class TpuExplorer:
             from .. import faults
             faults.kill_self("run_kill", level=depth, engine="host_seen")
             faults.inject("device_run_fail", level=depth)
+            if self._drain_requested(warnings, "host_seen"):
+                if self.checkpoint_path:
+                    self._write_ck(
+                        "host_seen", store=store.dump(),
+                        frontier=frontier_np,
+                        **self._ck_state_kwargs(distinct, generated,
+                                                depth, trace_levels,
+                                                frontier_maps, graph,
+                                                frontier_sids))
+                    self._write_host_snapshot(trace_levels, frontier_maps,
+                                              graph, depth, generated)
+                return self._mk_result(True, distinct, generated, depth,
+                                       t0, warnings, None,
+                                       truncated=True, drained=True)
             L = len(frontier_np)
             lvl_t0 = time.time()
             lvl_gen0 = generated
@@ -2530,6 +2641,16 @@ class TpuExplorer:
         self.log("Model checking completed. No error has been found.")
         self.log(f"{generated} states generated, {distinct} distinct "
                  f"states found, 0 states left on queue.")
+        if self.checkpoint_path and self.final_checkpoint:
+            # COMPLETED-run checkpoint (serve warm resume): an empty
+            # frontier over the full store — resuming it skips the
+            # level loop and replays the stored totals
+            self._write_ck(
+                "host_seen", store=store.dump(),
+                frontier=np.zeros((0, self.PW), np.int32),
+                **self._ck_state_kwargs(distinct, generated, depth,
+                                        trace_levels, frontier_maps,
+                                        graph, frontier_sids))
         return self._mk_result(True, distinct, generated, depth - 1, t0,
                                warnings)
 
@@ -2926,6 +3047,20 @@ class TpuExplorer:
             from .. import faults
             faults.kill_self("run_kill", level=depth, engine="level")
             faults.inject("device_run_fail", level=depth)
+            if self._drain_requested(warnings, "level"):
+                if self.checkpoint_path:
+                    self._write_ck(
+                        "level", seen=np.asarray(seen[:seen_count]),
+                        frontier=np.asarray(frontier[:fcount]),
+                        **self._ck_state_kwargs(distinct, generated,
+                                                depth, trace_levels,
+                                                frontier_maps, graph,
+                                                frontier_sids))
+                    self._write_host_snapshot(trace_levels, frontier_maps,
+                                              graph, depth, generated)
+                return self._mk_result(True, distinct, generated, depth,
+                                       t0, warnings, None,
+                                       truncated=True, drained=True)
             lvl_t0 = time.time()
             C = self.A * FC
             if seen_count + C > SC:
@@ -3071,11 +3206,22 @@ class TpuExplorer:
                  f"found, 0 states left on queue.")
         self.log(f"The depth of the complete state graph search is "
                  f"{depth}.")
+        if self.checkpoint_path and self.final_checkpoint:
+            # COMPLETED-run checkpoint (serve warm resume): an empty
+            # frontier over the full seen table — resuming it skips the
+            # level loop and replays the stored totals
+            self._write_ck(
+                "level", seen=np.asarray(seen[:seen_count]),
+                frontier=np.zeros((0, self.PW), np.int32),
+                **self._ck_state_kwargs(distinct, generated, depth,
+                                        trace_levels, frontier_maps,
+                                        graph, frontier_sids))
         return self._mk_result(True, distinct, generated, depth - 1, t0,
                                warnings)
 
     def _mk_result(self, ok, distinct, generated, diameter, t0, warnings,
-                   violation=None, truncated=False) -> CheckResult:
+                   violation=None, truncated=False,
+                   drained=False) -> CheckResult:
         tel = obs.current()
         tel.high_water("device.mem_high_water_bytes",
                        obs.device_mem_high_water())
@@ -3089,7 +3235,26 @@ class TpuExplorer:
         return CheckResult(ok=ok, distinct=distinct, generated=generated,
                            diameter=max(diameter, 0), violation=violation,
                            wall_s=time.time() - t0, truncated=truncated,
-                           warnings=warnings)
+                           warnings=warnings, drained=drained)
+
+    def _drain_requested(self, warnings, engine: str) -> bool:
+        """Cooperative drain poll at a device-safe boundary (between
+        dispatches / at a level barrier).  Appends the named warning and
+        emits the trace event; the CALLER writes its own mode-specific
+        checkpoint and returns a drained result."""
+        from .. import drain as _drain
+        if not _drain.requested():
+            return False
+        why = _drain.reason()
+        self.log(f"-- drain requested ({why}): stopping at a safe "
+                 f"boundary")
+        obs.current().event("drain", reason=why, engine=engine)
+        warnings.append(
+            f"run drained before completion ({why})"
+            + (f"; resume with --resume {self.checkpoint_path}"
+               if self.checkpoint_path else "; no checkpoint was "
+               "configured — progress was discarded"))
+        return True
 
     def _trace_to(self, trace_levels, frontier_maps, level: int, idx: int,
                   from_new: bool = False) -> List[Tuple[Dict, str]]:

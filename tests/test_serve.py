@@ -1,0 +1,357 @@
+r"""jaxmc.serve: the checking-as-a-service daemon (ISSUE 7).
+
+Covers the acceptance surface end to end:
+  - submit/poll/result round-trip over a REAL socket (the daemon's own
+    HTTP listener, in-process for speed);
+  - durable spool: a daemon started over a non-empty on-disk queue
+    answers every job; identical queued jobs BATCH through one run;
+  - warm second submission: same daemon, identical job — the warm
+    session resumes the first job's FINAL checkpoint with
+    window_recompiles == 0 and a capacity-profile hit (the jax resident
+    scenario is the acceptance criterion verbatim);
+  - daemon restart: the signature-keyed checkpoint + persistent compile
+    cache + capacity profile make the next life's identical job a
+    resume with nonzero persistent-cache hits;
+  - SIGTERM drain (real subprocess): the in-flight job checkpoints and
+    parks, queued jobs survive, no orphan workers, no open spans in the
+    trace, and the next daemon life re-answers everything from
+    checkpoints — no job lost.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from jaxmc import drain
+from jaxmc.engine.explore import Explorer
+from jaxmc.serve import JobQueue, ServeDaemon
+from jaxmc.serve.protocol import (ServeClient, build_config,
+                                  job_signature)
+from jaxmc.session import load_model
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SPECS = os.path.join(REPO, "specs")
+
+
+def spec(name):
+    return os.path.join(SPECS, f"{name}.tla")
+
+
+_EXPECT = {}
+
+
+def expect(name, max_states=None):
+    """Reference counts from the serial engine (cached per suite)."""
+    key = (name, max_states)
+    if key not in _EXPECT:
+        _EXPECT[key] = Explorer(load_model(spec(name), None, False),
+                                max_states=max_states).run()
+    return _EXPECT[key]
+
+
+@pytest.fixture(autouse=True)
+def _clean_drain():
+    drain.clear()
+    yield
+    drain.clear()
+
+
+@pytest.fixture()
+def spool(tmp_path):
+    return str(tmp_path / "spool")
+
+
+@pytest.fixture()
+def daemon(spool):
+    d = ServeDaemon(spool, workers=1, quiet=True).start()
+    yield d
+    d.shutdown()
+
+
+def client(d):
+    return ServeClient("127.0.0.1", d.port)
+
+
+JAX_OPTS = {"backend": "jax", "platform": "cpu", "resident": True,
+            "no_trace": True}
+
+
+def start_subprocess_daemon(spool, trace=None, extra_env=None):
+    """A REAL daemon process (the restart/SIGTERM scenarios need
+    process death, not object teardown).  Returns (Popen, client)."""
+    args = [sys.executable, "-m", "jaxmc.serve", "run",
+            "--spool", spool, "--workers", "1", "--quiet"]
+    if trace:
+        args += ["--trace", trace]
+    env = dict(os.environ, JAX_PLATFORMS="cpu", **(extra_env or {}))
+    p = subprocess.Popen(args, cwd=REPO, stdout=subprocess.DEVNULL,
+                         stderr=subprocess.PIPE, text=True, env=env)
+    stamp = os.path.join(spool, "serve.json")
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        try:
+            with open(stamp) as fh:
+                info = json.load(fh)
+            if info.get("status") == "serving" and \
+                    info.get("pid") == p.pid:
+                return p, ServeClient(info["host"], info["port"])
+        except (OSError, ValueError):
+            pass
+        assert p.poll() is None, p.stderr.read()
+        time.sleep(0.1)
+    raise AssertionError("daemon did not stamp the spool in time")
+
+
+class TestRoundTrip:
+    def test_submit_poll_result_over_socket(self, daemon):
+        c = client(daemon)
+        code, job = c.submit(spec("viewtoy"))
+        assert code == 200 and job["status"] == "queued" and job["sig"]
+        done = c.wait(job["id"], timeout=60)
+        assert done["status"] == "done" and done["ok"]
+        code, res = c.result(job["id"])
+        assert code == 200
+        exp = expect("viewtoy")
+        assert res["result"]["distinct"] == exp.distinct
+        assert res["result"]["generated"] == exp.generated
+        assert str(res["schema"]).startswith("jaxmc.metrics")
+        assert res["serve"]["sig"] == job["sig"]
+        code, st = c.status()
+        assert code == 200 and st["queue_depth"] == 0
+        assert st["counters"].get("serve.jobs_done") == 1
+
+    def test_violation_job_carries_trace(self, daemon):
+        c = client(daemon)
+        _, job = c.submit(spec("symtoy"))
+        done = c.wait(job["id"], timeout=60)
+        assert done["status"] == "done" and done["ok"] is False
+        _, res = c.result(job["id"])
+        assert res["result"]["ok"] is False
+        assert res["result"]["violation"]["kind"] == "deadlock"
+        assert "Error: Deadlock reached." in res["result"]["trace"]
+        assert "The behavior up to this point is:" in \
+            res["result"]["trace"]
+
+    def test_bad_jobs_rejected(self, daemon):
+        c = client(daemon)
+        code, body = c.submit(spec("nonexistent_spec"))
+        assert code == 400 and "not found" in body["error"]
+        code, body = c.submit(spec("viewtoy"),
+                              options={"checkpoint": "/tmp/x"})
+        assert code == 400 and "forbidden" in body["error"]
+        code, body = c.job("j99999999")
+        assert code == 404
+
+
+class TestDurableQueue:
+    def test_restart_answers_nonempty_on_disk_queue(self, spool):
+        # jobs land in the spool with NO daemon alive; the next daemon
+        # start finds and answers them — the restart-survival contract
+        q = JobQueue(spool)
+        ids = []
+        for name in ("viewtoy", "constoy"):
+            cfg = build_config(spec(name), None, {})
+            ids.append(q.new_job(spec(name), None, {},
+                                 job_signature(cfg))["id"])
+        d = ServeDaemon(spool, workers=1, quiet=True).start()
+        try:
+            c = client(d)
+            for jid, name in zip(ids, ("viewtoy", "constoy")):
+                rec = c.wait(jid, timeout=60)
+                assert rec["status"] == "done", rec
+                assert rec["distinct"] == expect(name).distinct
+        finally:
+            d.shutdown()
+
+    def test_identical_queued_jobs_batch_through_one_run(self, spool):
+        q = JobQueue(spool)
+        cfg = build_config(spec("constoy"), None, {})
+        sig = job_signature(cfg)
+        ids = [q.new_job(spec("constoy"), None, {}, sig)["id"]
+               for _ in range(3)]
+        d = ServeDaemon(spool, workers=1, quiet=True).start()
+        try:
+            c = client(d)
+            recs = [c.wait(jid, timeout=60) for jid in ids]
+            assert all(r["status"] == "done" for r in recs)
+            followers = [r for r in recs if r.get("batch_leader")]
+            assert len(followers) == 2, \
+                "identical queued jobs must coalesce into one dispatch"
+            assert d.tel.counters.get("serve.batched_jobs") == 2
+            exp = expect("constoy")
+            for jid in ids:
+                res = q.load_result(jid)
+                assert res["result"]["distinct"] == exp.distinct
+        finally:
+            d.shutdown()
+
+
+class TestWarmReuse:
+    def test_warm_second_submission_interp(self, daemon):
+        c = client(daemon)
+        _, j1 = c.submit(spec("constoy"))
+        r1 = c.wait(j1["id"], timeout=60)
+        _, j2 = c.submit(spec("constoy"))
+        r2 = c.wait(j2["id"], timeout=60)
+        assert j1["sig"] == j2["sig"]
+        assert r1["warm_engine"] is False
+        assert r2["warm_engine"] is True
+        assert r2["resumed_from_checkpoint"] is True
+        assert (r2["distinct"], r2["generated"]) == \
+            (r1["distinct"], r1["generated"])
+        assert daemon.tel.counters.get("serve.warm_hits") == 1
+
+    def test_warm_jax_resident_zero_recompiles(self, daemon,
+                                               monkeypatch, tmp_path):
+        # the acceptance criterion verbatim: a second identical spec+cfg
+        # job to a warm daemon resumes the first job's checkpoint with
+        # window_recompiles == 0 and nonzero capacity-profile hits
+        monkeypatch.setenv("JAXMC_PROFILE_STORE",
+                           str(tmp_path / "profiles"))
+        c = client(daemon)
+        _, j1 = c.submit(spec("constoy"), options=JAX_OPTS)
+        r1 = c.wait(j1["id"], timeout=180)
+        assert r1["status"] == "done", r1
+        _, j2 = c.submit(spec("constoy"), options=JAX_OPTS)
+        r2 = c.wait(j2["id"], timeout=120)
+        assert r2["status"] == "done", r2
+        _, res2 = c.result(j2["id"])
+        sv = res2["serve"]
+        assert sv["warm_engine"] is True
+        assert sv["resumed_from_checkpoint"] is True
+        assert sv["window_recompiles"] == 0
+        assert sv["profile_hits"] >= 1
+        assert (r2["distinct"], r2["generated"]) == \
+            (r1["distinct"], r1["generated"])
+        exp = expect("constoy")
+        assert r2["distinct"] == exp.distinct
+        # the warm artifact is a normal metrics summary: the session's
+        # search span lands in THIS job's recorder, not the cold job's
+        assert "search" in {p["name"] for p in res2["phases"]}
+
+    def test_warm_second_submission_jax_level_mode(self, daemon):
+        # the DEFAULT device mode (level, traces on) also finalizes a
+        # checkpoint on completion: a repeat submission must warm-resume
+        # it, not silently re-search
+        opts = {"backend": "jax", "platform": "cpu"}
+        c = client(daemon)
+        _, j1 = c.submit(spec("constoy"), options=opts)
+        r1 = c.wait(j1["id"], timeout=180)
+        assert r1["status"] == "done", r1
+        _, j2 = c.submit(spec("constoy"), options=opts)
+        r2 = c.wait(j2["id"], timeout=120)
+        assert r2["status"] == "done", r2
+        assert r2["warm_engine"] is True
+        assert r2["resumed_from_checkpoint"] is True
+        assert (r2["distinct"], r2["generated"]) == \
+            (r1["distinct"], r1["generated"])
+
+    def test_restart_resumes_with_persistent_cache_hits(
+            self, spool, tmp_path):
+        # across daemon LIVES (real processes — an in-process pair
+        # would be short-circuited by jax's in-memory caches) the
+        # durable artifacts carry the warmth: the signature-keyed final
+        # checkpoint (resume), the capacity profile (caps), and the
+        # persistent compile cache (the one fresh XLA program becomes a
+        # disk hit)
+        extra_env = {
+            "JAXMC_PROFILE_STORE": str(tmp_path / "profiles"),
+            "JAXMC_COMPILE_CACHE": str(tmp_path / "xla_cache"),
+            "JAXMC_CACHE_PROBE": "0",
+        }
+        q = JobQueue(spool)
+        p1, c1 = start_subprocess_daemon(spool, extra_env=extra_env)
+        try:
+            _, j1 = c1.submit(spec("constoy"), options=JAX_OPTS)
+            r1 = c1.wait(j1["id"], timeout=180)
+            assert r1["status"] == "done", r1
+            c1.drain()
+            assert p1.wait(timeout=60) == 0
+        finally:
+            if p1.poll() is None:
+                p1.kill()
+        p2, c2 = start_subprocess_daemon(spool, extra_env=extra_env)
+        try:
+            _, j2 = c2.submit(spec("constoy"), options=JAX_OPTS)
+            r2 = c2.wait(j2["id"], timeout=180)
+            assert r2["status"] == "done", r2
+            res2 = q.load_result(j2["id"])
+            sv = res2["serve"]
+            assert sv["warm_engine"] is False  # new process, new engine
+            assert sv["resumed_from_checkpoint"] is True
+            assert sv["profile_hits"] >= 1
+            assert sv["persistent_cache_hits"] >= 1
+            assert (r2["distinct"], r2["generated"]) == \
+                (r1["distinct"], r1["generated"])
+            c2.drain()
+            assert p2.wait(timeout=60) == 0
+        finally:
+            if p2.poll() is None:
+                p2.kill()
+
+
+class TestSigtermDrain:
+    def test_sigterm_drains_inflight_and_restart_loses_nothing(
+            self, spool, tmp_path):
+        trace = str(tmp_path / "fleet.jsonl")
+        limit = 30000
+        p, c = start_subprocess_daemon(spool, trace=trace)
+        try:
+            _, slow = c.submit(spec("transfer_scaled"),
+                               options={"max_states": limit})
+            _, queued = c.submit(spec("viewtoy"))
+            # wait until the slow job is actually IN FLIGHT
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                _, st = c.status()
+                if slow["id"] in st.get("running", {}):
+                    break
+                time.sleep(0.1)
+            else:
+                raise AssertionError("slow job never started")
+            time.sleep(1.0)  # well inside the multi-second search
+            p.send_signal(signal.SIGTERM)
+            rc = p.wait(timeout=120)
+        finally:
+            if p.poll() is None:
+                p.kill()
+        assert rc == 0, p.stderr.read()
+
+        q = JobQueue(spool)
+        slow_rec = q.load(slow["id"])
+        assert slow_rec["status"] == "drained", slow_rec
+        assert os.path.exists(q.ckpt_path(slow["sig"])), \
+            "drained job must leave a checkpoint"
+        assert q.load(queued["id"])["status"] == "queued", \
+            "queued job must survive the drain untouched"
+        # no open spans in the fleet trace = nothing leaked at drain
+        events = [json.loads(ln) for ln in open(trace)]
+        opens = sum(1 for e in events if e["ev"] == "span_open")
+        closes = sum(1 for e in events if e["ev"] == "span")
+        assert opens == closes, "drain left open spans"
+        assert any(e["ev"] == "run_end" for e in events)
+
+        # ---- next daemon life: both jobs answered, from checkpoints --
+        p2, c2 = start_subprocess_daemon(spool)
+        try:
+            done_slow = c2.wait(slow["id"], timeout=120)
+            assert done_slow["status"] == "done", done_slow
+            assert done_slow["resumed_from_checkpoint"] is True
+            exp = expect("transfer_scaled", max_states=limit)
+            assert (done_slow["distinct"], done_slow["generated"]) == \
+                (exp.distinct, exp.generated), \
+                "drain+resume must be bit-identical to an uninterrupted run"
+            done_q = c2.wait(queued["id"], timeout=60)
+            assert done_q["status"] == "done"
+            assert done_q["distinct"] == expect("viewtoy").distinct
+            c2.drain()
+            rc2 = p2.wait(timeout=60)
+            assert rc2 == 0
+        finally:
+            if p2.poll() is None:
+                p2.kill()
