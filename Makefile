@@ -163,6 +163,38 @@ bench-check:
 	# to a live daemon must be a checkpoint-resume with ZERO in-window
 	# recompiles — see serve-check below
 	$(MAKE) serve-check
+	# multi-chip parity leg (ISSUE 8): D=2 and D=4 virtual-device mesh
+	# runs must match the manifest pins bit-for-bit — see
+	# multichip-check below
+	$(MAKE) multichip-check
+
+# multi-chip parity gate (ISSUE 8): the mesh-resident engine
+# (owner-routed a2a dedup, seen shards + frontier + trace ring on
+# device, scalars-only host reads) at D=2 and D=4 VIRTUAL cpu devices
+# on the repo-local bench rungs (+ MCraft_micro when the reference
+# corpus is mounted — a parseable SKIP line otherwise).  Counts must
+# equal the corpus manifest pins, host_syncs must equal the level
+# count, and each leg's metrics artifact gates via
+# `python -m jaxmc.obs diff --fail-on-regress` against a saved
+# baseline (first run snapshots it; baselines live in
+# $(BENCH_CHECK_DIR)/jaxmc_multichip_*.baseline.json).
+MULTICHIP_DEVICES ?= 2,4
+multichip-check:
+	$(PY) -m jaxmc.meshbench check --devices $(MULTICHIP_DEVICES) \
+	    --out-dir $(BENCH_CHECK_DIR)
+
+# the published scaling curve (ISSUE 8): per-rung, per-D warm-up +
+# timed fully-warm mesh runs over D in {1,2,4,8} virtual devices
+# (real chips when JAXMC_MESHBENCH_PLATFORM names an accelerator) —
+# states/sec/chip, per-level exchange bytes, shard balance,
+# host_syncs == levels, window_recompiles == 0 — written to
+# MULTICHIP_r06.json and gated per leg like multichip-check.
+MULTICHIP_BENCH_DEVICES ?= 1,2,4,8
+MULTICHIP_OUT ?= MULTICHIP_r06.json
+multichip-bench:
+	$(PY) -m jaxmc.meshbench bench \
+	    --devices $(MULTICHIP_BENCH_DEVICES) \
+	    --out $(MULTICHIP_OUT) --out-dir $(BENCH_CHECK_DIR)
 
 # checking-as-a-service smoke gate (ISSUE 7): fresh spool, in-process
 # daemon, two identical jax-resident jobs — the second MUST reuse the
@@ -193,4 +225,4 @@ native:
 
 .PHONY: all check check-corpus test chaos bench bench-warm bench-tlc \
         pin-si-env bench-check bench-check-reset serve serve-check \
-        native
+        multichip-check multichip-bench native

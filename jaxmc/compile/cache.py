@@ -473,23 +473,38 @@ def profile_dir() -> str:
     return (cache_dir_from_env() or default_cache_dir()) + ".profiles"
 
 
-def profile_path(module: str, layout_sig: str) -> str:
+def profile_path(module: str, layout_sig: str, variant: str = "") -> str:
     safe = "".join(ch if ch.isalnum() or ch in "._-" else "_"
                    for ch in module)[:80]
-    return os.path.join(profile_dir(), f"{safe}.{layout_sig[:16]}.json")
+    vtag = ""
+    if variant:
+        vsafe = "".join(ch if ch.isalnum() or ch in "._-" else "_"
+                        for ch in variant)[:40]
+        vtag = f".{vsafe}"
+    return os.path.join(profile_dir(),
+                        f"{safe}.{layout_sig[:16]}{vtag}.json")
 
 
-def load_capacity_profile(module: str, layout_sig: str, tel=None
+def load_capacity_profile(module: str, layout_sig: str, tel=None,
+                          variant: str = "",
+                          keys: Tuple[str, ...] = _PROFILE_CAP_KEYS
                           ) -> Optional[dict]:
     """The validated caps dict, or None with a NAMED degrade reason in
     the `profile.status` gauge (absent / unreadable / foreign schema /
-    module mismatch / stale layout / bad caps).  Never raises."""
+    module mismatch / stale layout / bad caps).  Never raises.
+
+    `variant` keys engine families apart: the resident single-chip
+    engine stores the default variant, the mesh engine stores one
+    profile per (device count, exchange strategy) — `mesh-d4-a2a` —
+    because its capacity shape (per-SHARD seen/frontier, trace-ring
+    levels, the a2a bucket factor) depends on D (ISSUE 8).  `keys`
+    names the cap fields that variant persists."""
     from .. import obs
     tel = tel if tel is not None else obs.current()
     if not profiles_enabled():
         tel.gauge("profile.status", "disabled:JAXMC_CAP_PROFILE")
         return None
-    path = profile_path(module, layout_sig)
+    path = profile_path(module, layout_sig, variant)
 
     def _no(reason: str) -> None:
         tel.gauge("profile.status", f"degraded:{reason}")
@@ -515,19 +530,24 @@ def load_capacity_profile(module: str, layout_sig: str, tel=None
         # changed since the profile was learned
         _no("stale layout signature (model, caps or packing changed)")
         return None
+    if p.get("variant", "") != variant:
+        _no(f"variant mismatch ({p.get('variant')!r})")
+        return None
     caps = p.get("caps")
     if not isinstance(caps, dict) or not all(
             isinstance(caps.get(k), int) and 0 < caps[k] < (1 << 31)
-            for k in _PROFILE_CAP_KEYS):
+            for k in keys):
         _no("malformed caps")
         return None
     tel.gauge("profile.status", "loaded")
     tel.counter("profile.hits")
-    return {k: int(caps[k]) for k in _PROFILE_CAP_KEYS}
+    return {k: int(caps[k]) for k in keys}
 
 
 def save_capacity_profile(module: str, layout_sig: str,
-                          caps: dict, tel=None, **extra) -> Optional[str]:
+                          caps: dict, tel=None, variant: str = "",
+                          keys: Tuple[str, ...] = _PROFILE_CAP_KEYS,
+                          **extra) -> Optional[str]:
     """Persist the caps a completed resident run ended with (atomic
     write; max-merged over any existing valid profile so alternating
     workloads never thrash each other downward).  Never raises."""
@@ -537,21 +557,23 @@ def save_capacity_profile(module: str, layout_sig: str,
         return None
     try:
         prev = load_capacity_profile(module, layout_sig,
-                                     tel=obs.NullTelemetry())
-        merged = {k: int(caps[k]) for k in _PROFILE_CAP_KEYS
+                                     tel=obs.NullTelemetry(),
+                                     variant=variant, keys=keys)
+        merged = {k: int(caps[k]) for k in keys
                   if isinstance(caps.get(k), int)}
-        if len(merged) != len(_PROFILE_CAP_KEYS):
+        if len(merged) != len(keys):
             return None
         if prev:
-            for k in _PROFILE_CAP_KEYS:
+            for k in keys:
                 merged[k] = max(merged[k], prev[k])
         d = profile_dir()
         os.makedirs(d, exist_ok=True)
-        path = profile_path(module, layout_sig)
+        path = profile_path(module, layout_sig, variant)
         tmp = path + f".tmp.{os.getpid()}"
         with open(tmp, "w", encoding="utf-8") as fh:
             json.dump({"schema": _PROFILE_SCHEMA, "module": module,
-                       "layout_sig": layout_sig, "caps": merged,
+                       "layout_sig": layout_sig, "variant": variant,
+                       "caps": merged,
                        "build": _fingerprint(), "saved_at": time.time(),
                        **extra}, fh)
         os.replace(tmp, path)

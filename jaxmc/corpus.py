@@ -67,6 +67,12 @@ class Case:
     # persisted capacity profile (compile/cache.py) max-merges over
     # this; the manifest value is the committed, review-able record.
     res_caps: Optional[dict] = None
+    # MESH capacity record (ISSUE 8): {SC, FC, TRL, GAM16} per-SHARD
+    # buckets for the mesh-resident engine at the bench device counts
+    # (measured at D=4 in this container; max-merged with the per-
+    # (D, exchange) learned profile, so other D start close and learn
+    # the rest).  jaxmc.meshbench passes it to MeshExplorer(mesh_caps=).
+    mesh_caps: Optional[dict] = None
 
     def spec_path(self) -> str:
         base = REFERENCE if self.root == "ref" else REPO
@@ -173,12 +179,16 @@ CASES: List[Case] = [
          # kernelbench rung (ISSUE 6): steady resident buckets so the
          # warm-up compile covers the whole run
          res_caps={"SC": 1 << 18, "FCap": 1 << 16, "AccCap": 1 << 17,
-                   "VC": 1 << 13, "chunk": 2048}),
+                   "VC": 1 << 13, "chunk": 2048},
+         mesh_caps={"SC": 1 << 17, "FC": 1 << 13, "TRL": 32,
+                    "GAM16": 32}),
     Case("specs/MCraftMicro.tla", root="repo",
          cfg="specs/MCraft_micro.cfg", includes=("examples",),
          distinct=694, generated=6185, jax="yes", mode="compiled",
          res_caps={"SC": 1 << 12, "FCap": 1 << 9, "AccCap": 1 << 12,
-                   "VC": 1 << 11, "chunk": 256}),
+                   "VC": 1 << 11, "chunk": 256},
+         mesh_caps={"SC": 1 << 12, "FC": 1 << 9, "TRL": 32,
+                    "GAM16": 32}),
     # mode=compiled proven by the BENCH_r02 resident-mode completion
     # (resident refuses hybrid/interp-arms outright)
     Case("specs/MCraftMicro.tla", root="repo",
@@ -188,7 +198,10 @@ CASES: List[Case] = [
          # the bench.py full rung's steady caps (one warm-up compile
          # covers the run; the persisted profile max-merges over this)
          res_caps={"SC": 1 << 18, "FCap": 1 << 16, "AccCap": 1 << 17,
-                   "VC": 1 << 13}),
+                   "VC": 1 << 13},
+         # meshbench rung (ISSUE 8): per-shard mesh-resident buckets
+         mesh_caps={"SC": 1 << 17, "FC": 1 << 14, "TRL": 64,
+                    "GAM16": 32}),
     Case("specs/MCtextbookSI.tla", root="repo",
          cfg="specs/MCtextbookSI_small.cfg", includes=("examples",),
          distinct=569, generated=945, jax="yes", mode="interp-arms"),
@@ -229,12 +242,18 @@ CASES: List[Case] = [
          cfg="specs/viewtoy_scaled.cfg",
          distinct=18432, generated=239617, jax="yes", mode="compiled",
          res_caps={"SC": 1 << 15, "FCap": 1 << 12, "AccCap": 1 << 15,
-                   "VC": 1 << 13, "chunk": 1024}),
+                   "VC": 1 << 13, "chunk": 1024},
+         # measured mesh-resident shard caps at D=4 in this container
+         # (SC grew 256 -> 65536 over 9 redo recompiles without it)
+         mesh_caps={"SC": 1 << 16, "FC": 1 << 11, "TRL": 32,
+                    "GAM16": 32}),
     Case("specs/symtoy_scaled.tla", root="repo",
          cfg="specs/symtoy_scaled.cfg", no_deadlock=True,
          distinct=10725, generated=65365, jax="yes", mode="compiled",
          res_caps={"SC": 1 << 15, "FCap": 1 << 12, "AccCap": 1 << 14,
-                   "VC": 1 << 13, "chunk": 1024}),
+                   "VC": 1 << 13, "chunk": 1024},
+         mesh_caps={"SC": 1 << 15, "FC": 1 << 11, "TRL": 32,
+                    "GAM16": 32}),
     # device SYMMETRY toys (orbit-canonical counts; deadlock expected
     # when every process exhausts its turns)
     Case("specs/symtoy.tla", root="repo", cfg="specs/symtoy.cfg",

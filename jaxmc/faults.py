@@ -59,6 +59,19 @@ tests/test_cache_guard.py):
                       (another process mid-quarantine): cold fallback
                       for this process only
 
+Mesh sites (ISSUE 8, tpu/mesh.py — evaluated at ENGINE BUILD time, not
+per dispatch, because the routing is compiled into the jitted step):
+
+    mesh_skew         the owner-routing hash collapses to shard 0 on
+                      BOTH the host init-shard path and the device
+                      all_to_all routing (one formula, so they cannot
+                      disagree): every state lands on one seen shard,
+                      forcing worst-case imbalance, the a2a spill pass
+                      and — once the spill overflows — the
+                      gamma-growth level rerun.  Counts and traces
+                      must stay exact throughout
+                      (tests/test_mesh_resident.py).
+
 Cross-process accounting: the first registry to activate creates a
 state directory and exports it as JAXMC_FAULTS_STATE, so forked pool
 workers AND subprocess children share one `n=` budget (the latch is an

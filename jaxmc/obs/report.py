@@ -212,13 +212,17 @@ def cmd_report(args, out=sys.stdout) -> int:
     c, g = s.get("counters", {}), s.get("gauges", {})
     for k in ("compile.kernels_built", "compile.cache_hits",
               "compile.cache_misses", "compile.jaxpr_eqns_total",
-              "compile.hlo_flops_total", "watchdog.stalls"):
+              "compile.hlo_flops_total", "watchdog.stalls",
+              "mesh.host_syncs", "mesh.row_syncs",
+              "mesh.exchange_bytes"):
         if k in c:
             hl.append(f"{k}={c[k]}")
     for k in ("expand.mode", "dedup.mode", "layout.width_lanes",
               "layout.packed_width_lanes", "layout.bits_per_state",
               "device.donation", "profile.status",
-              "fingerprint.occupancy",
+              "fingerprint.occupancy", "mesh.exchange", "mesh.devices",
+              "mesh.a2a_gamma", "mesh.a2a_spill", "mesh.a2a_max_bucket",
+              "mesh.shard_balance",
               "device.mem_high_water_bytes", "watchdog.max_stall_s"):
         if k in g:
             hl.append(f"{k}={g[k]}")

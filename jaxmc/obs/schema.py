@@ -173,6 +173,40 @@ jaxmc.metrics/2 artifact minus the new optional surface, so readers and
       number of fused expansion jits when a many-instance model splits
       per arm-group (JAXMC_FUSED_MAX_INSTANCES instances per group)
       instead of per action.
+
+  (PR 8, still jaxmc.metrics/2 — all additive/optional; the mesh-
+   resident multi-chip surface, tpu/mesh.py + jaxmc/meshbench.py:)
+    - exchange strategy: gauges `mesh.exchange` ("a2a" | "gather"),
+      `mesh.devices`; the strategy + gamma are also logged once per
+      run.
+    - resident-loop host traffic: counter `mesh.host_syncs` — one per
+      level, counting the SINGLE replicated scalar-vector read the
+      resident loop performs (on a clean run it EQUALS the level-record
+      count: no row traffic crosses to the host between levels);
+      counter `mesh.row_syncs` — whole-ring row pulls (violation trace
+      assembly, checkpoints) — the only other device->host transfers.
+    - exchange volume: counter `mesh.exchange_bytes` — whole-mesh bytes
+      moved by the level exchanges (a2a: D^2*(B+SB)*(K+PW+1)*4 per
+      level incl. the spill pass; gather: D^2*C*(K+PW)*4), computed
+      from the static shapes.
+    - a2a routing: gauges `mesh.a2a_gamma` (final bucket capacity
+      factor; grows to the observed per-peer need on overflow),
+      `mesh.a2a_spill` (total rows drained through the second
+      all_to_all spill pass instead of rerunning the level),
+      `mesh.a2a_max_bucket` (peak per-destination bucket occupancy).
+    - shard health: gauge `mesh.shard_balance` — max/mean seen-shard
+      occupancy (1.0 = perfectly balanced hash partition).
+    - mesh level records add `devices`, `fc` (frontier capacity),
+      `spill`, `max_bucket`, and the existing `fresh_compile` flag
+      (so `window_recompiles` computes for mesh runs exactly like
+      serve jobs).
+    - multichip artifacts: MULTICHIP_r*.json (schema
+      jaxmc.multichip/1, jaxmc/meshbench.py) — per-rung scaling curves
+      [{devices, exchange, states_per_sec, states_per_sec_per_chip,
+      window_recompiles, host_syncs, levels, exchange_bytes_per_level,
+      shard_balance, a2a_*}]; per-leg jaxmc.metrics/2 artifacts carry
+      the same numbers in a top-level `multichip` block and gate via
+      `obs diff --fail-on-regress`.
 """
 
 from __future__ import annotations

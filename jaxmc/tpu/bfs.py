@@ -724,6 +724,54 @@ class TpuExplorer:
 
         return expand
 
+    def _candidate_block_fn(self, FC: int):
+        """Shared mesh-step prologue (ISSUE 8): expand one frontier
+        block of capacity FC and produce the flat candidate block with
+        its dedup keys, packed rows and fault scalars.  Both mesh step
+        builders (the legacy exchange step and the device-resident
+        level step, tpu/mesh.py) start from exactly this closure so the
+        candidate semantics — validity masking, pack-guard overflow
+        folding (OV_PACK under kernel codes), assert/deadlock
+        provenance — cannot drift between them.
+
+        Returns a closure (frontier_lanes, fvalid) -> dict with keys:
+          gen_local, overflow (max OV_* code, 0 = none),
+          ckeys [C,K], cand [C,PW] packed, cand_u [C,W], cvalid [C],
+          dead [FC] bool, dead_slot, assert_bad (scalar), asrt_a, asrt_f
+        where C = A * FC."""
+        A, W = self.A, self.W
+        C = A * FC
+        keys_of = self._keys_of
+        expand = self._expand_fn()
+
+        def block(frontier, fvalid):
+            en, aok, ov, succ = expand(frontier)
+            valid = en & fvalid[None, :]
+            abad = (~aok) & fvalid[None, :]
+            assert_bad = jnp.any(abad)
+            aflat = jnp.argmax(abad.reshape(-1))
+            asrt_a = (aflat // FC).astype(jnp.int32)
+            asrt_f = (aflat % FC).astype(jnp.int32)
+            overflow = jnp.max(jnp.where(fvalid[None, :], ov, 0)) \
+                .astype(jnp.int32)
+            dead = fvalid & ~jnp.any(en, axis=0)
+            dead_slot = jnp.argmax(dead).astype(jnp.int32)
+            gen_local = jnp.sum(valid)
+            cand_u = succ.reshape(C, W)
+            cvalid = valid.reshape(C)
+            cand_u = jnp.where(cvalid[:, None], cand_u, SENTINEL)
+            ckeys, cand, pack_ovf = keys_of(cand_u, cvalid)
+            overflow = jnp.where(
+                overflow != 0, overflow,
+                jnp.where(pack_ovf, OV_PACK, 0).astype(jnp.int32))
+            return dict(gen_local=gen_local, overflow=overflow,
+                        ckeys=ckeys, cand=cand, cand_u=cand_u,
+                        cvalid=cvalid, dead=dead, dead_slot=dead_slot,
+                        assert_bad=assert_bad, asrt_a=asrt_a,
+                        asrt_f=asrt_f)
+
+        return block
+
     def _temporal_warnings(self) -> List[str]:
         out = []
         if self.live_unsupported:
@@ -1615,19 +1663,28 @@ class TpuExplorer:
         return jitted
 
 
-    def _save_caps_profile(self, caps: Dict[str, int]) -> None:
+    def _save_caps_profile(self, caps: Dict[str, int],
+                           variant: str = "",
+                           keys: Optional[Tuple[str, ...]] = None
+                           ) -> None:
         """Persist the capacity profile a finished resident search ended
         with (ISSUE 6): the next resident run on this (module, layout)
         starts at these caps, so its warm-up compile covers the whole
         run and `window_recompiles` reads 0.  Best-effort: a profile is
-        a hint, never allowed to fail a successful run."""
+        a hint, never allowed to fail a successful run.  `variant`/
+        `keys` let engine families persist their own cap shapes (the
+        mesh engine stores one profile per device count + exchange
+        strategy, ISSUE 8)."""
         if not self.cap_profile:
             return
         try:
             from ..compile.cache import save_capacity_profile
+            kw = dict(chunk=int(self.chunk))
+            if keys is not None:
+                kw = dict(variant=variant, keys=keys)
             path = save_capacity_profile(
                 self.model.module.name, self._layout_sig(), dict(caps),
-                chunk=int(self.chunk))
+                **kw)
             if path:
                 self.log(f"-- capacity profile saved to {path}")
         except Exception:  # noqa: BLE001 — hints never break runs

@@ -6,38 +6,63 @@ the single-chip path (compile/kernel2.py — wide layouts, slotted dynamic
 \E, capacity buckets), and (b) a hash range of the seen-set, held as
 128-bit fingerprints with an explicit validity lane (never in-band
 sentinels — a valid state's lane can legitimately equal SENTINEL).
-Per level, every device expands its frontier shard, the candidate rows and
-their fingerprint keys are all_gather'd over the ICI axis, and each device
-keeps exactly the rows whose fingerprint lands in its range — the
-structural analogue of ring-partitioned attention state for a model
-checker (SURVEY.md §5 "long-context" row). A hash-routed
-ppermute/all_to_all exchange (traffic ~C*gamma instead of C*D per device)
-is the planned upgrade once profiled on real multi-chip hardware. Dedup within a shard is the same
-validity-lane-first lexicographic key sort as tpu/bfs.py; totals are
-psum'd. CONSTRAINT-discarded states are fingerprinted but never counted,
-checked, or explored (TLC semantics).
 
-Parity features (VERDICT r2 #5):
+Two exchange strategies route each level's candidates to their owner
+shard (chosen per run; `a2a` is the DEFAULT for D > 1,
+JAXMC_MESH_EXCHANGE overrides):
+
+  a2a     hash-routes each candidate straight to its owner via
+          all_to_all with per-peer buckets of B = C*gamma/D (traffic
+          ~C*gamma per device).  Hash skew past gamma lands overflow
+          rows in a small per-peer SPILL bucket drained by a second
+          all_to_all pass (mesh.a2a_spill); only when the spill also
+          overflows is the level rerun with gamma doubled (ISSUE 8).
+  gather  all_gathers every candidate to every device (traffic C*D per
+          device, no routing state); each device keeps the rows whose
+          fingerprint lands in its range — the structural analogue of
+          ring-partitioned attention state (SURVEY.md §5).
+
+MESH-RESIDENT level loop (ISSUE 8 tentpole): the seen shards, the
+packed frontier and the per-level trace ring all stay ON DEVICE across
+levels; one jitted shard_map step per level expands, exchanges,
+merge-dedups, appends the trace ring and emits a single replicated
+scalar vector.  The host reads exactly that vector per level
+(mesh.host_syncs == level count — no row traffic), pre-sizes nothing,
+and only pulls rows on a violation (trace assembly), at a checkpoint, or
+never.  Capacity overflows (seen / frontier / trace ring / a2a bucket)
+roll the level back inside the step, so the host can grow the named
+capacity and redo the level — the same redo discipline as the
+single-chip resident engine (tpu/bfs.py).  Learned capacities persist
+as a profile keyed by (module, layout_sig, D, exchange)
+(compile/cache.py variants), so a second mesh run compiles once and
+reports window_recompiles == 0.
+
+Refinement and temporal PROPERTYs still check on the mesh via the
+LEGACY host loop (the exchanged-candidate stream feeds the same
+host-side stepwise refinement and behavior-graph liveness checkers as
+the single-chip device modes; store_trace required, resume with
+PROPERTYs rejected) — JAXMC_MESH_RESIDENT=0 forces that loop for
+diagnosis.
+
+Parity features (VERDICT r2 #5, preserved by the resident loop):
   * counterexample TRACES with action provenance: each kept new-frontier
-    row carries its global candidate index off the device; the host keeps
-    per-level (rows, provenance) so a violation replays the shortest path
-    exactly like the single-chip level mode (store_trace=True, default);
-  * NAMED violations: the step reports which invariant failed (index into
-    the cfg INVARIANT list) plus the violating row; deadlock/assert
-    report the offending state row the same way;
+    row carries its global candidate index (the src lane of the trace
+    ring); a violation replays the shortest path exactly like the
+    single-chip level mode (store_trace=True, default);
+  * NAMED violations: which invariant failed, plus the violating row;
+    deadlock/assert report the offending state row the same way;
   * checkpoint/resume at level boundaries (--checkpoint/--resume), the
     TLC states/ equivalent, with full-run count exactness.
 
 The driver validates this path with N virtual CPU devices via
 __graft_entry__.dryrun_multichip (no multi-chip hardware needed) on the
-raft workload. Refinement and temporal PROPERTYs check on the mesh too
-(r4): the exchanged-candidate stream feeds the same host-side stepwise
-refinement and behavior-graph liveness checkers as the single-chip
-device modes (store_trace required; resume with PROPERTYs is rejected).
+raft workload; `make multichip-check` / `make multichip-bench`
+(jaxmc/meshbench.py) run the parity and scaling legs.
 """
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -48,6 +73,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from .. import obs
+from .. import faults
 from ..sem.modules import Model
 from ..engine.explore import CheckResult, Violation
 from ..compile.vspec import ModeError
@@ -56,6 +82,42 @@ from .bfs import (SENTINEL, TpuExplorer, _LiveGraph, _pow2_at_least,
                   filter_init_states, fingerprint128)
 
 _BIG = np.int32(2 ** 31 - 1)
+
+# the mesh capacity-profile shape (compile/cache.py variant
+# "mesh-d<D>-<exchange>"): per-shard seen keys, per-shard frontier rows,
+# trace-ring levels, and the a2a bucket factor gamma stored as
+# round(gamma * 16) so the profile stays integer-valued
+_MESH_PROFILE_KEYS = ("SC", "FC", "TRL", "GAM16")
+
+# resident-step scalar vector layout (one replicated [NS] i32 vector is
+# ALL the host reads per level)
+_S_GEN = 0        # psum generated this level
+_S_NEW = 1        # psum kept-new (post-constraint) this level
+_S_FRONT = 2      # psum next-frontier occupancy
+_S_MAXF = 3       # pmax per-shard next-frontier occupancy (true need)
+_S_MAXS = 4       # pmax per-shard seen occupancy (true need)
+_S_SUMS = 5       # psum seen occupancy
+_S_OVC = 6        # pmax kernel overflow code (OV_*; 0 = none)
+_S_DEAD = 7       # any deadlocked row (int)
+_S_ASSERT = 8     # any failed Assert (int)
+_S_INVMIN = 9     # pmin first-violated invariant index (_BIG = none)
+_S_FOVF = 10      # frontier outgrew FC (redo after growth)
+_S_SOVF = 11      # a seen shard outgrew SC (redo after growth)
+_S_TOVF = 12      # trace ring outgrew TRL (redo after growth)
+_S_AOVF = 13      # a2a bucket AND spill overflowed (redo, gamma grows)
+_S_SPILL = 14     # psum rows drained through the spill pass
+_S_MAXDEST = 15   # pmax per-destination bucket occupancy (a2a)
+_NS = 16
+
+# per-device violation-localization vector (fetched only on violation)
+_A_INVW = 0
+_A_INVSLOT = 1
+_A_DEAD = 2
+_A_DEADSLOT = 3
+_A_ASSERT = 4
+_A_ASRTA = 5
+_A_ASRTF = 6
+_NA = 7
 
 
 class MeshExplorer(TpuExplorer):
@@ -70,7 +132,8 @@ class MeshExplorer(TpuExplorer):
                  log: Callable[[str], None] = None,
                  max_states: Optional[int] = None,
                  progress_every: float = 30.0, store_trace: bool = True,
-                 exchange: str = "gather", **kw):
+                 exchange: Optional[str] = None,
+                 mesh_caps: Optional[Dict[str, int]] = None, **kw):
         super().__init__(model, log=log, max_states=max_states,
                          progress_every=progress_every,
                          store_trace=store_trace, **kw)
@@ -82,18 +145,59 @@ class MeshExplorer(TpuExplorer):
         self.fp_mode = True
         self.K = 4 + 1
         # ICI exchange strategy (SURVEY.md §2.3 "communication
-        # scheduling"): "gather" all_gathers every candidate to every
-        # device (traffic C*D per device, no routing state); "a2a"
-        # hash-routes each candidate straight to its owner via
-        # all_to_all with per-peer buckets of B = C*gamma/D (traffic
-        # C*gamma). Bucket overflow (hash skew beyond gamma) reruns the
-        # level with gamma doubled.
+        # scheduling"): a2a is the default whenever the mesh has more
+        # than one device — its traffic is ~C*gamma per device instead
+        # of gather's C*D, and the spill pass makes hash skew cheap.
+        # JAXMC_MESH_EXCHANGE overrides; an explicit constructor arg
+        # outranks both (tests pin each strategy).
+        self._exchange_src = "explicit"
+        if exchange is None:
+            env = os.environ.get("JAXMC_MESH_EXCHANGE", "").strip()
+            if env:
+                exchange, self._exchange_src = env, "JAXMC_MESH_EXCHANGE"
+            else:
+                exchange = "a2a" if self.D > 1 else "gather"
+                self._exchange_src = "default"
         if exchange not in ("gather", "a2a"):
             raise ValueError(f"exchange must be 'gather' or 'a2a', "
                              f"got {exchange!r}")
         self.exchange = exchange
         self._a2a_gamma = 2.0
         self._mesh_step_cache: Dict[Tuple, Callable] = {}
+        # skewed-hash fault site (ISSUE 8 satellite): when armed, EVERY
+        # state hashes to shard 0 — on both the host init-shard path and
+        # the device routing (one owner formula, so they cannot
+        # disagree) — forcing the a2a spill pass (and, once the spill
+        # overflows, the gamma-doubling rerun) on models far too small
+        # to skew naturally.  Counts/traces must stay exact throughout;
+        # tests/test_mesh_resident.py pins it.
+        self._skew = faults.fire("mesh_skew", devices=self.D) is not None
+        # resident-loop accounting (ISSUE 8 obs satellite)
+        self._spill_rows = 0
+        self._max_bucket = 0
+        self._shard_balance: Optional[float] = None
+        self._lvl_FC: List[int] = []   # expanding FC per ring level
+        # learned mesh capacity profile, keyed (module, layout_sig, D,
+        # exchange): a second mesh run starts at the learned caps and
+        # gamma, so its one warm-up compile covers the run
+        # (window_recompiles == 0).  Max-merged with the caller's
+        # manifest hint (corpus.Case.mesh_caps).
+        self._mesh_caps_hint: Dict[str, int] = dict(mesh_caps or {})
+        if self.cap_profile:
+            from ..compile.cache import load_capacity_profile
+            prof = load_capacity_profile(
+                model.module.name, self._layout_sig(),
+                variant=self._profile_variant(), keys=_MESH_PROFILE_KEYS)
+            if prof:
+                for kk, vv in prof.items():
+                    self._mesh_caps_hint[kk] = max(
+                        int(self._mesh_caps_hint.get(kk, 0)), int(vv))
+        if self._mesh_caps_hint.get("GAM16"):
+            self._a2a_gamma = max(
+                self._a2a_gamma, self._mesh_caps_hint["GAM16"] / 16.0)
+
+    def _profile_variant(self) -> str:
+        return f"mesh-d{self.D}-{self.exchange}"
 
     # ---- the sharded level step ----
     def _a2a_bucket(self, C: int, FC: int) -> int:
@@ -104,141 +208,160 @@ class MeshExplorer(TpuExplorer):
         return max(1, math.ceil(C * self._a2a_gamma / self.D),
                    math.ceil(FC / self.D))
 
-    def _get_mesh_step(self, SC: int, FC: int,
-                       out_cap: Optional[int] = None) -> Callable:
-        """out_cap=None: the single-controller step (MeshExplorer.run —
-        the host compacts/resizes the frontier between levels). out_cap
-        set: the MULTI-HOST variant (tpu/multihost.py): the new frontier
-        is cropped on device to a fixed [out_cap] shard so the host never
-        needs non-addressable remote rows, and three extra REPLICATED
-        flags (psum'd over the DCN+ICI axis) are appended to the outputs:
-        any_inv (any device saw an invariant violation), fixed_ovf (a
-        frontier/seen shard outgrew its fixed capacity, incl. a2a bucket
-        overflow), any_dead, any_assert."""
+    def _a2a_spill_bucket(self, B: int) -> int:
+        # the spill bucket is deliberately small: it exists to absorb
+        # ordinary hash skew (a few rows past B on a hot shard), not to
+        # double capacity — B//4 keeps the second all_to_all cheap
+        return max(1, B // 4)
+
+    def _owner_from_keys(self, keys: np.ndarray) -> np.ndarray:
+        """THE ownership formula (keys lane 1 mod D) — one definition
+        for every host path; _owner_jnp is its device-side twin (both
+        routes call it, so host and device can never disagree).  The
+        mesh_skew fault collapses it to shard 0 on BOTH paths."""
+        if self._skew:
+            return np.zeros(len(keys), np.int64)
+        return (keys[:, 1].astype(np.uint32) % np.uint32(self.D)) \
+            .astype(np.int64)
+
+    def _owner_jnp(self, key_lane1):
+        """Device-side twin of _owner_from_keys over the keys' lane-1
+        column (traced int32 [N]) — the ONLY place the exchange
+        closures compute ownership."""
+        if self._skew:
+            return jnp.zeros(key_lane1.shape[0], jnp.int32)
+        return (key_lane1.astype(jnp.uint32)
+                % jnp.uint32(self.D)).astype(jnp.int32)
+
+    def _route_fn(self, C: int, FC: int) -> Tuple[Callable, int, int, int]:
+        """Build the exchange closure shared by the legacy and resident
+        steps: route(ckeys, cand, cvalid, me) ->
+        (gkeys [R,K], gcand [R,PW], gsrc [R], spill_local,
+        a2a_ovf_local, maxdest_local, evalid [R]).
+        `evalid` is the EDGE-STREAM validity — every valid exchanged
+        row BEFORE ownership masking (gather replicates the full
+        candidate set, so the host's device-0 read must not lose
+        foreign-owned rows; a2a buckets are disjoint per device and the
+        host concatenates all of them, so per-device validity is
+        already complete).  Returns (route, R, B, SB); B/SB are 0 in
+        gather mode."""
+        D, K, PW = self.D, self.K, self.PW
         a2a = self.exchange == "a2a"
-        B = self._a2a_bucket(self.A * FC, FC) if a2a else 0
-        key = (SC, FC, B, out_cap)
-        if key in self._mesh_step_cache:
-            return self._mesh_step_cache[key]
-        A, W, K, D = self.A, self.W, self.K, self.D
-        PW = self.PW
-        plan = self.plan
-        inv_fns = self.inv_fns
-        con_fns = self.constraint_fns
-        keys_of = self._keys_of
-        expand = self._expand_fn()
-        # refinement/temporal PROPERTYs: stream every exchanged
-        # candidate (revisits included) to the host, which runs the SAME
-        # stepwise refinement and behavior-graph checkers as the
-        # single-chip device modes (r4; closes VERDICT r3 #9)
-        need_edges = (out_cap is None and
-                      (bool(self.refiners) or self.collect_edges))
-        C = A * FC
-        # R: rows each device holds after the exchange. gather: every
-        # candidate from every device (D*C); a2a: my bucket from each
-        # peer (D*B)
-        G = D * C
-        R = D * B if a2a else G
         Pw = K + PW + 1  # a2a payload: [keys | packed row | src-index]
+        invalid_key_np = np.concatenate(
+            [np.ones(1, np.int32), np.full(K - 1, SENTINEL, np.int32)])
+        if not a2a:
+            R = D * C
 
-        def device_step(seen_keys, frontier_p, fcount):
-            # per-device blocks: seen_keys [SC,K], frontier [FC,PW], [1]
-            seen_keys = seen_keys.reshape(SC, K)
-            frontier = plan.unpack_rows(frontier_p.reshape(FC, PW))
-            me = lax.axis_index("d")
-            fvalid = jnp.arange(FC) < fcount[0]
-            en, aok, ov, succ = expand(frontier)
-            valid = en & fvalid[None, :]
-            abad = (~aok) & fvalid[None, :]
-            assert_bad = jnp.any(abad)
-            # first (action, slot) whose enabled evaluation hit a failed
-            # Assert — provenance for the assert trace
-            aflat = jnp.argmax(abad.reshape(-1))
-            asrt_a = (aflat // FC).astype(jnp.int32)
-            asrt_f = (aflat % FC).astype(jnp.int32)
-            # ov is the int overflow code (kernel2.OV_*); any nonzero
-            # valid-row code aborts the mesh run. The MAX code is kept
-            # (not just a flag) so the host can tell OV_DEMOTED — a
-            # compile-recovery demotion, where raising caps cannot help —
-            # from a real lane-capacity overflow
-            overflow = jnp.max(jnp.where(fvalid[None, :], ov, 0)) \
-                .astype(jnp.int32)
-            dead = fvalid & ~jnp.any(en, axis=0)
-            dead_local = jnp.any(dead)
-            dead_slot = jnp.argmax(dead).astype(jnp.int32)
-            gen_local = jnp.sum(valid)
-
-            cand_u = succ.reshape(C, W)
-            cvalid = valid.reshape(C)
-            cand_u = jnp.where(cvalid[:, None], cand_u, SENTINEL)
-            ckeys, cand, pack_ovf = keys_of(cand_u, cvalid)  # [C, K/PW]
-            # pack-guard overflow joins the overflow channel (OV_PACK);
-            # kernel codes (OV_DEMOTED) keep priority
-            overflow = jnp.where(
-                overflow != 0, overflow,
-                jnp.where(pack_ovf, OV_PACK, 0).astype(jnp.int32))
-
-            invalid_key = jnp.concatenate(
-                [jnp.ones(1, jnp.int32),
-                 jnp.full(K - 1, SENTINEL, jnp.int32)])
-            a2a_ovf = jnp.asarray(False)
-            if a2a:
-                # hash-route each candidate straight to its owner:
-                # bucket-sort by destination, scatter into [D, B] slots,
-                # one all_to_all. Traffic per device: D*B = C*gamma rows
-                # instead of gather's C*D.
-                dest = jnp.where(
-                    cvalid,
-                    (ckeys[:, 1].astype(jnp.uint32)
-                     % jnp.uint32(D)).astype(jnp.int32),
-                    D)
-                sperm = lax.sort(
-                    (dest, jnp.arange(C, dtype=jnp.int32)),
-                    num_keys=1, is_stable=True)[1]
-                sdest = jnp.take(dest, sperm)
-                counts = jnp.zeros((D + 1,), jnp.int32).at[dest].add(1)
-                excl = jnp.concatenate(
-                    [jnp.zeros(1, jnp.int32),
-                     jnp.cumsum(counts)[:-1]])
-                pos = jnp.arange(C, dtype=jnp.int32) -                     jnp.take(excl, sdest)
-                a2a_ovf = jnp.any(counts[:D] > B)
-                slot = jnp.where((sdest < D) & (pos < B),
-                                 sdest * B + pos, D * B)
-                srcid = me.astype(jnp.int32) * C + sperm
-                payload = jnp.concatenate(
-                    [jnp.take(ckeys, sperm, axis=0),
-                     jnp.take(cand, sperm, axis=0),
-                     srcid[:, None]], axis=1)          # [C, Pw]
-                buckets = jnp.full((D * B + 1, Pw), SENTINEL, jnp.int32)
-                buckets = buckets.at[:, 0].set(1)  # invalid slots
-                buckets = buckets.at[slot].set(payload, mode="drop")
-                recv = lax.all_to_all(
-                    buckets[:D * B].reshape(D, B, Pw), "d",
-                    split_axis=0, concat_axis=0).reshape(R, Pw)
-                gkeys = recv[:, :K]
-                gcand = recv[:, K:K + PW]
-                gsrc = recv[:, K + PW]
-                gvalid = gkeys[:, 0] == 0
-                # routed rows are mine by construction; invalid slots
-                # keep the sorts-last key shape
-                gkeys = jnp.where(gvalid[:, None], gkeys, invalid_key)
-            else:
+            def route_gather(ckeys, cand, cvalid, me):
+                invalid_key = jnp.asarray(invalid_key_np)
                 # ICI exchange: gather all candidates + keys, keep my
                 # range
-                gcand = lax.all_gather(cand, "d", tiled=True)  # [G, PW]
-                gkeys = lax.all_gather(ckeys, "d", tiled=True)  # [G, K]
+                gcand = lax.all_gather(cand, "d", tiled=True)   # [R, PW]
+                gkeys = lax.all_gather(ckeys, "d", tiled=True)  # [R, K]
                 gsrc = jnp.arange(R, dtype=jnp.int32)
                 gvalid = gkeys[:, 0] == 0     # explicit validity lane
-                owner = (gkeys[:, 1].astype(jnp.uint32)
-                         % jnp.uint32(D)).astype(jnp.int32)
+                owner = self._owner_jnp(gkeys[:, 1])
                 mine = gvalid & (owner == me)
                 # foreign/invalid rows: validity lane 1 (sorts last),
                 # data lanes sentinel so equal keys cannot straddle the
                 # mask
                 gkeys = jnp.where(mine[:, None], gkeys, invalid_key)
+                zero = jnp.zeros((), jnp.int32)
+                return (gkeys, gcand, gsrc, zero, jnp.asarray(False),
+                        zero, gvalid)
 
-            # merge-dedup against my seen shard (key sort; seen first at
-            # equal keys via the flag tiebreaker)
+            return route_gather, R, 0, 0
+
+        B = self._a2a_bucket(C, FC)
+        SB = self._a2a_spill_bucket(B)
+        R = D * (B + SB)
+
+        def route_a2a(ckeys, cand, cvalid, me):
+            invalid_key = jnp.asarray(invalid_key_np)
+            # hash-route each candidate straight to its owner:
+            # bucket-sort by destination, scatter into [D, B] slots,
+            # one all_to_all; rows past B land in the [D, SB] SPILL
+            # buckets drained by a second all_to_all (ISSUE 8) —
+            # traffic per device: D*(B+SB) = ~C*gamma rows instead of
+            # gather's C*D.
+            dest = jnp.where(cvalid, self._owner_jnp(ckeys[:, 1]), D)
+            sperm = lax.sort(
+                (dest, jnp.arange(C, dtype=jnp.int32)),
+                num_keys=1, is_stable=True)[1]
+            sdest = jnp.take(dest, sperm)
+            counts = jnp.zeros((D + 1,), jnp.int32).at[dest].add(1)
+            excl = jnp.concatenate(
+                [jnp.zeros(1, jnp.int32), jnp.cumsum(counts)[:-1]])
+            pos = jnp.arange(C, dtype=jnp.int32) - jnp.take(excl, sdest)
+            # overflow only when bucket AND spill are exhausted; the
+            # max per-destination occupancy rides the scalar vector so
+            # the host can grow gamma straight to the observed need
+            # (one rerun, not log2 doublings)
+            a2a_ovf = jnp.any(counts[:D] > B + SB)
+            spill_local = jnp.sum(
+                jnp.clip(counts[:D] - B, 0, SB)).astype(jnp.int32)
+            maxdest_local = jnp.max(counts[:D]).astype(jnp.int32)
+            srcid = me.astype(jnp.int32) * C + sperm
+            payload = jnp.concatenate(
+                [jnp.take(ckeys, sperm, axis=0),
+                 jnp.take(cand, sperm, axis=0),
+                 srcid[:, None]], axis=1)              # [C, Pw]
+            slot1 = jnp.where((sdest < D) & (pos < B),
+                              sdest * B + pos, D * B)
+            spos = pos - B
+            slot2 = jnp.where((sdest < D) & (spos >= 0) & (spos < SB),
+                              sdest * SB + spos, D * SB)
+            b1 = jnp.full((D * B + 1, Pw), SENTINEL, jnp.int32)
+            b1 = b1.at[:, 0].set(1)  # invalid slots
+            b1 = b1.at[slot1].set(payload, mode="drop")
+            b2 = jnp.full((D * SB + 1, Pw), SENTINEL, jnp.int32)
+            b2 = b2.at[:, 0].set(1)
+            b2 = b2.at[slot2].set(payload, mode="drop")
+            recv1 = lax.all_to_all(
+                b1[:D * B].reshape(D, B, Pw), "d",
+                split_axis=0, concat_axis=0).reshape(D * B, Pw)
+            recv2 = lax.all_to_all(
+                b2[:D * SB].reshape(D, SB, Pw), "d",
+                split_axis=0, concat_axis=0).reshape(D * SB, Pw)
+            recv = jnp.concatenate([recv1, recv2])     # [R, Pw]
+            gkeys = recv[:, :K]
+            gcand = recv[:, K:K + PW]
+            gsrc = recv[:, K + PW]
+            gvalid = gkeys[:, 0] == 0
+            # routed rows are mine by construction; invalid slots keep
+            # the sorts-last key shape
+            gkeys = jnp.where(gvalid[:, None], gkeys, invalid_key)
+            return (gkeys, gcand, gsrc, spill_local, a2a_ovf,
+                    maxdest_local, gvalid)
+
+        return route_a2a, R, B, SB
+
+    def _exchange_bytes(self, C: int, B: int, SB: int) -> int:
+        """Whole-mesh bytes moved by one level's exchange (host-side,
+        from the static shapes): a2a moves D*(B+SB) payload rows of
+        K+PW+1 words per device; gather replicates C candidate+key rows
+        to every device."""
+        D, K, PW = self.D, self.K, self.PW
+        if self.exchange == "a2a":
+            return D * D * (B + SB) * (K + PW + 1) * 4
+        return D * D * C * (K + PW) * 4
+
+    def _merge_fn(self, SC: int, R: int) -> Callable:
+        """The shard-local merge-dedup shared by both step builders:
+        (seen_keys [SC,K], gkeys [R,K], gcand [R,PW], gsrc [R]) ->
+        dict(seen2, seen_count2, front_rows [R,PW], front_rows_u,
+        front_src [R], front_count, new_count).  Key sort with the
+        seen-first flag tiebreaker, then two stable compactions
+        (new rows, then constraint-kept rows); constraint-discarded
+        states stay fingerprinted but are never counted, checked, or
+        explored (TLC semantics)."""
+        K, PW = self.K, self.PW
+        plan = self.plan
+        con_fns = self.constraint_fns
+        inv_fns = self.inv_fns
+
+        def merge(seen_keys, gkeys, gcand, gsrc):
             allk = jnp.concatenate([seen_keys, gkeys])    # [SC+R, K]
             flag = jnp.concatenate([jnp.zeros(SC, jnp.int32),
                                     jnp.ones(R, jnp.int32)])
@@ -268,7 +391,10 @@ class MeshExplorer(TpuExplorer):
             nvalid = jnp.arange(R) < new_count
             new_rows = jnp.where(nvalid[:, None], new_rows, SENTINEL)
 
-            # merged seen keys, compacted (keeps key order)
+            # merged seen keys, compacted (keeps key order).  NOTE
+            # seen_count2 counts BEFORE the [:SC] crop, so it reports
+            # the TRUE per-shard need — the resident loop grows SC to
+            # exactly this on overflow
             keep = ((sflag == 0) & rvalid) | new
             ops3 = ((1 - keep.astype(jnp.int32)),) + \
                 tuple(skeys[:, i] for i in range(K))
@@ -289,22 +415,95 @@ class MeshExplorer(TpuExplorer):
             comp4 = lax.sort(ops4, num_keys=1, is_stable=True)
             front_rows = jnp.take(new_rows, comp4[1], axis=0)
             front_rows_u = jnp.take(new_rows_u, comp4[1], axis=0)
-            # provenance follows the same two compactions
             front_src = jnp.take(new_src, comp4[1])
             front_count = jnp.sum(explore)
-            frontvalid = jnp.arange(R) < front_count
-            # named invariants: index of the FIRST cfg invariant any kept
-            # row violates, plus the first violating slot
-            inv_which = jnp.int32(_BIG)
-            inv_slot = jnp.int32(-1)
-            for i, (nm, f) in enumerate(inv_fns):
-                bad = frontvalid & ~jax.vmap(f)(front_rows_u)
-                anyb = jnp.any(bad)
-                hit = anyb & (inv_which == _BIG)
-                inv_which = jnp.where(hit, jnp.int32(i), inv_which)
-                inv_slot = jnp.where(hit,
-                                     jnp.argmax(bad).astype(jnp.int32),
-                                     inv_slot)
+            return dict(seen2=seen2, seen_count2=seen_count2,
+                        front_rows=front_rows, front_rows_u=front_rows_u,
+                        front_src=front_src, front_count=front_count,
+                        new_count=new_count)
+
+        return merge
+
+    def _inv_scan(self, front_rows_u, front_count, R: int):
+        """Named invariants: index of the FIRST cfg invariant any kept
+        row violates, plus the first violating slot."""
+        frontvalid = jnp.arange(R) < front_count
+        inv_which = jnp.int32(_BIG)
+        inv_slot = jnp.int32(-1)
+        for i, (nm, f) in enumerate(self.inv_fns):
+            bad = frontvalid & ~jax.vmap(f)(front_rows_u)
+            anyb = jnp.any(bad)
+            hit = anyb & (inv_which == _BIG)
+            inv_which = jnp.where(hit, jnp.int32(i), inv_which)
+            inv_slot = jnp.where(hit,
+                                 jnp.argmax(bad).astype(jnp.int32),
+                                 inv_slot)
+        return inv_which, inv_slot
+
+    def _shard_map(self):
+        try:
+            from jax import shard_map
+        except ImportError:  # older jax
+            from jax.experimental.shard_map import shard_map
+        return shard_map
+
+    def _get_mesh_step(self, SC: int, FC: int,
+                       out_cap: Optional[int] = None) -> Callable:
+        """The LEGACY exchange step: out_cap=None drives the host-loop
+        modes (refinement/temporal PROPERTYs — _run_hostloop); out_cap
+        set is the MULTI-HOST variant (tpu/multihost.py): the new
+        frontier is cropped on device to a fixed [out_cap] shard so the
+        host never needs non-addressable remote rows, and extra
+        REPLICATED flags (psum'd over the DCN+ICI axis) are appended to
+        the outputs: any_inv, fixed_ovf (a frontier/seen shard outgrew
+        its fixed capacity, incl. a2a bucket+spill overflow), any_dead,
+        any_assert."""
+        a2a = self.exchange == "a2a"
+        C = self.A * FC
+        route, R, B, SB = self._route_fn(C, FC)
+        key = (SC, FC, B, SB, out_cap)
+        if key in self._mesh_step_cache:
+            return self._mesh_step_cache[key]
+        K, D, PW = self.K, self.D, self.PW
+        plan = self.plan
+        con_fns = self.constraint_fns
+        block_fn = self._candidate_block_fn(FC)
+        merge_fn = self._merge_fn(SC, R)
+        # refinement/temporal PROPERTYs: stream every exchanged
+        # candidate (revisits included) to the host, which runs the SAME
+        # stepwise refinement and behavior-graph checkers as the
+        # single-chip device modes (r4; closes VERDICT r3 #9)
+        need_edges = (out_cap is None and
+                      (bool(self.refiners) or self.collect_edges))
+
+        def device_step(seen_keys, frontier_p, fcount):
+            # per-device blocks: seen_keys [SC,K], frontier [FC,PW], [1]
+            seen_keys = seen_keys.reshape(SC, K)
+            frontier = plan.unpack_rows(frontier_p.reshape(FC, PW))
+            me = lax.axis_index("d")
+            fvalid = jnp.arange(FC) < fcount[0]
+            blk = block_fn(frontier, fvalid)
+            overflow = blk["overflow"]
+            dead = blk["dead"]
+            dead_local = jnp.any(dead)
+            dead_slot = blk["dead_slot"]
+            assert_bad = blk["assert_bad"]
+            asrt_a, asrt_f = blk["asrt_a"], blk["asrt_f"]
+            gen_local = blk["gen_local"]
+
+            (gkeys, gcand, gsrc, spill_local, a2a_ovf, _maxdest,
+             evalid) = route(blk["ckeys"], blk["cand"], blk["cvalid"],
+                             me)
+
+            mg = merge_fn(seen_keys, gkeys, gcand, gsrc)
+            seen2 = mg["seen2"]
+            seen_count2 = mg["seen_count2"]
+            front_rows = mg["front_rows"]
+            front_rows_u = mg["front_rows_u"]
+            front_src = mg["front_src"]
+            front_count = mg["front_count"]
+            inv_which, inv_slot = self._inv_scan(front_rows_u,
+                                                 front_count, R)
 
             # global totals over ICI; violation flags stay PER-DEVICE so
             # the host can locate the offending device's row/provenance
@@ -312,15 +511,17 @@ class MeshExplorer(TpuExplorer):
             tot_new = lax.psum(front_count, "d")
             any_ovf = lax.pmax(overflow, "d")  # 0 = none, else max OV_*
             tot_front = lax.psum(front_count, "d")
+            tot_spill = lax.psum(spill_local, "d")
 
             any_a2a_ovf = lax.psum(a2a_ovf.astype(jnp.int32), "d") > 0
             if out_cap is not None:
                 # multi-host: fixed-capacity frontier shard + replicated
                 # abort flags — the host loop reads ONLY replicated
-                # scalars and its own addressable shards. a2a bucket
-                # overflow folds into the fixed-capacity abort (the
-                # multi-host loop cannot re-run a level, so it aborts
-                # loudly instead of retrying with a larger gamma).
+                # scalars and its own addressable shards. a2a bucket+
+                # spill overflow folds into the fixed-capacity abort
+                # (the multi-host loop cannot re-run a level, so it
+                # aborts loudly instead of retrying with a larger
+                # gamma).
                 fixed_ovf = lax.psum(
                     ((front_count > out_cap) | (seen_count2 > SC) |
                      a2a_ovf).astype(jnp.int32), "d") > 0
@@ -330,11 +531,12 @@ class MeshExplorer(TpuExplorer):
                     dead_local.astype(jnp.int32), "d") > 0
                 any_assert = lax.psum(
                     assert_bad.astype(jnp.int32), "d") > 0
-                # indices 0-11 are the r4 surface; 12+ add PER-DEVICE
+                # indices 0-11 are the r4 surface; 12-19 add PER-DEVICE
                 # provenance (each process reads only its own shards) so
                 # the multi-host loop can assemble exact counterexample
                 # traces via the process-allgather protocol
-                # (multihost.py, VERDICT r4 #7)
+                # (multihost.py, VERDICT r4 #7); 20 is the psum'd spill
+                # row count (ISSUE 8)
                 return (seen2.reshape(1, SC, K), seen_count2.reshape(1),
                         front_rows[:out_cap].reshape(1, out_cap, PW),
                         front_count.reshape(1),
@@ -346,7 +548,7 @@ class MeshExplorer(TpuExplorer):
                         inv_which.reshape(1), inv_slot.reshape(1),
                         dead_local.reshape(1), dead_slot.reshape(1),
                         assert_bad.reshape(1), asrt_a.reshape(1),
-                        asrt_f.reshape(1))
+                        asrt_f.reshape(1), tot_spill.reshape(1))
             out = (seen2.reshape(1, SC, K), seen_count2.reshape(1),
                    front_rows.reshape(1, R, PW), front_count.reshape(1),
                    front_src.reshape(1, R),
@@ -355,13 +557,19 @@ class MeshExplorer(TpuExplorer):
                    assert_bad.reshape(1), asrt_a.reshape(1),
                    asrt_f.reshape(1), any_ovf.reshape(1),
                    inv_which.reshape(1), inv_slot.reshape(1),
-                   tot_front.reshape(1), any_a2a_ovf.reshape(1))
+                   tot_front.reshape(1), any_a2a_ovf.reshape(1),
+                   tot_spill.reshape(1))
             if need_edges:
                 # every exchanged candidate row + its explore mask +
                 # global source index — the host-side edge stream.
                 # gather mode: identical on every device (host reads
                 # device 0); a2a: each device holds its own bucket.
-                exp_all = gvalid
+                # `evalid` is the PRE-ownership validity from the
+                # route: gkeys is already masked to owner-local rows,
+                # and recomputing validity from it would silently drop
+                # foreign-owned edges from the device-0 read
+                # (review r8).
+                exp_all = evalid
                 gcand_u = plan.unpack_rows(gcand)
                 for nm, f in con_fns:
                     exp_all = exp_all & jax.vmap(f)(gcand_u)
@@ -370,16 +578,158 @@ class MeshExplorer(TpuExplorer):
                              gsrc.reshape(1, R))
             return out
 
-        try:
-            from jax import shard_map
-        except ImportError:  # older jax
-            from jax.experimental.shard_map import shard_map
-        n_out = 20 if out_cap is not None else \
-            (20 if need_edges else 17)
+        shard_map = self._shard_map()
+        n_out = 21 if out_cap is not None else \
+            (21 if need_edges else 18)
         step = jax.jit(shard_map(
             device_step, mesh=self.mesh,
             in_specs=(P("d"), P("d"), P("d")),
             out_specs=tuple([P("d")] * n_out)))
+        self._mesh_step_cache[key] = step
+        return step
+
+    def _get_mesh_resident_step(self, SC: int, FC: int,
+                                TRL: int) -> Callable:
+        """The MESH-RESIDENT level step (ISSUE 8 tentpole): one jitted
+        shard_map dispatch per level that expands, exchanges,
+        merge-dedups against the seen shards, appends the per-level
+        trace ring IN PLACE and returns the full device state plus ONE
+        replicated scalar vector — the only thing the host reads on the
+        clean path.  Any capacity overflow (seen / frontier / trace
+        ring / a2a bucket+spill) rolls the level back inside the step
+        (outputs == inputs), so the host can grow the named capacity
+        and redo the level without ever pulling rows."""
+        a2a = self.exchange == "a2a"
+        C = self.A * FC
+        route, R, B, SB = self._route_fn(C, FC)
+        with_trace = self.store_trace
+        key = ("res", SC, FC, TRL, B, SB, with_trace)
+        if key in self._mesh_step_cache:
+            return self._mesh_step_cache[key]
+        K, D, PW = self.K, self.D, self.PW
+        plan = self.plan
+        block_fn = self._candidate_block_fn(FC)
+        merge_fn = self._merge_fn(SC, R)
+        check_deadlock = self.model.check_deadlock
+
+        def device_step(seen_keys, seen_count, frontier_p, fcount,
+                        *rest):
+            if with_trace:
+                tr_rows, tr_src, lvl = rest
+                tr_rows = tr_rows.reshape(TRL, FC, PW)
+                tr_src = tr_src.reshape(TRL, FC)
+            else:
+                (lvl,) = rest
+            seen_keys = seen_keys.reshape(SC, K)
+            frontier_p = frontier_p.reshape(FC, PW)
+            frontier = plan.unpack_rows(frontier_p)
+            me = lax.axis_index("d")
+            fvalid = jnp.arange(FC) < fcount[0]
+            blk = block_fn(frontier, fvalid)
+            dead_local = (jnp.any(blk["dead"]) if check_deadlock
+                          else jnp.asarray(False))
+
+            (gkeys, gcand, gsrc, spill_local, a2a_ovf, maxdest,
+             _evalid) = route(blk["ckeys"], blk["cand"],
+                              blk["cvalid"], me)
+
+            mg = merge_fn(seen_keys, gkeys, gcand, gsrc)
+            front_rows = mg["front_rows"]
+            front_count = mg["front_count"]
+            front_src = mg["front_src"]
+            seen_count2 = mg["seen_count2"]
+            inv_which, inv_slot = self._inv_scan(mg["front_rows_u"],
+                                                 front_count, R)
+
+            # ---- capacity verdicts (replicated) ----
+            f_ovf = lax.psum((front_count > FC).astype(jnp.int32),
+                             "d") > 0
+            s_ovf = lax.psum((seen_count2 > SC).astype(jnp.int32),
+                             "d") > 0
+            t_ovf = (jnp.asarray(with_trace) & (lvl >= TRL)) \
+                if with_trace else jnp.asarray(False)
+            any_a2a_ovf = lax.psum(a2a_ovf.astype(jnp.int32), "d") > 0
+            grow = f_ovf | s_ovf | t_ovf | any_a2a_ovf
+            commit = ~grow
+
+            # ---- commit or roll back the device state ----
+            seen_out = jnp.where(commit, mg["seen2"], seen_keys)
+            seen_count_out = jnp.where(commit, seen_count2,
+                                       seen_count[0])
+            new_frontier = front_rows[:FC]       # R >= FC by the floors
+            # ring src rows keep the documented -1-means-empty
+            # convention: slots past front_count hold compaction
+            # leftovers (nonnegative), and an unmasked write would make
+            # _ring_levels' occupied-prefix trim inert (review r8)
+            new_src_fc = jnp.where(
+                jnp.arange(FC) < front_count,
+                front_src[:FC], -1).astype(jnp.int32)
+            frontier_out = jnp.where(commit, new_frontier, frontier_p)
+            fcount_out = jnp.where(commit, front_count, fcount[0])
+            outs = [seen_out.reshape(1, SC, K),
+                    seen_count_out.reshape(1),
+                    frontier_out.reshape(1, FC, PW),
+                    fcount_out.reshape(1)]
+            if with_trace:
+                wl = jnp.clip(lvl, 0, TRL - 1)
+                tr_rows2 = lax.dynamic_update_slice(
+                    tr_rows, new_frontier[None], (wl, 0, 0))
+                tr_src2 = lax.dynamic_update_slice(
+                    tr_src, new_src_fc[None], (wl, 0))
+                outs.append(jnp.where(commit, tr_rows2, tr_rows)
+                            .reshape(1, TRL, FC, PW))
+                outs.append(jnp.where(commit, tr_src2, tr_src)
+                            .reshape(1, TRL, FC))
+
+            # ---- the per-level scalar vector (replicated values) ----
+            scal = jnp.zeros((_NS,), jnp.int32)
+            scal = scal.at[_S_GEN].set(lax.psum(blk["gen_local"], "d"))
+            scal = scal.at[_S_NEW].set(lax.psum(front_count, "d"))
+            scal = scal.at[_S_FRONT].set(lax.psum(front_count, "d"))
+            scal = scal.at[_S_MAXF].set(lax.pmax(front_count, "d"))
+            scal = scal.at[_S_MAXS].set(lax.pmax(seen_count2, "d"))
+            scal = scal.at[_S_SUMS].set(lax.psum(seen_count2, "d"))
+            scal = scal.at[_S_OVC].set(lax.pmax(blk["overflow"], "d"))
+            scal = scal.at[_S_DEAD].set(
+                lax.psum(dead_local.astype(jnp.int32), "d"))
+            scal = scal.at[_S_ASSERT].set(
+                lax.psum(blk["assert_bad"].astype(jnp.int32), "d"))
+            scal = scal.at[_S_INVMIN].set(lax.pmin(inv_which, "d"))
+            scal = scal.at[_S_FOVF].set(f_ovf.astype(jnp.int32))
+            scal = scal.at[_S_SOVF].set(s_ovf.astype(jnp.int32))
+            scal = scal.at[_S_TOVF].set(t_ovf.astype(jnp.int32))
+            scal = scal.at[_S_AOVF].set(any_a2a_ovf.astype(jnp.int32))
+            scal = scal.at[_S_SPILL].set(lax.psum(spill_local, "d"))
+            scal = scal.at[_S_MAXDEST].set(lax.pmax(maxdest, "d"))
+            outs.append(scal.reshape(1, _NS))
+
+            # per-device localization vector (fetched only on violation)
+            aux = jnp.zeros((_NA,), jnp.int32)
+            aux = aux.at[_A_INVW].set(inv_which)
+            aux = aux.at[_A_INVSLOT].set(inv_slot)
+            aux = aux.at[_A_DEAD].set(dead_local.astype(jnp.int32))
+            aux = aux.at[_A_DEADSLOT].set(blk["dead_slot"])
+            aux = aux.at[_A_ASSERT].set(
+                blk["assert_bad"].astype(jnp.int32))
+            aux = aux.at[_A_ASRTA].set(blk["asrt_a"])
+            aux = aux.at[_A_ASRTF].set(blk["asrt_f"])
+            outs.append(aux.reshape(1, _NA))
+            return tuple(outs)
+
+        shard_map = self._shard_map()
+        n_in = 7 if with_trace else 5
+        n_out = 8 if with_trace else 6
+        in_specs = tuple([P("d")] * (n_in - 1)) + (P(),)
+        # donate the big device buffers — seen, frontier, trace ring —
+        # so XLA updates them in place across levels (accelerators;
+        # XLA:CPU ignores donation with a warning, JAXMC_DONATE forces)
+        donate = ((0, 2, 4, 5) if with_trace else (0, 2)) \
+            if self.donate else ()
+        step = jax.jit(shard_map(
+            device_step, mesh=self.mesh,
+            in_specs=in_specs,
+            out_specs=tuple([P("d")] * n_out)),
+            donate_argnums=donate)
         self._mesh_step_cache[key] = step
         return step
 
@@ -391,7 +741,7 @@ class MeshExplorer(TpuExplorer):
         (tpu/multihost.py): per-owner frontier fill and lexsorted seen
         keys with the validity-lane-1 empty-slot convention. One layout
         rule, so host and device dedup can never diverge. Returns
-        (seen [D,SC,K], frontier [D,FC,W], fcount [D]) as numpy."""
+        (seen [D,SC,K], frontier [D,FC,PW], fcount [D]) as numpy."""
         K = self.K
         if keys is None:
             keys, packed, povf = self._host_keys(init_rows)
@@ -416,12 +766,6 @@ class MeshExplorer(TpuExplorer):
             fcount[d] = len(p)
         return seen, frontier, fcount
 
-    def _owner_from_keys(self, keys: np.ndarray) -> np.ndarray:
-        """THE ownership formula (keys lane 1 mod D) — one definition
-        for every host path; device_step mirrors it in jnp."""
-        return (keys[:, 1].astype(np.uint32) % np.uint32(self.D)) \
-            .astype(np.int64)
-
     # ---- trace reconstruction (host side) ----
     #
     # self._levels[L] = (rows [D, cap_L, W] np, src [D, cap_L] np | None).
@@ -429,6 +773,8 @@ class MeshExplorer(TpuExplorer):
     # device d holds global candidate index g = src[d][i]; with C_L =
     # A * FC_L (the expanding level's capacity): source device g // C_L,
     # candidate c = g % C_L, action c // FC_L, parent slot c % FC_L.
+    # The resident loop materializes _levels lazily from the device
+    # trace ring (one pull, only on a violation or checkpoint).
 
     def _mesh_trace_to(self, dev: int, slot: int, depth: int,
                        extra: Optional[Tuple[Dict, str]] = None):
@@ -506,6 +852,423 @@ class MeshExplorer(TpuExplorer):
             levels=self._levels if self.store_trace else None)
 
     def run(self) -> CheckResult:
+        # the edge stream feeds refiners and non-[]P liveness; []P-only
+        # obligations still need the behavior-graph STATES (per-level
+        # kept rows), so the mode guards key on the wider condition
+        need_edges = bool(self.refiners) or self.collect_edges
+        need_props = bool(self.refiners) or bool(self.live_obligations)
+        # per-RUN accounting: the final gauges (_mk) must describe THIS
+        # run — a warm re-run (bench timed window) must not inherit the
+        # warm-up's spill/bucket peaks (review r8).  Learned caps and
+        # gamma deliberately persist on the instance.
+        self._spill_rows = 0
+        self._max_bucket = 0
+        self._shard_balance = None
+        # chosen strategy + gamma, once per run (ISSUE 8 satellite)
+        resident = not (need_props or need_edges or
+                        os.environ.get("JAXMC_MESH_RESIDENT", "1")
+                        == "0")
+        self.log(f"-- mesh: {self.D} device(s), exchange="
+                 f"{self.exchange} ({self._exchange_src}), "
+                 f"gamma={self._a2a_gamma:g}, "
+                 f"loop={'resident' if resident else 'host'}"
+                 + (" [mesh_skew fault armed]" if self._skew else ""))
+        tel = obs.current()
+        tel.gauge("mesh.exchange", self.exchange)
+        tel.gauge("mesh.devices", self.D)
+        if resident:
+            return self._run_mesh_resident()
+        return self._run_hostloop(need_edges, need_props)
+
+    # ------------------------------------------------------------------
+    # the MESH-RESIDENT loop (ISSUE 8 tentpole)
+    # ------------------------------------------------------------------
+
+    def _pad_dev(self, arr, axis: int, newdim: int, fill: int,
+                 lane1: bool = False):
+        """Grow a [D, ...] device array along `axis` with constant fill
+        (validity-lane-1 empty-slot convention for seen shards)."""
+        shape = list(arr.shape)
+        shape[axis] = newdim - shape[axis]
+        pad = np.full(shape, fill, np.int32)
+        if lane1:
+            pad[..., 0] = 1
+        return jnp.concatenate([arr, jnp.asarray(pad)], axis=axis)
+
+    def _ring_levels(self, tr_rows, tr_src, upto: int) -> None:
+        """Materialize self._levels[1..upto] from the device trace ring
+        — the ONE row pull a violating/checkpointing resident run pays
+        (mesh.row_syncs)."""
+        if not self.store_trace or upto <= 0:
+            return
+        tel = obs.current()
+        tel.counter("mesh.row_syncs")
+        rows_np = np.asarray(tr_rows)   # [D, TRL, FC, PW]
+        src_np = np.asarray(tr_src)     # [D, TRL, FC]
+        del self._levels[1:]
+        for l in range(upto):
+            # trim to the occupied prefix (src == -1 marks empty slots)
+            occ = np.nonzero((src_np[:, l] >= 0).any(axis=0))[0]
+            keep = int(occ.max()) + 1 if len(occ) else 1
+            self._levels.append((rows_np[:, l, :keep].copy(),
+                                 src_np[:, l, :keep].copy(),
+                                 self._lvl_FC[l]))
+
+    def _run_mesh_resident(self) -> CheckResult:
+        t0 = time.time()
+        tel = obs.current()
+        model = self.model
+        D, K, PW = self.D, self.K, self.PW
+        warnings = ["mesh backend: dedup on 128-bit fingerprints; "
+                    "collision probability < n^2 * 2^-129"]
+        warnings.extend(self._temporal_warnings())
+        warnings.extend(self._symmetry_warnings())
+
+        init_rows, explored_init, n_init, err = \
+            self._prepare_init(t0, warnings)
+        if err is not None:
+            return err
+        generated = n_init
+        explored_mask = np.zeros(n_init, bool)
+        explored_mask[explored_init] = True
+        distinct = int(explored_mask.sum())
+
+        self._levels: List[Tuple[np.ndarray, Optional[np.ndarray], int]] \
+            = []
+        self._lvl_FC = []
+        hint = self._mesh_caps_hint
+
+        if self.resume_from:
+            ck = self._load_ck("mesh")
+            if ck["D"] != D:
+                raise ValueError(
+                    f"cannot resume: checkpoint has {ck['D']} devices, "
+                    f"mesh has {D}")
+            FC = max(ck["FC"], _pow2_at_least(
+                int(hint.get("FC", 1)), lo=64))
+            SC = max(ck["SC"], _pow2_at_least(
+                int(hint.get("SC", 1)), lo=256))
+            depth = ck["depth"]
+            generated = ck["generated"]
+            distinct = ck["distinct"]
+            seen_np = np.full((D, SC, K), SENTINEL, np.int32)
+            seen_np[:, :, 0] = 1
+            seen_np[:, :ck["SC"]] = ck["seen"]
+            seen = jnp.asarray(seen_np)
+            seen_count = jnp.asarray(
+                ck["seen_counts"].astype(np.int32))
+            fr_np = np.full((D, FC, PW), SENTINEL, np.int32)
+            fr_np[:, :ck["FC"]] = ck["frontier"]
+            frontier = jnp.asarray(fr_np)
+            fcount = jnp.asarray(ck["fcount"].astype(np.int32))
+            if ck.get("levels") is not None:
+                self._levels = list(ck["levels"])
+            elif self.store_trace:
+                # advisor r3: match _restore_ck_state — a user expecting
+                # traces must hear it up front, not get an empty-trace
+                # violation later
+                raise ValueError(
+                    "cannot resume with traces: the checkpoint was "
+                    "written with --no-trace")
+            self._lvl_FC = [lv[2] for lv in self._levels[1:]]
+            TRL = _pow2_at_least(
+                max(depth + 1, int(hint.get("TRL", 1)), 16), lo=16)
+            self.log(f"Resuming mesh run at depth {depth} "
+                     f"({distinct} distinct states)")
+        else:
+            init_keys, init_packed, init_povf = \
+                self._host_keys(init_rows)
+            if init_povf:
+                from ..compile.vspec import CompileError
+                raise CompileError(self._pack_ovf_msg())
+            owner = self._owner_from_keys(init_keys)
+            per_dev = [init_rows[(owner == d) & explored_mask]
+                       for d in range(D)]
+            FC = _pow2_at_least(
+                max(max((len(p) for p in per_dev), default=1), 1,
+                    int(hint.get("FC", 1))), lo=64)
+            SC = _pow2_at_least(max(4 * FC, int(hint.get("SC", 1))),
+                                lo=256)
+            TRL = _pow2_at_least(max(int(hint.get("TRL", 1)), 16),
+                                 lo=16)
+            explored_idx = np.nonzero(explored_mask)[0]
+            seen_np, frontier_np, fcount_np = self._init_shards(
+                init_rows, explored_idx, D, SC, FC,
+                keys=init_keys, packed=init_packed, owner=owner)
+            if self.store_trace:
+                self._levels.append((frontier_np.copy(), None, FC))
+            seen = jnp.asarray(seen_np)
+            frontier = jnp.asarray(frontier_np)
+            fcount = jnp.asarray(fcount_np.astype(np.int32))
+            seen_count = jnp.asarray(
+                np.array([int((owner == d).sum()) for d in range(D)],
+                         np.int32))
+            depth = 0
+
+        tr_rows = tr_src = None
+        if self.store_trace:
+            ring_np = np.full((D, TRL, FC, PW), SENTINEL, np.int32)
+            src_np_ = np.full((D, TRL, FC), -1, np.int32)
+            for l, (rows, src, _fcl) in enumerate(self._levels[1:]):
+                k = min(rows.shape[1], FC)
+                ring_np[:, l, :k] = rows[:, :k]
+                src_np_[:, l, :k] = src[:, :k]
+            tr_rows = jnp.asarray(ring_np)
+            tr_src = jnp.asarray(src_np_)
+            # _levels beyond the init level will be re-materialized from
+            # the ring on demand; keep only level 0 host-side
+            del self._levels[1:]
+
+        last_progress = last_ck = time.time()
+        lvl_frontier = int(np.sum(np.asarray(fcount)))
+        levels_run = 0
+        while lvl_frontier > 0:
+            lvl_t0 = time.time()
+            # chaos sites: crash / drain between dispatches (the only
+            # host-attention points the resident mesh loop has)
+            faults.kill_self("run_kill", level=depth, engine="mesh")
+            faults.inject("device_run_fail", level=depth, engine="mesh")
+            if self._drain_requested(warnings, "mesh"):
+                if self.checkpoint_path:
+                    self._ring_levels(tr_rows, tr_src, depth)
+                    self._mesh_ck(seen, np.asarray(seen_count),
+                                  frontier, fcount, FC, SC, depth,
+                                  generated, distinct)
+                return self._mk(True, distinct, generated, depth, t0,
+                                warnings, truncated=True, drained=True)
+
+            C = self.A * FC
+            B = self._a2a_bucket(C, FC) if self.exchange == "a2a" else 0
+            SB = self._a2a_spill_bucket(B) if B else 0
+            step_key = ("res", SC, FC, TRL, B, SB, self.store_trace)
+            fresh_compile = step_key not in self._mesh_step_cache
+            step = self._get_mesh_resident_step(SC, FC, TRL)
+            args = (seen, seen_count, frontier, fcount)
+            if self.store_trace:
+                args = args + (tr_rows, tr_src)
+            args = args + (jnp.int32(depth),)
+            outs = step(*args)
+            if self.store_trace:
+                (seen2, seen_count2, frontier2, fcount2, tr_rows2,
+                 tr_src2, scal_d, aux_d) = outs
+            else:
+                (seen2, seen_count2, frontier2, fcount2, scal_d,
+                 aux_d) = outs
+                tr_rows2 = tr_src2 = None
+            # THE one host sync of the level: the replicated scalar
+            # vector (every per-device row is identical; tiny)
+            scal = np.asarray(scal_d)[0]
+            tel.counter("mesh.host_syncs")
+            tel.counter("mesh.exchange_bytes",
+                        self._exchange_bytes(C, B, SB))
+
+            ovc = int(scal[_S_OVC])
+            if ovc:
+                if ovc == OV_DEMOTED:
+                    msg = ("a demoted compile-recovery fired (the "
+                           "kernel under-approximates here): run the "
+                           "host_seen mode, which demotes the arm to "
+                           "the interpreter and restarts — raising "
+                           "caps cannot help")
+                elif ovc == OV_PACK:
+                    msg = self._pack_ovf_msg()
+                else:
+                    msg = ("a container exceeded its lane capacity "
+                           f"({self._caps_note()}); counts would no "
+                           "longer be exact")
+                return self._mk(False, distinct, generated, depth, t0,
+                                warnings, Violation(
+                                    "error", "capacity overflow", [],
+                                    msg))
+
+            if scal[_S_FOVF] or scal[_S_SOVF] or scal[_S_TOVF] or \
+                    scal[_S_AOVF]:
+                # the step rolled the level back on device: grow every
+                # flagged capacity at once (each growth recompiles the
+                # step, so batching growths minimizes recompiles), then
+                # redo the level
+                grew = []
+                if scal[_S_AOVF]:
+                    # grow gamma straight to the OBSERVED per-peer need
+                    # (the max bucket occupancy rode the scalar vector)
+                    # instead of blind doubling: one rerun covers even
+                    # pathological skew, and the spill bucket keeps
+                    # absorbing between-level drift afterwards
+                    need_g = int(scal[_S_MAXDEST]) * self.D / max(C, 1)
+                    self._a2a_gamma = max(self._a2a_gamma * 2, need_g)
+                    grew.append(f"gamma->{self._a2a_gamma:g}")
+                if scal[_S_SOVF]:
+                    SC2 = _pow2_at_least(int(scal[_S_MAXS]), lo=2 * SC)
+                    seen2 = self._pad_dev(seen2, 1, SC2, SENTINEL,
+                                          lane1=True)
+                    SC = SC2
+                    grew.append(f"SC->{SC}")
+                if scal[_S_FOVF]:
+                    FC2 = _pow2_at_least(int(scal[_S_MAXF]), lo=2 * FC)
+                    frontier2 = self._pad_dev(frontier2, 1, FC2,
+                                              SENTINEL)
+                    if self.store_trace:
+                        tr_rows2 = self._pad_dev(tr_rows2, 2, FC2,
+                                                 SENTINEL)
+                        tr_src2 = self._pad_dev(tr_src2, 2, FC2, -1)
+                    FC = FC2
+                    grew.append(f"FC->{FC}")
+                if scal[_S_TOVF]:
+                    TRL2 = _pow2_at_least(depth + 1, lo=2 * TRL)
+                    tr_rows2 = self._pad_dev(tr_rows2, 1, TRL2,
+                                             SENTINEL)
+                    tr_src2 = self._pad_dev(tr_src2, 1, TRL2, -1)
+                    TRL = TRL2
+                    grew.append(f"TRL->{TRL}")
+                self._remember_caps(SC, FC, TRL)
+                self.log(f"-- mesh: growing {', '.join(grew)} "
+                         f"(level {depth} redone)")
+                tel.level(depth, frontier=lvl_frontier, generated=0,
+                          new=0, distinct=distinct, devices=D,
+                          redo=",".join(grew),
+                          fresh_compile=fresh_compile,
+                          wall_s=round(time.time() - lvl_t0, 6))
+                seen, seen_count = seen2, seen_count2
+                frontier, fcount = frontier2, fcount2
+                tr_rows, tr_src = tr_rows2, tr_src2
+                continue
+
+            # committed: adopt the device state
+            seen, seen_count = seen2, seen_count2
+            frontier, fcount = frontier2, fcount2
+            if self.store_trace:
+                tr_rows, tr_src = tr_rows2, tr_src2
+                self._lvl_FC.append(FC)
+            self._spill_rows += int(scal[_S_SPILL])
+            self._max_bucket = max(self._max_bucket,
+                                   int(scal[_S_MAXDEST]))
+            levels_run += 1
+
+            # deadlock/assert live in the CURRENT frontier (depth d):
+            # totals exclude the partial level, like the host loop
+            if model.check_deadlock and scal[_S_DEAD]:
+                aux = np.asarray(aux_d)
+                dv = int(np.argmax(aux[:, _A_DEAD]))
+                ds = int(aux[dv, _A_DEADSLOT])
+                self._ring_levels(tr_rows, tr_src, depth)
+                trace = self._mesh_trace_to(dv, ds, depth)
+                return self._mk(False, distinct, generated, depth, t0,
+                                warnings,
+                                self._viol("deadlock", "deadlock",
+                                           trace))
+            if scal[_S_ASSERT]:
+                aux = np.asarray(aux_d)
+                av = int(np.argmax(aux[:, _A_ASSERT]))
+                aa = int(aux[av, _A_ASRTA])
+                af = int(aux[av, _A_ASRTF])
+                self._ring_levels(tr_rows, tr_src, depth)
+                trace = self._mesh_trace_to(av, af, depth)
+                return self._mk(
+                    False, distinct, generated, depth, t0, warnings,
+                    self._viol("assert", "Assert", trace,
+                               f"assertion in {self.labels_flat[aa]}"))
+
+            generated += int(scal[_S_GEN])
+            distinct += int(scal[_S_NEW])
+            sum_seen = int(scal[_S_SUMS])
+            max_seen = int(scal[_S_MAXS])
+            self._fp_occupancy = sum_seen
+            if sum_seen:
+                self._shard_balance = max_seen / (sum_seen / D)
+            tel.level(depth, frontier=lvl_frontier,
+                      generated=int(scal[_S_GEN]),
+                      new=int(scal[_S_NEW]), distinct=distinct,
+                      seen=sum_seen, devices=D, fc=FC,
+                      spill=int(scal[_S_SPILL]),
+                      max_bucket=int(scal[_S_MAXDEST]),
+                      fresh_compile=fresh_compile,
+                      wall_s=round(time.time() - lvl_t0, 6))
+
+            which = int(scal[_S_INVMIN])
+            if which != _BIG:
+                # invariant violations live in the NEW frontier
+                # (depth+1); the globally LOWEST violated cfg-invariant
+                # index wins, then the first device holding it
+                aux = np.asarray(aux_d)
+                nm = self.inv_fns[which][0]
+                iv_dev = int(np.argmax(aux[:, _A_INVW] == which))
+                iv_slot = int(aux[iv_dev, _A_INVSLOT])
+                self._ring_levels(tr_rows, tr_src, depth + 1)
+                trace = self._mesh_trace_to(iv_dev, iv_slot, depth + 1)
+                return self._mk(False, distinct, generated, depth + 1,
+                                t0, warnings,
+                                self._viol("invariant", nm, trace))
+            depth += 1
+            lvl_frontier = int(scal[_S_FRONT])
+
+            if self.max_states and distinct >= self.max_states:
+                # a truncation point IS a level boundary: leave a
+                # checkpoint so the run can be resumed past the limit
+                if self.checkpoint_path:
+                    self._ring_levels(tr_rows, tr_src, depth)
+                    self._mesh_ck(seen, np.asarray(seen_count),
+                                  frontier, fcount, FC, SC, depth,
+                                  generated, distinct)
+                self._save_mesh_profile(SC, FC, TRL)
+                self.log("-- state limit reached, search truncated")
+                return self._mk(True, distinct, generated, depth, t0,
+                                warnings, truncated=True)
+
+            now = time.time()
+            if now - last_progress >= self.progress_every:
+                last_progress = now
+                self.log(f"Progress({depth}): {generated} generated, "
+                         f"{distinct} distinct, "
+                         f"{lvl_frontier} on queue.")
+            if self.checkpoint_path and \
+                    now - last_ck >= self.checkpoint_every:
+                last_ck = now
+                self._ring_levels(tr_rows, tr_src, depth)
+                self._mesh_ck(seen, np.asarray(seen_count), frontier,
+                              fcount, FC, SC, depth, generated,
+                              distinct)
+
+        self._save_mesh_profile(SC, FC, TRL)
+        if self.checkpoint_path and self.final_checkpoint:
+            # COMPLETED-run checkpoint (serve warm resume): an empty
+            # frontier over the full seen set
+            self._ring_levels(tr_rows, tr_src, depth)
+            self._mesh_ck(seen, np.asarray(seen_count),
+                          jnp.asarray(np.zeros((D, FC, PW), np.int32)),
+                          jnp.asarray(np.zeros(D, np.int32)),
+                          FC, SC, depth, generated, distinct)
+        self.log("Model checking completed. No error has been found.")
+        self.log(f"{generated} states generated, {distinct} distinct "
+                 f"states found, 0 states left on queue.")
+        return self._mk(True, distinct, generated, depth - 1, t0,
+                        warnings)
+
+    def _remember_caps(self, SC: int, FC: int, TRL: int) -> None:
+        """Keep the learned caps on the INSTANCE so warm re-runs (bench
+        timed windows) start at them — zero growth redos, zero
+        recompiles — exactly like the single-chip resident engine's
+        _res_caps."""
+        h = self._mesh_caps_hint
+        h["SC"] = max(int(h.get("SC", 0)), SC)
+        h["FC"] = max(int(h.get("FC", 0)), FC)
+        h["TRL"] = max(int(h.get("TRL", 0)), TRL)
+        h["GAM16"] = max(int(h.get("GAM16", 0)),
+                         int(round(self._a2a_gamma * 16)))
+
+    def _save_mesh_profile(self, SC: int, FC: int, TRL: int) -> None:
+        self._remember_caps(SC, FC, TRL)
+        self._save_caps_profile(
+            {"SC": SC, "FC": FC, "TRL": TRL,
+             "GAM16": max(1, int(round(self._a2a_gamma * 16)))},
+            variant=self._profile_variant(), keys=_MESH_PROFILE_KEYS)
+
+    # ------------------------------------------------------------------
+    # the LEGACY host loop (refinement/temporal PROPERTYs; the
+    # JAXMC_MESH_RESIDENT=0 diagnosis escape hatch)
+    # ------------------------------------------------------------------
+
+    def _run_hostloop(self, need_edges: bool,
+                      need_props: bool) -> CheckResult:
         t0 = time.time()
         tel = obs.current()
         model = self.model
@@ -514,11 +1277,6 @@ class MeshExplorer(TpuExplorer):
         warnings = ["mesh backend: dedup on 128-bit fingerprints; "
                     "collision probability < n^2 * 2^-129"]
         warnings.extend(self._temporal_warnings())
-        # the edge stream feeds refiners and non-[]P liveness; []P-only
-        # obligations still need the behavior-graph STATES (per-level
-        # kept rows), so the mode guards key on the wider condition
-        need_edges = bool(self.refiners) or self.collect_edges
-        need_props = bool(self.refiners) or bool(self.live_obligations)
         if need_props and not self.store_trace:
             raise ModeError(
                 "mesh refinement/temporal checking needs the per-level "
@@ -619,21 +1377,31 @@ class MeshExplorer(TpuExplorer):
             while True:
                 step = self._get_mesh_step(SC, FC)
                 outs = step(seen, frontier, fcount)
+                # count THIS attempt's exchange with the gamma it ran
+                # at: gamma-doubling reruns each pay a full exchange
+                # (review r8)
+                B_att = self._a2a_bucket(C, FC) \
+                    if self.exchange == "a2a" else 0
+                tel.counter("mesh.exchange_bytes", self._exchange_bytes(
+                    C, B_att,
+                    self._a2a_spill_bucket(B_att) if B_att else 0))
                 (seen2_, seen_cnt, front_rows, front_cnt, front_src,
                  tot_gen, tot_new, dead_local, dead_slot, assert_local,
                  asrt_a, asrt_f, any_ovf, inv_which, inv_slot,
-                 tot_front, a2a_ovf) = outs[:17]
+                 tot_front, a2a_ovf, tot_spill) = outs[:18]
                 if self.exchange == "a2a" and \
                         bool(np.asarray(a2a_ovf)[0]):
-                    # hash skew exceeded the per-peer bucket: rerun the
-                    # level with doubled capacity factor (inputs are
-                    # untouched — the step is functional)
+                    # hash skew exceeded the per-peer bucket AND the
+                    # spill pass: rerun the level with doubled capacity
+                    # factor (inputs are untouched — the step is
+                    # functional)
                     self._a2a_gamma *= 2
-                    self.log(f"-- mesh: a2a bucket overflow, gamma -> "
-                             f"{self._a2a_gamma}")
+                    self.log(f"-- mesh: a2a bucket+spill overflow, "
+                             f"gamma -> {self._a2a_gamma}")
                     continue
                 seen = seen2_
                 break
+            self._spill_rows += int(np.asarray(tot_spill)[0])
 
             ovc = int(np.asarray(any_ovf)[0])
             if ovc:
@@ -678,13 +1446,13 @@ class MeshExplorer(TpuExplorer):
                 # gather mode replicates it on every device (read device
                 # 0); a2a routes disjoint buckets (concatenate all)
                 if self.exchange == "a2a":
-                    ecand = np.asarray(outs[17]).reshape(-1, self.PW)
-                    eexp = np.asarray(outs[18]).reshape(-1)
-                    esrc = np.asarray(outs[19]).reshape(-1)
+                    ecand = np.asarray(outs[18]).reshape(-1, self.PW)
+                    eexp = np.asarray(outs[19]).reshape(-1)
+                    esrc = np.asarray(outs[20]).reshape(-1)
                 else:
-                    ecand = np.asarray(outs[17][0])
-                    eexp = np.asarray(outs[18][0])
-                    esrc = np.asarray(outs[19][0])
+                    ecand = np.asarray(outs[18][0])
+                    eexp = np.asarray(outs[19][0])
+                    esrc = np.asarray(outs[20][0])
                 if self.refiners:
                     fr_np = np.asarray(frontier)
                     rv = self._mesh_refine_edges(fr_np, ecand, eexp,
@@ -703,6 +1471,9 @@ class MeshExplorer(TpuExplorer):
                       seen=int(seen_counts.sum()), devices=D,
                       wall_s=round(time.time() - lvl_t0, 6))
             self._fp_occupancy = int(seen_counts.sum())
+            if seen_counts.sum():
+                self._shard_balance = float(
+                    seen_counts.max() / (seen_counts.sum() / D))
             max_front = int(np.asarray(front_cnt).max(initial=0))
             # device->host frontier copies only when something needs
             # them (tracing, a violation to localize, or FC regrowth):
@@ -826,14 +1597,22 @@ class MeshExplorer(TpuExplorer):
         return self._mk(True, distinct, generated, depth - 1, t0, warnings)
 
     def _mk(self, ok, distinct, generated, diameter, t0, warnings,
-            violation=None, truncated=False):
+            violation=None, truncated=False, drained=False):
         tel = obs.current()
         tel.high_water("device.mem_high_water_bytes",
                        obs.device_mem_high_water())
         occ = getattr(self, "_fp_occupancy", None)
         if occ is not None:
             tel.gauge("fingerprint.occupancy", occ)
+        if self.exchange == "a2a":
+            tel.gauge("mesh.a2a_gamma", round(self._a2a_gamma, 4))
+            tel.gauge("mesh.a2a_spill", self._spill_rows)
+            if self._max_bucket:
+                tel.gauge("mesh.a2a_max_bucket", self._max_bucket)
+        if self._shard_balance is not None:
+            tel.gauge("mesh.shard_balance",
+                      round(self._shard_balance, 4))
         return CheckResult(ok=ok, distinct=distinct, generated=generated,
                            diameter=max(diameter, 0), violation=violation,
                            wall_s=time.time() - t0, truncated=truncated,
-                           warnings=warnings)
+                           warnings=warnings, drained=drained)

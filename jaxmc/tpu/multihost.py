@@ -3,7 +3,9 @@ communication backend".
 
 The single-controller MeshExplorer shards over the devices of ONE
 process. This module runs the SAME sharded level step (mesh.py
-_get_mesh_step — compiled kernels, all_gather exchange, fp128
+_get_mesh_step — compiled kernels, gather exchange by default — this
+fixed-capacity loop cannot re-run a level on an a2a bucket overflow,
+JAXMC_MESH_EXCHANGE overrides — fp128
 hash-partitioned seen shards, psum'd totals) over a mesh that spans
 SEVERAL jax processes, the way a TPU pod spans hosts: each process
 contributes its local devices, `jax.distributed.initialize` wires the
@@ -97,8 +99,16 @@ def run_multihost_child(process_id: int, num_processes: int,
         parse_cfg(open(cfg).read()))
 
     # the compile pipeline is process-local and deterministic: both
-    # processes build byte-identical kernels and step programs
-    me = MeshExplorer(model, mesh=mesh, store_trace=False)
+    # processes build byte-identical kernels and step programs.
+    # Exchange stays GATHER here even though a2a is the D>1 default
+    # (ISSUE 8): this fixed-capacity multi-controller loop cannot
+    # re-run a level, so an a2a bucket+spill overflow would abort a
+    # run the gather exchange completes — JAXMC_MESH_EXCHANGE still
+    # overrides for pods whose skew envelope is known.
+    exchange = os.environ.get("JAXMC_MESH_EXCHANGE", "").strip() \
+        or "gather"
+    me = MeshExplorer(model, mesh=mesh, store_trace=False,
+                      exchange=exchange)
     W, K = me.W, me.K
 
     # init states: identical host computation on every process (the
@@ -194,8 +204,10 @@ def run_multihost_child(process_id: int, num_processes: int,
         (seen, _seen_cnt, frontier, fcount, tot_gen, tot_new,
          any_ovf, tot_front, fixed_ovf, any_inv, any_dead,
          any_assert) = outs[:12]
+        # index 20 is the psum'd a2a spill-row count (ISSUE 8): rows
+        # drained by the second all_to_all pass instead of aborting
         (front_src, inv_which, inv_slot, dead_local, dead_slot,
-         assert_bad, asrt_a, asrt_f) = outs[12:]
+         assert_bad, asrt_a, asrt_f) = outs[12:20]
         ovc = _local_scalar(any_ovf)  # 0 = none, else max kernel2.OV_*
         if ovc:
             from ..compile.kernel2 import OV_DEMOTED, OV_PACK

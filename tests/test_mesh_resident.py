@@ -1,0 +1,353 @@
+r"""Mesh-resident sharded BFS (ISSUE 8): owner-routed a2a dedup with no
+per-level host round-trip.
+
+Pins, on repo-local models only (no reference corpus needed):
+  * a2a is the DEFAULT exchange for D > 1 (JAXMC_MESH_EXCHANGE
+    overrides);
+  * the resident loop reads ONE scalar vector per level —
+    mesh.host_syncs == level-record count, no row traffic;
+  * a second run on a warm engine has window_recompiles == 0, and a
+    FRESH engine starting from the persisted (module, layout, D,
+    exchange) capacity profile compiles exactly once with zero
+    growth redos;
+  * checkpoint/resume parity under a2a at D=4 — truncation resume and
+    a SIGKILL mid-run (chaos) both finish with totals and traces
+    bit-identical to the uninterrupted run;
+  * the mesh_skew fault forces every state onto shard 0: the spill
+    pass drains the overflow and counts/traces stay exact.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+import pytest
+
+from jaxmc.front.cfg import ModelConfig, parse_cfg
+from jaxmc.sem.modules import Loader, bind_model
+
+SPECS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "specs")
+REPO = os.path.dirname(SPECS)
+
+
+def load(name, cfg_name=None, no_deadlock=False):
+    p = os.path.join(SPECS, name + ".tla")
+    m = Loader([SPECS]).load_path(p)
+    if cfg_name is None and os.path.exists(
+            os.path.join(SPECS, name + ".cfg")):
+        cfg_name = name
+    if cfg_name:
+        cfg = parse_cfg(open(os.path.join(SPECS,
+                                          cfg_name + ".cfg")).read())
+    else:
+        cfg = ModelConfig(specification="Spec")
+    if no_deadlock:
+        cfg.check_deadlock = False
+    return bind_model(m, cfg)
+
+
+@pytest.fixture(autouse=True)
+def _no_profile_store(tmp_path, monkeypatch):
+    # isolate every test's capacity profiles (and keep the box-wide
+    # store out of the parity measurements)
+    monkeypatch.setenv("JAXMC_PROFILE_STORE", str(tmp_path / "prof"))
+
+
+def mesh4():
+    import jax
+    from jax.sharding import Mesh
+    return Mesh(np.array(jax.devices()[:4]), ("d",))
+
+
+class TestExchangeDefault:
+    def test_a2a_default_for_multidevice(self):
+        from jaxmc.tpu.mesh import MeshExplorer
+        me = MeshExplorer(load("constoy"))
+        assert me.D > 1 and me.exchange == "a2a"
+        assert me._exchange_src == "default"
+
+    def test_env_override(self, monkeypatch):
+        monkeypatch.setenv("JAXMC_MESH_EXCHANGE", "gather")
+        from jaxmc.tpu.mesh import MeshExplorer
+        me = MeshExplorer(load("constoy"))
+        assert me.exchange == "gather"
+        assert me._exchange_src == "JAXMC_MESH_EXCHANGE"
+
+    def test_explicit_arg_beats_env(self, monkeypatch):
+        monkeypatch.setenv("JAXMC_MESH_EXCHANGE", "gather")
+        from jaxmc.tpu.mesh import MeshExplorer
+        me = MeshExplorer(load("constoy"), exchange="a2a")
+        assert me.exchange == "a2a"
+
+    def test_single_device_defaults_gather(self):
+        import jax
+        from jax.sharding import Mesh
+        from jaxmc.tpu.mesh import MeshExplorer
+        me = MeshExplorer(load("constoy"),
+                          mesh=Mesh(np.array(jax.devices()[:1]),
+                                    ("d",)))
+        assert me.exchange == "gather"
+
+
+class TestResidentLoop:
+    def test_host_syncs_equals_levels_and_scalars_only(self):
+        from jaxmc import obs
+        from jaxmc.tpu.mesh import MeshExplorer
+        from jaxmc.engine.explore import Explorer
+        ri = Explorer(load("constoy")).run()
+        tel = obs.Telemetry()
+        with obs.use(tel):
+            me = MeshExplorer(load("constoy"), exchange="a2a")
+            r = me.run()
+        assert (r.generated, r.distinct, r.ok) == \
+            (ri.generated, ri.distinct, ri.ok)
+        # one scalar read per level record; clean run pulls NO rows
+        assert tel.counters["mesh.host_syncs"] == len(tel.levels)
+        assert "mesh.row_syncs" not in tel.counters
+        assert tel.counters["mesh.exchange_bytes"] > 0
+        assert tel.gauges["mesh.exchange"] == "a2a"
+        assert tel.gauges["mesh.shard_balance"] >= 1.0
+
+    def test_second_run_zero_window_recompiles(self):
+        from jaxmc import obs
+        from jaxmc.tpu.mesh import MeshExplorer
+        tel = obs.Telemetry()
+        with obs.use(tel):
+            me = MeshExplorer(load("constoy"), exchange="a2a")
+            r1 = me.run()
+            lvl0 = len(tel.levels)
+            r2 = me.run()
+        fresh = sum(1 for lv in tel.levels[lvl0:]
+                    if lv.get("fresh_compile"))
+        assert fresh == 0
+        assert (r2.generated, r2.distinct) == (r1.generated, r1.distinct)
+
+    def test_profile_warms_a_fresh_engine(self):
+        # run 1 persists the (module, layout_sig, D, exchange) profile;
+        # a FRESH engine loads it, compiles exactly once, never grows
+        from jaxmc import obs
+        from jaxmc.tpu.mesh import MeshExplorer
+        MeshExplorer(load("viewtoy"), exchange="a2a").run()
+        tel = obs.Telemetry()
+        with obs.use(tel):
+            me = MeshExplorer(load("viewtoy"), exchange="a2a")
+            assert me._mesh_caps_hint, "profile did not load"
+            me.run()
+        assert sum(1 for lv in tel.levels
+                   if lv.get("fresh_compile")) == 1
+        assert not any(lv.get("redo") for lv in tel.levels)
+
+    def test_profile_is_keyed_by_device_count(self):
+        from jaxmc.compile.cache import profile_path
+        p4 = profile_path("m", "sig", variant="mesh-d4-a2a")
+        p8 = profile_path("m", "sig", variant="mesh-d8-a2a")
+        assert p4 != p8
+
+    def test_gather_and_a2a_bit_identical(self):
+        from jaxmc.tpu.mesh import MeshExplorer
+        rg = MeshExplorer(load("constoy"), exchange="gather").run()
+        ra = MeshExplorer(load("constoy"), exchange="a2a").run()
+        assert (rg.generated, rg.distinct, rg.ok) == \
+            (ra.generated, ra.distinct, ra.ok)
+
+    def test_d4_counts_and_view_symmetry_parity(self):
+        from jaxmc.engine.explore import Explorer
+        from jaxmc.tpu.mesh import MeshExplorer
+        for name, kw in (("viewtoy", {}),
+                         ("symtoy", dict(no_deadlock=True))):
+            ri = Explorer(load(name, **kw)).run()
+            r = MeshExplorer(load(name, **kw), mesh=mesh4(),
+                             exchange="a2a").run()
+            assert (r.generated, r.distinct, r.ok) == \
+                (ri.generated, ri.distinct, ri.ok), name
+
+    def test_violation_trace_parity_with_hostloop(self):
+        # the resident loop and the legacy host loop must report the
+        # SAME counterexample (rows ride the device ring vs per-level
+        # host pulls — one provenance contract)
+        from jaxmc.tpu.mesh import MeshExplorer
+        r_res = MeshExplorer(load("pcal_intro_buggy"),
+                             exchange="a2a").run()
+        os.environ["JAXMC_MESH_RESIDENT"] = "0"
+        try:
+            r_host = MeshExplorer(load("pcal_intro_buggy"),
+                                  exchange="a2a").run()
+        finally:
+            os.environ.pop("JAXMC_MESH_RESIDENT", None)
+        assert not r_res.ok and not r_host.ok
+        assert r_res.violation.kind == r_host.violation.kind == "assert"
+        assert [s for s, _ in r_res.violation.trace] == \
+            [s for s, _ in r_host.violation.trace]
+        assert [a for _, a in r_res.violation.trace] == \
+            [a for _, a in r_host.violation.trace]
+
+
+class TestCheckpointResume:
+    def test_truncate_resume_parity_a2a_d4(self, tmp_path):
+        from jaxmc.tpu.mesh import MeshExplorer
+        ck = str(tmp_path / "mesh.ck")
+        r1 = MeshExplorer(load("pcal_intro_buggy"), mesh=mesh4(),
+                          exchange="a2a", max_states=20,
+                          checkpoint_path=ck,
+                          checkpoint_every=0).run()
+        assert r1.truncated and os.path.exists(ck)
+        r2 = MeshExplorer(load("pcal_intro_buggy"), mesh=mesh4(),
+                          exchange="a2a", resume_from=ck).run()
+        rd = MeshExplorer(load("pcal_intro_buggy"), mesh=mesh4(),
+                          exchange="a2a").run()
+        assert (r2.ok, r2.violation.kind) == (rd.ok, rd.violation.kind)
+        assert [s for s, _ in r2.violation.trace] == \
+            [s for s, _ in rd.violation.trace]
+
+    @pytest.mark.chaos
+    @pytest.mark.slow
+    def test_kill_resume_parity_a2a_d4(self, tmp_path):
+        # SIGKILL the run mid-search (run_kill fault at the mesh
+        # engine's level boundary), resume from its checkpoint, and
+        # require bit-identical totals + trace vs an uninterrupted run
+        from jaxmc import faults
+        from jaxmc.tpu.mesh import MeshExplorer
+        ck = str(tmp_path / "mesh_kill.ck")
+        code = f"""
+import os
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=4")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import sys
+sys.path.insert(0, {REPO!r})
+from jaxmc.front.cfg import ModelConfig
+from jaxmc.sem.modules import Loader, bind_model
+from jaxmc.tpu.mesh import MeshExplorer
+m = bind_model(Loader([{SPECS!r}]).load_path(
+    os.path.join({SPECS!r}, "pcal_intro_buggy.tla")),
+    ModelConfig(specification="Spec"))
+MeshExplorer(m, exchange="a2a", checkpoint_path={ck!r},
+             checkpoint_every=0).run()
+"""
+        env = dict(os.environ, PYTHONPATH=REPO,
+                   JAXMC_FAULTS="run_kill:level=3:engine=mesh",
+                   JAXMC_PROFILE_STORE=str(tmp_path / "prof"))
+        env.pop("JAXMC_FAULTS_STATE", None)
+        p = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True, env=env,
+                           timeout=600)
+        assert p.returncode == -9, (p.returncode, p.stderr[-500:])
+        assert os.path.exists(ck), "no checkpoint before the kill"
+        faults.reset_for_tests()
+        r2 = MeshExplorer(load("pcal_intro_buggy"), mesh=mesh4(),
+                          exchange="a2a", resume_from=ck).run()
+        rd = MeshExplorer(load("pcal_intro_buggy"), mesh=mesh4(),
+                          exchange="a2a").run()
+        assert (r2.ok, r2.violation.kind, r2.generated, r2.distinct) \
+            == (rd.ok, rd.violation.kind, rd.generated, rd.distinct)
+        assert [s for s, _ in r2.violation.trace] == \
+            [s for s, _ in rd.violation.trace]
+
+
+class TestForcedSpill:
+    def test_skew_routes_everything_to_shard_zero(self, monkeypatch):
+        from jaxmc import faults
+        monkeypatch.setenv("JAXMC_FAULTS", "mesh_skew")
+        faults.reset_for_tests()
+        from jaxmc.tpu.mesh import MeshExplorer
+        from jaxmc.engine.explore import Explorer
+        ri = Explorer(load("constoy")).run()
+        me = MeshExplorer(load("constoy"), exchange="a2a")
+        assert me._skew
+        keys = np.arange(40, dtype=np.int32).reshape(8, 5)
+        assert (me._owner_from_keys(keys) == 0).all()
+        r = me.run()
+        assert (r.generated, r.distinct, r.ok) == \
+            (ri.generated, ri.distinct, ri.ok)
+        faults.reset_for_tests()
+
+    def test_forced_spill_parity(self, monkeypatch):
+        # two passes: measure the peak per-destination bucket under
+        # skew, then pin FC and size gamma so the peak level lands in
+        # the SPILL window (B < need <= B+SB) — the spill pass must
+        # drain it with counts and trace bit-identical to the
+        # spill-free skewed run
+        from jaxmc import faults, obs
+        from jaxmc.tpu.mesh import MeshExplorer
+        monkeypatch.setenv("JAXMC_FAULTS", "mesh_skew:n=3")
+        faults.reset_for_tests()
+        tel = obs.Telemetry()
+        with obs.use(tel):
+            m1 = MeshExplorer(load("pcal_intro_buggy"), exchange="a2a")
+            assert m1._skew
+            r1 = m1.run()
+        assert m1._spill_rows == 0  # generous gamma: no spill yet
+        lv = [(r["max_bucket"], r["fc"]) for r in tel.levels
+              if r.get("max_bucket")]
+        fcmax = max(fc for _, fc in lv)
+        mb = max(v for v, _ in lv)
+        D, A = m1.D, m1.A
+        m2 = MeshExplorer(load("pcal_intro_buggy"), exchange="a2a",
+                          mesh_caps={"SC": 1 << 15, "FC": fcmax,
+                                     "TRL": 16, "GAM16": 1})
+        assert m2._skew
+        m2._a2a_gamma = (mb - 1) * D / (A * fcmax)
+        r2 = m2.run()
+        assert m2._spill_rows > 0, "spill pass never drained a row"
+        assert (r2.ok, r2.violation.kind) == (r1.ok, r1.violation.kind)
+        assert [s for s, _ in r2.violation.trace] == \
+            [s for s, _ in r1.violation.trace]
+        faults.reset_for_tests()
+
+
+class TestEdgeStream:
+    def test_gather_edge_stream_covers_foreign_owned_rows(self):
+        # regression (review r8): the legacy gather step's host-side
+        # edge stream is read from DEVICE 0 ONLY — its explore mask
+        # must cover every valid exchanged candidate, not just the
+        # rows device 0 happens to own (recomputing validity from the
+        # ownership-masked keys dropped ~(D-1)/D of the edges, which
+        # would silently skip refinement/liveness checks on them)
+        import time as _t
+        import jax.numpy as jnp
+        from jaxmc.tpu.mesh import MeshExplorer
+        me = MeshExplorer(load("viewtoy_scaled"), exchange="gather")
+        me.collect_edges = True   # forces the edge-stream outputs
+        init_rows, explored, n_init, err = me._prepare_init(
+            _t.time(), [])
+        assert err is None
+        D, SC, FC = me.D, 256, 64
+        seen, frontier, fcount = me._init_shards(
+            init_rows, explored, D, SC, FC)
+        step = me._get_mesh_step(SC, FC)
+        outs = step(jnp.asarray(seen), jnp.asarray(frontier),
+                    jnp.asarray(fcount))
+        tot_gen = int(np.asarray(outs[5])[0])
+        assert tot_gen > me.D  # wide enough to spread over shards
+        eexp0 = np.asarray(outs[19][0])
+        assert int(eexp0.sum()) == tot_gen
+
+
+class TestMeshbenchChild:
+    def test_child_leg_constoy_d2(self, tmp_path):
+        out = str(tmp_path / "leg.json")
+        env = dict(os.environ, PYTHONPATH=REPO,
+                   JAXMC_PROFILE_STORE=str(tmp_path / "prof"))
+        p = subprocess.run(
+            [sys.executable, "-m", "jaxmc.meshbench", "child",
+             "--spec", "specs/constoy.tla", "--devices", "2",
+             "--timed", "--metrics-out", out],
+            capture_output=True, text=True, cwd=REPO, env=env,
+            timeout=600)
+        assert p.returncode == 0, p.stderr[-800:]
+        line = [l for l in p.stdout.splitlines()
+                if l.startswith("MESHBENCH_RESULT ")][0]
+        r = json.loads(line[len("MESHBENCH_RESULT "):])
+        assert r["ok"] and r["devices"] == 2
+        assert (r["generated"], r["distinct"]) == (43, 21)
+        assert r["window_recompiles"] == 0       # warm timed window
+        assert r["host_syncs"] == r["levels"]    # scalars only
+        assert r["exchange"] == "a2a"
+        art = json.load(open(out))
+        assert art["schema"] == "jaxmc.metrics/2"
+        assert art["multichip"]["devices"] == 2
