@@ -51,6 +51,26 @@ check-corpus:
 test:
 	$(PY) -m pytest tests/ -q
 
+# static analysis gates (ISSUE 9) — both run inside `make bench-check`:
+#   lint-corpus  the TLA+ corpus linter over every manifest pair; the
+#                repo-local pairs must be clean modulo explicit waivers
+#                (corpus.py Case.lint_waive), the linttoy fixture must
+#                produce every expected diagnostic class, and
+#                reference-rooted pairs SKIP (parseably) when
+#                /root/reference is absent
+#   pylint       Python-side static analysis of jaxmc itself — ruff
+#                (pyflakes+bugbear, see ruff.toml) when the host has
+#                it, else the builtin checker in jaxmc/analyze/pylint.py
+lint-corpus:
+	$(PY) -m jaxmc.analyze lint-corpus
+
+pylint:
+	@if command -v ruff >/dev/null 2>&1; then \
+	  ruff check jaxmc; \
+	else \
+	  $(PY) -m jaxmc.analyze pylint jaxmc; \
+	fi
+
 # fault-injection smoke suite (ISSUE 4): every chaos-marked test — the
 # JAXMC_FAULTS harness killing pool workers, corrupting checkpoints,
 # failing device init, SIGKILLing whole runs mid-level — on the CPU
@@ -167,6 +187,12 @@ bench-check:
 	# runs must match the manifest pins bit-for-bit — see
 	# multichip-check below
 	$(MAKE) multichip-check
+	# static-analysis legs (ISSUE 9): an analyzer regression gates the
+	# same way perf regressions do — the corpus must stay lint-clean
+	# (modulo manifest waivers) and jaxmc's own Python must stay free
+	# of dead imports/locals
+	$(MAKE) lint-corpus
+	$(MAKE) pylint
 
 # multi-chip parity gate (ISSUE 8): the mesh-resident engine
 # (owner-routed a2a dedup, seen shards + frontier + trace ring on
@@ -225,4 +251,4 @@ native:
 
 .PHONY: all check check-corpus test chaos bench bench-warm bench-tlc \
         pin-si-env bench-check bench-check-reset serve serve-check \
-        multichip-check multichip-bench native
+        multichip-check multichip-bench native lint-corpus pylint

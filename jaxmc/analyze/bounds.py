@@ -1,0 +1,1032 @@
+r"""Static bounds/type inference over the TLA+ AST (ISSUE 9 tentpole).
+
+Abstract interpretation on an interval/type lattice: starting from the
+cfg-bound CONSTANT values and Init's assignments, the analyzer walks the
+next-state relation the way sem/enumerate.Walker does — conjunction
+threads abstract assignments, disjunction joins, `v' = e` assigns an
+abstract evaluation of e, `v' \in S` assigns S's element abstraction,
+guards REFINE the pre-state intervals — and iterates to a fixpoint over
+the transition relation, widening to ±inf when an interval keeps
+growing.  The result is a per-variable summary interval covering every
+integer scalar component the encoded value can hold.
+
+Soundness contract (what compile/pack.py relies on): a variable's
+summary must contain every int that can appear in ANY row the engines
+encode — reachable states, their raw successors (CONSTRAINT-violating
+candidates are fingerprinted before being discarded, so post-states are
+NOT refined by constraints), and layout-sampler rows.  Anything the
+abstract evaluator does not model precisely evaluates to TOP, and a
+budget/branch-cap breach abandons the whole proof (returns no bounds)
+rather than guessing.  Statically-proven lanes additionally keep the
+runtime OV_PACK guard as a safety net — if a proof were ever wrong the
+engine aborts exactly (naming the analyzer), never miscounts.
+
+The same machinery answers the linter's dead-action question: an action
+arm whose guards are definitely false under the fixpoint env can never
+fire (analyze/lint.py JMC202).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..front import tla_ast as A
+from ..sem.values import Fcn, InfiniteSet, ModelValue
+
+# ---------------------------------------------------------------------------
+# interval lattice
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Iv:
+    """Integer interval; a None bound is ±infinity."""
+    lo: Optional[int]
+    hi: Optional[int]
+
+    def join(self, o: "Iv") -> "Iv":
+        lo = None if (self.lo is None or o.lo is None) \
+            else min(self.lo, o.lo)
+        hi = None if (self.hi is None or o.hi is None) \
+            else max(self.hi, o.hi)
+        return Iv(lo, hi)
+
+    def bounded(self) -> bool:
+        return self.lo is not None and self.hi is not None
+
+
+TOP = Iv(None, None)
+
+
+def _add(a, b):
+    return None if a is None or b is None else a + b
+
+
+def _neg(a):
+    return None if a is None else -a
+
+
+def iv_add(a: Iv, b: Iv) -> Iv:
+    return Iv(_add(a.lo, b.lo), _add(a.hi, b.hi))
+
+
+def iv_sub(a: Iv, b: Iv) -> Iv:
+    return Iv(_add(a.lo, _neg(b.hi)), _add(a.hi, _neg(b.lo)))
+
+
+def iv_mul(a: Iv, b: Iv) -> Iv:
+    if not (a.bounded() and b.bounded()):
+        return TOP
+    cands = [a.lo * b.lo, a.lo * b.hi, a.hi * b.lo, a.hi * b.hi]
+    return Iv(min(cands), max(cands))
+
+
+def iv_div(a: Iv, b: Iv) -> Iv:
+    # TLA \div on a positive divisor; anything else is TOP
+    if not (a.bounded() and b.bounded()) or b.lo is None or b.lo < 1:
+        return TOP
+    cands = []
+    for x in (a.lo, a.hi):
+        for y in (b.lo, b.hi):
+            cands += [x // y, -((-x) // y)]  # floor and trunc variants
+    return Iv(min(cands), max(cands))
+
+
+def iv_mod(a: Iv, b: Iv) -> Iv:
+    # TLA a % b with b > 0 always lands in [0, b-1]
+    if b.lo is not None and b.lo >= 1:
+        return Iv(0, None if b.hi is None else b.hi - 1)
+    return TOP
+
+
+# ---------------------------------------------------------------------------
+# abstract values
+# ---------------------------------------------------------------------------
+#
+# AV = ("int", Iv)          integer scalar
+#    | ("bool",)            boolean scalar
+#    | ("enum",)            string / model value scalar
+#    | ("set", elem|None)   set; elem abstracts every member (None: empty)
+#    | ("seq", elem|None)   sequence/tuple
+#    | ("fun", dom, rng)    function/record; dom/rng abstract keys/values
+#    | ("blob", Iv)         opaque value whose int components lie in Iv
+#
+# summary(AV) -> Iv | None: every integer scalar component anywhere in
+# the value (None = the value contains no ints).
+
+AV = Tuple
+INT_TOP = ("int", TOP)
+BOOL = ("bool",)
+ENUM = ("enum",)
+BLOB_TOP = ("blob", TOP)
+
+_MAX_DEPTH = 8
+
+
+def summary(av: Optional[AV]) -> Optional[Iv]:
+    if av is None:
+        return TOP
+    k = av[0]
+    if k == "int":
+        return av[1]
+    if k in ("bool", "enum"):
+        return None
+    if k in ("set", "seq"):
+        return summary(av[1]) if av[1] is not None else None
+    if k == "fun":
+        return _sum_join(summary(av[1]), summary(av[2]))
+    if k == "blob":
+        return av[1]
+    return TOP
+
+
+def _sum_join(a: Optional[Iv], b: Optional[Iv]) -> Optional[Iv]:
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return a.join(b)
+
+
+def join(a: Optional[AV], b: Optional[AV], depth: int = 0) -> AV:
+    if a is None:
+        return b if b is not None else BLOB_TOP
+    if b is None:
+        return a
+    if depth > _MAX_DEPTH:
+        sa, sb = summary(a), summary(b)
+        s = _sum_join(sa, sb)
+        return ("blob", s) if s is not None else ENUM
+    ka, kb = a[0], b[0]
+    if ka == kb:
+        if ka == "int":
+            return ("int", a[1].join(b[1]))
+        if ka in ("bool", "enum"):
+            return a
+        if ka in ("set", "seq"):
+            if a[1] is None:
+                return b
+            if b[1] is None:
+                return a
+            return (ka, join(a[1], b[1], depth + 1))
+        if ka == "fun":
+            return ("fun", join(a[1], b[1], depth + 1),
+                    join(a[2], b[2], depth + 1))
+        if ka == "blob":
+            return ("blob", a[1].join(b[1]))
+    s = _sum_join(summary(a), summary(b))
+    return ("blob", s) if s is not None else ENUM
+
+
+def widen(new: AV, old: AV, depth: int = 0) -> AV:
+    """Widen `new` against the previous iterate `old`: any interval bound
+    that moved goes to infinity (guarantees fixpoint termination)."""
+    if depth > _MAX_DEPTH or new[0] != old[0]:
+        s = summary(new)
+        if s is None:
+            return new
+        so = summary(old)
+        lo = s.lo if (so is not None and so.lo is not None
+                      and s.lo is not None and s.lo >= so.lo) else None
+        hi = s.hi if (so is not None and so.hi is not None
+                      and s.hi is not None and s.hi <= so.hi) else None
+        return ("blob", Iv(lo, hi))
+    k = new[0]
+    if k == "int" or k == "blob":
+        ln, lo_ = new[1], old[1]
+        wlo = ln.lo if (lo_.lo is not None and ln.lo is not None
+                        and ln.lo >= lo_.lo) else None
+        whi = ln.hi if (lo_.hi is not None and ln.hi is not None
+                        and ln.hi <= lo_.hi) else None
+        return (k, Iv(wlo, whi))
+    if k in ("bool", "enum"):
+        return new
+    if k in ("set", "seq"):
+        if new[1] is None or old[1] is None:
+            return new
+        return (k, widen(new[1], old[1], depth + 1))
+    if k == "fun":
+        return ("fun", widen(new[1], old[1], depth + 1),
+                widen(new[2], old[2], depth + 1))
+    return new
+
+
+def lift_concrete(v: Any, depth: int = 0) -> AV:
+    """Abstract a concrete interpreter value (cfg constants, def
+    results)."""
+    if isinstance(v, bool):
+        return BOOL
+    if isinstance(v, int):
+        return ("int", Iv(v, v))
+    if isinstance(v, (str, ModelValue)):
+        return ENUM
+    if isinstance(v, InfiniteSet):
+        if v.kind == "Nat":
+            return ("set", ("int", Iv(0, None)))
+        if v.kind in ("Int", "Real"):
+            return ("set", INT_TOP)
+        if v.kind == "STRING":
+            return ("set", ENUM)
+        if v.kind == "Seq":
+            return ("set", ("seq", lift_concrete(v.param, depth + 1)
+                            if v.param is not None else BLOB_TOP))
+        return BLOB_TOP
+    if depth > _MAX_DEPTH:
+        return BLOB_TOP
+    if isinstance(v, frozenset):
+        elem = None
+        for x in list(v)[:4096]:
+            elem = join(elem, lift_concrete(x, depth + 1), depth)
+        return ("set", elem)
+    if isinstance(v, Fcn):
+        dom = rng = None
+        for k, val in list(v.d.items())[:4096]:
+            dom = join(dom, lift_concrete(k, depth + 1), depth)
+            rng = join(rng, lift_concrete(val, depth + 1), depth)
+        if dom is None:
+            return ("seq", None)
+        return ("fun", dom, rng if rng is not None else BLOB_TOP)
+    return BLOB_TOP
+
+
+def elem_opt(av: AV) -> Optional[AV]:
+    """Abstract element of a set/sequence-like value; None for a
+    definitely-empty container (the lattice bottom for elements)."""
+    if av[0] in ("set", "seq"):
+        return av[1]
+    if av[0] == "blob":
+        return av
+    return BLOB_TOP
+
+
+def elem_of(av: AV) -> AV:
+    e = elem_opt(av)
+    return e if e is not None else BLOB_TOP
+
+
+def join_opt(a: Optional[AV], b: Optional[AV]) -> Optional[AV]:
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return join(a, b)
+
+
+# ---------------------------------------------------------------------------
+# abstract expression evaluation
+# ---------------------------------------------------------------------------
+
+_CMP_OPS = {"<", ">", "<=", ">=", "=<", "\\leq", "\\geq"}
+_NORM = {"=<": "<=", "\\leq": "<=", "\\geq": ">=", "\\mod": "%", "#": "/="}
+
+
+def _norm(name: str) -> str:
+    return _NORM.get(name, name)
+
+
+class _Bail(Exception):
+    """Analysis abandoned (budget/branch cap/recursion) — no proof."""
+
+
+class AbsEval:
+    """Abstract evaluator + abstract transition walker for one model."""
+
+    def __init__(self, model, budget_s: float = 5.0):
+        self.model = model
+        self.vars = tuple(model.vars)
+        self.defs = model.defs
+        self.budget_s = budget_s
+        self.t0 = time.time()
+        self.branch_cap = int(os.environ.get("JAXMC_ANALYZE_BRANCH_CAP",
+                                             "768"))
+        self._branches = 0
+        self._const_cache: Dict[int, AV] = {}
+
+    def _tick(self):
+        if time.time() - self.t0 > self.budget_s:
+            raise _Bail("analysis budget exceeded")
+
+    # ---- expression evaluation ---------------------------------------
+    def eval(self, e: A.Node, env: Dict[str, AV], bound: Dict[str, Any],
+             primes: Dict[str, AV], stack: Tuple[str, ...] = ()) -> AV:
+        self._tick()
+        if isinstance(e, A.Num):
+            return ("int", Iv(e.val, e.val))
+        if isinstance(e, A.Bool):
+            return BOOL
+        if isinstance(e, A.Str):
+            return ENUM
+        if isinstance(e, A.Prime):
+            if isinstance(e.expr, A.Ident) and e.expr.name in self.vars:
+                return primes.get(e.expr.name, BLOB_TOP)
+            return BLOB_TOP
+        if isinstance(e, A.Ident):
+            return self._ident(e.name, env, bound, primes, stack)
+        if isinstance(e, A.OpApp):
+            return self._opapp(e, env, bound, primes, stack)
+        if isinstance(e, A.If):
+            return join(self.eval(e.then, env, bound, primes, stack),
+                        self.eval(e.els, env, bound, primes, stack))
+        if isinstance(e, A.Case):
+            out = None
+            for _g, b in e.arms:
+                out = join(out, self.eval(b, env, bound, primes, stack))
+            if e.other is not None:
+                out = join(out, self.eval(e.other, env, bound, primes,
+                                          stack))
+            return out if out is not None else BLOB_TOP
+        if isinstance(e, A.TupleExpr):
+            elem = None
+            for x in e.items:
+                elem = join(elem, self.eval(x, env, bound, primes, stack))
+            return ("seq", elem)
+        if isinstance(e, A.SetEnum):
+            elem = None
+            for x in e.items:
+                elem = join(elem, self.eval(x, env, bound, primes, stack))
+            return ("set", elem)
+        if isinstance(e, A.SetFilter):
+            return ("set", elem_opt(self.eval(e.set, env, bound, primes,
+                                              stack)))
+        if isinstance(e, A.SetMap):
+            b2 = dict(bound)
+            for names, sexpr in e.binders:
+                ev = elem_of(self.eval(sexpr, env, bound, primes, stack))
+                for nm in names:
+                    b2[nm] = ev
+            return ("set", self.eval(e.expr, env, b2, primes, stack))
+        if isinstance(e, A.FnDef):
+            b2 = dict(bound)
+            dom = None
+            for names, sexpr in e.binders:
+                ev = elem_of(self.eval(sexpr, env, bound, primes, stack))
+                dom = join(dom, ev)
+                for nm in names:
+                    b2[nm] = ev
+            return ("fun", dom if dom is not None else BLOB_TOP,
+                    self.eval(e.body, env, b2, primes, stack))
+        if isinstance(e, A.FnSet):
+            return ("set", ("fun",
+                            elem_of(self.eval(e.dom, env, bound, primes,
+                                              stack)),
+                            elem_of(self.eval(e.rng, env, bound, primes,
+                                              stack))))
+        if isinstance(e, A.RecordExpr):
+            rng = None
+            for _k, vex in e.fields:
+                rng = join(rng, self.eval(vex, env, bound, primes, stack))
+            return ("fun", ENUM, rng if rng is not None else BLOB_TOP)
+        if isinstance(e, A.RecordSet):
+            rng = None
+            for _k, sexpr in e.fields:
+                rng = join(rng, elem_of(self.eval(sexpr, env, bound,
+                                                  primes, stack)))
+            return ("set", ("fun", ENUM,
+                            rng if rng is not None else BLOB_TOP))
+        if isinstance(e, A.FnApp):
+            f = self.eval(e.fn, env, bound, primes, stack)
+            if f[0] == "fun":
+                return f[2]
+            if f[0] == "seq":
+                return f[1] if f[1] is not None else BLOB_TOP
+            if f[0] == "blob":
+                return f
+            return BLOB_TOP
+        if isinstance(e, A.Dot):
+            f = self.eval(e.expr, env, bound, primes, stack)
+            if f[0] == "fun":
+                return f[2]
+            if f[0] == "blob":
+                return f
+            return BLOB_TOP
+        if isinstance(e, A.Except):
+            f = self.eval(e.fn, env, bound, primes, stack)
+            acc = f
+            for _path, rhs in e.updates:
+                rv = self.eval(rhs, env, dict(bound, **{"@": elem_of(acc)
+                               if acc[0] in ("set", "seq")
+                               else (acc[2] if acc[0] == "fun" else acc)}),
+                               primes, stack)
+                if acc[0] == "fun":
+                    acc = ("fun", acc[1], join(acc[2], rv))
+                elif acc[0] == "seq":
+                    acc = ("seq", join(acc[1], rv))
+                else:
+                    s = _sum_join(summary(acc), summary(rv))
+                    acc = ("blob", s) if s is not None else acc
+            return acc
+        if isinstance(e, A.At):
+            at = bound.get("@")
+            return at if at is not None else BLOB_TOP
+        if isinstance(e, A.Quant):
+            return BOOL
+        if isinstance(e, A.Choose):
+            if e.set is not None:
+                return elem_of(self.eval(e.set, env, bound, primes,
+                                         stack))
+            return BLOB_TOP
+        if isinstance(e, A.Let):
+            b2 = dict(bound)
+            for d in e.defs:
+                if isinstance(d, A.OpDef):
+                    b2[d.name] = ("$closure", d.params, d.body)
+                elif isinstance(d, A.FnConstrDef):
+                    b2[d.name] = BLOB_TOP
+            return self.eval(e.body, env, b2, primes, stack)
+        if isinstance(e, (A.Unchanged, A.Enabled, A.Fair, A.BoxAction,
+                          A.AngleAction, A.TemporalQuant)):
+            return BOOL
+        return BLOB_TOP
+
+    def _ident(self, name, env, bound, primes, stack) -> AV:
+        if name in bound:
+            v = bound[name]
+            if isinstance(v, tuple) and v and v[0] == "$closure":
+                if v[1]:
+                    return BLOB_TOP
+                return self.eval(v[2], env, bound, primes, stack)
+            return v if isinstance(v, tuple) else lift_concrete(v)
+        if name in self.vars and name in env:
+            return env[name]
+        d = self.defs.get(name)
+        if d is None:
+            return BLOB_TOP
+        return self._def_value(name, d, env, bound, primes, stack)
+
+    def _def_value(self, name, d, env, bound, primes, stack) -> AV:
+        from ..sem.eval import OpClosure
+        if isinstance(d, OpClosure):
+            if d.params:
+                return BLOB_TOP  # operator used as a value
+            if name in stack or len(stack) > 48:
+                return BLOB_TOP  # recursion/depth: no proof through it
+            body = d.body
+            if isinstance(body, A.FnConstrDef):
+                return BLOB_TOP
+            return self.eval(body, env, dict(d.bound), primes,
+                             stack + (name,))
+        if not callable(d):
+            key = id(d)
+            av = self._const_cache.get(key)
+            if av is None:
+                av = lift_concrete(d)
+                self._const_cache[key] = av
+            return av
+        return BLOB_TOP
+
+    def _opapp(self, e: A.OpApp, env, bound, primes, stack) -> AV:
+        name = _norm(e.name)
+        if e.path:
+            return BLOB_TOP  # instance-qualified: unmodelled
+        args = e.args
+        if name in ("/\\", "\\/", "=>", "<=>", "~", "=", "/=", "\\in",
+                    "\\notin", "\\subseteq", "\\supseteq"):
+            return BOOL
+        if name in _CMP_OPS:
+            return BOOL
+        if name in ("+", "-", "*", "\\div", "/", "%"):
+            if name == "-" and len(args) == 1:
+                a = self._as_iv(args[0], env, bound, primes, stack)
+                return ("int", Iv(_neg(a.hi), _neg(a.lo)))
+            a = self._as_iv(args[0], env, bound, primes, stack)
+            b = self._as_iv(args[1], env, bound, primes, stack)
+            if name == "+":
+                return ("int", iv_add(a, b))
+            if name == "-":
+                return ("int", iv_sub(a, b))
+            if name == "*":
+                return ("int", iv_mul(a, b))
+            if name == "%":
+                return ("int", iv_mod(a, b))
+            return ("int", iv_div(a, b))
+        if name == "-." and len(args) == 1:
+            a = self._as_iv(args[0], env, bound, primes, stack)
+            return ("int", Iv(_neg(a.hi), _neg(a.lo)))
+        if name == "..":
+            a = self._as_iv(args[0], env, bound, primes, stack)
+            b = self._as_iv(args[1], env, bound, primes, stack)
+            return ("set", ("int", Iv(a.lo, b.hi)))
+        if name in ("\\cup", "\\union"):
+            return ("set", join_opt(
+                elem_opt(self.eval(args[0], env, bound, primes, stack)),
+                elem_opt(self.eval(args[1], env, bound, primes,
+                                   stack))))
+        if name in ("\\cap", "\\intersect", "\\"):
+            return ("set", elem_opt(self.eval(args[0], env, bound,
+                                              primes, stack)))
+        if name in ("Cardinality", "Len"):
+            return ("int", Iv(0, None))
+        if name == "SUBSET":
+            return ("set", ("set", elem_of(
+                self.eval(args[0], env, bound, primes, stack))))
+        if name == "UNION":
+            return ("set", elem_of(elem_of(
+                self.eval(args[0], env, bound, primes, stack))))
+        if name == "DOMAIN":
+            f = self.eval(args[0], env, bound, primes, stack)
+            if f[0] == "fun":
+                return ("set", f[1])
+            if f[0] == "seq":
+                return ("set", ("int", Iv(1, None)))
+            return ("set", ("blob", summary(f) or Iv(0, 0))) \
+                if summary(f) is not None else ("set", ENUM)
+        if name == "Append":
+            s = self.eval(args[0], env, bound, primes, stack)
+            x = self.eval(args[1], env, bound, primes, stack)
+            return ("seq", join_opt(elem_opt(s) if s[0] in ("seq", "set")
+                                    else s, x))
+        if name in ("Head", "Last"):
+            return elem_of(self.eval(args[0], env, bound, primes, stack))
+        if name in ("Tail", "SubSeq", "Front", "SelectSeq"):
+            s = self.eval(args[0], env, bound, primes, stack)
+            return s if s[0] == "seq" else ("seq", elem_of(s))
+        if name == "\\o":
+            return ("seq", join_opt(
+                elem_opt(self.eval(args[0], env, bound, primes, stack)),
+                elem_opt(self.eval(args[1], env, bound, primes,
+                                   stack))))
+        if name == "Seq":
+            return ("set", ("seq", elem_of(
+                self.eval(args[0], env, bound, primes, stack))))
+        if name in ("Min", "Max"):
+            a = self._as_iv(args[0], env, bound, primes, stack)
+            b = self._as_iv(args[1], env, bound, primes, stack)
+            return ("int", a.join(b))
+        # user-defined operator application
+        tgt = bound.get(name)
+        if isinstance(tgt, tuple) and tgt and tgt[0] == "$closure":
+            if len(tgt[1]) != len(args):
+                return BLOB_TOP
+            b2 = dict(bound)
+            for p, aex in zip(tgt[1], args):
+                b2[p] = self.eval(aex, env, bound, primes, stack)
+            return self.eval(tgt[2], env, b2, primes, stack)
+        from ..sem.eval import OpClosure
+        d = self.defs.get(name)
+        if isinstance(d, OpClosure) and d.params and \
+                len(d.params) == len(args):
+            if name in stack or len(stack) > 48:
+                return BLOB_TOP
+            b2 = dict(d.bound)
+            for p, aex in zip(d.params, args):
+                b2[p] = self.eval(aex, env, bound, primes, stack)
+            if isinstance(d.body, A.FnConstrDef):
+                return BLOB_TOP
+            return self.eval(d.body, env, b2, primes, stack + (name,))
+        return BLOB_TOP
+
+    def _as_iv(self, e, env, bound, primes, stack) -> Iv:
+        av = self.eval(e, env, bound, primes, stack)
+        if av[0] == "int":
+            return av[1]
+        s = summary(av)
+        return s if s is not None else TOP
+
+    # ---- guard refinement --------------------------------------------
+    def refine(self, e: A.Node, env: Dict[str, AV],
+               bound: Dict[str, Any]) -> Dict[str, AV]:
+        """Return env refined by guard e holding (pre-state vars only);
+        refinement is best-effort — returning env unchanged is sound."""
+        if isinstance(e, A.OpApp):
+            name = _norm(e.name)
+            if name == "/\\":
+                return self.refine(e.args[1],
+                                   self.refine(e.args[0], env, bound),
+                                   bound)
+            if name in ("<", "<=", ">", ">=", "="):
+                return self._refine_cmp(name, e.args[0], e.args[1], env,
+                                        bound)
+            if name == "\\in":
+                x, s = e.args
+                if isinstance(x, A.Ident) and x.name in self.vars \
+                        and x.name in env and env[x.name][0] == "int":
+                    sv = self.eval(s, env, bound, {})
+                    el = elem_of(sv)
+                    if el[0] == "int":
+                        cur = env[x.name][1]
+                        lo = cur.lo if el[1].lo is None else \
+                            (el[1].lo if cur.lo is None
+                             else max(cur.lo, el[1].lo))
+                        hi = cur.hi if el[1].hi is None else \
+                            (el[1].hi if cur.hi is None
+                             else min(cur.hi, el[1].hi))
+                        env = dict(env)
+                        env[x.name] = ("int", Iv(lo, hi))
+                return env
+        if isinstance(e, A.Ident):
+            from ..sem.eval import OpClosure
+            d = self.defs.get(e.name)
+            if isinstance(d, OpClosure) and not d.params \
+                    and e.name not in self.vars:
+                return self.refine(d.body, env, dict(d.bound))
+        return env
+
+    def _refine_cmp(self, op, l, r, env, bound) -> Dict[str, AV]:
+        def clamp(var, lo=None, hi=None):
+            nonlocal env
+            if var in self.vars and var in env and env[var][0] == "int":
+                cur = env[var][1]
+                nlo = cur.lo if lo is None else \
+                    (lo if cur.lo is None else max(cur.lo, lo))
+                nhi = cur.hi if hi is None else \
+                    (hi if cur.hi is None else min(cur.hi, hi))
+                env = dict(env)
+                env[var] = ("int", Iv(nlo, nhi))
+
+        def iv(e):
+            return self._as_iv(e, env, bound, {}, ())
+
+        # x op e  /  e op x
+        if isinstance(l, A.Ident):
+            b = iv(r)
+            if op == "<" and b.hi is not None:
+                clamp(l.name, hi=b.hi - 1)
+            elif op == "<=" and b.hi is not None:
+                clamp(l.name, hi=b.hi)
+            elif op == ">" and b.lo is not None:
+                clamp(l.name, lo=b.lo + 1)
+            elif op == ">=" and b.lo is not None:
+                clamp(l.name, lo=b.lo)
+            elif op == "=":
+                clamp(l.name, lo=b.lo, hi=b.hi)
+        if isinstance(r, A.Ident):
+            a = iv(l)
+            if op == "<" and a.lo is not None:
+                clamp(r.name, lo=a.lo + 1)
+            elif op == "<=" and a.lo is not None:
+                clamp(r.name, lo=a.lo)
+            elif op == ">" and a.hi is not None:
+                clamp(r.name, hi=a.hi - 1)
+            elif op == ">=" and a.hi is not None:
+                clamp(r.name, hi=a.hi)
+            elif op == "=":
+                clamp(r.name, lo=a.lo, hi=a.hi)
+        # x + y <= c  (CONSTRAINT shape, constoy): bound each addend by
+        # c - other.lo
+        if op in ("<", "<=") and isinstance(l, A.OpApp) \
+                and _norm(l.name) == "+" and len(l.args) == 2 \
+                and isinstance(l.args[0], A.Ident) \
+                and isinstance(l.args[1], A.Ident):
+            c = iv(r)
+            if c.hi is not None:
+                chi = c.hi - (1 if op == "<" else 0)
+                xn, yn = l.args[0].name, l.args[1].name
+                xv = env.get(xn, INT_TOP)
+                yv = env.get(yn, INT_TOP)
+                if yv[0] == "int" and yv[1].lo is not None:
+                    clamp(xn, hi=chi - yv[1].lo)
+                if xv[0] == "int" and xv[1].lo is not None:
+                    clamp(yn, hi=chi - xv[1].lo)
+        return env
+
+    # ---- abstract transition walker ----------------------------------
+    def walk(self, e: A.Node, env: Dict[str, AV], bound: Dict[str, Any],
+             partial: Dict[str, AV], mode: str,
+             stack: Tuple[str, ...] = ()) -> List[Tuple[Dict[str, AV],
+                                                        Dict[str, AV]]]:
+        """Abstract mirror of sem/enumerate.Walker.walk: returns a list
+        of (assignments, refined-env) branches.  A definitely-false
+        guard kills its branch; everything unmodelled keeps the branch
+        with TOP effects (sound)."""
+        self._tick()
+        self._branches += 1
+        if self._branches > self.branch_cap:
+            raise _Bail("branch cap exceeded")
+        from ..sem.eval import OpClosure
+        if isinstance(e, A.OpApp):
+            name = _norm(e.name)
+            if name == "/\\":
+                out = []
+                for p1, env1 in self.walk(e.args[0], env, bound, partial,
+                                          mode, stack):
+                    out.extend(self.walk(e.args[1], env1, bound, p1,
+                                         mode, stack))
+                return out
+            if name == "\\/":
+                out = []
+                for arm in e.args:
+                    out.extend(self.walk(arm, env, bound, dict(partial),
+                                         mode, stack))
+                return out
+            if name == "=":
+                tgt = self._target(e.args[0], mode, bound)
+                if tgt is not None:
+                    if tgt in partial:
+                        return [(partial, env)]
+                    rhs = self.eval(e.args[1], env, bound, partial,
+                                    stack)
+                    p2 = dict(partial)
+                    p2[tgt] = rhs
+                    return [(p2, env)]
+            if name == "\\in":
+                tgt = self._target(e.args[0], mode, bound)
+                if tgt is not None:
+                    if tgt in partial:
+                        return [(partial, env)]
+                    sv = self.eval(e.args[1], env, bound, partial, stack)
+                    p2 = dict(partial)
+                    p2[tgt] = elem_of(sv)
+                    return [(p2, env)]
+            # user operator expansion
+            tgt_d = bound.get(name)
+            if isinstance(tgt_d, tuple) and tgt_d and \
+                    tgt_d[0] == "$closure":
+                from ..front.subst import subst
+                if len(tgt_d[1]) != len(e.args) or name in stack \
+                        or len(stack) > 48:
+                    return [(partial, env)]
+                try:
+                    body = subst(tgt_d[2], dict(zip(tgt_d[1], e.args)))
+                except Exception:
+                    return [(partial, env)]
+                return self.walk(body, env, bound, partial, mode,
+                                 stack + (name,))
+            d = self.defs.get(name) if name not in bound else None
+            if isinstance(d, OpClosure) and d.params and \
+                    len(d.params) == len(e.args):
+                if name in stack or len(stack) > 48:
+                    return [(partial, env)]
+                from ..front.subst import subst
+                try:
+                    body = subst(d.body, dict(zip(d.params, e.args)))
+                except Exception:
+                    return [(partial, env)]
+                # call-by-name, like Walker: the substituted body carries
+                # the CALLER's arg ASTs, so it walks under the caller's
+                # binder env (module-level closures capture nothing)
+                return self.walk(body, env, {**d.bound, **bound},
+                                 partial, mode, stack + (name,))
+        elif isinstance(e, A.Ident):
+            d = bound.get(e.name)
+            if isinstance(d, tuple) and d and d[0] == "$closure" \
+                    and not d[1] and e.name not in stack \
+                    and len(stack) <= 48:
+                return self.walk(d[2], env, bound, partial, mode,
+                                 stack + (e.name,))
+            if not (isinstance(d, tuple) and d) and e.name not in bound:
+                dd = self.defs.get(e.name)
+                from ..sem.eval import OpClosure as OC
+                if isinstance(dd, OC) and not dd.params \
+                        and e.name not in self.vars \
+                        and e.name not in stack and len(stack) <= 48:
+                    return self.walk(dd.body, env,
+                                     {**bound, **dd.bound},
+                                     partial, mode,
+                                     stack + (e.name,))
+        elif isinstance(e, A.Quant):
+            if e.kind == "E":
+                b2 = dict(bound)
+                for names, sexpr in e.binders:
+                    if sexpr is None:
+                        for nm in names:
+                            b2[nm] = BLOB_TOP
+                        continue
+                    ev = elem_of(self.eval(sexpr, env, bound, partial,
+                                           stack))
+                    for nm in names:
+                        b2[nm] = ev
+                return self.walk(e.body, env, b2, dict(partial), mode,
+                                 stack)
+            # \A as a guard: fall through
+        elif isinstance(e, A.If):
+            out = self.walk(e.then, env, bound, dict(partial), mode,
+                            stack)
+            out += self.walk(e.els, env, bound, dict(partial), mode,
+                             stack)
+            return out
+        elif isinstance(e, A.Case):
+            out = []
+            for _g, b in e.arms:
+                out += self.walk(b, env, bound, dict(partial), mode,
+                                 stack)
+            if e.other is not None:
+                out += self.walk(e.other, env, bound, dict(partial),
+                                 mode, stack)
+            return out
+        elif isinstance(e, A.Let):
+            b2 = dict(bound)
+            for d in e.defs:
+                if isinstance(d, A.OpDef):
+                    b2[d.name] = ("$closure", d.params, d.body)
+                elif isinstance(d, A.FnConstrDef):
+                    b2[d.name] = BLOB_TOP
+            return self.walk(e.body, env, b2, partial, mode, stack)
+        elif isinstance(e, A.Unchanged):
+            p2 = dict(partial)
+            self._unchanged(e.expr, env, bound, p2)
+            return [(p2, env)]
+        elif isinstance(e, A.BoxAction):
+            out = self.walk(e.action, env, bound, dict(partial), mode,
+                            stack)
+            p2 = dict(partial)
+            self._unchanged(e.sub, env, bound, p2)
+            out.append((p2, env))
+            return out
+        elif isinstance(e, A.Bool):
+            return [(partial, env)] if e.val else []
+        # default: boolean guard — kill the branch only when DEFINITELY
+        # false, refine the env otherwise
+        verdict = self.guard_verdict(e, env, bound, partial, stack)
+        if verdict is False:
+            return []
+        return [(partial, self.refine(e, env, bound))]
+
+    def _target(self, e, mode, bound) -> Optional[str]:
+        if mode == "next":
+            if isinstance(e, A.Prime) and isinstance(e.expr, A.Ident) \
+                    and e.expr.name in self.vars:
+                return e.expr.name
+            return None
+        if isinstance(e, A.Ident) and e.name in self.vars \
+                and e.name not in bound:
+            return e.name
+        return None
+
+    def _unchanged(self, e, env, bound, partial) -> None:
+        from ..sem.eval import OpClosure
+        if isinstance(e, A.Ident):
+            if e.name in self.vars:
+                if e.name not in partial:
+                    partial[e.name] = env.get(e.name, BLOB_TOP)
+                return
+            d = self.defs.get(e.name)
+            if isinstance(d, OpClosure) and not d.params:
+                self._unchanged(d.body, env, bound, partial)
+            return
+        if isinstance(e, A.TupleExpr):
+            for x in e.items:
+                self._unchanged(x, env, bound, partial)
+
+    def guard_verdict(self, e, env, bound, primes,
+                      stack=()) -> Optional[bool]:
+        """True/False when the guard is decided under the abstract env,
+        None when unknown.  Only interval-decidable comparisons are
+        modelled — everything else is None (keep the branch)."""
+        if isinstance(e, A.Bool):
+            return e.val
+        if not isinstance(e, A.OpApp):
+            return None
+        name = _norm(e.name)
+        if name in ("<", "<=", ">", ">=") and len(e.args) == 2:
+            a = self._as_iv(e.args[0], env, bound, primes, stack)
+            b = self._as_iv(e.args[1], env, bound, primes, stack)
+            if name in (">", ">="):
+                a, b = b, a
+                name = {"<": "<", ">": "<", ">=": "<=", "<=": "<="}[name]
+            # now: a < b or a <= b
+            if name == "<":
+                if a.hi is not None and b.lo is not None \
+                        and a.hi < b.lo:
+                    return True
+                if a.lo is not None and b.hi is not None \
+                        and a.lo >= b.hi:
+                    return False
+            else:
+                if a.hi is not None and b.lo is not None \
+                        and a.hi <= b.lo:
+                    return True
+                if a.lo is not None and b.hi is not None \
+                        and a.lo > b.hi:
+                    return False
+            return None
+        if name == "/\\":
+            va = self.guard_verdict(e.args[0], env, bound, primes, stack)
+            vb = self.guard_verdict(e.args[1], env, bound, primes, stack)
+            if va is False or vb is False:
+                return False
+            if va is True and vb is True:
+                return True
+            return None
+        return None
+
+
+# ---------------------------------------------------------------------------
+# fixpoint driver
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class BoundsReport:
+    """The fixpoint result: per-variable abstract values + summaries."""
+    env: Dict[str, AV]
+    iterations: int
+    converged: bool
+    wall_s: float
+
+    def summaries(self) -> Dict[str, Iv]:
+        """var -> summary interval over every int component (only vars
+        whose summary exists — vars with no int components are absent)."""
+        out = {}
+        for v, av in self.env.items():
+            s = summary(av)
+            if s is not None:
+                out[v] = s
+        return out
+
+    def lane_bounds(self) -> Dict[str, Tuple[int, int]]:
+        """var -> (lo, hi) for vars with a FINITE proven int summary —
+        the shape compile/pack.py consumes as structural bounds.
+
+        A truncated (non-converged) fixpoint proves NOTHING: its
+        intervals only cover states reachable within max_iter abstract
+        steps, so consuming them would mislabel correct values as
+        analyzer bugs (OV_PACK) — no proofs in that case."""
+        if not self.converged:
+            return {}
+        out = {}
+        for v, s in self.summaries().items():
+            if s.bounded() and abs(s.lo) < 2 ** 31 and s.hi < 2 ** 31:
+                out[v] = (s.lo, s.hi)
+        return out
+
+
+def _join_env(a: Dict[str, AV], b: Dict[str, AV],
+              vars_) -> Dict[str, AV]:
+    return {v: join(a.get(v), b.get(v)) for v in vars_
+            if v in a or v in b}
+
+
+def infer_state_bounds(model, budget_s: Optional[float] = None
+                       ) -> Optional[BoundsReport]:
+    """Fixpoint interval inference for every state variable; returns
+    None when the analysis bails (budget, branch explosion, internal
+    error) — callers treat None as 'no proofs'."""
+    t0 = time.time()
+    if budget_s is None:
+        budget_s = float(os.environ.get("JAXMC_ANALYZE_BUDGET", "5"))
+    try:
+        ae = AbsEval(model, budget_s=budget_s)
+        # Init: abstract assignments from the initial predicate
+        init_branches = ae.walk(model.init, {}, {}, {}, "init")
+        env: Dict[str, AV] = {}
+        for p, _e in init_branches:
+            env = _join_env(env, p, model.vars)
+        for v in model.vars:
+            env.setdefault(v, BLOB_TOP)
+        max_iter = int(os.environ.get("JAXMC_ANALYZE_MAX_ITER", "64"))
+        widen_at = max(8, max_iter // 2)
+        converged = False
+        it = 0
+        for it in range(1, max_iter + 1):
+            ae._branches = 0
+            pre = dict(env)
+            # frontier states satisfy every CONSTRAINT; successors of
+            # refined pre-states are NOT re-refined (candidate rows are
+            # encoded before the constraint check discards them)
+            for _nm, cexpr in model.constraints:
+                pre = ae.refine(cexpr, pre, {})
+            new = dict(env)
+            for p, _e in ae.walk(model.next, pre, {}, {}, "next"):
+                post = {v: p.get(v, BLOB_TOP) for v in model.vars}
+                new = _join_env(new, post, model.vars)
+            if it >= widen_at:
+                new = {v: widen(new[v], env[v]) for v in model.vars}
+            if new == env:
+                converged = True
+                break
+            env = new
+        return BoundsReport(env=env, iterations=it, converged=converged,
+                            wall_s=time.time() - t0)
+    except _Bail:
+        return None
+    except RecursionError:
+        return None
+    except Exception:
+        # the analyzer must never break a build; no proof is always safe
+        if os.environ.get("JAXMC_DEBUG"):
+            raise
+        return None
+
+
+def dead_arms(model, arms, report: Optional[BoundsReport] = None
+              ) -> List[Tuple[int, str]]:
+    """Indices (+labels) of action arms that can NEVER fire: every
+    abstract branch of the arm dies on a definitely-false guard under
+    the fixpoint env.  Used by the linter (JMC202)."""
+    if report is None:
+        report = infer_state_bounds(model)
+    if report is None or not report.converged:
+        # a truncated fixpoint env is NOT an invariant: a guard that is
+        # false under it may hold in deeper states — no dead verdicts
+        return []
+    out = []
+    for i, arm in enumerate(arms):
+        try:
+            ae = AbsEval(model)
+            env = dict(report.env)
+            for _nm, cexpr in model.constraints:
+                env = ae.refine(cexpr, env, {})
+            branches = ae.walk(arm.expr, env, dict(arm.bound or {}), {},
+                               "next")
+            if not branches:
+                out.append((i, arm.label or "Next"))
+        except (_Bail, RecursionError):
+            continue
+        except Exception:
+            if os.environ.get("JAXMC_DEBUG"):
+                raise
+            continue
+    return out

@@ -71,7 +71,32 @@ def _run_check(args, tel, log, t0) -> int:
     from .engine.explore import format_trace
     from .session import CheckSession, SessionConfig
 
+    if args.analyze not in ("off", "warn", "strict"):
+        # argparse validates only user-typed values against choices —
+        # a typo'd JAXMC_ANALYZE env default must fail LOUDLY, not
+        # silently degrade a strict CI gate to warn
+        print(f"error: invalid --analyze/JAXMC_ANALYZE value "
+              f"{args.analyze!r} (expected off, warn or strict)",
+              file=sys.stderr)
+        _metrics_error(args, tel, f"invalid analyze mode {args.analyze!r}")
+        return 2
     sess = CheckSession(SessionConfig.from_args(args), tel=tel, log=log)
+    if args.analyze != "off":
+        # static analysis stage (ISSUE 9), BEFORE parse so a cfg defect
+        # that would make bind_model refuse still reports its full
+        # diagnostic list; strict mode refuses to go further
+        from .session import AnalyzeError
+        try:
+            for d in sess.analyze():
+                print(f"analyze: {d.render()}", file=sys.stderr)
+        except AnalyzeError as ex:
+            for d in ex.diagnostics:
+                print(f"analyze: {d.render()}", file=sys.stderr)
+            print(f"error: --analyze=strict refused the run ({ex}); "
+                  f"fix the spec/cfg or re-run with --analyze=warn",
+                  file=sys.stderr)
+            _metrics_error(args, tel, f"analyze strict: {ex}")
+            return 2
     if sess.parse() == "assumes":
         rc = sess.run_assumes()
         if args.metrics_out:
@@ -240,6 +265,19 @@ def main(argv=None) -> int:
                         "(env: JAXMC_COMPILE_CACHE)")
     c.add_argument("--no-deadlock", action="store_true",
                    help="disable deadlock checking")
+    c.add_argument("--analyze", choices=["off", "warn", "strict"],
+                   default=os.environ.get("JAXMC_ANALYZE", "off"),
+                   help="static analysis stage between parse and "
+                        "compile (ISSUE 9): lint the spec/cfg pair "
+                        "(unused defs/VARIABLEs/CONSTANTs, dead "
+                        "actions, cfg mismatches, symmetry hazards — "
+                        "stable JMC* codes). warn prints diagnostics "
+                        "on stderr and continues; strict exits 2 on "
+                        "any error diagnostic BEFORE compiling "
+                        "(env: JAXMC_ANALYZE). Bounds inference and "
+                        "demotion prediction are independent of this "
+                        "flag (JAXMC_ANALYZE_BOUNDS / "
+                        "JAXMC_ANALYZE_PREDICT, both default on)")
     c.add_argument("--no-device-fallback", action="store_true",
                    help="jax backend: exit on a terminal device failure "
                         "instead of falling back to the parallel CPU "

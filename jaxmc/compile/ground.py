@@ -10,13 +10,12 @@ raft.tla:482-493 shape: ~10 action families x parameter instantiations
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..front import tla_ast as A
-from ..sem.values import (EvalError, Fcn, InfiniteSet, ModelValue,
-                          enumerate_set, fmt, sort_key)
-from ..sem.eval import Ctx, OpClosure, eval_expr, iter_binders, bind_pattern
+from ..sem.values import EvalError, fmt
+from ..sem.eval import Ctx, OpClosure, eval_expr, iter_binders
 from ..sem.modules import Model
 
 

@@ -31,10 +31,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..front import tla_ast as A
-from ..sem.values import (EvalError, Fcn, InfiniteSet, ModelValue, fmt,
-                          in_set, mk_seq, sort_key, tla_eq)
-from ..sem.eval import Ctx, OpClosure, eval_expr, bind_pattern
-from ..sem.modules import Model, InstanceNamespace
+from ..sem.values import (EvalError, Fcn, InfiniteSet, ModelValue,
+                          in_set, sort_key, tla_eq)
+from ..sem.eval import OpClosure, bind_pattern
+from ..sem.modules import Model
 from .vspec import (Bounds, CompileError, EnumUniverse, SENTINEL_LANE, VS,
                     encode as vs_encode, merge as vs_merge)
 
@@ -2013,7 +2013,7 @@ def _sym_opapp2(e: A.OpApp, fr: Frame):
                 for c in itertools.combinations(ms, r):
                     out.append(frozenset(c))
             return frozenset(out)
-        raise CompileError("SUBSET of symbolic set")
+        raise CompileError(SUBSET_SYMBOLIC_MSG)
     if name == "UNION":
         s = sym_eval2(e.args[0], fr)
         if isinstance(s, frozenset):
@@ -2141,6 +2141,19 @@ class UnrollLimitError(CompileError):
     predicate) demotes whole, with the operator's name in the reason."""
 
 
+# shared demotion-reason wording (ISSUE 9): jaxmc/analyze/verdicts.py
+# predicts these demotions BEFORE any build, and the predicted verdict
+# must carry the exact string the build-time path reports — both sides
+# read the one constant, so the wording cannot diverge
+SUBSET_SYMBOLIC_MSG = "SUBSET of symbolic set"
+
+
+def unroll_limit_message(name: str, limit: int) -> str:
+    return (f"recursive operator {name} exceeds the compile-time "
+            f"unroll limit ({limit}; raise with JAXMC_OP_UNROLL_LIMIT) "
+            f"— its expansion diverges on symbolic arguments")
+
+
 class _op_unroll:
     """Same-name re-entry counter around user-operator expansion: trips
     BEFORE Python's recursion limit so a diverging RECURSIVE operator
@@ -2154,10 +2167,7 @@ class _op_unroll:
         depth = kc.op_depth.get(name, 0)
         if depth >= kc.op_unroll_limit:
             raise UnrollLimitError(
-                f"recursive operator {name} exceeds the compile-time "
-                f"unroll limit ({kc.op_unroll_limit}; raise with "
-                f"JAXMC_OP_UNROLL_LIMIT) — its expansion diverges on "
-                f"symbolic arguments")
+                unroll_limit_message(name, kc.op_unroll_limit))
         kc.op_depth[name] = depth + 1
 
     def __enter__(self):
@@ -2294,7 +2304,9 @@ class Layout2:
 
 
 def build_layout2(model: Model, sampled_states: List[Dict[str, Any]],
-                  bounds: Bounds) -> Layout2:
+                  bounds: Bounds,
+                  static_bounds: Optional[Dict[str, Tuple[int, int]]]
+                  = None) -> Layout2:
     from .vspec import (apply_bounds, collect_enums_from_value, infer)
     from .. import obs
     uni = EnumUniverse()
@@ -2327,7 +2339,7 @@ def build_layout2(model: Model, sampled_states: List[Dict[str, Any]],
             # a sampled state the merged layout cannot encode would have
             # failed the search anyway; the plan just profiles without it
             continue
-    lay.plan = build_lane_plan(lay, sample_rows)
+    lay.plan = build_lane_plan(lay, sample_rows, static_bounds)
     tel = obs.current()
     tel.gauge("layout.enum_universe", len(uni.values))
     tel.gauge("layout.samples", len(sampled_states))
@@ -2336,6 +2348,10 @@ def build_layout2(model: Model, sampled_states: List[Dict[str, Any]],
     tel.gauge("layout.pack_ratio",
               round(lay.plan.packed_width / max(lay.width, 1), 4))
     tel.gauge("layout.pack_guarded_lanes", lay.plan.guarded_lanes)
+    # statically-proven int lanes (ISSUE 9): previously observed-range
+    # guarded lanes whose width now comes from the bounds analyzer —
+    # read against layout.pack_guarded_lanes (the two are disjoint)
+    tel.gauge("analyze.proven_lanes", lay.plan.proven_lanes)
     return lay
 
 

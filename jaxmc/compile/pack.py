@@ -40,7 +40,7 @@ from __future__ import annotations
 
 import os
 from dataclasses import dataclass
-from typing import List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -59,12 +59,18 @@ class _LaneClass:
     """Admissible value range of one unpacked lane.
 
     lo/hi of None mean "no structural bound — profile from observed
-    rows and guard at runtime"."""
+    rows and guard at runtime".  `proven` marks a bound derived by the
+    static analyzer (jaxmc/analyze/bounds.py): packed at the proven
+    width with NO sampling margin, but keeping the runtime OV_PACK
+    check as a soundness net — a fired check names the analyzer, and
+    the recovery re-profile widens past it (observed ranges always
+    extend the bound at plan time)."""
     lo: Optional[int]
     hi: Optional[int]
     guarded: bool
     sent_ok: bool      # the lane can hold SENTINEL_LANE padding
     zero_pad: bool     # the lane can hold 0 padding
+    proven: bool = False
 
     def merge(self, other: "_LaneClass") -> "_LaneClass":
         lo = None if (self.lo is None or other.lo is None) \
@@ -73,17 +79,28 @@ class _LaneClass:
             else max(self.hi, other.hi)
         return _LaneClass(lo, hi, self.guarded or other.guarded,
                           self.sent_ok or other.sent_ok,
-                          self.zero_pad or other.zero_pad)
+                          self.zero_pad or other.zero_pad,
+                          self.proven or other.proven)
 
 
 def _walk(spec: VS, uni_n: int, zero_pad: bool, sent_ok: bool,
-          out: List[_LaneClass]) -> None:
-    """Emit one _LaneClass per lane, in exactly vspec.encode's order."""
+          out: List[_LaneClass],
+          static: Optional[Tuple[int, int]] = None) -> None:
+    """Emit one _LaneClass per lane, in exactly vspec.encode's order.
+
+    `static` is the variable's analyzer-proven summary interval (ISSUE
+    9): it covers EVERY integer scalar component anywhere in the value,
+    so it applies to each raw-int lane the walk reaches — those lanes
+    become proven-width instead of observed-range."""
     k = spec.kind
     if k == "justempty":
         return
     if k == "int":
-        out.append(_LaneClass(None, None, True, sent_ok, zero_pad))
+        if static is not None:
+            out.append(_LaneClass(static[0], static[1], True, sent_ok,
+                                  zero_pad, proven=True))
+        else:
+            out.append(_LaneClass(None, None, True, sent_ok, zero_pad))
     elif k == "bool":
         out.append(_LaneClass(0, 1, False, sent_ok, zero_pad))
     elif k == "enum":
@@ -91,12 +108,12 @@ def _walk(spec: VS, uni_n: int, zero_pad: bool, sent_ok: bool,
                               zero_pad))
     elif k == "fcn":
         for e in spec.elems:
-            _walk(e, uni_n, zero_pad, sent_ok, out)
+            _walk(e, uni_n, zero_pad, sent_ok, out, static)
     elif k == "seq":
         out.append(_LaneClass(0, spec.cap, False, sent_ok, zero_pad))
         for _ in range(spec.cap):
             # tail slots beyond the length are zero-padded
-            _walk(spec.elem, uni_n, True, sent_ok, out)
+            _walk(spec.elem, uni_n, True, sent_ok, out, static)
     elif k == "set":
         for _ in spec.dom:
             out.append(_LaneClass(0, 1, False, sent_ok, zero_pad))
@@ -104,12 +121,12 @@ def _walk(spec: VS, uni_n: int, zero_pad: bool, sent_ok: bool,
         out.append(_LaneClass(0, spec.cap, False, sent_ok, zero_pad))
         for _ in range(spec.cap):
             # slots beyond the cardinality are SENTINEL-padded
-            _walk(spec.elem, uni_n, zero_pad, True, out)
+            _walk(spec.elem, uni_n, zero_pad, True, out, static)
     elif k == "pfcn":
         for _kk, e in zip(spec.dom, spec.elems):
             out.append(_LaneClass(0, 1, False, sent_ok, zero_pad))
             # absent keys zero their value lanes
-            _walk(e, uni_n, True, sent_ok, out)
+            _walk(e, uni_n, True, sent_ok, out, static)
     elif k == "union":
         out.append(_LaneClass(0, max(len(spec.variants) - 1, 0), False,
                               sent_ok, zero_pad))
@@ -121,15 +138,15 @@ def _walk(spec: VS, uni_n: int, zero_pad: bool, sent_ok: bool,
         for _names, fields in spec.variants:
             sub: List[_LaneClass] = []
             for f in fields:
-                _walk(f, uni_n, True, sent_ok, sub)
+                _walk(f, uni_n, True, sent_ok, sub, static)
             for i, lc in enumerate(sub):
                 lanes[i] = lanes[i].merge(lc)
         out.extend(lanes)
     elif k == "kvtable":
         out.append(_LaneClass(0, spec.cap, False, sent_ok, zero_pad))
         for _ in range(spec.cap):
-            _walk(spec.elem, uni_n, zero_pad, True, out)
-            _walk(spec.val, uni_n, zero_pad, True, out)
+            _walk(spec.elem, uni_n, zero_pad, True, out, static)
+            _walk(spec.val, uni_n, zero_pad, True, out, static)
     else:
         raise AssertionError(k)
 
@@ -150,9 +167,14 @@ class LanePlan:
       bias                  code = value - bias
       allowed               largest VALID code (sentinel code included)
       sent_code             reserved code for SENTINEL_LANE, -1 if none
-      guarded               True for observed-range (int) lanes: a code
-                            outside [0, allowed] at pack time raises the
+      guarded               True for observed-range (int) lanes AND for
+                            analyzer-proven lanes: a code outside
+                            [0, allowed] at pack time raises the
                             packed-lane overflow
+      proven                True for lanes whose bound came from the
+                            static analyzer (no sampling margin; the
+                            guard is a soundness net that should never
+                            fire)
       full                  True for 32-bit (unpacked) lanes: raw bitcast,
                             never guarded
     """
@@ -167,6 +189,7 @@ class LanePlan:
         allowed = np.zeros(W, np.int64)
         sent_code = np.full(W, -1, np.int64)
         guarded = np.zeros(W, bool)
+        proven = np.zeros(W, bool)
         full = np.zeros(W, bool)
         for i, lc in enumerate(classes):
             lo, hi = lc.lo, lc.hi
@@ -190,12 +213,21 @@ class LanePlan:
                 hi = lo + (ohi + span - lo + 1) * 4 - 1
                 guarded[i] = True
             else:
-                # structural bound; extend with the observed range as a
-                # belt-and-braces guard against walk-order defects (an
-                # extension here means wider lanes, never wrong ones)
+                # structural OR analyzer-proven bound; extend with the
+                # observed range as a belt-and-braces guard against
+                # walk-order/analyzer defects (an extension here means
+                # wider lanes, never wrong ones)
                 if obs_seen[i]:
                     lo = min(lo, int(obs_lo[i]))
                     hi = max(hi, int(obs_hi[i]))
+                if lc.proven:
+                    # proven-width lane: packed exactly (no sampling
+                    # margin), runtime-checked as a soundness net — the
+                    # check cannot fire unless the static inference was
+                    # wrong, and then the engine aborts exactly and the
+                    # re-profile recovery widens past the bad bound
+                    proven[i] = True
+                    guarded[i] = True
             if lc.zero_pad:
                 lo = min(lo, 0)
                 hi = max(hi, 0)
@@ -209,6 +241,7 @@ class LanePlan:
                 bits[i] = 32
                 sent_code[i] = -1
                 guarded[i] = False
+                proven[i] = False
                 continue
             bits[i] = b
             bias[i] = lo
@@ -236,6 +269,7 @@ class LanePlan:
             bias = np.zeros(W, np.int64)
             sent_code = np.full(W, -1, np.int64)
             guarded = np.zeros(W, bool)
+            proven = np.zeros(W, bool)
             full = np.ones(W, bool)
             allowed = np.zeros(W, np.int64)
         self.packed_width = packed_width
@@ -248,9 +282,14 @@ class LanePlan:
         self.allowed = allowed
         self.sent_code = sent_code
         self.guarded = guarded
+        self.proven = proven
         self.full = full
         self.bits_per_state = int(bits.sum())
-        self.guarded_lanes = int(guarded.sum())
+        # the two int-lane accounting gauges are disjoint: a lane is
+        # either proven (static bound, no margin) or observed-range
+        # guarded (sampled + margin + runtime abort)
+        self.proven_lanes = int(proven.sum())
+        self.guarded_lanes = int((guarded & ~proven).sum())
 
     # deterministic description for layout signatures (checkpoint/resume
     # compatibility: a resumed run must rebuild the identical plan)
@@ -280,6 +319,14 @@ class LanePlan:
             ((code < 0) | (code > self.allowed[None, :]))
         if bad.any():
             i = int(np.nonzero(bad.any(axis=0))[0][0])
+            if self.proven[i]:
+                raise CompileError(
+                    f"packed lane {i} overflow: value outside the "
+                    f"STATICALLY PROVEN range [{self.bias[i]}, "
+                    f"{self.bias[i] + self.allowed[i]}] — the bounds "
+                    f"analyzer derived a wrong interval (please report)"
+                    f"; JAXMC_ANALYZE_BOUNDS=0 or JAXMC_PACK=0 works "
+                    f"around it")
             raise CompileError(
                 f"packed lane {i} overflow: value outside the profiled "
                 f"range [{self.bias[i]}, {self.bias[i] + self.allowed[i]}]"
@@ -379,12 +426,20 @@ def identity_plan(width: int) -> LanePlan:
             np.zeros(width, bool), force_identity=True)
 
 
-def build_lane_plan(layout, sample_rows: List[np.ndarray]) -> LanePlan:
-    """Plan for a Layout2 from its specs + the encoded sample rows."""
+def build_lane_plan(layout, sample_rows: List[np.ndarray],
+                    static_bounds: Optional[Dict[str, Tuple[int, int]]]
+                    = None) -> LanePlan:
+    """Plan for a Layout2 from its specs + the encoded sample rows.
+
+    static_bounds (ISSUE 9): per-variable PROVEN summary intervals from
+    jaxmc/analyze/bounds.py — every raw-int lane under such a variable
+    is packed at the proven width (no sampling margin, no re-profile
+    cycle) instead of the guarded observed range."""
     classes: List[_LaneClass] = []
     uni_n = len(layout.uni)
     for v in layout.vars:
-        _walk(layout.specs[v], uni_n, False, False, classes)
+        _walk(layout.specs[v], uni_n, False, False, classes,
+              (static_bounds or {}).get(v))
     W = layout.width
     if len(classes) != W:
         # a walk-order defect would corrupt every row: refuse to pack

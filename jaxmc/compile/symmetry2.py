@@ -22,7 +22,7 @@ unreduced search with the existing SYMMETRY warning.
 from __future__ import annotations
 
 import os
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, Optional
 
 import numpy as np
 
@@ -213,7 +213,6 @@ def _seg_tf(spec: VS, pd: Dict, uni: EnumUniverse,
         return pfcn_tf
 
     if k == "union":
-        pay = spec.width - 1
         var_tfs = []
         any_tf = False
         for vnames, vfields in spec.variants:

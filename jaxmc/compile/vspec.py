@@ -32,10 +32,10 @@ overflow is a hard error — state counts stay exact (BASELINE.json).
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field, replace
+from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Tuple
 
-from ..sem.values import (EvalError, Fcn, ModelValue, fmt, sort_key)
+from ..sem.values import Fcn, ModelValue, fmt, sort_key
 
 
 class CompileError(Exception):

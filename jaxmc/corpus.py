@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import os
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import List, Optional, Tuple
 
 REFERENCE = os.environ.get("JAXMC_REFERENCE", "/root/reference")
@@ -73,6 +73,14 @@ class Case:
     # (D, exchange) learned profile, so other D start close and learn
     # the rest).  jaxmc.meshbench passes it to MeshExplorer(mesh_caps=).
     mesh_caps: Optional[dict] = None
+    # LINT surface (ISSUE 9, `make lint-corpus`): diagnostic codes this
+    # pair is WAIVED for (intentional fixture constructs — each waiver
+    # carries a comment at the case naming why), and, for lint-only
+    # fixtures, the codes the pair MUST produce.  A lint_only case is
+    # never swept/checked — it exists to exercise the linter.
+    lint_waive: Tuple[str, ...] = ()
+    lint_only: bool = False
+    lint_expect: Tuple[str, ...] = ()
 
     def spec_path(self) -> str:
         base = REFERENCE if self.root == "ref" else REPO
@@ -104,8 +112,11 @@ CASES: List[Case] = [
     # -- top level + tutorial variants
     Case("pcal_intro.tla", distinct=3800, generated=5850, jax="yes",
          mode="compiled"),
+    # JMC301 waived: the PlusCal translator emits Termination /
+    # MoneyInvariant whether or not the (absent) cfg checks them
     Case("specs/pcal_intro_buggy.tla", root="repo", cfg="",
-         expect="violation:assert", jax="yes", mode="compiled"),
+         expect="violation:assert", jax="yes", mode="compiled",
+         lint_waive=("JMC301",)),
     Case("atomic_add.tla", cfg="", distinct=5, generated=7,
          no_deadlock=True, jax="yes", mode="compiled"),
     # -- Paxos chain
@@ -230,8 +241,11 @@ CASES: List[Case] = [
          distinct=5, generated=11, jax="yes", mode="compiled",
          res_caps={"SC": 256, "FCap": 64, "AccCap": 128, "VC": 64,
                    "chunk": 64}),
+    # JMC301 waived: AssertBound is a deliberate spare CONSTRAINT the
+    # parity tests swap in for the Assert-raising discard path
     Case("specs/constoy.tla", root="repo", cfg="specs/constoy.cfg",
          distinct=21, generated=43, jax="yes", mode="compiled",
+         lint_waive=("JMC301",),
          res_caps={"SC": 256, "FCap": 64, "AccCap": 128, "VC": 64,
                    "chunk": 64}),
     # bench-scale kernelbench rungs (ISSUE 6): wide-shallow variants of
@@ -273,6 +287,15 @@ CASES: List[Case] = [
     Case("specs/interparm_toy.tla", root="repo",
          cfg="specs/interparm_toy.cfg", distinct=19, generated=29,
          jax="yes", mode="hybrid"),
+    # LINT-ONLY fixture (ISSUE 9): deliberately unclean — a dead
+    # action, an unused CONSTANT/VARIABLE/definition, a cfg naming an
+    # undefined invariant, an unassigned CONSTANT, and a CHOOSE over
+    # the symmetry set.  `make lint-corpus` asserts every expected
+    # diagnostic class fires; no search ever runs it.
+    Case("specs/linttoy.tla", root="repo", cfg="specs/linttoy.cfg",
+         lint_only=True,
+         lint_expect=("JMC101", "JMC102", "JMC201", "JMC202",
+                      "JMC203", "JMC301", "JMC302")),
 ]
 
 # mode-slide severity order: a case may only move LEFT (toward
@@ -308,6 +331,9 @@ def run_case(case: Case, backend: str = "interp"):
     from .sem.modules import Loader, bind_model
     from .engine.explore import Explorer
 
+    if case.lint_only:
+        return "skip", ("lint-only fixture (make lint-corpus checks "
+                        "it); not a checkable model"), None, None
     spec = case.spec_path()
     cfgp = case.cfg_path()
     if cfgp:
@@ -547,6 +573,8 @@ def sweep(backend: str = "interp", include_slow: bool = False,
     for i, case in enumerate(CASES):
         if case.slow and not include_slow:
             continue
+        if case.lint_only:
+            continue  # `make lint-corpus` owns these fixtures
         n += 1
         name = case.cfg or case.spec
         t1 = time.time()

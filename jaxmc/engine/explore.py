@@ -14,7 +14,7 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-from ..sem.values import EvalError, Fcn, ModelValue, fmt, sort_key
+from ..sem.values import Fcn, ModelValue, fmt, sort_key
 from ..sem.eval import TLCAssertFailure, eval_expr, _bool
 from ..sem.enumerate import (Walker, enumerate_init, enumerate_next,
                              label_str)

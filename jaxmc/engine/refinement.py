@@ -21,7 +21,7 @@ round-2+ item, ROADMAP.md).
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List
 
 from ..front import tla_ast as A
 from ..sem.values import EvalError, tla_eq

@@ -11,13 +11,12 @@ from __future__ import annotations
 
 import random
 from collections import deque
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List
 
 from ..sem.eval import TLCAssertFailure, eval_expr, _bool
-from ..sem.values import EvalError
 from ..sem.enumerate import enumerate_init, enumerate_next, label_str
 from ..sem.modules import Model
-from .explore import CheckResult, Violation
+from .explore import Violation
 
 
 def random_walks(model: Model, n_walks: int, depth: int,

@@ -22,8 +22,8 @@ import re
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
-from .lexer import Token, tokenize
-from .parser import Parser, ParseError
+from .lexer import tokenize
+from .parser import Parser
 from . import tla_ast as A
 
 

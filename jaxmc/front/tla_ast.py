@@ -9,7 +9,7 @@ kernel compiler both walk these.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Any, List, Optional, Tuple
+from typing import Any, Optional, Tuple
 
 
 class Node:

@@ -214,9 +214,18 @@ def cmd_report(args, out=sys.stdout) -> int:
               "compile.cache_misses", "compile.jaxpr_eqns_total",
               "compile.hlo_flops_total", "watchdog.stalls",
               "mesh.host_syncs", "mesh.row_syncs",
-              "mesh.exchange_bytes"):
+              "mesh.exchange_bytes", "analyze.predicted_demotions",
+              "analyze.lint_diags"):
         if k in c:
             hl.append(f"{k}={c[k]}")
+    # proven-lane ratio (ISSUE 9): how much of the int-lane surface the
+    # static analyzer proved vs what stayed sampled+guarded
+    pv, gd = g.get("analyze.proven_lanes"), \
+        g.get("layout.pack_guarded_lanes")
+    if isinstance(pv, int) and isinstance(gd, int) and (pv or gd):
+        hl.append(f"analyze.proven_lanes={pv}/{pv + gd} "
+                  f"({100.0 * pv / (pv + gd):.0f}% of int lanes "
+                  f"proven)")
     for k in ("expand.mode", "dedup.mode", "layout.width_lanes",
               "layout.packed_width_lanes", "layout.bits_per_state",
               "device.donation", "profile.status",

@@ -207,6 +207,31 @@ jaxmc.metrics/2 artifact minus the new optional surface, so readers and
       shard_balance, a2a_*}]; per-leg jaxmc.metrics/2 artifacts carry
       the same numbers in a top-level `multichip` block and gate via
       `obs diff --fail-on-regress`.
+
+  (PR 9, still jaxmc.metrics/2 — all additive/optional; the static-
+   analysis surface, jaxmc/analyze/*:)
+    - session stage span `analyze` (attrs: mode) between `load` and
+      `engine_build` when `check --analyze != off`; engine-side spans
+      `analyze_bounds` (the interval fixpoint) and `analyze_arms` (the
+      per-arm demotion scan) inside the jax engine build.
+    - bounds inference: gauge `analyze.proven_lanes` — int lanes whose
+      packed width is a STATICALLY PROVEN interval (no sampling
+      margin; the runtime OV_PACK check remains as a soundness net) —
+      disjoint from `layout.pack_guarded_lanes`, which now counts ONLY
+      observed-range lanes; gauge `analyze.bounds_converged` (bool).
+      `obs report` renders the proven/(proven+guarded) ratio as a
+      highlight line.
+    - demotion prediction: counter `analyze.predicted_demotions` and
+      gauge `analyze.arm_verdicts` ({arm label -> predicted reason});
+      a predicted arm's reason string is IDENTICAL to the build-time
+      demotion wording (kernel2's shared message constants), so the
+      per-arm demotion table reads the same on either path.
+    - linter: counter `analyze.lint_diags`, gauges
+      `analyze.lint_max_severity` ("error"|"warning"|"info") and
+      `analyze.lint_codes` (sorted JMC* code list).  Serve adds
+      counter `serve.jobs_rejected` + trace event `serve.job_rejected
+      {spec, codes}` for submissions refused by the submit-time lint
+      gate.
 """
 
 from __future__ import annotations

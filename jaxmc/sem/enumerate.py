@@ -20,10 +20,9 @@ from __future__ import annotations
 from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 from ..front import tla_ast as A
-from .values import EvalError, Fcn, enumerate_set, fmt, in_set, tla_eq
-from .eval import (Ctx, OpClosure, BuiltinOp, UnassignedPrime, _arg_value,
-                   _bool, _resolve, bind_pattern, eval_expr, iter_binders,
-                   make_let_defs)
+from .values import EvalError, enumerate_set, fmt, in_set, tla_eq
+from .eval import (Ctx, OpClosure, _arg_value, _bool, _resolve, eval_expr,
+                   iter_binders, make_let_defs)
 
 
 _OP_PLAN_CAP = 1 << 16  # entries; cleared beyond (LET-heavy specs mint

@@ -13,10 +13,9 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..front import tla_ast as A
-from .values import (EvalError, Fcn, FcnSetV, InfiniteSet, ModelValue,
-                     BOOLEAN_SET, EMPTY_FCN, INT, NAT, REAL, STRING_SET,
+from .values import (EvalError, Fcn, FcnSetV, ModelValue,
                      enumerate_set, fmt, in_set, mk_record, mk_seq,
-                     sort_key, tla_eq, check_set_mix)
+                     tla_eq, check_set_mix)
 
 
 class TLCAssertFailure(EvalError):

@@ -16,12 +16,12 @@ so a module shadowing an operator wins.
 from __future__ import annotations
 
 import itertools
-from typing import Any, Dict, List
+from typing import Any, Dict
 
-from .values import (EvalError, Fcn, InfiniteSet, ModelValue, EMPTY_FCN,
-                     enumerate_set, fmt, in_set, mk_record, mk_seq,
-                     sort_key, tla_eq, check_set_mix)
-from .eval import TLCAssertFailure, apply_op, Ctx
+from .values import (EvalError, Fcn, InfiniteSet, EMPTY_FCN,
+                     enumerate_set, fmt, in_set, mk_seq,
+                     tla_eq, check_set_mix)
+from .eval import TLCAssertFailure, apply_op
 
 
 def _int(v, op):

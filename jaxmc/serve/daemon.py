@@ -259,6 +259,26 @@ class ServeDaemon:
                                "next daemon life (the spool persists)")
         cfg = build_config(payload.get("spec"), payload.get("cfg"),
                            payload.get("options"))
+        # submit-time static analysis (ISSUE 9): a statically-broken
+        # spec/cfg pair (cfg names an undefined invariant, unassigned
+        # CONSTANTs, unparseable inputs — the linter's error-severity
+        # classes) is rejected HERE, before it occupies a worker or
+        # enters the durable spool; the 400 payload carries the
+        # diagnostics.  JAXMC_SERVE_ANALYZE=0 opts out.
+        if os.environ.get("JAXMC_SERVE_ANALYZE", "1").strip().lower() \
+                not in ("0", "off", "no", "false"):
+            from ..analyze.lint import errors, lint_pair
+            errs = errors(lint_pair(cfg.spec, cfg.cfg,
+                                    tuple(cfg.include or ()),
+                                    semantic=False))
+            if errs:
+                self.tel.counter("serve.jobs_rejected")
+                self.tel.event("serve.job_rejected",
+                               spec=cfg.spec,
+                               codes=[d.code for d in errs])
+                raise BadJob(
+                    "statically broken job rejected by the analyzer: "
+                    + "; ".join(d.render() for d in errs[:5]))
         sig = job_signature(cfg)
         job = self.q.new_job(cfg.spec, cfg.cfg, payload.get("options"),
                              sig)

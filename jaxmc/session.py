@@ -5,6 +5,10 @@ cfg sniffing, model load, device init, engine construction, search,
 fallback — becomes one object with three named stages,
 
     parse    cfg + spec  ->  a bound Model (or an ASSUME-mode verdict)
+    analyze  Model       ->  lint diagnostics (ISSUE 9; gated by
+                             cfg.analyze off/warn/strict — strict
+                             raises AnalyzeError on error diagnostics
+                             before any compile cost is paid)
     compile  Model       ->  a ready engine (device init, kernel build;
                              carries the layout signature when the jax
                              backend compiled one)
@@ -34,7 +38,7 @@ from __future__ import annotations
 import os
 import sys
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Dict, Optional, Tuple
 
 from . import obs
@@ -75,6 +79,21 @@ def load_model(spec_path: str, cfg_path, no_deadlock: bool,
 _SENTINEL = object()  # "keep the configured value" for explore overrides
 
 
+class AnalyzeError(Exception):
+    """--analyze=strict found error-severity diagnostics: the run must
+    not proceed to compile/search (exit 2 on the CLI, a rejected job on
+    the serve daemon).  Carries the full diagnostic list so drivers can
+    render every finding, not only the first."""
+
+    def __init__(self, diagnostics):
+        self.diagnostics = list(diagnostics)
+        errs = [d for d in self.diagnostics if d.severity == "error"]
+        super().__init__(
+            f"{len(errs)} error diagnostic"
+            f"{'s' if len(errs) != 1 else ''} "
+            f"({'; '.join(d.code for d in errs[:6])})")
+
+
 @dataclass
 class SessionConfig:
     """Everything a check run is parameterized by — field names and
@@ -103,6 +122,10 @@ class SessionConfig:
     checkpoint: Optional[str] = None
     checkpoint_every: float = 600.0
     resume: Optional[str] = None
+    # static analysis (ISSUE 9): lint severity gate for the analyze
+    # stage — "off" (skip), "warn" (print diagnostics, continue),
+    # "strict" (error diagnostics abort with exit 2 before compile)
+    analyze: str = "off"
     # serve-only knobs (no CLI flags):
     final_checkpoint: bool = False  # checkpoint COMPLETED runs too —
     # the daemon's warm-resume source
@@ -162,6 +185,7 @@ class CheckSession:
         self.layout_sig: Optional[str] = None
         self.result = None
         self.explore_count = 0
+        self.diagnostics = None  # analyze stage output (lint findings)
 
     # ---- stage: parse -------------------------------------------------
     def parse(self) -> str:
@@ -225,6 +249,59 @@ class CheckSession:
               "No error has been found.")
         return 0
 
+    # ---- stage: analyze -----------------------------------------------
+    def analyze(self):
+        """The static-analysis stage between parse and compile (ISSUE
+        9): lint the spec/cfg pair and store the diagnostics.  Severity
+        policy follows cfg.analyze — "off" skips entirely (stage chain
+        passes through), "warn" records, "strict" raises AnalyzeError
+        when any error-severity diagnostic exists.  Idempotent like the
+        other stages — and deliberately runnable BEFORE parse: the
+        linter re-loads the pair itself, so a cfg broken in a way that
+        makes bind_model refuse (an undefined invariant name, an
+        unassigned CONSTANT) still gets its diagnostics reported
+        instead of a bare parse error.  Assumes-mode pairs (no behavior
+        spec) have nothing to analyze."""
+        mode = (self.cfg.analyze or "off").lower()
+        if self.diagnostics is not None:
+            if mode == "strict":
+                errs = [d for d in self.diagnostics
+                        if d.severity == "error"]
+                if errs:
+                    # the strict refusal must hold on EVERY call — a
+                    # driver that caught the first AnalyzeError cannot
+                    # compile/explore its way past it via the stage
+                    # chain (compile() re-enters here)
+                    raise AnalyzeError(self.diagnostics)
+            return self.diagnostics
+        if mode == "off":
+            return []
+        cfgp = self.cfg.cfg or default_cfg_path(self.cfg.spec)
+        if cfgp:
+            try:
+                from .front.cfg import parse_cfg
+                c = parse_cfg(read_text(cfgp))
+                if not c.specification and not c.init:
+                    return []  # assumes-mode: no model to lint
+            except Exception:
+                pass  # unparseable cfg: lint_pair reports it as JMC100
+        from .analyze.lint import errors, lint_pair, max_severity
+        with self.tel.span("analyze", mode=mode):
+            diags = lint_pair(self.cfg.spec, cfgp,
+                              tuple(self.cfg.include))
+        self.diagnostics = diags
+        if diags:
+            self.tel.counter("analyze.lint_diags", len(diags))
+            self.tel.gauge("analyze.lint_max_severity",
+                           max_severity(diags))
+            self.tel.gauge("analyze.lint_codes",
+                           sorted({d.code for d in diags}))
+        if self.stage == "parse":
+            self.stage = "analyze"
+        if mode == "strict" and errors(diags):
+            raise AnalyzeError(diags)
+        return diags
+
     # ---- stage: compile -----------------------------------------------
     def device_init(self) -> Optional[str]:
         """Device/plugin init with bounded retries + backoff
@@ -287,8 +364,10 @@ class CheckSession:
         CompileError / device failures) — the driver owns the policy."""
         if self.stage in ("compile", "explore"):
             return self
-        if self.stage != "parse":
+        if self.stage is None:
             self.parse()
+        if self.stage == "parse":
+            self.analyze()  # no-op when cfg.analyze == "off"
         assert self.kind == "model", "assumes sessions have no engine"
         cfg = self.cfg
         if cfg.backend == "interp":
@@ -345,7 +424,7 @@ class CheckSession:
         submission: explore(resume_from=last_final_checkpoint) replays
         the completed search's verdict through the already-compiled
         kernels.  Returns (and stores) the CheckResult."""
-        if self.stage is None or self.stage == "parse":
+        if self.stage in (None, "parse", "analyze"):
             self.compile()
         ex = self.engine
         if resume_from is not _SENTINEL:
@@ -421,4 +500,6 @@ class CheckSession:
             "layout_sig": self.layout_sig,
             "checkpoint": self.cfg.checkpoint,
             "explore_count": self.explore_count,
+            "analyze_diags": len(self.diagnostics)
+            if self.diagnostics is not None else None,
         }

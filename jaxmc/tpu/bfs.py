@@ -24,7 +24,6 @@ from __future__ import annotations
 
 import os
 import time
-from dataclasses import dataclass
 from functools import partial
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -41,7 +40,7 @@ from ..sem.values import EvalError
 from ..engine.explore import CheckResult, Violation
 from ..engine.simulate import sample_states
 from ..compile.vspec import Bounds, CompileError, ModeError
-from ..compile.kernel2 import (KernelCtx, Layout2, OV_DEMOTED, OV_PACK,
+from ..compile.kernel2 import (KernelCtx, OV_DEMOTED, OV_PACK,
                                build_layout2, compile_action2,
                                compile_predicate2, compile_value2,
                                introspect_kernel)
@@ -49,6 +48,9 @@ from ..compile.ground import ground_arm, split_arms
 
 SENTINEL = np.int32(2**31 - 1)
 FP_THRESHOLD = 48  # lanes; beyond this, dedup on 128-bit fingerprints
+# "bounds inference not yet attempted" marker for the per-model cache
+# (the cached report itself may legitimately be None = analysis bailed)
+_SENTINEL_NO_REPORT = object()
 
 # resident-mode status codes (one summary scalar per dispatched batch)
 ST_CONTINUE = 0     # level budget exhausted, search not finished
@@ -278,8 +280,29 @@ class TpuExplorer:
             sampled = sample_states(model, bfs_states=bfs_n,
                                     n_walks=walks, walk_depth=depth)
         sampled = list(sampled) + self.extra_samples
+        # static bounds inference (ISSUE 9): a converged interval proof
+        # turns observed-range guarded int lanes into proven-width lanes
+        # — the OV_PACK re-sample cycle cannot fire on a proven lane.
+        # The fixpoint result is cached on the model so relayout
+        # restarts and mesh subclasses do not re-run it.
+        self._static_bounds = None
+        from .. import analyze as _analyze
+        if _analyze.bounds_enabled():
+            rep = getattr(model, "_bounds_report", _SENTINEL_NO_REPORT)
+            if rep is _SENTINEL_NO_REPORT:
+                with tel.span("analyze_bounds"):
+                    rep = _analyze.infer_state_bounds(model)
+                try:
+                    model._bounds_report = rep
+                except AttributeError:
+                    pass
+            if rep is not None:
+                self._static_bounds = rep.lane_bounds()
+                tel.gauge("analyze.bounds_converged",
+                          bool(rep.converged))
         with tel.span("layout_build", samples=len(sampled)):
-            self.layout = build_layout2(model, sampled, self.bounds)
+            self.layout = build_layout2(model, sampled, self.bounds,
+                                        static_bounds=self._static_bounds)
         self.kc = KernelCtx(model, self.layout, self.bounds)
         # dynamic \E expansion applies to message tables AND to
         # state-dependent intervals (\E i \in 1..Len(q), AlternatingBit's
@@ -319,8 +342,34 @@ class TpuExplorer:
             self.fb_arms = [(arm, "pinned interp-arms (corpus "
                                   "manifest): kernel construction "
                                   "skipped") for arm in self.arms]
+        # statically-predicted demotions (ISSUE 9): arms the analyzer is
+        # CERTAIN compile_action2 would demote skip grounding + kernel
+        # construction + forced tracing outright — the derived
+        # generalization of the manifest's measured pin_interp_arms
+        # pins.  The verdict string IS the build-time reason string
+        # (kernel2's shared message constants), so the demotion table,
+        # the ModeError text and the sweep notes read identically on
+        # either path.
+        self.arm_verdicts: Dict[int, str] = {}
+        if not self.pin_interp_arms and _analyze.predict_enabled() \
+                and self.arms:
+            with tel.span("analyze_arms", arms=len(self.arms)):
+                self.arm_verdicts = _analyze.predict_arm_demotions(
+                    model, self.arms)
+            if self.arm_verdicts:
+                tel.counter("analyze.predicted_demotions",
+                            len(self.arm_verdicts))
+                tel.gauge("analyze.arm_verdicts",
+                          {(self.arms[i].label or "Next"): r
+                           for i, r in sorted(self.arm_verdicts.items())})
         for ai, arm in enumerate(
                 () if self.pin_interp_arms else self.arms):
+            if ai in self.arm_verdicts:
+                # zero futile build attempts: the arm goes straight to
+                # the interpreter with the predicted (== build-time)
+                # reason
+                self.fb_arms.append((arm, self.arm_verdicts[ai]))
+                continue
             try:
                 for attempt in range(compile_retries + 1):
                     # per-ATTEMPT introspection buffer: the rollup
@@ -1996,7 +2045,6 @@ class TpuExplorer:
     def _run_resident(self) -> CheckResult:
         t0 = time.time()
         tel = obs.current()
-        model = self.model
         layout = self.layout
         W, K = self.W, self.K
         warnings = ["resident mode: search runs device-side end to end; "
@@ -2345,7 +2393,6 @@ class TpuExplorer:
         tel = obs.current()
         model = self.model
         layout = self.layout
-        W = self.W
         warnings = ["seen-set resident in the native host fingerprint "
                     "store (host_seen); dedup on 128-bit fingerprints"]
         warnings.extend(self._temporal_warnings())
@@ -3022,7 +3069,6 @@ class TpuExplorer:
         t0 = time.time()
         tel = obs.current()
         model = self.model
-        layout = self.layout
         W, K = self.W, self.K
         warnings = []
         warnings.extend(self._temporal_warnings())

@@ -78,8 +78,7 @@ from ..sem.modules import Model
 from ..engine.explore import CheckResult, Violation
 from ..compile.vspec import ModeError
 from ..compile.kernel2 import OV_DEMOTED, OV_PACK
-from .bfs import (SENTINEL, TpuExplorer, _LiveGraph, _pow2_at_least,
-                  filter_init_states, fingerprint128)
+from .bfs import SENTINEL, TpuExplorer, _LiveGraph, _pow2_at_least
 
 _BIG = np.int32(2 ** 31 - 1)
 
@@ -458,7 +457,6 @@ class MeshExplorer(TpuExplorer):
         the outputs: any_inv, fixed_ovf (a frontier/seen shard outgrew
         its fixed capacity, incl. a2a bucket+spill overflow), any_dead,
         any_assert."""
-        a2a = self.exchange == "a2a"
         C = self.A * FC
         route, R, B, SB = self._route_fn(C, FC)
         key = (SC, FC, B, SB, out_cap)
@@ -599,7 +597,6 @@ class MeshExplorer(TpuExplorer):
         ring / a2a bucket+spill) rolls the level back inside the step
         (outputs == inputs), so the host can grow the named capacity
         and redo the level without ever pulling rows."""
-        a2a = self.exchange == "a2a"
         C = self.A * FC
         route, R, B, SB = self._route_fn(C, FC)
         with_trace = self.store_trace
@@ -1272,7 +1269,6 @@ class MeshExplorer(TpuExplorer):
         t0 = time.time()
         tel = obs.current()
         model = self.model
-        layout = self.layout
         D, W, K = self.D, self.W, self.K
         warnings = ["mesh backend: dedup on 128-bit fingerprints; "
                     "collision probability < n^2 * 2^-129"]

@@ -31,8 +31,6 @@ multi-host pod would (VERDICT r3 #7; ROADMAP gap 6).
 from __future__ import annotations
 
 import os
-import sys
-from typing import Tuple
 
 _REPO = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -72,7 +70,6 @@ def run_multihost_child(process_id: int, num_processes: int,
                                num_processes=num_processes,
                                process_id=process_id)
     import numpy as np
-    import jax.numpy as jnp
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     from ..sem.modules import Loader, bind_model

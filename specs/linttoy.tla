@@ -1,0 +1,40 @@
+---------------------------- MODULE linttoy ----------------------------
+(* Deliberately UNCLEAN fixture for `python -m jaxmc.analyze lint`
+   (ISSUE 9): every diagnostic class fires exactly where the comments
+   say.  The model is lint-only — the cfg names an undefined invariant
+   and `ghost` is never assigned, so it is not checkable and the corpus
+   manifest carries it as a lint_only case (no search runs it).
+
+     JMC101  cfg INVARIANT names `Missing` (undefined below)
+     JMC102  CONSTANT Ghost is declared but the cfg never assigns it
+     JMC201  VARIABLE ghost is never referenced
+     JMC202  Stuck's guard x > Limit + 99 is statically false:
+             the analyzer proves x \in [0, Limit]
+     JMC203  Lowest CHOOSEs over the symmetry set P (order-sensitive)
+     JMC301  Orphan is defined but unreachable from the cfg
+     JMC302  CONSTANT Unused is assigned but never referenced       *)
+EXTENDS Naturals, FiniteSets, TLC
+
+CONSTANTS P, Limit, Unused, Ghost
+VARIABLES x, ghost
+
+Perms == Permutations(P)
+
+Init == x = 0
+
+Bump == x < Limit /\ x' = x + 1
+
+Stuck == x > Limit + 99 /\ x' = x
+
+Next == Bump \/ Stuck
+
+Spec == Init /\ [][Next]_x
+
+Orphan == x + 1
+
+Lowest == CHOOSE p \in P : TRUE
+
+HazInv == Lowest \in P
+
+TypeInv == x \in 0..Limit
+=========================================================================

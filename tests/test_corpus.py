@@ -53,6 +53,11 @@ def test_corpus_case(case):
     if _case_needs_reference(case) and not HAVE_REFERENCE:
         pytest.skip(f"needs the reference spec corpus at {REFERENCE} "
                     f"(driver environment only)")
+    if case.lint_only:
+        # deliberately-unclean linter fixture (ISSUE 9): not a
+        # checkable model; `make lint-corpus` + tests/test_analyze.py
+        # assert its expected diagnostics instead
+        pytest.skip("lint-only fixture (covered by lint-corpus)")
     status, detail, _r, _mode = run_case(case)
     assert status == "pass", detail
 
