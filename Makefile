@@ -194,29 +194,49 @@ bench-check:
 	$(MAKE) lint-corpus
 	$(MAKE) pylint
 
-# multi-chip parity gate (ISSUE 8): the mesh-resident engine
+# multi-chip parity gate (ISSUE 8/10): the mesh-resident engine
 # (owner-routed a2a dedup, seen shards + frontier + trace ring on
-# device, scalars-only host reads) at D=2 and D=4 VIRTUAL cpu devices
-# on the repo-local bench rungs (+ MCraft_micro when the reference
-# corpus is mounted — a parseable SKIP line otherwise).  Counts must
-# equal the corpus manifest pins, host_syncs must equal the level
-# count, and each leg's metrics artifact gates via
+# device, scalars-only host reads, rank-merge + fused supersteps) at
+# D=2 and D=4 VIRTUAL cpu devices on the repo-local bench rungs
+# (+ MCraft_micro when the reference corpus is mounted — a parseable
+# SKIP line otherwise).  Counts must equal the corpus manifest pins,
+# host_syncs may never exceed the level count (supersteps make it
+# smaller), and each leg's metrics artifact gates via
 # `python -m jaxmc.obs diff --fail-on-regress` against a saved
 # baseline (first run snapshots it; baselines live in
 # $(BENCH_CHECK_DIR)/jaxmc_multichip_*.baseline.json).
+# The RANK-MERGE leg (ISSUE 10): the default check runs the rank
+# strategy; a second fullsort leg on one rung proves the
+# JAXMC_MESH_RANKMERGE=0 escape hatch answers bit-identically.
+# Finally, when two committed MULTICHIP_r* scaling artifacts exist,
+# `obs diff` gates the newer per-rung states/sec/chip against the
+# older (wired into `make bench-check` through this target).
 MULTICHIP_DEVICES ?= 2,4
+MULTICHIP_PREV ?= MULTICHIP_r06.json
+MULTICHIP_CUR  ?= MULTICHIP_r07.json
 multichip-check:
 	$(PY) -m jaxmc.meshbench check --devices $(MULTICHIP_DEVICES) \
 	    --out-dir $(BENCH_CHECK_DIR)
+	$(PY) -m jaxmc.meshbench check --devices 2 \
+	    --rung specs/viewtoy_scaled.tla --merge fullsort \
+	    --out-dir $(BENCH_CHECK_DIR)
+	@if [ -f $(MULTICHIP_PREV) ] && [ -f $(MULTICHIP_CUR) ]; then \
+	  echo "== multichip scaling curve: $(MULTICHIP_CUR) vs" \
+	       "$(MULTICHIP_PREV) =="; \
+	  $(PY) -m jaxmc.obs diff --fail-on-regress --threshold 25 \
+	      $(MULTICHIP_PREV) $(MULTICHIP_CUR) || exit 1; \
+	fi
 
-# the published scaling curve (ISSUE 8): per-rung, per-D warm-up +
+# the published scaling curve (ISSUE 8/10): per-rung, per-D warm-up +
 # timed fully-warm mesh runs over D in {1,2,4,8} virtual devices
 # (real chips when JAXMC_MESHBENCH_PLATFORM names an accelerator) —
 # states/sec/chip, per-level exchange bytes, shard balance,
-# host_syncs == levels, window_recompiles == 0 — written to
-# MULTICHIP_r06.json and gated per leg like multichip-check.
+# host_syncs <= levels (supersteps), window_recompiles == 0, and the
+# measured expand/exchange/merge phase-wall breakdown (incl. the
+# rank-vs-fullsort merge wall) — written to MULTICHIP_r07.json and
+# gated per leg like multichip-check.
 MULTICHIP_BENCH_DEVICES ?= 1,2,4,8
-MULTICHIP_OUT ?= MULTICHIP_r06.json
+MULTICHIP_OUT ?= MULTICHIP_r07.json
 multichip-bench:
 	$(PY) -m jaxmc.meshbench bench \
 	    --devices $(MULTICHIP_BENCH_DEVICES) \

@@ -72,6 +72,10 @@ class Case:
     # (measured at D=4 in this container; max-merged with the per-
     # (D, exchange) learned profile, so other D start close and learn
     # the rest).  jaxmc.meshbench passes it to MeshExplorer(mesh_caps=).
+    # PR 10 adds the optional MSL key — the superstep controller's
+    # learned levels-per-dispatch — so a cold engine skips the
+    # 1 -> 2 -> 4 dispatch ramp and `mesh.host_syncs` drops below the
+    # level count from the first run.
     mesh_caps: Optional[dict] = None
     # LINT surface (ISSUE 9, `make lint-corpus`): diagnostic codes this
     # pair is WAIVED for (intentional fixture constructs — each waiver
@@ -192,14 +196,14 @@ CASES: List[Case] = [
          res_caps={"SC": 1 << 18, "FCap": 1 << 16, "AccCap": 1 << 17,
                    "VC": 1 << 13, "chunk": 2048},
          mesh_caps={"SC": 1 << 17, "FC": 1 << 13, "TRL": 32,
-                    "GAM16": 32}),
+                    "GAM16": 32, "MSL": 32}),
     Case("specs/MCraftMicro.tla", root="repo",
          cfg="specs/MCraft_micro.cfg", includes=("examples",),
          distinct=694, generated=6185, jax="yes", mode="compiled",
          res_caps={"SC": 1 << 12, "FCap": 1 << 9, "AccCap": 1 << 12,
                    "VC": 1 << 11, "chunk": 256},
          mesh_caps={"SC": 1 << 12, "FC": 1 << 9, "TRL": 32,
-                    "GAM16": 32}),
+                    "GAM16": 32, "MSL": 32}),
     # mode=compiled proven by the BENCH_r02 resident-mode completion
     # (resident refuses hybrid/interp-arms outright)
     Case("specs/MCraftMicro.tla", root="repo",
@@ -212,7 +216,7 @@ CASES: List[Case] = [
                    "VC": 1 << 13},
          # meshbench rung (ISSUE 8): per-shard mesh-resident buckets
          mesh_caps={"SC": 1 << 17, "FC": 1 << 14, "TRL": 64,
-                    "GAM16": 32}),
+                    "GAM16": 32, "MSL": 64}),
     Case("specs/MCtextbookSI.tla", root="repo",
          cfg="specs/MCtextbookSI_small.cfg", includes=("examples",),
          distinct=569, generated=945, jax="yes", mode="interp-arms"),
@@ -260,14 +264,14 @@ CASES: List[Case] = [
          # measured mesh-resident shard caps at D=4 in this container
          # (SC grew 256 -> 65536 over 9 redo recompiles without it)
          mesh_caps={"SC": 1 << 16, "FC": 1 << 11, "TRL": 32,
-                    "GAM16": 32}),
+                    "GAM16": 32, "MSL": 32}),
     Case("specs/symtoy_scaled.tla", root="repo",
          cfg="specs/symtoy_scaled.cfg", no_deadlock=True,
          distinct=10725, generated=65365, jax="yes", mode="compiled",
          res_caps={"SC": 1 << 15, "FCap": 1 << 12, "AccCap": 1 << 14,
                    "VC": 1 << 13, "chunk": 1024},
          mesh_caps={"SC": 1 << 15, "FC": 1 << 11, "TRL": 32,
-                    "GAM16": 32}),
+                    "GAM16": 32, "MSL": 32}),
     # device SYMMETRY toys (orbit-canonical counts; deadlock expected
     # when every process exhausts its turns)
     Case("specs/symtoy.tla", root="repo", cfg="specs/symtoy.cfg",

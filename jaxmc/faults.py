@@ -34,7 +34,13 @@ Sites wired in this PR:
                       expanding its chunk
     run_kill          the MAIN process SIGKILLs itself entering a BFS
                       level (serial / parallel / device engines) — the
-                      kill/resume parity harness
+                      kill/resume parity harness.  Resident engines
+                      fire it at their DISPATCH boundaries: for the
+                      mesh engine under multi-level supersteps
+                      (ISSUE 10) `level=` therefore matches only
+                      depths that are superstep boundaries — pin
+                      JAXMC_MESH_SUPERSTEP=1 to make every level a
+                      boundary in chaos runs
     ckpt_corrupt      every checkpoint write leaves a truncated
                       (mode=truncate, default) or bit-flipped
                       (mode=flip) file behind
@@ -69,8 +75,9 @@ per dispatch, because the routing is compiled into the jitted step):
                       forcing worst-case imbalance, the a2a spill pass
                       and — once the spill overflows — the
                       gamma-growth level rerun.  Counts and traces
-                      must stay exact throughout
-                      (tests/test_mesh_resident.py).
+                      must stay exact throughout, under BOTH merge
+                      strategies (rank / fullsort, ISSUE 10) and any
+                      superstep size (tests/test_mesh_resident.py).
 
 Cross-process accounting: the first registry to activate creates a
 state directory and exports it as JAXMC_FAULTS_STATE, so forked pool

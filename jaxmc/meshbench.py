@@ -15,20 +15,27 @@ Subcommands
   check   D in {2,4} (default) parity legs over the repo-local rungs
           (viewtoy_scaled / symtoy_scaled + MCraft_micro when the
           reference corpus is mounted): counts must equal the corpus
-          manifest pins, and each leg's jaxmc.metrics/2 artifact gates
-          like every bench-check leg via
+          manifest pins, host_syncs may never exceed the level count
+          (it counts SUPERSTEPS since ISSUE 10, so it is usually well
+          below), and each leg's jaxmc.metrics/2 artifact gates like
+          every bench-check leg via
           `python -m jaxmc.obs diff --fail-on-regress` against a saved
-          baseline (first run snapshots it).  Wired into
+          baseline (first run snapshots it).  `--merge fullsort` runs
+          the same leg under the JAXMC_MESH_RANKMERGE=0 escape hatch
+          (the Makefile's rank-merge parity leg).  Wired into
           `make bench-check` via `make multichip-check`.
   bench   D in {1,2,4,8} (default) timed legs over the bench rungs
           (MCraft_3s_bench + transfer_scaled): per D, one warm-up run
           (compile + capacity training + profile persist) then a timed
           fully-warm run — states/sec/chip, per-level exchange bytes,
-          shard balance, host_syncs (must equal the level count: the
-          resident loop reads scalars only) and window_recompiles
-          (must be 0 on the warm run).  Writes the MULTICHIP_r*
+          shard balance, host_syncs <= levels (supersteps working),
+          window_recompiles (must be 0 on the warm run) and the
+          measured expand/exchange/merge phase-wall breakdown
+          (probe_phase_walls — both merge strategies timed, so the
+          rank win is in the artifact).  Writes the MULTICHIP_r*
           artifact (--out) plus per-leg metrics artifacts, gated the
-          same way when baselines exist.
+          same way when baselines exist; two MULTICHIP_r* artifacts
+          diff directly via `python -m jaxmc.obs diff`.
   child   one (spec, D) leg — internal.
 
 Rungs that need the reference corpus (the MCraft family EXTENDS the
@@ -83,10 +90,13 @@ def _leg_name(spec: str, cfg: Optional[str]) -> str:
 def _run_child(spec: str, cfg: Optional[str], devices: int,
                exchange: Optional[str], timed: bool, out_dir: str,
                store_trace: bool, timeout_s: float,
+               merge: Optional[str] = None,
+               phase_probe: bool = False,
                log=print) -> Dict:
     name = _leg_name(spec, cfg)
-    metrics = os.path.join(out_dir,
-                           f"jaxmc_multichip_{name}_d{devices}.json")
+    suffix = f"_{merge}" if merge else ""
+    metrics = os.path.join(
+        out_dir, f"jaxmc_multichip_{name}_d{devices}{suffix}.json")
     cmd = [sys.executable, "-m", "jaxmc.meshbench", "child",
            "--spec", spec, "--devices", str(devices),
            "--metrics-out", metrics]
@@ -94,8 +104,12 @@ def _run_child(spec: str, cfg: Optional[str], devices: int,
         cmd += ["--cfg", cfg]
     if exchange:
         cmd += ["--exchange", exchange]
+    if merge:
+        cmd += ["--merge", merge]
     if timed:
         cmd += ["--timed"]
+    if phase_probe:
+        cmd += ["--phase-probe"]
     if store_trace:
         cmd += ["--store-trace"]
     env = dict(os.environ, PYTHONPATH=_REPO)
@@ -158,7 +172,7 @@ def cmd_check(args) -> int:
             # loaded box
             r = _run_child(spec, cfg, D, args.exchange, True,
                            args.out_dir, store_trace=False,
-                           timeout_s=args.timeout)
+                           timeout_s=args.timeout, merge=args.merge)
             if not r.get("ok"):
                 print(f"MESHBENCH FAIL {name} D={D}: "
                       f"{r.get('error', r)}")
@@ -171,16 +185,21 @@ def cmd_check(args) -> int:
                       f"pinned {want}")
                 failures += 1
                 continue
-            if r["host_syncs"] != r["levels"]:
-                # validate BEFORE the parseable ok-line: a leg must
-                # never print both ok and FAIL
+            if r["host_syncs"] > r["levels"]:
+                # one scalar-ring read per SUPERSTEP (ISSUE 10):
+                # host_syncs may be well below the level count but can
+                # never exceed it — more syncs than levels means row
+                # traffic leaked into the level loop.  Validate BEFORE
+                # the parseable ok-line: a leg must never print both
+                # ok and FAIL
                 print(f"MESHBENCH FAIL {name} D={D}: host_syncs "
-                      f"{r['host_syncs']} != levels {r['levels']} "
+                      f"{r['host_syncs']} > levels {r['levels']} "
                       f"(row traffic leaked into the level loop)")
                 failures += 1
                 continue
             print(f"MESHBENCH ok {name} D={D} exchange="
-                  f"{r['exchange']}: {r['generated']} gen / "
+                  f"{r['exchange']} merge={r.get('merge')}: "
+                  f"{r['generated']} gen / "
                   f"{r['distinct']} distinct "
                   f"({r['states_per_sec']:,.0f} st/s, host_syncs="
                   f"{r['host_syncs']}, levels={r['levels']}, "
@@ -208,7 +227,8 @@ def cmd_bench(args) -> int:
         for D in args.devices:
             r = _run_child(spec, cfg, D, args.exchange, True,
                            args.out_dir, store_trace=False,
-                           timeout_s=args.timeout)
+                           timeout_s=args.timeout, merge=args.merge,
+                           phase_probe=True)
             if not r.get("ok"):
                 print(f"MESHBENCH FAIL {name} D={D}: "
                       f"{r.get('error', r)}")
@@ -217,11 +237,14 @@ def cmd_bench(args) -> int:
                               "error": r.get("error", "failed")})
                 continue
             point = {k: r[k] for k in
-                     ("devices", "exchange", "generated", "distinct",
-                      "wall_s", "warmup_wall_s", "states_per_sec",
+                     ("devices", "exchange", "merge", "generated",
+                      "distinct", "wall_s", "warmup_wall_s",
+                      "states_per_sec",
                       "states_per_sec_per_chip", "window_recompiles",
-                      "host_syncs", "levels", "exchange_bytes",
-                      "exchange_bytes_per_level") if k in r}
+                      "host_syncs", "levels", "supersteps",
+                      "superstep_levels", "exchange_bytes",
+                      "exchange_bytes_per_level", "phase_walls")
+                     if k in r}
             for k in ("a2a_gamma", "a2a_spill", "a2a_max_bucket",
                       "shard_balance"):
                 if k in r:
@@ -239,9 +262,9 @@ def cmd_bench(args) -> int:
                       f"recompiled {r['window_recompiles']}x inside "
                       f"the window")
                 failures += 1
-            if r["host_syncs"] != r["levels"]:
+            if r["host_syncs"] > r["levels"]:
                 print(f"MESHBENCH FAIL {name} D={D}: host_syncs "
-                      f"{r['host_syncs']} != levels {r['levels']}")
+                      f"{r['host_syncs']} > levels {r['levels']}")
                 failures += 1
             if _gate(r["metrics_path"]):
                 failures += 1
@@ -267,6 +290,11 @@ def cmd_bench(args) -> int:
 
 
 def cmd_child(args) -> int:
+    if args.merge:
+        # the merge strategy is read from the environment at engine
+        # build (tpu/mesh.py): rank is the default, 0 forces fullsort
+        os.environ["JAXMC_MESH_RANKMERGE"] = \
+            "0" if args.merge == "fullsort" else "1"
     plat = os.environ.get("JAXMC_MESHBENCH_PLATFORM", "cpu")
     if plat == "cpu":
         # must precede ANY jax import in this process
@@ -342,6 +370,8 @@ def cmd_child(args) -> int:
             wall = time.time() - t0
             window_recompiles = sum(
                 1 for lv in tel.levels[lvl0:] if lv.get("fresh_compile"))
+        phase_walls = me.probe_phase_walls() if args.phase_probe \
+            else None
     levels = len(tel.levels) - (lvl0 if args.timed else 0)
     host_syncs = tel.counters.get("mesh.host_syncs", 0) - \
         (sync0 if args.timed else 0)
@@ -351,6 +381,7 @@ def cmd_child(args) -> int:
         "ok": bool(result.ok),
         "devices": args.devices,
         "exchange": me.exchange,
+        "merge": me.merge,
         "generated": int(result.generated),
         "distinct": int(result.distinct),
         "diameter": int(result.diameter),
@@ -362,11 +393,17 @@ def cmd_child(args) -> int:
             result.generated / max(wall, 1e-9) / args.devices, 3),
         "window_recompiles": window_recompiles,
         "host_syncs": host_syncs,
+        # host_syncs counts SUPERSTEPS (ISSUE 10): one scalar-ring
+        # read per dispatch; `levels` stays the per-level record count
+        "supersteps": host_syncs,
         "levels": levels,
         "exchange_bytes": int(xbytes),
         "exchange_bytes_per_level": int(xbytes / max(levels, 1)),
     }
-    for k, src in (("a2a_gamma", "mesh.a2a_gamma"),
+    if phase_walls:
+        out["phase_walls"] = phase_walls
+    for k, src in (("superstep_levels", "mesh.superstep_levels"),
+                   ("a2a_gamma", "mesh.a2a_gamma"),
                    ("a2a_spill", "mesh.a2a_spill"),
                    ("a2a_max_bucket", "mesh.a2a_max_bucket"),
                    ("shard_balance", "mesh.shard_balance")):
@@ -382,10 +419,14 @@ def cmd_child(args) -> int:
         summary["backend"] = "jax"
         summary["spec"] = args.spec
         summary["multichip"] = {k: out[k] for k in
-                                ("devices", "exchange", "states_per_sec",
+                                ("devices", "exchange", "merge",
+                                 "states_per_sec",
                                  "states_per_sec_per_chip",
                                  "window_recompiles", "host_syncs",
-                                 "exchange_bytes_per_level")}
+                                 "supersteps", "superstep_levels",
+                                 "levels", "phase_walls",
+                                 "exchange_bytes_per_level")
+                                if k in out}
         obs.write_json_atomic(args.metrics_out, summary)
     print(_RESULT_TAG + json.dumps(out), flush=True)
     return 0
@@ -416,6 +457,11 @@ def main(argv=None) -> int:
         p.add_argument("--exchange", default=None,
                        choices=(None, "a2a", "gather"),
                        help="override the per-D default strategy")
+        p.add_argument("--merge", default=None,
+                       choices=(None, "rank", "fullsort"),
+                       help="pin the dedup-merge strategy (default: "
+                            "the engine default, rank; the fullsort "
+                            "leg proves escape-hatch parity)")
         p.add_argument("--rung", action="append", default=None,
                        help="spec[=cfg], repeatable (repo-relative)")
         p.add_argument("--out-dir", default=os.environ.get(
@@ -428,13 +474,16 @@ def main(argv=None) -> int:
     pb = sub.add_parser("bench", help="scaling curve (make multichip-bench)")
     common(pb, "1,2,4,8")
     pb.add_argument("--out", default=os.path.join(_REPO,
-                                                  "MULTICHIP_r06.json"))
+                                                  "MULTICHIP_r07.json"))
     pch = sub.add_parser("child")
     pch.add_argument("--spec", required=True)
     pch.add_argument("--cfg", default=None)
     pch.add_argument("--devices", type=int, required=True)
     pch.add_argument("--exchange", default=None)
+    pch.add_argument("--merge", default=None,
+                     choices=(None, "rank", "fullsort"))
     pch.add_argument("--timed", action="store_true")
+    pch.add_argument("--phase-probe", action="store_true")
     pch.add_argument("--store-trace", action="store_true")
     pch.add_argument("--metrics-out", default=None)
     args = ap.parse_args(argv)

@@ -69,6 +69,8 @@ def load_record(path: str) -> Dict[str, Any]:
     for ext in (".json", ".jsonl"):
         if label.endswith(ext):
             label = label[:-len(ext)]
+    if str(obj.get("schema", "")).startswith("jaxmc.multichip/"):
+        return _from_multichip(obj, path, label)
     if "schema" in obj and "phases" in obj:
         return _from_metrics(obj, path, label)
     if "parsed" in obj and isinstance(obj["parsed"], dict):
@@ -112,6 +114,30 @@ def _from_metrics(s: Dict[str, Any], path: str, label: str
         "env": env,
         "result": res,
         "summary": s,
+    }
+
+
+def _from_multichip(s: Dict[str, Any], path: str, label: str
+                    ) -> Dict[str, Any]:
+    """A MULTICHIP_r*.json scaling artifact (jaxmc.multichip/1,
+    jaxmc/meshbench.py): one record whose `curve` maps each
+    (rung, devices) point to its per-chip rate, so `obs diff` can gate
+    r07-vs-r06 states/sec/chip per rung (ISSUE 10 CI satellite)."""
+    curve: Dict[str, Dict[str, Any]] = {}
+    for rung in s.get("rungs", []):
+        for pt in rung.get("curve", []) or []:
+            if "error" in pt:
+                continue
+            curve[f"{rung['rung']}@D{pt['devices']}"] = pt
+    return {
+        "path": path, "label": label, "kind": "multichip",
+        "states_per_sec": None,
+        "backend": "mesh", "platform": s.get("platform", "cpu"),
+        "rank": _RANK.get(s.get("platform", "cpu"), 1),
+        "mode": s.get("mode"), "wall_s": None,
+        "phases": {}, "env": s.get("env") or {},
+        "result": {"ok": s.get("ok")},
+        "curve": curve, "summary": s,
     }
 
 
@@ -165,6 +191,25 @@ def _phase_table(phases: List[Dict[str, Any]], out) -> int:
 def cmd_report(args, out=sys.stdout) -> int:
     rec = load_record(args.file)
     print(f"== {rec['label']} ({rec['kind']}: {args.file})", file=out)
+    if rec["kind"] == "multichip":
+        print(f"  platform={rec['platform']}  mode={rec['mode']}  "
+              f"ok={rec['result'].get('ok')}", file=out)
+        for key, pt in rec["curve"].items():
+            bits = [f"{pt.get('states_per_sec_per_chip', 0):,.0f} "
+                    f"st/s/chip",
+                    f"syncs={pt.get('host_syncs')}/"
+                    f"{pt.get('levels')} lvls"]
+            if pt.get("merge"):
+                bits.append(f"merge={pt['merge']}")
+            pw = pt.get("phase_walls")
+            if pw:
+                bits.append(
+                    f"walls expand={pw.get('expand_s')}s "
+                    f"exchange={pw.get('exchange_s')}s "
+                    f"merge(rank)={pw.get('merge_rank_s')}s "
+                    f"merge(fullsort)={pw.get('merge_fullsort_s')}s")
+            print(f"  {key:<28} " + "  ".join(bits), file=out)
+        return 0
     env = rec["env"]
     bits = [f"backend={rec['backend']}", f"platform={rec['platform']}"]
     if rec["mode"]:
@@ -230,8 +275,12 @@ def cmd_report(args, out=sys.stdout) -> int:
               "layout.packed_width_lanes", "layout.bits_per_state",
               "device.donation", "profile.status",
               "fingerprint.occupancy", "mesh.exchange", "mesh.devices",
+              "mesh.merge", "mesh.supersteps", "mesh.superstep_levels",
               "mesh.a2a_gamma", "mesh.a2a_spill", "mesh.a2a_max_bucket",
               "mesh.shard_balance",
+              "mesh.phase_expand_s", "mesh.phase_exchange_s",
+              "mesh.phase_merge_s", "mesh.phase_merge_rank_s",
+              "mesh.phase_merge_fullsort_s",
               "device.mem_high_water_bytes", "watchdog.max_stall_s"):
         if k in g:
             hl.append(f"{k}={g[k]}")
@@ -292,8 +341,62 @@ def find_regressions(prev: Dict[str, Any], cur: Dict[str, Any],
     return flags
 
 
+def _diff_multichip(recs: List[Dict[str, Any]], threshold: float,
+                    fail_on_regress: bool, out) -> int:
+    """Scaling-artifact trajectory (ISSUE 10 CI satellite): per
+    (rung, D) states/sec/chip across MULTICHIP_r* artifacts, a REGRESS
+    flag when a later artifact's per-chip rate drops past the
+    threshold on any shared point."""
+    keys: List[str] = []
+    for r in recs:
+        for k in r["curve"]:
+            if k not in keys:
+                keys.append(k)
+    lw = max([5] + [len(r["label"]) for r in recs])
+    kw = max([10] + [len(k) for k in keys])
+    print(f"{'point':<{kw}}  "
+          + "  ".join(f"{r['label']:>{max(lw, 12)}}" for r in recs),
+          file=out)
+    for k in keys:
+        cells = []
+        for r in recs:
+            pt = r["curve"].get(k)
+            cells.append(_fmt_rate(pt.get("states_per_sec_per_chip")
+                                   if pt else None))
+        print(f"{k:<{kw}}  "
+              + "  ".join(f"{c:>{max(lw, 12)}}" for c in cells),
+              file=out)
+    flags: List[str] = []
+    for prev, cur in zip(recs, recs[1:]):
+        step = f"{prev['label']} -> {cur['label']}"
+        for k in keys:
+            a, b = prev["curve"].get(k), cur["curve"].get(k)
+            if not a or not b:
+                continue
+            d = _pct(b.get("states_per_sec_per_chip"),
+                     a.get("states_per_sec_per_chip"))
+            if d is not None and d < -threshold:
+                flags.append(
+                    f"REGRESS states/sec/chip {k} {step}: "
+                    f"{_fmt_rate(a['states_per_sec_per_chip'])} -> "
+                    f"{_fmt_rate(b['states_per_sec_per_chip'])} "
+                    f"({d:+.1f}%)")
+    print("", file=out)
+    if flags:
+        print("regressions:", file=out)
+        for f in flags:
+            print(f"  {f}", file=out)
+    else:
+        print(f"no regressions flagged (threshold {threshold:.0f}%).",
+              file=out)
+    return 1 if (flags and fail_on_regress) else 0
+
+
 def cmd_diff(args, out=sys.stdout) -> int:
     recs = [load_record(p) for p in args.files]
+    if all(r["kind"] == "multichip" for r in recs):
+        return _diff_multichip(recs, args.threshold,
+                               args.fail_on_regress, out)
     # trajectory table: one row per run, the shared top phases as columns
     phase_tot: Dict[str, float] = {}
     for r in recs:

@@ -232,6 +232,46 @@ jaxmc.metrics/2 artifact minus the new optional surface, so readers and
       counter `serve.jobs_rejected` + trace event `serve.job_rejected
       {spec, codes}` for submissions refused by the submit-time lint
       gate.
+
+  (PR 10, still jaxmc.metrics/2 — all additive/optional; the mesh
+   rank-merge + superstep surface, tpu/mesh.py + jaxmc/meshbench.py:)
+    - merge strategy: gauge `mesh.merge` ("rank" | "fullsort") — the
+      shard-local dedup-merge that actually ran (rank is the default;
+      JAXMC_MESH_RANKMERGE=0 forces the PR-8 fullsort); the mesh
+      engine now also re-stamps `dedup.mode` at run start (the PR-6
+      gauge was stamped before the mesh subclass forced fp128 keys,
+      so multichip artifacts carried a stale value).
+    - supersteps: `mesh.host_syncs` now counts SUPERSTEPS — one
+      scalar-RING read per dispatch, each dispatch fusing up to
+      JAXMC_MESH_SUPERSTEP levels in a device-side lax.while_loop —
+      so host_syncs <= level-record count and < on any multi-level
+      run; gauges `mesh.supersteps` (== host_syncs for the run) and
+      `mesh.superstep_levels` (deepest fused dispatch).  Mesh level
+      records gain `superstep` (which dispatch the level rode) and
+      their `wall_s` is the dispatch wall amortized over its levels.
+    - phase walls (jaxmc.meshbench bench legs, MeshExplorer
+      .probe_phase_walls): gauges `mesh.phase_levels`,
+      `mesh.phase_expand_s`, `mesh.phase_exchange_s`,
+      `mesh.phase_merge_s`, `mesh.phase_merge_rank_s`,
+      `mesh.phase_merge_fullsort_s` — a measured expand / exchange /
+      merge wall breakdown at the run's learned capacities (both
+      merge strategies timed on identical inputs, so the rank win is
+      in the artifact); per-probed-level trace event
+      `mesh.phase_walls {level, expand_s, exchange_s, merge_rank_s,
+      merge_fullsort_s}`.
+    - multichip artifacts add per-point `merge`, `supersteps`,
+      `superstep_levels` and `phase_walls`; `python -m jaxmc.obs
+      diff` accepts two+ jaxmc.multichip/1 artifacts directly and
+      gates per-(rung, D) states/sec/chip with REGRESS flags.
+    - serve warm-registry eviction (ROADMAP item 3): counter
+      `serve.evictions` + trace event `serve.evicted {sig}` when the
+      bounded LRU (JAXMC_SERVE_WARM_MAX, default 32) drops the
+      least-recently-used idle session; evicted signatures fall back
+      to the final-checkpoint resume path (`serve.ckpt_resumes`).
+    - mesh capacity profiles (compile/cache.py variant
+      mesh-d<D>-<exchange>) gain the MSL key — the superstep
+      controller's learned levels-per-dispatch — alongside
+      SC/FC/TRL/GAM16.
 """
 
 from __future__ import annotations

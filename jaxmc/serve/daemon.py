@@ -75,7 +75,21 @@ class ServeDaemon:
         # each signature additionally serializes its RUNS through
         # _sig_lock: a CheckSession's engine is single-flight state, so
         # two same-signature jobs that dodged batching must not drive
-        # it concurrently
+        # it concurrently.
+        # BOUNDED LRU (ISSUE 10 satellite, ROADMAP item 3): a
+        # long-lived fleet daemon otherwise pins one compiled engine
+        # per signature forever.  JAXMC_SERVE_WARM_MAX (default a
+        # generous 32) caps the registry; the least-recently-used idle
+        # signature is evicted (`serve.evictions` + a `serve.evicted`
+        # event), and a re-submission after eviction falls back to the
+        # FINAL-CHECKPOINT resume path — bit-identical answer, just
+        # cold (the spool checkpoint and the persisted capacity
+        # profile survive eviction).
+        try:
+            self.warm_max = max(1, int(os.environ.get(
+                "JAXMC_SERVE_WARM_MAX", "32") or 32))
+        except ValueError:
+            self.warm_max = 32
         self.warm: Dict[str, Dict[str, Any]] = {}
         self._sig_locks: Dict[str, threading.Lock] = {}
         self._cv = threading.Condition()
@@ -344,6 +358,37 @@ class ServeDaemon:
                 lk = self._sig_locks[sig] = threading.Lock()
             return lk
 
+    def _touch_warm_locked(self, sig: str) -> None:
+        """Move `sig` to the registry's most-recently-used end (dicts
+        are insertion-ordered; caller holds _cv)."""
+        entry = self.warm.pop(sig, None)
+        if entry is not None:
+            self.warm[sig] = entry
+
+    def _evict_warm_locked(self) -> None:
+        """Evict least-recently-used IDLE signatures past warm_max
+        (caller holds _cv).  A signature mid-run (claimed in _running
+        or its per-sig lock held) is never evicted — the next-oldest
+        idle one goes instead."""
+        if len(self.warm) <= self.warm_max:
+            return
+        busy = set(self._running.values())
+        for sig in list(self.warm):
+            if len(self.warm) <= self.warm_max:
+                break
+            if sig in busy:
+                continue
+            lk = self._sig_locks.get(sig)
+            if lk is not None and lk.locked():
+                continue
+            del self.warm[sig]
+            self._sig_locks.pop(sig, None)
+            self.tel.counter("serve.evictions")
+            self.tel.event("serve.evicted", sig=sig)
+            self.log(f"serve: evicted warm session {sig[:12]} "
+                     f"(LRU, warm_max={self.warm_max}; resubmission "
+                     f"resumes its final checkpoint cold)")
+
     def _revalidate_profile(self, sess: CheckSession, job_tel) -> None:
         """Warm-path consistency check: confirm the DURABLE capacity
         profile still matches the warm engine's layout before trusting
@@ -387,6 +432,8 @@ class ServeDaemon:
 
         with self._cv:
             warm = self.warm.get(sig)
+            if warm is not None:
+                self._touch_warm_locked(sig)
         warm_engine = resumed = False
         with self._sig_lock(sig), obs.use_local(job_tel), \
                 self.tel.span("job", id=jid, sig=sig, spec=job["spec"],
@@ -435,6 +482,7 @@ class ServeDaemon:
                 with self._cv:
                     self.warm[sig] = {"session": sess,
                                       "completed": False}
+                    self._evict_warm_locked()
 
         drained = bool(getattr(res, "drained", False))
         completed = res.ok and not res.truncated and not drained

@@ -153,6 +153,126 @@ def _lower_bound(table, count, queries, cap):
     return lo
 
 
+def _lsd_sort(key_cols, extra_cols):
+    """Stable multi-key sort as chained STABLE single-key passes (LSD
+    radix over the key words, least-significant first).  Equivalent to
+    `lax.sort(key_cols + extra_cols, num_keys=len(key_cols))` with
+    key_cols[0] most significant — but multi-key sort comparators
+    explode XLA compile time inside while loops, and both resident
+    engines (single-chip tpu/bfs.py and the mesh superstep,
+    tpu/mesh.py) run this under lax.while_loop.  Returns the
+    (key_cols, extra_cols) lists co-sorted."""
+    cols = list(key_cols) + list(extra_cols)
+    nk = len(key_cols)
+    for kj in range(nk - 1, -1, -1):  # least-significant first
+        rest = [c for i, c in enumerate(cols) if i != kj]
+        res = lax.sort(tuple([cols[kj]] + rest), num_keys=1,
+                       is_stable=True)
+        out_rest = list(res[1:])
+        cols = [res[0] if i == kj else out_rest.pop(0)
+                for i in range(len(cols))]
+    return cols[:nk], cols[nk:]
+
+
+def _rank_merge(seen, seen_count, keys, N, SC, K, multikey=False):
+    """The O(new) seen-merge core SHARED by the single-chip resident
+    level and the mesh rank-merge strategy (ISSUE 10; the
+    _candidate_block_fn-style shared-plumbing pattern): the seen table
+    keeps a sorted valid prefix [0:seen_count) as an INVARIANT, so a
+    level only sorts its ≤N incoming keys (_lsd_sort — while_loop
+    safe), dedups them against the prefix with vectorized binary
+    searches (_lower_bound) and scatters the genuinely-new keys at
+    their ranks.  No per-level re-sort of the seen table: the sort
+    work is O(N log N), not O((SC+N) log (SC+N)).
+
+    seen [SC, K] (validity lane first, prefix sorted by the K-1 data
+    words), seen_count traced scalar, keys [N, K] unsorted candidate
+    keys (invalid rows: lane 0 != 0, SENTINEL data — they sort last).
+
+    Returns dict:
+      new_count  how many sorted candidate keys are genuinely new
+      nk_sidx    [N] each compacted new key's ORIGINAL row index in
+                 `keys` (key-sorted order; ties keep first occurrence)
+      seen2      [SC, K] merged table — sorted valid prefix of length
+                 seen_count + new_count, invalid tail (lane 1,
+                 SENTINEL data).  Positions past SC are DROPPED: the
+                 caller must treat seen_count2 > SC as an overflow and
+                 roll the level back (seen_count2 still reports the
+                 TRUE need, so growth can jump straight to it).
+      seen_count2  seen_count + new_count (NOT cropped to SC).
+
+    multikey=True sorts the candidate keys with ONE stable multi-key
+    lax.sort instead of the LSD chain — measured 3x faster on XLA:CPU
+    at mesh shapes, and a 5-key sort inside a while_loop compiles in
+    well under a second on current XLA (the mesh superstep uses it);
+    the single-chip resident engine keeps the LSD chain its compile
+    envelope was measured with."""
+    sidx = jnp.arange(N, dtype=jnp.int32)
+    if multikey:
+        res = lax.sort(tuple(keys[:, j] for j in range(K)) + (sidx,),
+                       num_keys=K, is_stable=True)
+        kc = list(res[:K])
+        sidx_s = res[K]
+    else:
+        kc, ec = _lsd_sort([keys[:, j] for j in range(K)], [sidx])
+        sidx_s = ec[0]
+    skeys = jnp.stack(kc, axis=1)
+    svalid = skeys[:, 0] == 0
+    neq_prev = jnp.concatenate([
+        jnp.array([True]),
+        jnp.any(skeys[1:] != skeys[:-1], axis=1)])
+
+    words = skeys[:, 1:]
+    seen_words = seen[:, 1:]
+    lb = _lower_bound(seen_words, seen_count, words, SC)
+    at_lb = jnp.take(seen_words, jnp.clip(lb, 0, SC - 1), axis=0)
+    found = (lb < seen_count) & jnp.all(at_lb == words, axis=1)
+    new = svalid & ~found & neq_prev
+    new_count = jnp.sum(new, dtype=jnp.int32)
+
+    # compact the new keys to the front (stable: key order kept)
+    flag2 = (1 - new.astype(jnp.int32))
+    res2 = lax.sort(tuple([flag2] + kc[1:] + [sidx_s, lb]),
+                    num_keys=1, is_stable=True)
+    nk_words = jnp.stack(res2[1:K], axis=1)
+    nk_sidx = res2[K]
+    nk_lb = res2[K + 1]
+    nvalid = sidx < new_count
+
+    # rank merge into seen2: pos(new j) = lb_seen + j,
+    # pos(seen i) = i + ranks(i) — a bijection since new keys are
+    # distinct from seen keys.  ranks[i] = #{valid new j : key_j <
+    # seen[i]} needs NO second binary search: key_j < seen[i] iff its
+    # lower bound nk_lb[j] <= i, so a scatter-add histogram of the
+    # nk_lb values + one inclusive cumsum gives every seen row's
+    # shift in O(SC + N) cheap ops (the SC-query binary search this
+    # replaces measurably dominated the mesh merge wall, ISSUE 10)
+    hist = jnp.zeros((SC + 1,), jnp.int32)
+    hist = hist.at[jnp.where(nvalid, jnp.clip(nk_lb, 0, SC), SC)] \
+        .add(1)
+    ranks = jnp.cumsum(hist[:SC])
+    valid_seen_rows = jnp.arange(SC) < seen_count
+    # dropped (invalid) rows get DISTINCT out-of-range indices
+    # (SC + arange): unique_indices=True is a correctness promise to
+    # XLA, and funnelling every invalid row to the same index would be
+    # documented UB even though mode="drop" discards the writes
+    # (advisor r2)
+    pos_s = jnp.where(valid_seen_rows,
+                      jnp.arange(SC, dtype=jnp.int32) + ranks,
+                      SC + jnp.arange(SC, dtype=jnp.int32))
+    seen2 = jnp.full((SC, K), SENTINEL, jnp.int32)
+    seen2 = seen2.at[:, 0].set(1)  # invalid tail: validity lane 1
+    seen2 = seen2.at[pos_s].set(seen, mode="drop",
+                                unique_indices=True)
+    nk_full = jnp.concatenate(
+        [jnp.zeros((N, 1), jnp.int32), nk_words], axis=1)
+    pos_n = jnp.where(nvalid, nk_lb + sidx, SC + sidx)
+    seen2 = seen2.at[pos_n].set(nk_full, mode="drop",
+                                unique_indices=True)
+    return dict(new_count=new_count, nk_sidx=nk_sidx, seen2=seen2,
+                seen_count2=seen_count + new_count)
+
+
 class _LiveGraph:
     """Host-side behavior-graph accumulator for device runs.
 
@@ -1530,71 +1650,21 @@ class TpuExplorer:
                              (seen_count + acc_n > SC), ST_OVF_SEEN, stat)
 
             # ---- merge-dedup the level's candidates against seen ----
-            # Multi-key lax.sort comparators explode XLA compile time
-            # inside while loops, so: (a) the candidate block is sorted
-            # by chained STABLE single-key passes (LSD radix over the
-            # key words), and (b) the seen-set is never re-sorted — new
-            # keys are merged by rank (two vectorized binary searches +
-            # scatters), which also touches O(new) not O(seen) per level.
-            sidx = jnp.arange(AccCap, dtype=jnp.int32)
-            cols = [acc_keys[:, j] for j in range(K)] + [sidx]
-            for kj in range(K - 1, -1, -1):  # least-significant first
-                rest = [c for i, c in enumerate(cols) if i != kj]
-                res = lax.sort(tuple([cols[kj]] + rest), num_keys=1,
-                               is_stable=True)
-                out_rest = list(res[1:])
-                cols = [res[0] if i == kj else out_rest.pop(0)
-                        for i in range(len(cols))]
-            skeys = jnp.stack(cols[:K], axis=1)
-            sidx_s = cols[K]
-            svalid = skeys[:, 0] == 0
-            neq_prev = jnp.concatenate([
-                jnp.array([True]),
-                jnp.any(skeys[1:] != skeys[:-1], axis=1)])
-
-            words = skeys[:, 1:]
-            seen_words = seen[:, 1:]
-            lb = _lower_bound(seen_words, seen_count, words, SC)
-            at_lb = jnp.take(seen_words, jnp.clip(lb, 0, SC - 1), axis=0)
-            found = (lb < seen_count) & jnp.all(at_lb == words, axis=1)
-            new = svalid & ~found & neq_prev
-            new_count = jnp.sum(new, dtype=jnp.int32)
-
-            # compact the new keys to the front (stable: key order kept)
-            flag2 = (1 - new.astype(jnp.int32))
-            res2 = lax.sort((flag2, cols[1], cols[2], cols[3], cols[4],
-                             sidx_s, lb), num_keys=1, is_stable=True)
-            nk_words = jnp.stack(res2[1:5], axis=1)
-            nk_sidx = res2[5]
-            nk_lb = res2[6]
+            # The shared O(new) rank-merge core (_rank_merge, also the
+            # mesh engine's merge strategy): the candidate block is
+            # sorted by chained STABLE single-key passes and the
+            # seen-set is never re-sorted — new keys merge by rank (two
+            # vectorized binary searches + scatters), so the sort work
+            # is O(new), not O(seen), per level.
+            rm = _rank_merge(seen, seen_count, acc_keys, AccCap, SC, K)
+            new_count = rm["new_count"]
             nvalid = jnp.arange(AccCap) < new_count
             new_rows = jnp.take(acc_rows,
-                                jnp.clip(nk_sidx, 0, AccCap - 1), axis=0)
+                                jnp.clip(rm["nk_sidx"], 0, AccCap - 1),
+                                axis=0)
             new_rows = jnp.where(nvalid[:, None], new_rows, SENTINEL)
-
-            # rank merge into seen2: pos(new j) = lb_seen + j,
-            # pos(seen i) = i + lb_new(seen i) — a bijection since new
-            # keys are distinct from seen keys
-            ranks = _lower_bound(nk_words, new_count, seen_words, AccCap)
-            valid_seen_rows = jnp.arange(SC) < seen_count
-            # dropped (invalid) rows get DISTINCT out-of-range indices
-            # (SC + arange): unique_indices=True is a correctness promise
-            # to XLA, and funnelling every invalid row to the same index
-            # would be documented UB even though mode="drop" discards
-            # the writes (advisor r2)
-            pos_s = jnp.where(valid_seen_rows,
-                              jnp.arange(SC, dtype=jnp.int32) + ranks,
-                              SC + jnp.arange(SC, dtype=jnp.int32))
-            seen2 = jnp.full((SC, K), SENTINEL, jnp.int32)
-            seen2 = seen2.at[pos_s].set(seen, mode="drop",
-                                        unique_indices=True)
-            nk_full = jnp.concatenate(
-                [jnp.zeros((AccCap, 1), jnp.int32), nk_words], axis=1)
-            pos_n = jnp.where(nvalid, nk_lb + sidx,
-                              SC + jnp.arange(AccCap, dtype=jnp.int32))
-            seen2 = seen2.at[pos_n].set(nk_full, mode="drop",
-                                        unique_indices=True)
-            seen_count2 = seen_count + new_count
+            seen2 = rm["seen2"]
+            seen_count2 = rm["seen_count2"]
 
             # constraints: violating states stay fingerprinted in seen2
             # but are discarded (not distinct / checked / explored).

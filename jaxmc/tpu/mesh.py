@@ -22,17 +22,29 @@ JAXMC_MESH_EXCHANGE overrides):
           fingerprint lands in its range — the structural analogue of
           ring-partitioned attention state (SURVEY.md §5).
 
-MESH-RESIDENT level loop (ISSUE 8 tentpole): the seen shards, the
-packed frontier and the per-level trace ring all stay ON DEVICE across
-levels; one jitted shard_map step per level expands, exchanges,
-merge-dedups, appends the trace ring and emits a single replicated
-scalar vector.  The host reads exactly that vector per level
-(mesh.host_syncs == level count — no row traffic), pre-sizes nothing,
-and only pulls rows on a violation (trace assembly), at a checkpoint, or
-never.  Capacity overflows (seen / frontier / trace ring / a2a bucket)
-roll the level back inside the step, so the host can grow the named
-capacity and redo the level — the same redo discipline as the
-single-chip resident engine (tpu/bfs.py).  Learned capacities persist
+MESH-RESIDENT superstep loop (ISSUE 8 tentpole; ISSUE 10 made the hot
+path O(new) and multi-level): the seen shards, the packed frontier and
+the per-level trace ring all stay ON DEVICE across levels; one jitted
+shard_map dispatch runs up to maxlvl levels in a lax.while_loop — each
+level expands, exchanges, RANK-MERGES against the sorted seen shards
+(only the <=R incoming keys are sorted; two binary searches + scatters
+shared with the single-chip resident engine, bfs._rank_merge — sort
+work no longer scales with the seen set; JAXMC_MESH_RANKMERGE=0 keeps
+the PR-8 full-sort as a bit-identical escape hatch, pinned to one
+level per dispatch), appends the trace ring and pushes one replicated
+[16]-i32 scalar vector into a device-side ring.  The host drains that
+ring once per superstep (mesh.host_syncs counts SUPERSTEPS, < level
+count — no row traffic), pre-sizes nothing, and only pulls rows on a
+violation (trace assembly), at a checkpoint, or never.  The loop exits
+early on violation / deadlock / assert / kernel overflow / truncation
+/ empty frontier, so violation localization, SIGTERM drain and
+checkpointing keep their exact level-boundary semantics; capacity
+overflows (seen / frontier / trace ring / a2a bucket) roll the
+offending level back inside the step, so the host can grow the named
+capacity and redo it.  JAXMC_MESH_SUPERSTEP pins the level budget per
+dispatch (1 = the one-level escape hatch); unset, it adapts to
+measured dispatch wall like the single-chip resident controller.
+Learned capacities (and the settled levels-per-dispatch, MSL) persist
 as a profile keyed by (module, layout_sig, D, exchange)
 (compile/cache.py variants), so a second mesh run compiles once and
 reports window_recompiles == 0.
@@ -78,15 +90,25 @@ from ..sem.modules import Model
 from ..engine.explore import CheckResult, Violation
 from ..compile.vspec import ModeError
 from ..compile.kernel2 import OV_DEMOTED, OV_PACK
-from .bfs import SENTINEL, TpuExplorer, _LiveGraph, _pow2_at_least
+from .bfs import (SENTINEL, TpuExplorer, _LiveGraph, _pow2_at_least,
+                  _rank_merge)
 
 _BIG = np.int32(2 ** 31 - 1)
 
+# device-side scalar ring capacity: the superstep while_loop writes one
+# [_NS] scalar vector per level into a [_SS_RINGCAP, _NS] ring the host
+# drains once per dispatch — the cap bounds levels-per-dispatch (a ring
+# entry is 64 bytes, so the whole ring stays trivially small)
+_SS_RINGCAP = 64
+
 # the mesh capacity-profile shape (compile/cache.py variant
 # "mesh-d<D>-<exchange>"): per-shard seen keys, per-shard frontier rows,
-# trace-ring levels, and the a2a bucket factor gamma stored as
-# round(gamma * 16) so the profile stays integer-valued
-_MESH_PROFILE_KEYS = ("SC", "FC", "TRL", "GAM16")
+# trace-ring levels, the a2a bucket factor gamma stored as
+# round(gamma * 16) so the profile stays integer-valued, and MSL — the
+# levels-per-dispatch the superstep controller settled on (ISSUE 10),
+# so a fresh engine skips the 1 -> 2 -> 4 ramp.  Profiles saved before
+# PR 10 simply lack MSL (hints max-merge, absent keys default).
+_MESH_PROFILE_KEYS = ("SC", "FC", "TRL", "GAM16", "MSL")
 
 # resident-step scalar vector layout (one replicated [NS] i32 vector is
 # ALL the host reads per level)
@@ -161,6 +183,37 @@ class MeshExplorer(TpuExplorer):
             raise ValueError(f"exchange must be 'gather' or 'a2a', "
                              f"got {exchange!r}")
         self.exchange = exchange
+        # shard-local merge strategy (ISSUE 10): "rank" keeps each seen
+        # shard's valid prefix SORTED as an invariant and merges only
+        # the ≤R incoming keys by rank (the single-chip resident
+        # engine's O(new) binary-search scatter, shared via
+        # bfs._rank_merge); "fullsort" is the PR-8 full
+        # [SC+R, K+1]-key stable sort, kept as the JAXMC_MESH_RANKMERGE=0
+        # escape hatch (bit-identical counts/traces, pinned by tests).
+        self.merge = "fullsort" \
+            if os.environ.get("JAXMC_MESH_RANKMERGE", "").strip() == "0" \
+            else "rank"
+        # levels per resident dispatch (ISSUE 10 supersteps):
+        # JAXMC_MESH_SUPERSTEP=<n> pins it (1 = the one-level-per-
+        # dispatch escape hatch); unset/auto adapts to measured
+        # dispatch wall like the single-chip resident maxlvl
+        # controller.  The fullsort merge cannot run under the
+        # superstep while_loop (multi-key sort comparators explode XLA
+        # compile time there), so it always runs one level per
+        # dispatch.
+        ss = os.environ.get("JAXMC_MESH_SUPERSTEP", "").strip().lower()
+        self._ss_fixed: Optional[int] = None
+        if ss not in ("", "0", "auto"):
+            try:
+                self._ss_fixed = max(1, min(int(ss), _SS_RINGCAP))
+            except ValueError:
+                self._ss_fixed = None
+        if self.merge == "fullsort":
+            self._ss_fixed = 1
+        self._mesh_maxlvl_warm = 1  # learned levels-per-dispatch ramp
+        self._ss_shrunk = False     # controller ever had to halve?
+        self._supersteps = 0
+        self._superstep_levels_max = 0
         self._a2a_gamma = 2.0
         self._mesh_step_cache: Dict[Tuple, Callable] = {}
         # skewed-hash fault site (ISSUE 8 satellite): when armed, EVERY
@@ -194,6 +247,10 @@ class MeshExplorer(TpuExplorer):
         if self._mesh_caps_hint.get("GAM16"):
             self._a2a_gamma = max(
                 self._a2a_gamma, self._mesh_caps_hint["GAM16"] / 16.0)
+        if self._mesh_caps_hint.get("MSL"):
+            self._mesh_maxlvl_warm = max(
+                self._mesh_maxlvl_warm,
+                min(int(self._mesh_caps_hint["MSL"]), _SS_RINGCAP))
 
     def _profile_variant(self) -> str:
         return f"mesh-d{self.D}-{self.exchange}"
@@ -348,19 +405,99 @@ class MeshExplorer(TpuExplorer):
 
     def _merge_fn(self, SC: int, R: int) -> Callable:
         """The shard-local merge-dedup shared by both step builders:
-        (seen_keys [SC,K], gkeys [R,K], gcand [R,PW], gsrc [R]) ->
-        dict(seen2, seen_count2, front_rows [R,PW], front_rows_u,
-        front_src [R], front_count, new_count).  Key sort with the
-        seen-first flag tiebreaker, then two stable compactions
-        (new rows, then constraint-kept rows); constraint-discarded
-        states stay fingerprinted but are never counted, checked, or
-        explored (TLC semantics)."""
-        K, PW = self.K, self.PW
+        (seen_keys [SC,K], seen_count scalar, gkeys [R,K], gcand [R,PW],
+        gsrc [R]) -> dict(seen2, seen_count2, front_rows [R,PW],
+        front_rows_u, front_src [R], front_count, new_count).
+
+        Two strategies, bit-identical counts/traces (ISSUE 10, pinned
+        by tests): "rank" (default) shares bfs._rank_merge — the seen
+        shard's sorted-prefix invariant means only the ≤R incoming keys
+        are sorted per level; "fullsort" (JAXMC_MESH_RANKMERGE=0) is
+        the PR-8 full stable sort over [SC+R, K+1] keys.  Both report
+        seen_count2 as the TRUE per-shard need BEFORE any [:SC] crop,
+        so the resident loop's grow-and-rerun path is strategy-blind;
+        both leave constraint-discarded states fingerprinted but never
+        counted, checked, or explored (TLC semantics)."""
+        if self.merge == "rank":
+            return self._merge_rank_fn(SC, R)
+        return self._merge_fullsort_fn(SC, R)
+
+    def _merge_finish_fn(self, R: int):
+        """Shared merge epilogue: constraint-mask the compacted new
+        rows and compact the explore-kept ones to the frontier front.
+        Constraints FIRST: violating states stay fingerprinted in the
+        seen shard but are discarded — not distinct, not checked, not
+        explored (TLC semantics, testout2:265)."""
         plan = self.plan
         con_fns = self.constraint_fns
         inv_fns = self.inv_fns
 
-        def merge(seen_keys, gkeys, gcand, gsrc):
+        def finish(new_rows, new_src, nvalid):
+            new_rows_u = plan.unpack_rows(new_rows) \
+                if (con_fns or inv_fns) else new_rows
+            explore = nvalid
+            for nm, f in con_fns:
+                explore = explore & jax.vmap(f)(new_rows_u)
+            idx4 = jnp.arange(R, dtype=jnp.int32)
+            ops4 = ((1 - explore.astype(jnp.int32)), idx4)
+            comp4 = lax.sort(ops4, num_keys=1, is_stable=True)
+            front_rows = jnp.take(new_rows, comp4[1], axis=0)
+            front_rows_u = jnp.take(new_rows_u, comp4[1], axis=0)
+            front_src = jnp.take(new_src, comp4[1])
+            front_count = jnp.sum(explore)
+            return front_rows, front_rows_u, front_src, front_count
+
+        return finish
+
+    def _merge_rank_fn(self, SC: int, R: int) -> Callable:
+        """O(new) rank-merge (ISSUE 10 tentpole): sort only the ≤R
+        exchanged keys, dedup against the sorted seen prefix with
+        binary searches, scatter the new keys at their ranks — the
+        single-chip resident engine's merge (bfs._rank_merge), shared
+        rather than duplicated.  Sort work no longer scales with the
+        size of the seen shard; single-key stable sorts only, so the
+        superstep while_loop can wrap it."""
+        K = self.K
+        finish = self._merge_finish_fn(R)
+
+        def merge(seen_keys, seen_count, gkeys, gcand, gsrc):
+            rm = _rank_merge(seen_keys, seen_count, gkeys, R, SC, K,
+                             multikey=True)
+            new_count = rm["new_count"]
+            nvalid = jnp.arange(R) < new_count
+            safe = jnp.clip(rm["nk_sidx"], 0, R - 1)
+            new_rows = jnp.take(gcand, safe, axis=0)
+            new_src = jnp.take(gsrc, safe)
+            new_rows = jnp.where(nvalid[:, None], new_rows, SENTINEL)
+            front_rows, front_rows_u, front_src, front_count = \
+                finish(new_rows, new_src, nvalid)
+            return dict(seen2=rm["seen2"],
+                        seen_count2=rm["seen_count2"],
+                        front_rows=front_rows, front_rows_u=front_rows_u,
+                        front_src=front_src, front_count=front_count,
+                        new_count=new_count)
+
+        return merge
+
+    def _merge_fullsort_fn(self, SC: int, R: int) -> Callable:
+        """The PR-8 full-sort merge (JAXMC_MESH_RANKMERGE=0 escape
+        hatch): one stable [SC+R, K+1]-key sort with the seen-first
+        flag tiebreaker, then stable compactions.  The seen INPUT is
+        masked to its valid prefix [0:seen_count) and the OUTPUT tail
+        re-masked invalid, so the shard always satisfies the rank
+        strategy's sorted-valid-prefix invariant (a checkpoint written
+        by either strategy resumes under the other) and stale tail
+        rows can never re-enter the occupancy count."""
+        K = self.K
+        finish = self._merge_finish_fn(R)
+        invalid_key_np = np.concatenate(
+            [np.ones(1, np.int32), np.full(K - 1, SENTINEL, np.int32)])
+
+        def merge(seen_keys, seen_count, gkeys, gcand, gsrc):
+            invalid_key = jnp.asarray(invalid_key_np)
+            srow_valid = jnp.arange(SC) < seen_count
+            seen_keys = jnp.where(srow_valid[:, None], seen_keys,
+                                  invalid_key)
             allk = jnp.concatenate([seen_keys, gkeys])    # [SC+R, K]
             flag = jnp.concatenate([jnp.zeros(SC, jnp.int32),
                                     jnp.ones(R, jnp.int32)])
@@ -400,22 +537,11 @@ class MeshExplorer(TpuExplorer):
             comp3 = lax.sort(ops3, num_keys=1, is_stable=True)
             seen2 = jnp.stack(comp3[1:], axis=1)[:SC]
             seen_count2 = jnp.sum(keep)
+            out_valid = jnp.arange(SC) < seen_count2
+            seen2 = jnp.where(out_valid[:, None], seen2, invalid_key)
 
-            # constraints FIRST: violating states stay fingerprinted in
-            # the seen shard but are discarded — not distinct, not
-            # checked, not explored (TLC semantics, testout2:265)
-            new_rows_u = plan.unpack_rows(new_rows) \
-                if (con_fns or inv_fns) else new_rows
-            explore = nvalid
-            for nm, f in con_fns:
-                explore = explore & jax.vmap(f)(new_rows_u)
-            idx4 = jnp.arange(R, dtype=jnp.int32)
-            ops4 = ((1 - explore.astype(jnp.int32)), idx4)
-            comp4 = lax.sort(ops4, num_keys=1, is_stable=True)
-            front_rows = jnp.take(new_rows, comp4[1], axis=0)
-            front_rows_u = jnp.take(new_rows_u, comp4[1], axis=0)
-            front_src = jnp.take(new_src, comp4[1])
-            front_count = jnp.sum(explore)
+            front_rows, front_rows_u, front_src, front_count = \
+                finish(new_rows, new_src, nvalid)
             return dict(seen2=seen2, seen_count2=seen_count2,
                         front_rows=front_rows, front_rows_u=front_rows_u,
                         front_src=front_src, front_count=front_count,
@@ -474,8 +600,9 @@ class MeshExplorer(TpuExplorer):
         need_edges = (out_cap is None and
                       (bool(self.refiners) or self.collect_edges))
 
-        def device_step(seen_keys, frontier_p, fcount):
-            # per-device blocks: seen_keys [SC,K], frontier [FC,PW], [1]
+        def device_step(seen_keys, seen_count, frontier_p, fcount):
+            # per-device blocks: seen_keys [SC,K], seen_count [1],
+            # frontier [FC,PW], fcount [1]
             seen_keys = seen_keys.reshape(SC, K)
             frontier = plan.unpack_rows(frontier_p.reshape(FC, PW))
             me = lax.axis_index("d")
@@ -493,7 +620,7 @@ class MeshExplorer(TpuExplorer):
              evalid) = route(blk["ckeys"], blk["cand"], blk["cvalid"],
                              me)
 
-            mg = merge_fn(seen_keys, gkeys, gcand, gsrc)
+            mg = merge_fn(seen_keys, seen_count[0], gkeys, gcand, gsrc)
             seen2 = mg["seen2"]
             seen_count2 = mg["seen_count2"]
             front_rows = mg["front_rows"]
@@ -581,26 +708,40 @@ class MeshExplorer(TpuExplorer):
             (21 if need_edges else 18)
         step = jax.jit(shard_map(
             device_step, mesh=self.mesh,
-            in_specs=(P("d"), P("d"), P("d")),
+            in_specs=(P("d"), P("d"), P("d"), P("d")),
             out_specs=tuple([P("d")] * n_out)))
         self._mesh_step_cache[key] = step
         return step
 
     def _get_mesh_resident_step(self, SC: int, FC: int,
                                 TRL: int) -> Callable:
-        """The MESH-RESIDENT level step (ISSUE 8 tentpole): one jitted
-        shard_map dispatch per level that expands, exchanges,
-        merge-dedups against the seen shards, appends the per-level
-        trace ring IN PLACE and returns the full device state plus ONE
-        replicated scalar vector — the only thing the host reads on the
-        clean path.  Any capacity overflow (seen / frontier / trace
-        ring / a2a bucket+spill) rolls the level back inside the step
-        (outputs == inputs), so the host can grow the named capacity
-        and redo the level without ever pulling rows."""
+        """The MESH-RESIDENT superstep (ISSUE 8 tentpole, ISSUE 10
+        multi-level fusion): one jitted shard_map dispatch that runs UP
+        TO `maxlvl` levels in a lax.while_loop — each level expands,
+        exchanges, merge-dedups against the seen shards and appends the
+        per-level trace ring IN PLACE — and returns the full device
+        state plus a device-side RING of per-level scalar vectors the
+        host drains once per superstep (the only thing it reads on the
+        clean path).  The loop exits early on violation / deadlock /
+        assert / kernel overflow / truncation / empty frontier, and on
+        any capacity overflow (seen / frontier / trace ring / a2a
+        bucket+spill) the offending level rolls back inside the step
+        (its outputs == its inputs), so rollback, violation
+        localization, drain and checkpointing keep their exact
+        one-level-per-dispatch semantics.
+
+        maxlvl, the level budget per dispatch, is a TRACED argument
+        (like the single-chip resident maxlvl) so the host adapts it
+        without recompiling.  The "fullsort" merge strategy cannot live
+        inside a while_loop (multi-key sort comparators explode XLA
+        compile time there), so it compiles the single-level body
+        applied once — the one-level-per-dispatch escape-hatch program
+        — with the identical ring-of-one output surface."""
         C = self.A * FC
         route, R, B, SB = self._route_fn(C, FC)
         with_trace = self.store_trace
-        key = ("res", SC, FC, TRL, B, SB, with_trace)
+        superstep = self.merge == "rank"
+        key = ("res", SC, FC, TRL, B, SB, with_trace, self.merge)
         if key in self._mesh_step_cache:
             return self._mesh_step_cache[key]
         K, D, PW = self.K, self.D, self.PW
@@ -612,120 +753,216 @@ class MeshExplorer(TpuExplorer):
         def device_step(seen_keys, seen_count, frontier_p, fcount,
                         *rest):
             if with_trace:
-                tr_rows, tr_src, lvl = rest
-                tr_rows = tr_rows.reshape(TRL, FC, PW)
-                tr_src = tr_src.reshape(TRL, FC)
+                tr_rows = rest[0].reshape(TRL, FC, PW)
+                tr_src = rest[1].reshape(TRL, FC)
+                lvl0, maxlvl, dist0, max_states = rest[2:]
             else:
-                (lvl,) = rest
+                tr_rows = tr_src = None
+                lvl0, maxlvl, dist0, max_states = rest
             seen_keys = seen_keys.reshape(SC, K)
             frontier_p = frontier_p.reshape(FC, PW)
-            frontier = plan.unpack_rows(frontier_p)
+            seen_count0 = seen_count[0]
+            fcount0 = fcount[0]
             me = lax.axis_index("d")
-            fvalid = jnp.arange(FC) < fcount[0]
-            blk = block_fn(frontier, fvalid)
-            dead_local = (jnp.any(blk["dead"]) if check_deadlock
-                          else jnp.asarray(False))
 
-            (gkeys, gcand, gsrc, spill_local, a2a_ovf, maxdest,
-             _evalid) = route(blk["ckeys"], blk["cand"],
-                              blk["cvalid"], me)
+            def one_level(seen_keys, seen_count, frontier_p, fcount,
+                          tr_rows, tr_src, lvl, dist):
+                """One BFS level (the PR-8 step body): returns the
+                committed-or-rolled-back state, the level's scalar
+                vector, the localization vector, and the replicated
+                stop verdict."""
+                frontier = plan.unpack_rows(frontier_p)
+                fvalid = jnp.arange(FC) < fcount
+                blk = block_fn(frontier, fvalid)
+                dead_local = (jnp.any(blk["dead"]) if check_deadlock
+                              else jnp.asarray(False))
 
-            mg = merge_fn(seen_keys, gkeys, gcand, gsrc)
-            front_rows = mg["front_rows"]
-            front_count = mg["front_count"]
-            front_src = mg["front_src"]
-            seen_count2 = mg["seen_count2"]
-            inv_which, inv_slot = self._inv_scan(mg["front_rows_u"],
-                                                 front_count, R)
+                (gkeys, gcand, gsrc, spill_local, a2a_ovf, maxdest,
+                 _evalid) = route(blk["ckeys"], blk["cand"],
+                                  blk["cvalid"], me)
 
-            # ---- capacity verdicts (replicated) ----
-            f_ovf = lax.psum((front_count > FC).astype(jnp.int32),
-                             "d") > 0
-            s_ovf = lax.psum((seen_count2 > SC).astype(jnp.int32),
-                             "d") > 0
-            t_ovf = (jnp.asarray(with_trace) & (lvl >= TRL)) \
-                if with_trace else jnp.asarray(False)
-            any_a2a_ovf = lax.psum(a2a_ovf.astype(jnp.int32), "d") > 0
-            grow = f_ovf | s_ovf | t_ovf | any_a2a_ovf
-            commit = ~grow
+                mg = merge_fn(seen_keys, seen_count, gkeys, gcand, gsrc)
+                front_rows = mg["front_rows"]
+                front_count = mg["front_count"]
+                front_src = mg["front_src"]
+                seen_count2 = mg["seen_count2"]
+                inv_which, inv_slot = self._inv_scan(mg["front_rows_u"],
+                                                     front_count, R)
 
-            # ---- commit or roll back the device state ----
-            seen_out = jnp.where(commit, mg["seen2"], seen_keys)
-            seen_count_out = jnp.where(commit, seen_count2,
-                                       seen_count[0])
-            new_frontier = front_rows[:FC]       # R >= FC by the floors
-            # ring src rows keep the documented -1-means-empty
-            # convention: slots past front_count hold compaction
-            # leftovers (nonnegative), and an unmasked write would make
-            # _ring_levels' occupied-prefix trim inert (review r8)
-            new_src_fc = jnp.where(
-                jnp.arange(FC) < front_count,
-                front_src[:FC], -1).astype(jnp.int32)
-            frontier_out = jnp.where(commit, new_frontier, frontier_p)
-            fcount_out = jnp.where(commit, front_count, fcount[0])
-            outs = [seen_out.reshape(1, SC, K),
-                    seen_count_out.reshape(1),
-                    frontier_out.reshape(1, FC, PW),
-                    fcount_out.reshape(1)]
+                # ---- capacity verdicts (replicated) ----
+                f_ovf = lax.psum((front_count > FC).astype(jnp.int32),
+                                 "d") > 0
+                s_ovf = lax.psum((seen_count2 > SC).astype(jnp.int32),
+                                 "d") > 0
+                t_ovf = (jnp.asarray(with_trace) & (lvl >= TRL)) \
+                    if with_trace else jnp.asarray(False)
+                any_a2a_ovf = lax.psum(a2a_ovf.astype(jnp.int32),
+                                       "d") > 0
+                grow = f_ovf | s_ovf | t_ovf | any_a2a_ovf
+                commit = ~grow
+
+                # ---- commit or roll back the device state ----
+                seen_out = jnp.where(commit, mg["seen2"], seen_keys)
+                seen_count_out = jnp.where(commit, seen_count2,
+                                           seen_count)
+                new_frontier = front_rows[:FC]   # R >= FC by the floors
+                # ring src rows keep the documented -1-means-empty
+                # convention: slots past front_count hold compaction
+                # leftovers (nonnegative), and an unmasked write would
+                # make _ring_levels' occupied-prefix trim inert
+                # (review r8)
+                new_src_fc = jnp.where(
+                    jnp.arange(FC) < front_count,
+                    front_src[:FC], -1).astype(jnp.int32)
+                frontier_out = jnp.where(commit, new_frontier,
+                                         frontier_p)
+                fcount_out = jnp.where(commit, front_count, fcount)
+                if with_trace:
+                    wl = jnp.clip(lvl, 0, TRL - 1)
+                    tr_rows2 = lax.dynamic_update_slice(
+                        tr_rows, new_frontier[None], (wl, 0, 0))
+                    tr_src2 = lax.dynamic_update_slice(
+                        tr_src, new_src_fc[None], (wl, 0))
+                    tr_rows_out = jnp.where(commit, tr_rows2, tr_rows)
+                    tr_src_out = jnp.where(commit, tr_src2, tr_src)
+                else:
+                    tr_rows_out = tr_src_out = None
+
+                # ---- the per-level scalar vector (replicated) ----
+                tot_new = lax.psum(front_count, "d")
+                ovc = lax.pmax(blk["overflow"], "d")
+                tot_dead = lax.psum(dead_local.astype(jnp.int32), "d")
+                tot_assert = lax.psum(
+                    blk["assert_bad"].astype(jnp.int32), "d")
+                inv_min = lax.pmin(inv_which, "d")
+                scal = jnp.zeros((_NS,), jnp.int32)
+                scal = scal.at[_S_GEN].set(
+                    lax.psum(blk["gen_local"], "d"))
+                scal = scal.at[_S_NEW].set(tot_new)
+                scal = scal.at[_S_FRONT].set(tot_new)
+                scal = scal.at[_S_MAXF].set(lax.pmax(front_count, "d"))
+                scal = scal.at[_S_MAXS].set(lax.pmax(seen_count2, "d"))
+                scal = scal.at[_S_SUMS].set(lax.psum(seen_count2, "d"))
+                scal = scal.at[_S_OVC].set(ovc)
+                scal = scal.at[_S_DEAD].set(tot_dead)
+                scal = scal.at[_S_ASSERT].set(tot_assert)
+                scal = scal.at[_S_INVMIN].set(inv_min)
+                scal = scal.at[_S_FOVF].set(f_ovf.astype(jnp.int32))
+                scal = scal.at[_S_SOVF].set(s_ovf.astype(jnp.int32))
+                scal = scal.at[_S_TOVF].set(t_ovf.astype(jnp.int32))
+                scal = scal.at[_S_AOVF].set(
+                    any_a2a_ovf.astype(jnp.int32))
+                scal = scal.at[_S_SPILL].set(
+                    lax.psum(spill_local, "d"))
+                scal = scal.at[_S_MAXDEST].set(lax.pmax(maxdest, "d"))
+
+                # per-device localization vector (fetched only on
+                # violation — always the LAST executed level's, because
+                # every violation stops the superstep)
+                aux = jnp.zeros((_NA,), jnp.int32)
+                aux = aux.at[_A_INVW].set(inv_which)
+                aux = aux.at[_A_INVSLOT].set(inv_slot)
+                aux = aux.at[_A_DEAD].set(dead_local.astype(jnp.int32))
+                aux = aux.at[_A_DEADSLOT].set(blk["dead_slot"])
+                aux = aux.at[_A_ASSERT].set(
+                    blk["assert_bad"].astype(jnp.int32))
+                aux = aux.at[_A_ASRTA].set(blk["asrt_a"])
+                aux = aux.at[_A_ASRTF].set(blk["asrt_f"])
+
+                # ---- superstep exit verdict (replicated) ----
+                dist2 = jnp.where(commit, dist + tot_new, dist)
+                viol = (inv_min != _BIG) | (tot_dead > 0) | \
+                    (tot_assert > 0) | (ovc != 0)
+                trunc = commit & (max_states > 0) & \
+                    (dist2 >= max_states)
+                done = commit & (tot_new == 0)
+                stop = grow | viol | trunc | done
+                lvl2 = jnp.where(commit, lvl + 1, lvl)
+                return (seen_out, seen_count_out, frontier_out,
+                        fcount_out, tr_rows_out, tr_src_out, lvl2,
+                        dist2, scal, aux, stop)
+
+            ring0 = jnp.zeros((_SS_RINGCAP, _NS), jnp.int32)
+            aux0 = jnp.zeros((_NA,), jnp.int32)
+
+            if superstep:
+                # one body serves both trace configurations: without
+                # tracing the two trace-ring carry slots hold scalar
+                # dummies that thread through unchanged (while_loop
+                # carries need consistent pytrees; one_level never
+                # touches its tr args when with_trace is False)
+                def body(carry):
+                    (sk, sc_, fp, fc_, trr, trs, lvl, dist, nlv, ring,
+                     aux, stop) = carry
+                    (sk, sc_, fp, fc_, trr2, trs2, lvl, dist, scal,
+                     aux, stop) = one_level(
+                        sk, sc_, fp, fc_,
+                        trr if with_trace else None,
+                        trs if with_trace else None, lvl, dist)
+                    if with_trace:
+                        trr, trs = trr2, trs2
+                    ring = lax.dynamic_update_slice(ring, scal[None],
+                                                    (nlv, 0))
+                    return (sk, sc_, fp, fc_, trr, trs, lvl, dist,
+                            nlv + 1, ring, aux, stop)
+
+                def cond(carry):
+                    nlv, stop = carry[8], carry[11]
+                    return (~stop) & (nlv < jnp.minimum(
+                        maxlvl, jnp.int32(_SS_RINGCAP)))
+
+                dummy = jnp.int32(0)
+                carry0 = (seen_keys, seen_count0, frontier_p, fcount0,
+                          tr_rows if with_trace else dummy,
+                          tr_src if with_trace else dummy,
+                          lvl0, dist0, jnp.int32(0), ring0, aux0,
+                          jnp.asarray(False))
+                carry = lax.while_loop(cond, body, carry0)
+                (seen_f, seen_count_f, frontier_f, fcount_f) = carry[:4]
+                tr_rows_f, tr_src_f = (carry[4], carry[5]) \
+                    if with_trace else (None, None)
+                nlv_f, ring_f, aux_f = carry[8], carry[9], carry[10]
+            else:
+                # fullsort escape hatch: the identical body, applied
+                # once outside any while_loop — a ring of one entry
+                (seen_f, seen_count_f, frontier_f, fcount_f, tr_rows_f,
+                 tr_src_f, _lvl, _dist, scal, aux_f, _stop) = one_level(
+                    seen_keys, seen_count0, frontier_p, fcount0,
+                    tr_rows, tr_src, lvl0, dist0)
+                ring_f = lax.dynamic_update_slice(ring0, scal[None],
+                                                  (0, 0))
+                nlv_f = jnp.int32(1)
+
+            outs = [seen_f.reshape(1, SC, K),
+                    seen_count_f.reshape(1),
+                    frontier_f.reshape(1, FC, PW),
+                    fcount_f.reshape(1)]
             if with_trace:
-                wl = jnp.clip(lvl, 0, TRL - 1)
-                tr_rows2 = lax.dynamic_update_slice(
-                    tr_rows, new_frontier[None], (wl, 0, 0))
-                tr_src2 = lax.dynamic_update_slice(
-                    tr_src, new_src_fc[None], (wl, 0))
-                outs.append(jnp.where(commit, tr_rows2, tr_rows)
-                            .reshape(1, TRL, FC, PW))
-                outs.append(jnp.where(commit, tr_src2, tr_src)
-                            .reshape(1, TRL, FC))
-
-            # ---- the per-level scalar vector (replicated values) ----
-            scal = jnp.zeros((_NS,), jnp.int32)
-            scal = scal.at[_S_GEN].set(lax.psum(blk["gen_local"], "d"))
-            scal = scal.at[_S_NEW].set(lax.psum(front_count, "d"))
-            scal = scal.at[_S_FRONT].set(lax.psum(front_count, "d"))
-            scal = scal.at[_S_MAXF].set(lax.pmax(front_count, "d"))
-            scal = scal.at[_S_MAXS].set(lax.pmax(seen_count2, "d"))
-            scal = scal.at[_S_SUMS].set(lax.psum(seen_count2, "d"))
-            scal = scal.at[_S_OVC].set(lax.pmax(blk["overflow"], "d"))
-            scal = scal.at[_S_DEAD].set(
-                lax.psum(dead_local.astype(jnp.int32), "d"))
-            scal = scal.at[_S_ASSERT].set(
-                lax.psum(blk["assert_bad"].astype(jnp.int32), "d"))
-            scal = scal.at[_S_INVMIN].set(lax.pmin(inv_which, "d"))
-            scal = scal.at[_S_FOVF].set(f_ovf.astype(jnp.int32))
-            scal = scal.at[_S_SOVF].set(s_ovf.astype(jnp.int32))
-            scal = scal.at[_S_TOVF].set(t_ovf.astype(jnp.int32))
-            scal = scal.at[_S_AOVF].set(any_a2a_ovf.astype(jnp.int32))
-            scal = scal.at[_S_SPILL].set(lax.psum(spill_local, "d"))
-            scal = scal.at[_S_MAXDEST].set(lax.pmax(maxdest, "d"))
-            outs.append(scal.reshape(1, _NS))
-
-            # per-device localization vector (fetched only on violation)
-            aux = jnp.zeros((_NA,), jnp.int32)
-            aux = aux.at[_A_INVW].set(inv_which)
-            aux = aux.at[_A_INVSLOT].set(inv_slot)
-            aux = aux.at[_A_DEAD].set(dead_local.astype(jnp.int32))
-            aux = aux.at[_A_DEADSLOT].set(blk["dead_slot"])
-            aux = aux.at[_A_ASSERT].set(
-                blk["assert_bad"].astype(jnp.int32))
-            aux = aux.at[_A_ASRTA].set(blk["asrt_a"])
-            aux = aux.at[_A_ASRTF].set(blk["asrt_f"])
-            outs.append(aux.reshape(1, _NA))
+                outs.append(tr_rows_f.reshape(1, TRL, FC, PW))
+                outs.append(tr_src_f.reshape(1, TRL, FC))
+            outs.append(ring_f.reshape(1, _SS_RINGCAP, _NS))
+            outs.append(nlv_f.reshape(1))
+            outs.append(aux_f.reshape(1, _NA))
             return tuple(outs)
 
         shard_map = self._shard_map()
-        n_in = 7 if with_trace else 5
-        n_out = 8 if with_trace else 6
-        in_specs = tuple([P("d")] * (n_in - 1)) + (P(),)
+        n_in = 10 if with_trace else 8
+        n_out = 9 if with_trace else 7
+        in_specs = tuple([P("d")] * (n_in - 4)) + (P(), P(), P(), P())
         # donate the big device buffers — seen, frontier, trace ring —
         # so XLA updates them in place across levels (accelerators;
         # XLA:CPU ignores donation with a warning, JAXMC_DONATE forces)
         donate = ((0, 2, 4, 5) if with_trace else (0, 2)) \
             if self.donate else ()
+        # check_rep=False: shard_map's replication checker has no rule
+        # for lax.while_loop (the superstep level loop); every output
+        # is P("d")-sharded anyway, so nothing relied on inferred
+        # replication
         step = jax.jit(shard_map(
             device_step, mesh=self.mesh,
             in_specs=in_specs,
-            out_specs=tuple([P("d")] * n_out)),
+            out_specs=tuple([P("d")] * n_out),
+            check_rep=False),
             donate_argnums=donate)
         self._mesh_step_cache[key] = step
         return step
@@ -738,7 +975,10 @@ class MeshExplorer(TpuExplorer):
         (tpu/multihost.py): per-owner frontier fill and lexsorted seen
         keys with the validity-lane-1 empty-slot convention. One layout
         rule, so host and device dedup can never diverge. Returns
-        (seen [D,SC,K], frontier [D,FC,PW], fcount [D]) as numpy."""
+        (seen [D,SC,K], frontier [D,FC,PW], fcount [D],
+        seen_counts [D]) as numpy — the per-shard valid-prefix lengths
+        the merge strategies key on, returned here so no caller
+        re-derives them from the validity lane."""
         K = self.K
         if keys is None:
             keys, packed, povf = self._host_keys(init_rows)
@@ -752,6 +992,7 @@ class MeshExplorer(TpuExplorer):
         seen = np.full((D, SC, K), SENTINEL, np.int32)
         seen[:, :, 0] = 1  # empty slots: validity lane 1
         fcount = np.zeros((D,), np.int32)
+        seen_counts = np.zeros((D,), np.int32)
         for d in range(D):
             p = packed[(owner == d) & exp]
             frontier[d, :len(p)] = p
@@ -761,7 +1002,8 @@ class MeshExplorer(TpuExplorer):
                                          for i in reversed(range(K))))
                 seen[d, :len(k)] = k[order]
             fcount[d] = len(p)
-        return seen, frontier, fcount
+            seen_counts[d] = len(k)
+        return seen, frontier, fcount, seen_counts
 
     # ---- trace reconstruction (host side) ----
     #
@@ -861,18 +1103,32 @@ class MeshExplorer(TpuExplorer):
         self._spill_rows = 0
         self._max_bucket = 0
         self._shard_balance = None
+        self._supersteps = 0
+        self._superstep_levels_max = 0
+        self._ss_shrunk = False
         # chosen strategy + gamma, once per run (ISSUE 8 satellite)
         resident = not (need_props or need_edges or
                         os.environ.get("JAXMC_MESH_RESIDENT", "1")
                         == "0")
         self.log(f"-- mesh: {self.D} device(s), exchange="
                  f"{self.exchange} ({self._exchange_src}), "
-                 f"gamma={self._a2a_gamma:g}, "
+                 f"gamma={self._a2a_gamma:g}, merge={self.merge}, "
                  f"loop={'resident' if resident else 'host'}"
                  + (" [mesh_skew fault armed]" if self._skew else ""))
         tel = obs.current()
         tel.gauge("mesh.exchange", self.exchange)
         tel.gauge("mesh.devices", self.D)
+        # the mesh engine's own strategy stamps (ISSUE 10 satellite):
+        # TpuExplorer.__init__ gauges dedup.mode BEFORE the mesh
+        # subclass forces fp128 keys, so multichip artifacts carried a
+        # stale (or, under serve/bench telemetry scoping, no) value —
+        # re-stamp both here so `obs report` highlights name the dedup
+        # and merge strategy that actually ran
+        tel.gauge("dedup.mode",
+                  "fp128" + ("-view" if self.view_fn is not None
+                             else ("-packed" if not self.plan.identity
+                                   else "")))
+        tel.gauge("mesh.merge", self.merge)
         if resident:
             return self._run_mesh_resident()
         return self._run_hostloop(need_edges, need_props)
@@ -989,17 +1245,16 @@ class MeshExplorer(TpuExplorer):
             TRL = _pow2_at_least(max(int(hint.get("TRL", 1)), 16),
                                  lo=16)
             explored_idx = np.nonzero(explored_mask)[0]
-            seen_np, frontier_np, fcount_np = self._init_shards(
-                init_rows, explored_idx, D, SC, FC,
-                keys=init_keys, packed=init_packed, owner=owner)
+            seen_np, frontier_np, fcount_np, scount_np = \
+                self._init_shards(
+                    init_rows, explored_idx, D, SC, FC,
+                    keys=init_keys, packed=init_packed, owner=owner)
             if self.store_trace:
                 self._levels.append((frontier_np.copy(), None, FC))
             seen = jnp.asarray(seen_np)
             frontier = jnp.asarray(frontier_np)
             fcount = jnp.asarray(fcount_np.astype(np.int32))
-            seen_count = jnp.asarray(
-                np.array([int((owner == d).sum()) for d in range(D)],
-                         np.int32))
+            seen_count = jnp.asarray(scount_np)
             depth = 0
 
         tr_rows = tr_src = None
@@ -1018,11 +1273,25 @@ class MeshExplorer(TpuExplorer):
 
         last_progress = last_ck = time.time()
         lvl_frontier = int(np.sum(np.asarray(fcount)))
-        levels_run = 0
+        # superstep controller (ISSUE 10): JAXMC_MESH_SUPERSTEP pins
+        # the level budget per dispatch; auto starts at the learned
+        # warm value (1 on a cold engine — the first dispatch is
+        # exactly the one-level program run) and adapts to measured
+        # dispatch wall so progress, checkpoint and drain attention
+        # keep their cadence, like the single-chip resident maxlvl
+        # controller (tpu/bfs.py)
+        maxlvl = self._ss_fixed or min(self._mesh_maxlvl_warm,
+                                       _SS_RINGCAP)
+        target_s = max(1.0, min(
+            self.progress_every or 30.0,
+            (self.checkpoint_every or 1e9) if self.checkpoint_path
+            else 1e9))
         while lvl_frontier > 0:
             lvl_t0 = time.time()
-            # chaos sites: crash / drain between dispatches (the only
-            # host-attention points the resident mesh loop has)
+            # chaos sites: crash / drain between dispatches — with
+            # supersteps these are SUPERSTEP boundaries, the only
+            # host-attention points the resident mesh loop has
+            # (jaxmc/faults.py)
             faults.kill_self("run_kill", level=depth, engine="mesh")
             faults.inject("device_run_fail", level=depth, engine="mesh")
             if self._drain_requested(warnings, "mesh"):
@@ -1037,179 +1306,226 @@ class MeshExplorer(TpuExplorer):
             C = self.A * FC
             B = self._a2a_bucket(C, FC) if self.exchange == "a2a" else 0
             SB = self._a2a_spill_bucket(B) if B else 0
-            step_key = ("res", SC, FC, TRL, B, SB, self.store_trace)
+            step_key = ("res", SC, FC, TRL, B, SB, self.store_trace,
+                        self.merge)
             fresh_compile = step_key not in self._mesh_step_cache
             step = self._get_mesh_resident_step(SC, FC, TRL)
             args = (seen, seen_count, frontier, fcount)
             if self.store_trace:
                 args = args + (tr_rows, tr_src)
-            args = args + (jnp.int32(depth),)
+            args = args + (jnp.int32(depth), jnp.int32(maxlvl),
+                           jnp.int32(distinct),
+                           jnp.int32(self.max_states or 0))
             outs = step(*args)
             if self.store_trace:
                 (seen2, seen_count2, frontier2, fcount2, tr_rows2,
-                 tr_src2, scal_d, aux_d) = outs
+                 tr_src2, ring_d, nlv_d, aux_d) = outs
             else:
-                (seen2, seen_count2, frontier2, fcount2, scal_d,
-                 aux_d) = outs
+                (seen2, seen_count2, frontier2, fcount2, ring_d,
+                 nlv_d, aux_d) = outs
                 tr_rows2 = tr_src2 = None
-            # THE one host sync of the level: the replicated scalar
-            # vector (every per-device row is identical; tiny)
-            scal = np.asarray(scal_d)[0]
+            # THE one host sync of the superstep: the replicated
+            # per-level scalar ring + its occupancy (every per-device
+            # row is identical; tiny).  mesh.host_syncs therefore
+            # counts SUPERSTEPS, not levels (obs/schema.py PR-10).
+            ring = np.asarray(ring_d)[0]
+            nlv = max(1, int(np.asarray(nlv_d)[0]))
+            disp_wall = time.time() - lvl_t0
             tel.counter("mesh.host_syncs")
             tel.counter("mesh.exchange_bytes",
-                        self._exchange_bytes(C, B, SB))
-
-            ovc = int(scal[_S_OVC])
-            if ovc:
-                if ovc == OV_DEMOTED:
-                    msg = ("a demoted compile-recovery fired (the "
-                           "kernel under-approximates here): run the "
-                           "host_seen mode, which demotes the arm to "
-                           "the interpreter and restarts — raising "
-                           "caps cannot help")
-                elif ovc == OV_PACK:
-                    msg = self._pack_ovf_msg()
-                else:
-                    msg = ("a container exceeded its lane capacity "
-                           f"({self._caps_note()}); counts would no "
-                           "longer be exact")
-                return self._mk(False, distinct, generated, depth, t0,
-                                warnings, Violation(
-                                    "error", "capacity overflow", [],
-                                    msg))
-
-            if scal[_S_FOVF] or scal[_S_SOVF] or scal[_S_TOVF] or \
-                    scal[_S_AOVF]:
-                # the step rolled the level back on device: grow every
-                # flagged capacity at once (each growth recompiles the
-                # step, so batching growths minimizes recompiles), then
-                # redo the level
-                grew = []
-                if scal[_S_AOVF]:
-                    # grow gamma straight to the OBSERVED per-peer need
-                    # (the max bucket occupancy rode the scalar vector)
-                    # instead of blind doubling: one rerun covers even
-                    # pathological skew, and the spill bucket keeps
-                    # absorbing between-level drift afterwards
-                    need_g = int(scal[_S_MAXDEST]) * self.D / max(C, 1)
-                    self._a2a_gamma = max(self._a2a_gamma * 2, need_g)
-                    grew.append(f"gamma->{self._a2a_gamma:g}")
-                if scal[_S_SOVF]:
-                    SC2 = _pow2_at_least(int(scal[_S_MAXS]), lo=2 * SC)
-                    seen2 = self._pad_dev(seen2, 1, SC2, SENTINEL,
-                                          lane1=True)
-                    SC = SC2
-                    grew.append(f"SC->{SC}")
-                if scal[_S_FOVF]:
-                    FC2 = _pow2_at_least(int(scal[_S_MAXF]), lo=2 * FC)
-                    frontier2 = self._pad_dev(frontier2, 1, FC2,
-                                              SENTINEL)
-                    if self.store_trace:
-                        tr_rows2 = self._pad_dev(tr_rows2, 2, FC2,
-                                                 SENTINEL)
-                        tr_src2 = self._pad_dev(tr_src2, 2, FC2, -1)
-                    FC = FC2
-                    grew.append(f"FC->{FC}")
-                if scal[_S_TOVF]:
-                    TRL2 = _pow2_at_least(depth + 1, lo=2 * TRL)
-                    tr_rows2 = self._pad_dev(tr_rows2, 1, TRL2,
-                                             SENTINEL)
-                    tr_src2 = self._pad_dev(tr_src2, 1, TRL2, -1)
-                    TRL = TRL2
-                    grew.append(f"TRL->{TRL}")
-                self._remember_caps(SC, FC, TRL)
-                self.log(f"-- mesh: growing {', '.join(grew)} "
-                         f"(level {depth} redone)")
-                tel.level(depth, frontier=lvl_frontier, generated=0,
-                          new=0, distinct=distinct, devices=D,
-                          redo=",".join(grew),
-                          fresh_compile=fresh_compile,
-                          wall_s=round(time.time() - lvl_t0, 6))
-                seen, seen_count = seen2, seen_count2
-                frontier, fcount = frontier2, fcount2
-                tr_rows, tr_src = tr_rows2, tr_src2
-                continue
-
-            # committed: adopt the device state
+                        self._exchange_bytes(C, B, SB) * nlv)
+            self._supersteps += 1
+            self._superstep_levels_max = max(self._superstep_levels_max,
+                                             nlv)
+            # adopt the device state: levels before a rolled-back or
+            # violating level committed inside the dispatch, the
+            # offending level itself rolled back (outputs == inputs)
             seen, seen_count = seen2, seen_count2
             frontier, fcount = frontier2, fcount2
             if self.store_trace:
                 tr_rows, tr_src = tr_rows2, tr_src2
-                self._lvl_FC.append(FC)
-            self._spill_rows += int(scal[_S_SPILL])
-            self._max_bucket = max(self._max_bucket,
-                                   int(scal[_S_MAXDEST]))
-            levels_run += 1
+            # adapt the level budget toward the host-attention target;
+            # a dispatch that just paid an XLA recompile is not
+            # evidence about execution speed — skip it.  The warm
+            # value tracks the SETTLED budget (it follows halvings
+            # down), not the running max: a budget the controller
+            # judged too slow must not come back on warm runs, where
+            # it would stall drain/checkpoint attention for the whole
+            # oversized dispatch (review r10)
+            if self._ss_fixed is None:
+                if fresh_compile:
+                    pass
+                elif disp_wall > 1.5 * target_s and maxlvl > 1:
+                    maxlvl = max(1, maxlvl // 2)
+                    self._ss_shrunk = True
+                elif disp_wall < target_s / 4 and maxlvl < _SS_RINGCAP:
+                    maxlvl = min(_SS_RINGCAP, maxlvl * 2)
+                self._mesh_maxlvl_warm = maxlvl
+            lwall = round(disp_wall / nlv, 6)
 
-            # deadlock/assert live in the CURRENT frontier (depth d):
-            # totals exclude the partial level, like the host loop
-            if model.check_deadlock and scal[_S_DEAD]:
-                aux = np.asarray(aux_d)
-                dv = int(np.argmax(aux[:, _A_DEAD]))
-                ds = int(aux[dv, _A_DEADSLOT])
-                self._ring_levels(tr_rows, tr_src, depth)
-                trace = self._mesh_trace_to(dv, ds, depth)
-                return self._mk(False, distinct, generated, depth, t0,
-                                warnings,
-                                self._viol("deadlock", "deadlock",
-                                           trace))
-            if scal[_S_ASSERT]:
-                aux = np.asarray(aux_d)
-                av = int(np.argmax(aux[:, _A_ASSERT]))
-                aa = int(aux[av, _A_ASRTA])
-                af = int(aux[av, _A_ASRTF])
-                self._ring_levels(tr_rows, tr_src, depth)
-                trace = self._mesh_trace_to(av, af, depth)
-                return self._mk(
-                    False, distinct, generated, depth, t0, warnings,
-                    self._viol("assert", "Assert", trace,
-                               f"assertion in {self.labels_flat[aa]}"))
+            # ---- drain the ring: one record per executed level, the
+            # exact PR-8 one-level host sequence replayed per entry ----
+            for li in range(nlv):
+                scal = ring[li]
+                fresh = fresh_compile and li == 0
+                ovc = int(scal[_S_OVC])
+                if ovc:
+                    if ovc == OV_DEMOTED:
+                        msg = ("a demoted compile-recovery fired (the "
+                               "kernel under-approximates here): run "
+                               "the host_seen mode, which demotes the "
+                               "arm to the interpreter and restarts — "
+                               "raising caps cannot help")
+                    elif ovc == OV_PACK:
+                        msg = self._pack_ovf_msg()
+                    else:
+                        msg = ("a container exceeded its lane capacity "
+                               f"({self._caps_note()}); counts would "
+                               "no longer be exact")
+                    return self._mk(False, distinct, generated, depth,
+                                    t0, warnings, Violation(
+                                        "error", "capacity overflow",
+                                        [], msg))
 
-            generated += int(scal[_S_GEN])
-            distinct += int(scal[_S_NEW])
-            sum_seen = int(scal[_S_SUMS])
-            max_seen = int(scal[_S_MAXS])
-            self._fp_occupancy = sum_seen
-            if sum_seen:
-                self._shard_balance = max_seen / (sum_seen / D)
-            tel.level(depth, frontier=lvl_frontier,
-                      generated=int(scal[_S_GEN]),
-                      new=int(scal[_S_NEW]), distinct=distinct,
-                      seen=sum_seen, devices=D, fc=FC,
-                      spill=int(scal[_S_SPILL]),
-                      max_bucket=int(scal[_S_MAXDEST]),
-                      fresh_compile=fresh_compile,
-                      wall_s=round(time.time() - lvl_t0, 6))
+                if scal[_S_FOVF] or scal[_S_SOVF] or scal[_S_TOVF] or \
+                        scal[_S_AOVF]:
+                    # the step rolled this level back on device (and
+                    # stopped the superstep, so it is the ring's LAST
+                    # entry): grow every flagged capacity at once
+                    # (each growth recompiles the step, so batching
+                    # growths minimizes recompiles), then redo the
+                    # level in the next dispatch
+                    grew = []
+                    if scal[_S_AOVF]:
+                        # grow gamma straight to the OBSERVED per-peer
+                        # need (the max bucket occupancy rode the
+                        # scalar vector) instead of blind doubling:
+                        # one rerun covers even pathological skew, and
+                        # the spill bucket keeps absorbing
+                        # between-level drift afterwards
+                        need_g = int(scal[_S_MAXDEST]) * self.D \
+                            / max(C, 1)
+                        self._a2a_gamma = max(self._a2a_gamma * 2,
+                                              need_g)
+                        grew.append(f"gamma->{self._a2a_gamma:g}")
+                    if scal[_S_SOVF]:
+                        SC2 = _pow2_at_least(int(scal[_S_MAXS]),
+                                             lo=2 * SC)
+                        seen = self._pad_dev(seen, 1, SC2, SENTINEL,
+                                             lane1=True)
+                        SC = SC2
+                        grew.append(f"SC->{SC}")
+                    if scal[_S_FOVF]:
+                        FC2 = _pow2_at_least(int(scal[_S_MAXF]),
+                                             lo=2 * FC)
+                        frontier = self._pad_dev(frontier, 1, FC2,
+                                                 SENTINEL)
+                        if self.store_trace:
+                            tr_rows = self._pad_dev(tr_rows, 2, FC2,
+                                                    SENTINEL)
+                            tr_src = self._pad_dev(tr_src, 2, FC2, -1)
+                        FC = FC2
+                        grew.append(f"FC->{FC}")
+                    if scal[_S_TOVF]:
+                        TRL2 = _pow2_at_least(depth + 1, lo=2 * TRL)
+                        tr_rows = self._pad_dev(tr_rows, 1, TRL2,
+                                                SENTINEL)
+                        tr_src = self._pad_dev(tr_src, 1, TRL2, -1)
+                        TRL = TRL2
+                        grew.append(f"TRL->{TRL}")
+                    self._remember_caps(SC, FC, TRL)
+                    self.log(f"-- mesh: growing {', '.join(grew)} "
+                             f"(level {depth} redone)")
+                    tel.level(depth, frontier=lvl_frontier, generated=0,
+                              new=0, distinct=distinct, devices=D,
+                              redo=",".join(grew),
+                              fresh_compile=fresh,
+                              wall_s=lwall)
+                    break
 
-            which = int(scal[_S_INVMIN])
-            if which != _BIG:
-                # invariant violations live in the NEW frontier
-                # (depth+1); the globally LOWEST violated cfg-invariant
-                # index wins, then the first device holding it
-                aux = np.asarray(aux_d)
-                nm = self.inv_fns[which][0]
-                iv_dev = int(np.argmax(aux[:, _A_INVW] == which))
-                iv_slot = int(aux[iv_dev, _A_INVSLOT])
-                self._ring_levels(tr_rows, tr_src, depth + 1)
-                trace = self._mesh_trace_to(iv_dev, iv_slot, depth + 1)
-                return self._mk(False, distinct, generated, depth + 1,
-                                t0, warnings,
-                                self._viol("invariant", nm, trace))
-            depth += 1
-            lvl_frontier = int(scal[_S_FRONT])
+                # committed level
+                if self.store_trace:
+                    self._lvl_FC.append(FC)
+                self._spill_rows += int(scal[_S_SPILL])
+                self._max_bucket = max(self._max_bucket,
+                                       int(scal[_S_MAXDEST]))
 
-            if self.max_states and distinct >= self.max_states:
-                # a truncation point IS a level boundary: leave a
-                # checkpoint so the run can be resumed past the limit
-                if self.checkpoint_path:
+                # deadlock/assert live in the CURRENT frontier (depth
+                # d): totals exclude the partial level, like the host
+                # loop
+                if model.check_deadlock and scal[_S_DEAD]:
+                    aux = np.asarray(aux_d)
+                    dv = int(np.argmax(aux[:, _A_DEAD]))
+                    ds = int(aux[dv, _A_DEADSLOT])
                     self._ring_levels(tr_rows, tr_src, depth)
-                    self._mesh_ck(seen, np.asarray(seen_count),
-                                  frontier, fcount, FC, SC, depth,
-                                  generated, distinct)
-                self._save_mesh_profile(SC, FC, TRL)
-                self.log("-- state limit reached, search truncated")
-                return self._mk(True, distinct, generated, depth, t0,
-                                warnings, truncated=True)
+                    trace = self._mesh_trace_to(dv, ds, depth)
+                    return self._mk(False, distinct, generated, depth,
+                                    t0, warnings,
+                                    self._viol("deadlock", "deadlock",
+                                               trace))
+                if scal[_S_ASSERT]:
+                    aux = np.asarray(aux_d)
+                    av = int(np.argmax(aux[:, _A_ASSERT]))
+                    aa = int(aux[av, _A_ASRTA])
+                    af = int(aux[av, _A_ASRTF])
+                    self._ring_levels(tr_rows, tr_src, depth)
+                    trace = self._mesh_trace_to(av, af, depth)
+                    return self._mk(
+                        False, distinct, generated, depth, t0,
+                        warnings,
+                        self._viol("assert", "Assert", trace,
+                                   f"assertion in "
+                                   f"{self.labels_flat[aa]}"))
+
+                generated += int(scal[_S_GEN])
+                distinct += int(scal[_S_NEW])
+                sum_seen = int(scal[_S_SUMS])
+                max_seen = int(scal[_S_MAXS])
+                self._fp_occupancy = sum_seen
+                if sum_seen:
+                    self._shard_balance = max_seen / (sum_seen / D)
+                tel.level(depth, frontier=lvl_frontier,
+                          generated=int(scal[_S_GEN]),
+                          new=int(scal[_S_NEW]), distinct=distinct,
+                          seen=sum_seen, devices=D, fc=FC,
+                          spill=int(scal[_S_SPILL]),
+                          max_bucket=int(scal[_S_MAXDEST]),
+                          superstep=self._supersteps,
+                          fresh_compile=fresh,
+                          wall_s=lwall)
+
+                which = int(scal[_S_INVMIN])
+                if which != _BIG:
+                    # invariant violations live in the NEW frontier
+                    # (depth+1); the globally LOWEST violated
+                    # cfg-invariant index wins, then the first device
+                    # holding it
+                    aux = np.asarray(aux_d)
+                    nm = self.inv_fns[which][0]
+                    iv_dev = int(np.argmax(aux[:, _A_INVW] == which))
+                    iv_slot = int(aux[iv_dev, _A_INVSLOT])
+                    self._ring_levels(tr_rows, tr_src, depth + 1)
+                    trace = self._mesh_trace_to(iv_dev, iv_slot,
+                                                depth + 1)
+                    return self._mk(False, distinct, generated,
+                                    depth + 1, t0, warnings,
+                                    self._viol("invariant", nm, trace))
+                depth += 1
+                lvl_frontier = int(scal[_S_FRONT])
+
+                if self.max_states and distinct >= self.max_states:
+                    # a truncation point IS a level boundary: leave a
+                    # checkpoint so the run can be resumed past the
+                    # limit
+                    if self.checkpoint_path:
+                        self._ring_levels(tr_rows, tr_src, depth)
+                        self._mesh_ck(seen, np.asarray(seen_count),
+                                      frontier, fcount, FC, SC, depth,
+                                      generated, distinct)
+                    self._save_mesh_profile(SC, FC, TRL)
+                    self.log("-- state limit reached, search truncated")
+                    return self._mk(True, distinct, generated, depth,
+                                    t0, warnings, truncated=True)
 
             now = time.time()
             if now - last_progress >= self.progress_every:
@@ -1225,6 +1541,14 @@ class MeshExplorer(TpuExplorer):
                               fcount, FC, SC, depth, generated,
                               distinct)
 
+        if self._ss_fixed is None and not self._ss_shrunk:
+            # fast models: remember enough budget to cover the whole
+            # search in ONE dispatch on a warm re-run (the early exit
+            # stops at the empty frontier, so over-budget is free) —
+            # but never after the controller had to shrink: a budget
+            # it judged too slow must stay retired
+            self._mesh_maxlvl_warm = min(
+                max(depth + 1, self._mesh_maxlvl_warm), _SS_RINGCAP)
         self._save_mesh_profile(SC, FC, TRL)
         if self.checkpoint_path and self.final_checkpoint:
             # COMPLETED-run checkpoint (serve warm resume): an empty
@@ -1251,13 +1575,167 @@ class MeshExplorer(TpuExplorer):
         h["TRL"] = max(int(h.get("TRL", 0)), TRL)
         h["GAM16"] = max(int(h.get("GAM16", 0)),
                          int(round(self._a2a_gamma * 16)))
+        # MSL is the SETTLED levels-per-dispatch, not a floor: it must
+        # follow the controller down when a budget proved too slow
+        h["MSL"] = max(1, int(self._mesh_maxlvl_warm))
 
     def _save_mesh_profile(self, SC: int, FC: int, TRL: int) -> None:
         self._remember_caps(SC, FC, TRL)
         self._save_caps_profile(
             {"SC": SC, "FC": FC, "TRL": TRL,
-             "GAM16": max(1, int(round(self._a2a_gamma * 16)))},
+             "GAM16": max(1, int(round(self._a2a_gamma * 16))),
+             "MSL": max(1, int(self._mesh_maxlvl_warm))},
             variant=self._profile_variant(), keys=_MESH_PROFILE_KEYS)
+
+    # ------------------------------------------------------------------
+    # phase-wall probe (ISSUE 10 obs satellite)
+    # ------------------------------------------------------------------
+
+    def probe_phase_walls(self, max_levels: int = 4
+                          ) -> Optional[Dict[str, float]]:
+        """Measured expand / exchange / merge wall breakdown.
+
+        The fused superstep makes the hot path unobservable from the
+        host (one dispatch covers many levels), so the breakdown comes
+        from a PROBE: the three phases built as SEPARATE jitted
+        shard_map programs at the run's learned capacities, driven a
+        few levels over the real initial shards, each phase timed with
+        block_until_ready (compile excluded by an untimed warm-up
+        pass).  BOTH merge strategies are timed on identical inputs
+        every level, so the artifact shows the rank-vs-fullsort merge
+        wall directly — the merge win lands in the obs artifact, not
+        just the scaling curve.  Best-effort perf probe only (stops if
+        the probe outgrows its fixed caps); counts are never consumed.
+
+        Gauges: mesh.phase_levels, mesh.phase_expand_s,
+        mesh.phase_exchange_s, mesh.phase_merge_rank_s,
+        mesh.phase_merge_fullsort_s, mesh.phase_merge_s (the active
+        strategy's total); one `mesh.phase_walls` trace event per
+        probed level."""
+        tel = obs.current()
+        t_all = time.time()
+        init_rows, explored_init, n_init, err = \
+            self._prepare_init(t_all, [])
+        if err is not None:
+            return None
+        D, K, PW = self.D, self.K, self.PW
+        hint = self._mesh_caps_hint
+        explored_mask = np.zeros(n_init, bool)
+        explored_mask[explored_init] = True
+        FC = _pow2_at_least(
+            max(int(hint.get("FC", 1)), max(1,
+                                            int(explored_mask.sum()))),
+            lo=64)
+        SC = _pow2_at_least(max(4 * FC, int(hint.get("SC", 1))),
+                            lo=256)
+        seen_np, frontier_np, fcount_np, scount_np = self._init_shards(
+            init_rows, np.nonzero(explored_mask)[0], D, SC, FC)
+        C = self.A * FC
+        route, R, B, SB = self._route_fn(C, FC)
+        block_fn = self._candidate_block_fn(FC)
+        plan = self.plan
+        shard_map = self._shard_map()
+
+        def expand_step(frontier_p, fcount):
+            frontier = plan.unpack_rows(frontier_p.reshape(FC, PW))
+            fvalid = jnp.arange(FC) < fcount[0]
+            blk = block_fn(frontier, fvalid)
+            return (blk["ckeys"].reshape(1, C, K),
+                    blk["cand"].reshape(1, C, PW),
+                    blk["cvalid"].reshape(1, C))
+
+        def route_step(ckeys, cand, cvalid):
+            me_ = lax.axis_index("d")
+            gkeys, gcand, gsrc = route(ckeys.reshape(C, K),
+                                       cand.reshape(C, PW),
+                                       cvalid.reshape(C), me_)[:3]
+            return (gkeys.reshape(1, R, K), gcand.reshape(1, R, PW),
+                    gsrc.reshape(1, R))
+
+        def mk_merge(strategy):
+            mfn = (self._merge_rank_fn if strategy == "rank"
+                   else self._merge_fullsort_fn)(SC, R)
+
+            def merge_step(seen_keys, seen_count, gkeys, gcand, gsrc):
+                mg = mfn(seen_keys.reshape(SC, K), seen_count[0],
+                         gkeys.reshape(R, K), gcand.reshape(R, PW),
+                         gsrc.reshape(R))
+                return (mg["seen2"].reshape(1, SC, K),
+                        mg["seen_count2"].reshape(1),
+                        mg["front_rows"][:FC].reshape(1, FC, PW),
+                        mg["front_count"].reshape(1))
+
+            return merge_step
+
+        jexp = jax.jit(shard_map(
+            expand_step, mesh=self.mesh,
+            in_specs=(P("d"), P("d")), out_specs=(P("d"),) * 3))
+        jrt = jax.jit(shard_map(
+            route_step, mesh=self.mesh,
+            in_specs=(P("d"),) * 3, out_specs=(P("d"),) * 3))
+        jmg = {s: jax.jit(shard_map(
+            mk_merge(s), mesh=self.mesh,
+            in_specs=(P("d"),) * 5, out_specs=(P("d"),) * 4))
+            for s in ("rank", "fullsort")}
+
+        seen = jnp.asarray(seen_np)
+        scount = jnp.asarray(scount_np)
+        frontier = jnp.asarray(frontier_np)
+        fcount = jnp.asarray(fcount_np.astype(np.int32))
+
+        def timed(f, *a):
+            t0 = time.time()
+            out = f(*a)
+            jax.block_until_ready(out)
+            return out, time.time() - t0
+
+        # untimed warm-up pass: compile all four programs once
+        o1 = jexp(frontier, fcount)
+        jax.block_until_ready(o1)
+        o2 = jrt(*o1)
+        jax.block_until_ready(o2)
+        for s in jmg:
+            jax.block_until_ready(jmg[s](seen, scount, *o2))
+
+        walls = {"expand": 0.0, "exchange": 0.0,
+                 "merge_rank": 0.0, "merge_fullsort": 0.0}
+        lv = 0
+        while lv < max_levels and int(np.sum(np.asarray(fcount))) > 0:
+            o1, w_e = timed(jexp, frontier, fcount)
+            walls["expand"] += w_e
+            o2, w_x = timed(jrt, *o1)
+            walls["exchange"] += w_x
+            outs = {}
+            w_m = {}
+            for s in ("fullsort", "rank"):
+                outs[s], w_m[s] = timed(jmg[s], seen, scount, *o2)
+                walls["merge_" + s] += w_m[s]
+            seen2, scount2, frontier2, fcount2 = outs["rank"]
+            tel.event("mesh.phase_walls", level=lv,
+                      expand_s=round(w_e, 6), exchange_s=round(w_x, 6),
+                      merge_rank_s=round(w_m["rank"], 6),
+                      merge_fullsort_s=round(w_m["fullsort"], 6))
+            if int(np.max(np.asarray(scount2))) > SC or \
+                    int(np.max(np.asarray(fcount2))) > FC:
+                break  # probe caps outgrown: keep what we measured
+            seen, scount = seen2, scount2
+            frontier, fcount = frontier2, fcount2
+            lv += 1
+        out = {"levels": lv,
+               "expand_s": round(walls["expand"], 6),
+               "exchange_s": round(walls["exchange"], 6),
+               "merge_rank_s": round(walls["merge_rank"], 6),
+               "merge_fullsort_s": round(walls["merge_fullsort"], 6)}
+        out["merge_s"] = out["merge_rank_s"] if self.merge == "rank" \
+            else out["merge_fullsort_s"]
+        tel.gauge("mesh.phase_levels", lv)
+        tel.gauge("mesh.phase_expand_s", out["expand_s"])
+        tel.gauge("mesh.phase_exchange_s", out["exchange_s"])
+        tel.gauge("mesh.phase_merge_s", out["merge_s"])
+        tel.gauge("mesh.phase_merge_rank_s", out["merge_rank_s"])
+        tel.gauge("mesh.phase_merge_fullsort_s",
+                  out["merge_fullsort_s"])
+        return out
 
     # ------------------------------------------------------------------
     # the LEGACY host loop (refinement/temporal PROPERTYs; the
@@ -1335,7 +1813,7 @@ class MeshExplorer(TpuExplorer):
                 max(max((len(p) for p in per_dev), default=1), 1), lo=64)
             SC = _pow2_at_least(4 * FC, lo=256)
             explored_idx = np.nonzero(explored_mask)[0]
-            seen, frontier, fcount = self._init_shards(
+            seen, frontier, fcount, init_scounts = self._init_shards(
                 init_rows, explored_idx, D, SC, FC,
                 keys=init_keys, packed=init_packed, owner=owner)
             if self.live_obligations:
@@ -1352,8 +1830,7 @@ class MeshExplorer(TpuExplorer):
             frontier = jnp.asarray(frontier)
             seen = jnp.asarray(seen)
             fcount = jnp.asarray(fcount)
-            seen_counts = np.array([int((owner == d).sum())
-                                    for d in range(D)], np.int64)
+            seen_counts = init_scounts.astype(np.int64)
             depth = 0
 
         last_progress = last_ck = time.time()
@@ -1372,7 +1849,9 @@ class MeshExplorer(TpuExplorer):
             expanding_FC = FC
             while True:
                 step = self._get_mesh_step(SC, FC)
-                outs = step(seen, frontier, fcount)
+                outs = step(seen,
+                            jnp.asarray(seen_counts.astype(np.int32)),
+                            frontier, fcount)
                 # count THIS attempt's exchange with the gamma it ran
                 # at: gamma-doubling reruns each pay a full exchange
                 # (review r8)
@@ -1608,6 +2087,12 @@ class MeshExplorer(TpuExplorer):
         if self._shard_balance is not None:
             tel.gauge("mesh.shard_balance",
                       round(self._shard_balance, 4))
+        if self._supersteps:
+            # host_syncs counts SUPERSTEPS (one scalar-ring read per
+            # dispatch); the gauge records the deepest fused dispatch
+            tel.gauge("mesh.supersteps", self._supersteps)
+            tel.gauge("mesh.superstep_levels",
+                      self._superstep_levels_max)
         return CheckResult(ok=ok, distinct=distinct, generated=generated,
                            diameter=max(diameter, 0), violation=violation,
                            wall_s=time.time() - t0, truncated=truncated,

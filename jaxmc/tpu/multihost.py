@@ -115,7 +115,12 @@ def run_multihost_child(process_id: int, num_processes: int,
     init_rows = np.stack([me.layout.encode(st) for st in me.init_states])
     explored, viol = filter_init_states(model, me.layout, init_rows)
     assert viol is None, "initial-state violation in the dryrun model"
-    seen_h, front_h, fcount_h = me._init_shards(
+    # per-shard seen occupancy (ISSUE 10): the step's merge now takes
+    # the valid-prefix length explicitly (the rank strategy binary-
+    # searches it; fullsort masks stale tail rows with it), so the
+    # loop carries the step's seen-count output back into the next
+    # level's input, seeded by the counts _init_shards built
+    seen_h, front_h, fcount_h, scount_h = me._init_shards(
         init_rows, explored, D, SC, FC)
 
     def dist(h):
@@ -124,6 +129,7 @@ def run_multihost_child(process_id: int, num_processes: int,
             h.shape, sh, lambda idx: h[idx])
 
     seen = dist(seen_h)
+    seen_cnt = dist(scount_h)
     frontier, fcount = dist(front_h), dist(fcount_h)
 
     generated = len(init_rows)
@@ -197,8 +203,8 @@ def run_multihost_child(process_id: int, num_processes: int,
         return None, full
 
     while depth < max_levels:
-        outs = step(seen, frontier, fcount)
-        (seen, _seen_cnt, frontier, fcount, tot_gen, tot_new,
+        outs = step(seen, seen_cnt, frontier, fcount)
+        (seen, seen_cnt, frontier, fcount, tot_gen, tot_new,
          any_ovf, tot_front, fixed_ovf, any_inv, any_dead,
          any_assert) = outs[:12]
         # index 20 is the psum'd a2a spill-row count (ISSUE 8): rows

@@ -1,18 +1,26 @@
-r"""Mesh-resident sharded BFS (ISSUE 8): owner-routed a2a dedup with no
-per-level host round-trip.
+r"""Mesh-resident sharded BFS (ISSUE 8 + ISSUE 10): owner-routed a2a
+dedup, O(new) rank-merge, multi-level fused supersteps.
 
 Pins, on repo-local models only (no reference corpus needed):
   * a2a is the DEFAULT exchange for D > 1 (JAXMC_MESH_EXCHANGE
-    overrides);
-  * the resident loop reads ONE scalar vector per level —
-    mesh.host_syncs == level-record count, no row traffic;
+    overrides); rank-merge is the DEFAULT dedup-merge
+    (JAXMC_MESH_RANKMERGE=0 forces the PR-8 fullsort);
+  * the resident loop reads ONE scalar ring per SUPERSTEP —
+    mesh.host_syncs counts supersteps (<= level records, < on any
+    multi-level run), no row traffic; JAXMC_MESH_SUPERSTEP=1 restores
+    one-sync-per-level exactly;
+  * rank vs fullsort and superstep vs one-level are BIT-IDENTICAL:
+    counts, distinct totals, violation traces, and (post the PR-10
+    stale-tail fix) seen-shard occupancy — including under the
+    mesh_skew fault and mid-superstep capacity growth;
   * a second run on a warm engine has window_recompiles == 0, and a
     FRESH engine starting from the persisted (module, layout, D,
     exchange) capacity profile compiles exactly once with zero
     growth redos;
-  * checkpoint/resume parity under a2a at D=4 — truncation resume and
-    a SIGKILL mid-run (chaos) both finish with totals and traces
-    bit-identical to the uninterrupted run;
+  * checkpoint/resume parity under a2a at D=4 — truncation resume,
+    a SIGTERM drain at a superstep boundary, and a SIGKILL mid-run
+    (chaos) all finish with totals and traces bit-identical to the
+    uninterrupted run;
   * the mesh_skew fault forces every state onto shard 0: the spill
     pass drains the overflow and counts/traces stay exact.
 """
@@ -94,7 +102,7 @@ class TestExchangeDefault:
 
 
 class TestResidentLoop:
-    def test_host_syncs_equals_levels_and_scalars_only(self):
+    def test_host_syncs_counts_supersteps_scalars_only(self):
         from jaxmc import obs
         from jaxmc.tpu.mesh import MeshExplorer
         from jaxmc.engine.explore import Explorer
@@ -105,12 +113,30 @@ class TestResidentLoop:
             r = me.run()
         assert (r.generated, r.distinct, r.ok) == \
             (ri.generated, ri.distinct, ri.ok)
-        # one scalar read per level record; clean run pulls NO rows
-        assert tel.counters["mesh.host_syncs"] == len(tel.levels)
+        # one scalar-ring read per SUPERSTEP (ISSUE 10): the adaptive
+        # controller fuses levels, so syncs < level records on this
+        # multi-level model; a clean run still pulls NO rows
+        levels = len(tel.levels)
+        assert tel.counters["mesh.host_syncs"] == \
+            tel.gauges["mesh.supersteps"] <= levels
+        assert tel.counters["mesh.host_syncs"] < levels
+        assert tel.gauges["mesh.superstep_levels"] >= 2
         assert "mesh.row_syncs" not in tel.counters
         assert tel.counters["mesh.exchange_bytes"] > 0
         assert tel.gauges["mesh.exchange"] == "a2a"
+        assert tel.gauges["mesh.merge"] == "rank"
+        assert tel.gauges["dedup.mode"].startswith("fp128")
         assert tel.gauges["mesh.shard_balance"] >= 1.0
+
+    def test_superstep_one_pins_one_sync_per_level(self, monkeypatch):
+        monkeypatch.setenv("JAXMC_MESH_SUPERSTEP", "1")
+        from jaxmc import obs
+        from jaxmc.tpu.mesh import MeshExplorer
+        tel = obs.Telemetry()
+        with obs.use(tel):
+            r = MeshExplorer(load("constoy"), exchange="a2a").run()
+        assert r.ok
+        assert tel.counters["mesh.host_syncs"] == len(tel.levels)
 
     def test_second_run_zero_window_recompiles(self):
         from jaxmc import obs
@@ -184,6 +210,168 @@ class TestResidentLoop:
             [s for s, _ in r_host.violation.trace]
         assert [a for _, a in r_res.violation.trace] == \
             [a for _, a in r_host.violation.trace]
+
+
+class TestMergeStrategies:
+    """ISSUE 10: rank-merge vs fullsort bit-identical parity."""
+
+    def test_rankmerge_env_escape_hatch(self, monkeypatch):
+        from jaxmc.tpu.mesh import MeshExplorer
+        assert MeshExplorer(load("constoy")).merge == "rank"
+        monkeypatch.setenv("JAXMC_MESH_RANKMERGE", "0")
+        me = MeshExplorer(load("constoy"))
+        assert me.merge == "fullsort"
+        # fullsort cannot run under the superstep while_loop: it is
+        # pinned to the one-level-per-dispatch program
+        assert me._ss_fixed == 1
+
+    def test_rank_vs_fullsort_counts_and_occupancy_d2(self,
+                                                      monkeypatch):
+        from jaxmc.tpu.mesh import MeshExplorer
+        ma = MeshExplorer(load("constoy"), exchange="a2a")
+        ra = ma.run()
+        monkeypatch.setenv("JAXMC_MESH_RANKMERGE", "0")
+        mf = MeshExplorer(load("constoy"), exchange="a2a")
+        rf = mf.run()
+        assert (ra.generated, ra.distinct, ra.ok) == \
+            (rf.generated, rf.distinct, rf.ok)
+        # the PR-10 stale-tail fix: both strategies agree on the TRUE
+        # fingerprint occupancy (the PR-8 fullsort re-counted dup tail
+        # rows across levels)
+        assert ma._fp_occupancy == mf._fp_occupancy
+
+    def test_rank_vs_fullsort_violation_trace_d2(self, monkeypatch):
+        from jaxmc.tpu.mesh import MeshExplorer
+        ra = MeshExplorer(load("pcal_intro_buggy"),
+                          exchange="a2a").run()
+        monkeypatch.setenv("JAXMC_MESH_RANKMERGE", "0")
+        rf = MeshExplorer(load("pcal_intro_buggy"),
+                          exchange="a2a").run()
+        assert not ra.ok and not rf.ok
+        assert (ra.generated, ra.distinct, ra.violation.kind) == \
+            (rf.generated, rf.distinct, rf.violation.kind)
+        assert [s for s, _ in ra.violation.trace] == \
+            [s for s, _ in rf.violation.trace]
+        assert [a for _, a in ra.violation.trace] == \
+            [a for _, a in rf.violation.trace]
+
+    @pytest.mark.slow
+    def test_rank_vs_fullsort_view_symmetry_d4(self, monkeypatch):
+        # the VIEW and SYMMETRY rungs at D=4: the key basis (cfg VIEW
+        # lanes / orbit-canonical packing) must dedup identically
+        # under both merge strategies
+        from jaxmc.tpu.mesh import MeshExplorer
+        for name, kw in (("viewtoy", {}),
+                         ("symtoy", dict(no_deadlock=True))):
+            monkeypatch.delenv("JAXMC_MESH_RANKMERGE", raising=False)
+            ra = MeshExplorer(load(name, **kw), mesh=mesh4(),
+                              exchange="a2a").run()
+            monkeypatch.setenv("JAXMC_MESH_RANKMERGE", "0")
+            rf = MeshExplorer(load(name, **kw), mesh=mesh4(),
+                              exchange="a2a").run()
+            assert (ra.generated, ra.distinct, ra.ok) == \
+                (rf.generated, rf.distinct, rf.ok), name
+
+    @pytest.mark.slow
+    def test_rank_vs_fullsort_under_skew_spill(self, monkeypatch):
+        # hash-skew (every state on shard 0) exercises the spill pass
+        # and the most imbalanced merge inputs — both strategies must
+        # stay exact
+        from jaxmc import faults
+        from jaxmc.tpu.mesh import MeshExplorer
+        monkeypatch.setenv("JAXMC_FAULTS", "mesh_skew:n=2")
+        faults.reset_for_tests()
+        ra = MeshExplorer(load("constoy"), exchange="a2a").run()
+        monkeypatch.setenv("JAXMC_MESH_RANKMERGE", "0")
+        rf = MeshExplorer(load("constoy"), exchange="a2a").run()
+        assert (ra.generated, ra.distinct, ra.ok) == \
+            (rf.generated, rf.distinct, rf.ok)
+        faults.reset_for_tests()
+
+
+class TestSuperstep:
+    """ISSUE 10: multi-level fused supersteps."""
+
+    def test_superstep_vs_one_level_violation_parity(self,
+                                                     monkeypatch):
+        from jaxmc.tpu.mesh import MeshExplorer
+        monkeypatch.setenv("JAXMC_MESH_SUPERSTEP", "8")
+        rs = MeshExplorer(load("pcal_intro_buggy"),
+                          exchange="a2a").run()
+        monkeypatch.setenv("JAXMC_MESH_SUPERSTEP", "1")
+        r1 = MeshExplorer(load("pcal_intro_buggy"),
+                          exchange="a2a").run()
+        assert not rs.ok and not r1.ok
+        assert (rs.generated, rs.distinct, rs.violation.kind) == \
+            (r1.generated, r1.distinct, r1.violation.kind)
+        assert [s for s, _ in rs.violation.trace] == \
+            [s for s, _ in r1.violation.trace]
+        assert [a for _, a in rs.violation.trace] == \
+            [a for _, a in r1.violation.trace]
+
+    def test_seen_overflow_mid_superstep_grows_and_redoes(
+            self, monkeypatch):
+        # pcal_intro_buggy outgrows the 256-key SC floor within the
+        # first few levels; with an 8-level budget the overflow lands
+        # MID-superstep — the offending level must roll back, grow,
+        # and redo with counts/trace identical to a generously-capped
+        # run
+        from jaxmc import obs
+        from jaxmc.tpu.mesh import MeshExplorer
+        monkeypatch.setenv("JAXMC_MESH_SUPERSTEP", "8")
+        tel = obs.Telemetry()
+        with obs.use(tel):
+            r = MeshExplorer(load("pcal_intro_buggy"),
+                             exchange="a2a").run()
+        redos = [lv for lv in tel.levels if lv.get("redo")]
+        assert redos, "no growth redo fired under the tiny SC floor"
+        assert any("SC->" in lv["redo"] for lv in redos)
+        rg = MeshExplorer(load("pcal_intro_buggy"), exchange="a2a",
+                          mesh_caps={"SC": 1 << 14, "FC": 1 << 10,
+                                     "TRL": 16, "GAM16": 32}).run()
+        assert (r.generated, r.distinct, r.violation.kind) == \
+            (rg.generated, rg.distinct, rg.violation.kind)
+        assert [s for s, _ in r.violation.trace] == \
+            [s for s, _ in rg.violation.trace]
+
+    @pytest.mark.chaos
+    def test_drain_at_superstep_boundary_resume_parity(
+            self, tmp_path, monkeypatch):
+        # request a drain (the SIGTERM path, jaxmc/drain.py) once the
+        # search reaches depth 2: the loop must stop at the NEXT
+        # superstep boundary, checkpoint, report drained=True — and a
+        # resume must answer bit-identically to an uninterrupted run
+        from jaxmc import drain, obs
+        from jaxmc.tpu.mesh import MeshExplorer
+        monkeypatch.setenv("JAXMC_MESH_SUPERSTEP", "2")
+        ck = str(tmp_path / "mesh_drain.ck")
+
+        class DrainAt(obs.Telemetry):
+            def level(self, lvl, **kw):
+                super().level(lvl, **kw)
+                if lvl >= 2 and not kw.get("redo"):
+                    drain.request("test drain at superstep boundary")
+
+        drain.clear()
+        try:
+            tel = DrainAt()
+            with obs.use(tel):
+                r1 = MeshExplorer(load("pcal_intro_buggy"),
+                                  exchange="a2a", checkpoint_path=ck,
+                                  checkpoint_every=0).run()
+            assert r1.drained and r1.truncated and r1.ok
+            assert os.path.exists(ck)
+        finally:
+            drain.clear()
+        r2 = MeshExplorer(load("pcal_intro_buggy"), exchange="a2a",
+                          resume_from=ck).run()
+        rd = MeshExplorer(load("pcal_intro_buggy"),
+                          exchange="a2a").run()
+        assert (r2.ok, r2.generated, r2.distinct,
+                r2.violation.kind) == \
+            (rd.ok, rd.generated, rd.distinct, rd.violation.kind)
+        assert [s for s, _ in r2.violation.trace] == \
+            [s for s, _ in rd.violation.trace]
 
 
 class TestCheckpointResume:
@@ -317,11 +505,11 @@ class TestEdgeStream:
             _t.time(), [])
         assert err is None
         D, SC, FC = me.D, 256, 64
-        seen, frontier, fcount = me._init_shards(
+        seen, frontier, fcount, scount = me._init_shards(
             init_rows, explored, D, SC, FC)
         step = me._get_mesh_step(SC, FC)
-        outs = step(jnp.asarray(seen), jnp.asarray(frontier),
-                    jnp.asarray(fcount))
+        outs = step(jnp.asarray(seen), jnp.asarray(scount),
+                    jnp.asarray(frontier), jnp.asarray(fcount))
         tot_gen = int(np.asarray(outs[5])[0])
         assert tot_gen > me.D  # wide enough to spread over shards
         eexp0 = np.asarray(outs[19][0])
@@ -346,8 +534,15 @@ class TestMeshbenchChild:
         assert r["ok"] and r["devices"] == 2
         assert (r["generated"], r["distinct"]) == (43, 21)
         assert r["window_recompiles"] == 0       # warm timed window
-        assert r["host_syncs"] == r["levels"]    # scalars only
+        # scalar-ring reads only: one per superstep, never more than
+        # the level count — and the warm window (learned MSL) must
+        # actually fuse levels
+        assert r["supersteps"] == r["host_syncs"] <= r["levels"]
+        assert r["host_syncs"] < r["levels"]
         assert r["exchange"] == "a2a"
+        assert r["merge"] == "rank"
         art = json.load(open(out))
         assert art["schema"] == "jaxmc.metrics/2"
         assert art["multichip"]["devices"] == 2
+        assert art["multichip"]["merge"] == "rank"
+        assert art["multichip"]["supersteps"] == r["supersteps"]

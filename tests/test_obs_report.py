@@ -151,6 +151,30 @@ class TestDiff:
         for label in ("a", "r07", "c"):
             assert label in out
 
+    def test_multichip_artifact_diff_gates_per_chip_rate(
+            self, tmp_path):
+        # ISSUE 10 CI satellite: two jaxmc.multichip/1 scaling
+        # artifacts diff directly — per-(rung, D) states/sec/chip
+        # drops raise REGRESS and gate the exit code
+        def art(path, rate):
+            obj = {"schema": "jaxmc.multichip/1", "platform": "cpu",
+                   "mode": "mesh-resident", "ok": True,
+                   "rungs": [{"rung": "toy", "curve": [
+                       {"devices": 2, "states_per_sec_per_chip": rate,
+                        "host_syncs": 3, "levels": 6,
+                        "merge": "rank"}]}]}
+            p = str(tmp_path / path)
+            json.dump(obj, open(p, "w"))
+            return p
+        a, b = art("r06.json", 1000.0), art("r07.json", 400.0)
+        rc, out = run_cli(["diff", "--fail-on-regress",
+                           "--threshold", "25", a, b])
+        assert rc == 1 and "REGRESS states/sec/chip toy@D2" in out
+        rc, out = run_cli(["diff", "--fail-on-regress", a, a])
+        assert rc == 0 and "no regressions" in out
+        rc, out = run_cli(["report", a])
+        assert rc == 0 and "toy@D2" in out and "syncs=3/6" in out
+
     def test_diff_needs_two(self, tmp_path):
         a = mk_artifact(tmp_path / "a.json", rate=1000.0,
                         platform="cpu", phases={"search": 1.0})

@@ -251,6 +251,39 @@ class TestWarmReuse:
         assert (r2["distinct"], r2["generated"]) == \
             (r1["distinct"], r1["generated"])
 
+    def test_warm_registry_lru_eviction(self, spool, monkeypatch):
+        # ISSUE 10 satellite (ROADMAP item 3): JAXMC_SERVE_WARM_MAX
+        # bounds the warm CheckSession registry.  With a 1-session cap,
+        # a second signature evicts the first (serve.evictions); the
+        # re-submission after eviction is answered from the
+        # FINAL-CHECKPOINT resume path — bit-identical, just cold
+        monkeypatch.setenv("JAXMC_SERVE_WARM_MAX", "1")
+        d = ServeDaemon(spool, workers=1, quiet=True).start()
+        try:
+            c = client(d)
+            _, j1 = c.submit(spec("constoy"))
+            r1 = c.wait(j1["id"], timeout=60)
+            assert r1["status"] == "done"
+            sig1 = j1["sig"]
+            _, j2 = c.submit(spec("viewtoy"))
+            r2 = c.wait(j2["id"], timeout=60)
+            assert r2["status"] == "done"
+            assert d.warm_max == 1
+            assert d.tel.counters.get("serve.evictions") == 1
+            assert sig1 not in d.warm and j2["sig"] in d.warm
+            # resubmit the evicted signature: cold engine, but the
+            # spool checkpoint survives eviction — same answer
+            _, j3 = c.submit(spec("constoy"))
+            r3 = c.wait(j3["id"], timeout=60)
+            assert r3["status"] == "done"
+            assert r3["warm_engine"] is False
+            assert r3["resumed_from_checkpoint"] is True
+            assert (r3["distinct"], r3["generated"]) == \
+                (r1["distinct"], r1["generated"])
+            assert d.tel.counters.get("serve.ckpt_resumes") == 1
+        finally:
+            d.shutdown()
+
     def test_restart_resumes_with_persistent_cache_hits(
             self, spool, tmp_path):
         # across daemon LIVES (real processes — an in-process pair
