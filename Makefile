@@ -187,6 +187,9 @@ bench-check:
 	# runs must match the manifest pins bit-for-bit — see
 	# multichip-check below
 	$(MAKE) multichip-check
+	# backend-portability leg (ISSUE 11): preflight oracle smoke +
+	# per-live-platform baseline gate (SKIP lines for dead platforms)
+	$(MAKE) backend-check
 	# static-analysis legs (ISSUE 9): an analyzer regression gates the
 	# same way perf regressions do — the corpus must stay lint-clean
 	# (modulo manifest waivers) and jaxmc's own Python must stay free
@@ -212,8 +215,8 @@ bench-check:
 # `obs diff` gates the newer per-rung states/sec/chip against the
 # older (wired into `make bench-check` through this target).
 MULTICHIP_DEVICES ?= 2,4
-MULTICHIP_PREV ?= MULTICHIP_r06.json
-MULTICHIP_CUR  ?= MULTICHIP_r07.json
+MULTICHIP_PREV ?= MULTICHIP_r07.json
+MULTICHIP_CUR  ?= MULTICHIP_r08.json
 multichip-check:
 	$(PY) -m jaxmc.meshbench check --devices $(MULTICHIP_DEVICES) \
 	    --out-dir $(BENCH_CHECK_DIR)
@@ -227,16 +230,31 @@ multichip-check:
 	      $(MULTICHIP_PREV) $(MULTICHIP_CUR) || exit 1; \
 	fi
 
+# backend-portability gate (ISSUE 11): two legs, both parseable —
+#   1. oracle smoke: the preflight oracle (jaxmc/backend/oracle.py)
+#      must find at least one live platform inside its deadline (<10s;
+#      a wedged accelerator tunnel costs the deadline, never a hang);
+#   2. per-backend baseline: for every LIVE platform, one pinned
+#      `--backend <plat>` check leg gated against that platform's OWN
+#      saved baseline via `python -m jaxmc.obs diff --fail-on-regress`
+#      (first run snapshots it — how a new platform's baseline is
+#      seeded, BASELINE.md "Per-backend baselines").  Dead platforms
+#      print `BACKEND-CHECK SKIP <plat>: <reason>` and never fail, so
+#      the target is green on a cpu-only builder box and a TPU pod
+#      alike; live platforms must agree on reachable-state counts.
+backend-check:
+	$(PY) -m jaxmc.backend.check --out-dir $(BENCH_CHECK_DIR)
+
 # the published scaling curve (ISSUE 8/10): per-rung, per-D warm-up +
 # timed fully-warm mesh runs over D in {1,2,4,8} virtual devices
 # (real chips when JAXMC_MESHBENCH_PLATFORM names an accelerator) —
 # states/sec/chip, per-level exchange bytes, shard balance,
 # host_syncs <= levels (supersteps), window_recompiles == 0, and the
 # measured expand/exchange/merge phase-wall breakdown (incl. the
-# rank-vs-fullsort merge wall) — written to MULTICHIP_r07.json and
-# gated per leg like multichip-check.
+# rank-vs-fullsort merge wall and the fused-step hot_share) — written
+# to MULTICHIP_r08.json and gated per leg like multichip-check.
 MULTICHIP_BENCH_DEVICES ?= 1,2,4,8
-MULTICHIP_OUT ?= MULTICHIP_r07.json
+MULTICHIP_OUT ?= MULTICHIP_r08.json
 multichip-bench:
 	$(PY) -m jaxmc.meshbench bench \
 	    --devices $(MULTICHIP_BENCH_DEVICES) \
@@ -271,4 +289,5 @@ native:
 
 .PHONY: all check check-corpus test chaos bench bench-warm bench-tlc \
         pin-si-env bench-check bench-check-reset serve serve-check \
-        multichip-check multichip-bench native lint-corpus pylint
+        multichip-check multichip-bench backend-check native \
+        lint-corpus pylint

@@ -134,6 +134,12 @@ _BENCH_RES_CAPS = {"SC": 1 << 18, "FCap": 1 << 16,
 
 _DEADLINE = None  # absolute time.time() deadline, set in main()
 _PROBE_SKIPPED = False  # verify probe skipped on a DOWN oracle verdict
+# preflight backend-oracle verdict (ISSUE 11, jaxmc/backend/oracle.py):
+# main() fills it before the workers start — {platform, probes, wall_s,
+# reason}.  The accelerator worker reads it instead of burning deadline
+# budget on its own probe children, and the orchestration block records
+# it so the artifact says WHY the bench measured the platform it did.
+_ORACLE = {}
 
 
 def _log(msg):
@@ -255,7 +261,7 @@ def child_bench(platform_pin: str, rung: str):
 
     from jaxmc.sem.modules import Loader, bind_model
     from jaxmc.front.cfg import parse_cfg
-    from jaxmc.tpu.bfs import TpuExplorer
+    from jaxmc.backend.bfs import TpuExplorer
     from jaxmc.engine.explore import Explorer
 
     cfg_path = _RUNG_CFG[rung]
@@ -488,7 +494,7 @@ def child_warmgen():
         enable_guarded_cache(tel=tel)
         from jaxmc.sem.modules import Loader, bind_model
         from jaxmc.front.cfg import parse_cfg
-        from jaxmc.tpu.bfs import TpuExplorer
+        from jaxmc.backend.bfs import TpuExplorer
         from jaxmc.engine.explore import Explorer
 
         def load(spec, cfg_path):
@@ -555,6 +561,7 @@ def child_warmgen():
 class _Results:
     """Thread-safe best-line store with a fixed priority order."""
     PRIORITY = [("tpu", "full"), ("tpu", "quick"),
+                ("gpu", "full"), ("gpu", "quick"),
                 ("cpu", "full"), ("cpu", "quick"),
                 ("interp", "emergency")]
 
@@ -758,9 +765,63 @@ def _tunnel_oracle() -> str:
     return "unknown"
 
 
+def _accel_worker():
+    """The accelerator-side worker.  Consults the PREFLIGHT backend
+    oracle first (ISSUE 11): a live accelerator verdict skips every
+    legacy probe and goes straight to measuring on that platform; a
+    cpu verdict means every accelerator probe failed in seconds — the
+    worker exits immediately so the whole deadline belongs to the
+    cpu/full rung.  Only when the preflight itself produced nothing
+    (_ORACLE empty/None verdict) does the legacy TPU probe-loop path
+    run."""
+    choice = _ORACLE.get("platform")
+    if choice in ("tpu", "gpu"):
+        _log(f"backend oracle: {choice} is live "
+             f"({_ORACLE.get('reason')}) — measuring on it")
+        _accel_rungs(choice)
+        return
+    if choice == "cpu":
+        # all accelerator probes failed fast: the cpu worker owns the
+        # deadline; recorded like the legacy probe-loop DOWN verdict
+        _log("backend oracle: no live accelerator — cpu/full gets the "
+             "whole deadline")
+        global _PROBE_SKIPPED
+        _PROBE_SKIPPED = True
+        _TEL.event("tpu_probe_skipped",
+                   reason="backend oracle verdict: cpu only")
+        return
+    _tpu_worker()
+
+
+def _accel_rungs(platform: str):
+    """quick rung first (earliest accelerator line), bounded profile
+    capture (tpu only), then the full rung — on the oracle's chosen
+    platform."""
+    try:  # evidence for the monitoring loop pattern (tpu_up.marker)
+        if platform == "tpu":
+            with open(_UP_MARKER, "w") as fh:
+                fh.write(str(time.time()))
+    except OSError:
+        pass
+    line = _run_child({"JAXMC_BENCH_CHILD": platform,
+                       "JAXMC_BENCH_RUNG": "quick"},
+                      _remaining(), f"{platform}/quick")
+    if line:
+        _RESULTS.put(platform, "quick", line)
+    if platform == "tpu" and _remaining() > 240:
+        _run_profile_tpu(min(300.0, _remaining() / 3))
+    line = _run_child({"JAXMC_BENCH_CHILD": platform,
+                       "JAXMC_BENCH_RUNG": "full"},
+                      _remaining(), f"{platform}/full")
+    if line:
+        _RESULTS.put(platform, "full", line)
+
+
 def _tpu_worker():
-    """Probe for the tunnel; on success run quick rung first (earliest
-    possible TPU line), bounded profile capture, then the full rung."""
+    """LEGACY probe path (only when the preflight oracle produced no
+    verdict): probe for the tunnel; on success run quick rung first
+    (earliest possible TPU line), bounded profile capture, then the
+    full rung."""
     oracle = _tunnel_oracle()
     found = oracle == "up"
     if found:
@@ -795,23 +856,7 @@ def _tpu_worker():
             time.sleep(min(20.0, _remaining()))
     if not found:
         return
-    try:  # evidence for the monitoring loop pattern (memory: tpu_up.marker)
-        with open(_UP_MARKER, "w") as fh:
-            fh.write(str(time.time()))
-    except OSError:
-        pass
-    line = _run_child({"JAXMC_BENCH_CHILD": "tpu", "JAXMC_BENCH_RUNG":
-                       "quick"}, _remaining(), "tpu/quick")
-    if line:
-        _RESULTS.put("tpu", "quick", line)
-    # per-step device timings survive in PROFILE_TPU.txt even if the full
-    # rung later dies; bounded so it cannot eat the full rung's slot
-    if _remaining() > 240:
-        _run_profile_tpu(min(300.0, _remaining() / 3))
-    line = _run_child({"JAXMC_BENCH_CHILD": "tpu", "JAXMC_BENCH_RUNG":
-                       "full"}, _remaining(), "tpu/full")
-    if line:
-        _RESULTS.put("tpu", "full", line)
+    _accel_rungs("tpu")
 
 
 def _run_profile_tpu(timeout_s: float):
@@ -900,23 +945,44 @@ def main():
     # stall lines name the actual wedge on the shared stderr.
     _log(f"deadline: {budget:.0f}s from now")
 
+    # PREFLIGHT backend oracle (ISSUE 11): answer "which live platform
+    # should this round measure?" in seconds — concurrent hang-proof
+    # subprocess probes of every visible platform — and then spend the
+    # WHOLE remaining deadline measuring on the winner instead of
+    # discovering a dead tunnel 120 s at a time mid-round.  Best-effort:
+    # an oracle failure falls back to the legacy probe-loop path.
+    try:
+        from jaxmc.backend.oracle import preflight
+        with _TEL.span("backend_oracle"):
+            _ORACLE.update(preflight(
+                deadline_s=float(os.environ.get("JAXMC_ORACLE_DEADLINE",
+                                                "10")),
+                tel=_TEL, use_cache=False))
+        _log(f"backend oracle: {_ORACLE.get('platform') or 'none'} "
+             f"({_ORACLE.get('reason')}; {_ORACLE.get('wall_s')}s)")
+    except Exception as ex:  # noqa: BLE001 — preflight must never
+        # kill the bench round it exists to speed up
+        _log(f"backend oracle failed ({ex}); legacy probe path")
+
+    accel = _ORACLE.get("platform") \
+        if _ORACLE.get("platform") in ("tpu", "gpu") else "tpu"
     t_cpu = threading.Thread(target=_cpu_worker, daemon=True)
-    t_tpu = threading.Thread(target=_tpu_worker, daemon=True)
+    t_tpu = threading.Thread(target=_accel_worker, daemon=True)
     t_cpu.start()
     t_tpu.start()
 
     # wait until the deadline, or stop early once the best line this
     # environment can produce is in hand
     while _remaining() > 10:
-        if _RESULTS.has("tpu", "full"):
+        if _RESULTS.has(accel, "full"):
             break
         if not t_tpu.is_alive() and not t_cpu.is_alive():
             break
         if not t_tpu.is_alive():
-            # tpu worker exited: tpu/quick (if it landed) outranks any
-            # later cpu line — waiting further cannot improve best();
-            # without it, cpu/full is the ceiling
-            if _RESULTS.has("tpu", "quick") or _RESULTS.has("cpu", "full"):
+            # accel worker exited: its quick line (if it landed)
+            # outranks any later cpu line — waiting further cannot
+            # improve best(); without it, cpu/full is the ceiling
+            if _RESULTS.has(accel, "quick") or _RESULTS.has("cpu", "full"):
                 break
         time.sleep(3)
 
@@ -937,6 +1003,11 @@ def main():
     orch = {"deadline_s": budget,
             "spent_s": round(budget - _remaining(), 1),
             "probe_skipped": _PROBE_SKIPPED,
+            # the preflight verdict (ISSUE 11): which platform this
+            # round measured and why — per-candidate probe walls
+            # included, so a dead-tunnel round is attributed in the
+            # artifact of record
+            "backend_oracle": dict(_ORACLE) if _ORACLE else None,
             "compile_cache": os.environ.get("JAXMC_COMPILE_CACHE"),
             # per-child fate + retry count (ISSUE 4): a signal-killed
             # child names its signal here instead of an opaque partial

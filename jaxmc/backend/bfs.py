@@ -1,0 +1,3511 @@
+r"""Device-resident BFS engine (BACKEND=jax) — SURVEY.md §7.5.
+
+The hot loop reconstructed in SURVEY.md §3.2, as array programs: the frontier
+and the seen-set live on the accelerator; one jitted level step expands every
+(state x grounded action) pair with vmap, masks disabled instances, and
+deduplicates by lexicographic multi-key sort (jax.lax.sort).
+
+Two dedup modes:
+  exact  (narrow layouts, W <= FP_THRESHOLD): sort keys are all W state
+         lanes — zero collision risk, stronger than TLC.
+  fp128  (wide layouts — raft's W is ~1-2k lanes): sort keys are four
+         independent 32-bit mixes of the row (a 128-bit fingerprint, vs
+         TLC's 64-bit, testout2:261-264); the collision probability is
+         reported in the result like TLC reports its estimate.
+
+Capacities are power-of-two buckets that grow on demand, so jit recompiles
+O(log N) times; all shapes inside a step are static (XLA/TPU requirement).
+Parent provenance rides the sorts as a non-key operand and is streamed to
+host per level for counterexample reconstruction — disable with
+store_trace=False for benchmark runs.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .. import obs
+from ..sem.modules import Model, satisfies_constraints
+from ..sem.enumerate import enumerate_init, enumerate_next
+from ..sem.eval import TLCAssertFailure, eval_expr, _bool
+from ..sem.values import EvalError
+from ..engine.explore import CheckResult, Violation
+from ..engine.simulate import sample_states
+from ..compile.vspec import Bounds, CompileError, ModeError
+from ..compile.kernel2 import (KernelCtx, OV_DEMOTED, OV_PACK,
+                               build_layout2, compile_action2,
+                               compile_predicate2, compile_value2,
+                               introspect_kernel)
+from ..compile.ground import ground_arm, split_arms
+
+SENTINEL = np.int32(2**31 - 1)
+FP_THRESHOLD = 48  # lanes; beyond this, dedup on 128-bit fingerprints
+# "bounds inference not yet attempted" marker for the per-model cache
+# (the cached report itself may legitimately be None = analysis bailed)
+_SENTINEL_NO_REPORT = object()
+
+# resident-mode status codes (one summary scalar per dispatched batch)
+ST_CONTINUE = 0     # level budget exhausted, search not finished
+ST_DONE = 1         # frontier empty: search complete
+ST_INV = 2          # invariant violated (aux: which, row)
+ST_DEADLOCK = 3     # deadlocked state (aux: row)
+ST_ASSERT = 4       # Assert failed inside an enabled action (aux: row)
+ST_TRUNC = 5        # max_states reached
+ST_OVF_SEEN = 6     # seen-set capacity: grow SC, redo level
+ST_OVF_FRONT = 7    # frontier capacity: grow FCap, redo level
+ST_OVF_ACC = 8      # level-accumulator capacity: grow AccCap, redo level
+ST_OVF_VC = 9       # per-chunk valid-candidate capacity: grow VC, redo level
+ST_OVF_LANES = 10   # a container outgrew its lane capacity: hard abort
+
+SYMMETRY_WARNING = (
+    "cfg SYMMETRY NOT applied on the jax backend: counts are "
+    "unreduced and will exceed the interp/TLC reduced counts")
+
+_FP_MIX = [(0x9E3779B1, 0x85EBCA6B), (0xC2B2AE35, 0x27D4EB2F),
+           (0x165667B1, 0x9E3779B1), (0x85EBCA6B, 0xC2B2AE35)]
+
+
+def filter_init_states(model, layout, init_rows):
+    """Apply TLC's CONSTRAINT-discard semantics to encoded init rows:
+    returns (explored_indices, (invariant_name, state) | None). Violating
+    inits are fingerprinted by the caller but never counted distinct,
+    invariant-checked, or explored; invariants run on kept inits only
+    (host-side interpreter — init sets are small)."""
+    from ..sem.modules import satisfies_constraints
+    from ..sem.eval import eval_expr, _bool
+    explored = []
+    for i, row in enumerate(init_rows):
+        st = layout.decode(row)
+        if not satisfies_constraints(model, st):
+            continue
+        ctx = model.ctx(state=st)
+        for nm, ex in model.invariants:
+            if not _bool(eval_expr(ex, ctx), f"invariant {nm}"):
+                return explored, (nm, st)
+        explored.append(i)
+    return explored, None
+
+
+def _pow2_at_least(n: int, lo: int = 256) -> int:
+    c = lo
+    while c < n:
+        c *= 2
+    return c
+
+
+def fingerprint128(rows):
+    """rows [N, W] i32 -> [N, 4] i32 (four independent 32-bit mixes)."""
+    u = rows.astype(jnp.uint32)
+    out = []
+    for j, (m1, m2) in enumerate(_FP_MIX):
+        h = jnp.full(rows.shape[0], 2166136261 + j * 0x9E3779B1,
+                     jnp.uint32)
+        for i in range(rows.shape[1]):
+            h = (h ^ (u[:, i] * jnp.uint32(m1))) * jnp.uint32(m2)
+        h = h ^ (h >> 15)
+        h = h * jnp.uint32(0x2C1B3C6D)
+        h = h ^ (h >> 12)
+        out.append(h.astype(jnp.int32))
+    return jnp.stack(out, axis=1)
+
+
+def _lower_bound(table, count, queries, cap):
+    """Vectorized lexicographic lower bound: for each query row (i32
+    words, signed order) the first index in table[0:count] whose row is
+    not less than the query. table [cap, w]: sorted valid prefix of
+    length count (traced). Fixed-trip binary search — compiles to plain
+    gathers/selects (no sort comparators), safe inside while loops.
+
+    The log2(cap) search steps MUST be a lax loop, not a Python unroll:
+    unrolled, XLA's fusion pass duplicates the whole dependent
+    gather/compare chain into every consumer (measured: 1 700+ copies of
+    the [cap,w] gather in the optimized HLO, turning a ms-scale level
+    step into minutes)."""
+    n = queries.shape[0]
+    iters = max(1, int(np.ceil(np.log2(max(cap, 2)))) + 1)
+
+    def step(_, lohi):
+        lo, hi = lohi
+        mid = (lo + hi) // 2
+        row = jnp.take(table, jnp.clip(mid, 0, cap - 1), axis=0)
+        lt = jnp.zeros(n, bool)
+        gt = jnp.zeros(n, bool)
+        for j in range(table.shape[1]):
+            undec = ~(lt | gt)
+            lt = lt | (undec & (row[:, j] < queries[:, j]))
+            gt = gt | (undec & (row[:, j] > queries[:, j]))
+        go = lo < hi
+        lo = jnp.where(go & lt, mid + 1, lo)
+        hi = jnp.where(go & ~lt, mid, hi)
+        return lo, hi
+
+    lo0 = jnp.zeros(n, jnp.int32)
+    hi0 = jnp.broadcast_to(jnp.asarray(count, jnp.int32), (n,))
+    lo, _ = lax.fori_loop(0, iters, step, (lo0, hi0))
+    return lo
+
+
+def _lsd_sort(key_cols, extra_cols):
+    """Stable multi-key sort as chained STABLE single-key passes (LSD
+    radix over the key words, least-significant first).  Equivalent to
+    `lax.sort(key_cols + extra_cols, num_keys=len(key_cols))` with
+    key_cols[0] most significant — but multi-key sort comparators
+    explode XLA compile time inside while loops, and both resident
+    engines (single-chip tpu/bfs.py and the mesh superstep,
+    tpu/mesh.py) run this under lax.while_loop.  Returns the
+    (key_cols, extra_cols) lists co-sorted."""
+    cols = list(key_cols) + list(extra_cols)
+    nk = len(key_cols)
+    for kj in range(nk - 1, -1, -1):  # least-significant first
+        rest = [c for i, c in enumerate(cols) if i != kj]
+        res = lax.sort(tuple([cols[kj]] + rest), num_keys=1,
+                       is_stable=True)
+        out_rest = list(res[1:])
+        cols = [res[0] if i == kj else out_rest.pop(0)
+                for i in range(len(cols))]
+    return cols[:nk], cols[nk:]
+
+
+def _rank_merge(seen, seen_count, keys, N, SC, K, multikey=False):
+    """The O(new) seen-merge core SHARED by the single-chip resident
+    level and the mesh rank-merge strategy (ISSUE 10; the
+    _candidate_block_fn-style shared-plumbing pattern): the seen table
+    keeps a sorted valid prefix [0:seen_count) as an INVARIANT, so a
+    level only sorts its ≤N incoming keys (_lsd_sort — while_loop
+    safe), dedups them against the prefix with vectorized binary
+    searches (_lower_bound) and scatters the genuinely-new keys at
+    their ranks.  No per-level re-sort of the seen table: the sort
+    work is O(N log N), not O((SC+N) log (SC+N)).
+
+    seen [SC, K] (validity lane first, prefix sorted by the K-1 data
+    words), seen_count traced scalar, keys [N, K] unsorted candidate
+    keys (invalid rows: lane 0 != 0, SENTINEL data — they sort last).
+
+    Returns dict:
+      new_count  how many sorted candidate keys are genuinely new
+      nk_sidx    [N] each compacted new key's ORIGINAL row index in
+                 `keys` (key-sorted order; ties keep first occurrence)
+      seen2      [SC, K] merged table — sorted valid prefix of length
+                 seen_count + new_count, invalid tail (lane 1,
+                 SENTINEL data).  Positions past SC are DROPPED: the
+                 caller must treat seen_count2 > SC as an overflow and
+                 roll the level back (seen_count2 still reports the
+                 TRUE need, so growth can jump straight to it).
+      seen_count2  seen_count + new_count (NOT cropped to SC).
+
+    multikey=True sorts the candidate keys with ONE stable multi-key
+    lax.sort instead of the LSD chain — measured 3x faster on XLA:CPU
+    at mesh shapes, and a 5-key sort inside a while_loop compiles in
+    well under a second on current XLA (the mesh superstep uses it);
+    the single-chip resident engine keeps the LSD chain its compile
+    envelope was measured with."""
+    sidx = jnp.arange(N, dtype=jnp.int32)
+    if multikey:
+        res = lax.sort(tuple(keys[:, j] for j in range(K)) + (sidx,),
+                       num_keys=K, is_stable=True)
+        kc = list(res[:K])
+        sidx_s = res[K]
+    else:
+        kc, ec = _lsd_sort([keys[:, j] for j in range(K)], [sidx])
+        sidx_s = ec[0]
+    skeys = jnp.stack(kc, axis=1)
+    svalid = skeys[:, 0] == 0
+    neq_prev = jnp.concatenate([
+        jnp.array([True]),
+        jnp.any(skeys[1:] != skeys[:-1], axis=1)])
+
+    words = skeys[:, 1:]
+    seen_words = seen[:, 1:]
+    lb = _lower_bound(seen_words, seen_count, words, SC)
+    at_lb = jnp.take(seen_words, jnp.clip(lb, 0, SC - 1), axis=0)
+    found = (lb < seen_count) & jnp.all(at_lb == words, axis=1)
+    new = svalid & ~found & neq_prev
+    new_count = jnp.sum(new, dtype=jnp.int32)
+
+    # compact the new keys to the front (stable: key order kept).
+    # A cumsum-rank scatter, NOT a sort: the 1-key compaction sort
+    # this replaces was ~0.5s per level at mesh candidate-block
+    # shapes (ISSUE 11 phase-wall profile) while the scatter is tens
+    # of ms — and the order is identical, because cumsum ranks
+    # preserve the (already key-sorted) row order.  Dropped rows get
+    # DISTINCT out-of-range indices (N + sidx): unique_indices=True
+    # is a correctness promise to XLA (advisor r2 rule).
+    npos = jnp.cumsum(new.astype(jnp.int32)) - 1
+    tgt = jnp.where(new, npos, N + sidx)
+    nk_words = jnp.zeros((N, K - 1), jnp.int32) \
+        .at[tgt].set(words, mode="drop", unique_indices=True)
+    nk_sidx = jnp.zeros((N,), jnp.int32) \
+        .at[tgt].set(sidx_s, mode="drop", unique_indices=True)
+    nk_lb = jnp.zeros((N,), jnp.int32) \
+        .at[tgt].set(lb, mode="drop", unique_indices=True)
+    nvalid = sidx < new_count
+
+    # rank merge into seen2: pos(new j) = lb_seen + j,
+    # pos(seen i) = i + ranks(i) — a bijection since new keys are
+    # distinct from seen keys.  ranks[i] = #{valid new j : key_j <
+    # seen[i]} needs NO second binary search: key_j < seen[i] iff its
+    # lower bound nk_lb[j] <= i, so a scatter-add histogram of the
+    # nk_lb values + one inclusive cumsum gives every seen row's
+    # shift in O(SC + N) cheap ops (the SC-query binary search this
+    # replaces measurably dominated the mesh merge wall, ISSUE 10)
+    hist = jnp.zeros((SC + 1,), jnp.int32)
+    hist = hist.at[jnp.where(nvalid, jnp.clip(nk_lb, 0, SC), SC)] \
+        .add(1)
+    ranks = jnp.cumsum(hist[:SC])
+    valid_seen_rows = jnp.arange(SC) < seen_count
+    # dropped (invalid) rows get DISTINCT out-of-range indices
+    # (SC + arange): unique_indices=True is a correctness promise to
+    # XLA, and funnelling every invalid row to the same index would be
+    # documented UB even though mode="drop" discards the writes
+    # (advisor r2)
+    pos_s = jnp.where(valid_seen_rows,
+                      jnp.arange(SC, dtype=jnp.int32) + ranks,
+                      SC + jnp.arange(SC, dtype=jnp.int32))
+    seen2 = jnp.full((SC, K), SENTINEL, jnp.int32)
+    seen2 = seen2.at[:, 0].set(1)  # invalid tail: validity lane 1
+    seen2 = seen2.at[pos_s].set(seen, mode="drop",
+                                unique_indices=True)
+    nk_full = jnp.concatenate(
+        [jnp.zeros((N, 1), jnp.int32), nk_words], axis=1)
+    pos_n = jnp.where(nvalid, nk_lb + sidx, SC + sidx)
+    seen2 = seen2.at[pos_n].set(nk_full, mode="drop",
+                                unique_indices=True)
+    return dict(new_count=new_count, nk_sidx=nk_sidx, seen2=seen2,
+                seen_count2=seen_count + new_count)
+
+
+class _LiveGraph:
+    """Host-side behavior-graph accumulator for device runs.
+
+    Mirrors the interp engine's bookkeeping (engine/explore.py): kept
+    states get dense ids in discovery order; edges record every
+    (parent, kept-successor) step including re-visits of already-seen
+    states; parents/labels form the BFS tree for trace reconstruction.
+    Constraint-discarded successors never enter the graph — the same
+    mask that keeps them off the device frontier keeps them out here."""
+
+    def __init__(self, labels_flat: List[str], collect_edges: bool):
+        self.labels_flat = labels_flat
+        self.collect_edges = collect_edges
+        self.rows: List[np.ndarray] = []
+        self.sid_by_key: Dict[bytes, int] = {}
+        self.parents: List[Optional[int]] = []
+        self.labels: List[str] = []
+        self.edges: List[Tuple[int, int]] = []
+
+    def add_inits(self, init_rows, explored_idx) -> np.ndarray:
+        sids = []
+        for i in explored_idx:
+            row = np.array(init_rows[i], copy=True)
+            sid = len(self.rows)
+            self.rows.append(row)
+            self.sid_by_key[row.tobytes()] = sid
+            self.parents.append(None)
+            self.labels.append("Initial predicate")
+            sids.append(sid)
+        return np.asarray(sids, dtype=np.int64)
+
+    def add_level(self, new_rows, new_prov, par_div: int,
+                  frontier_sids: np.ndarray) -> np.ndarray:
+        """Register this level's kept rows; prov = action*par_div + f."""
+        sids = []
+        for i in range(len(new_rows)):
+            row = np.array(new_rows[i], copy=True)
+            sid = len(self.rows)
+            self.rows.append(row)
+            self.sid_by_key[row.tobytes()] = sid
+            p = int(new_prov[i])
+            a, f = p // par_div, p % par_div
+            self.parents.append(int(frontier_sids[f]))
+            self.labels.append(self.labels_flat[a])
+            sids.append(sid)
+        return np.asarray(sids, dtype=np.int64)
+
+    def add_edges(self, rows: np.ndarray, parent_f: np.ndarray,
+                  frontier_sids: np.ndarray) -> None:
+        """Record edges (frontier_sids[parent_f[i]] -> sid of rows[i]) for
+        pre-masked kept candidates; call after add_level so same-level
+        successors resolve."""
+        if not self.collect_edges:
+            return
+        for i in range(len(rows)):
+            t = self.sid_by_key.get(rows[i].tobytes())
+            if t is None:
+                continue  # fp-collision shadow; counts already report it
+            self.edges.append(
+                (int(frontier_sids[int(parent_f[i])]), t))
+
+
+class TpuExplorer:
+    def __init__(self, model: Model, log: Callable[[str], None] = None,
+                 max_states: Optional[int] = None, store_trace: bool = True,
+                 progress_every: float = 30.0,
+                 bounds: Optional[Bounds] = None,
+                 sample_cfg: Tuple[int, int, int] = (800, 40, 60),
+                 host_seen: bool = False, chunk: int = 2048,
+                 resident: bool = False,
+                 checkpoint_path: Optional[str] = None,
+                 checkpoint_every: float = 600.0,
+                 resume_from: Optional[str] = None,
+                 extra_samples: Optional[List[Dict[str, Any]]] = None,
+                 relayouts_left: int = 3,
+                 pin_interp_arms: bool = False,
+                 res_caps: Optional[Dict[str, int]] = None,
+                 cap_profile: bool = True,
+                 final_checkpoint: bool = False,
+                 backend: Optional["BackendDescriptor"] = None):
+        self.model = model
+        # the device layer this engine is compiled FOR (ISSUE 11): one
+        # descriptor instead of per-engine re-derivation from global
+        # jax state — platform, donation policy and the capacity-
+        # profile namespace all read from it, so caps learned on one
+        # platform can never warm-start another
+        from . import describe_backend
+        self.backend_desc = backend if backend is not None \
+            else describe_backend()
+        # persist a checkpoint when the search COMPLETES (not just on
+        # truncation): the serve daemon's warm-resume source — an
+        # identical later job resumes it, replays the stored totals
+        # over an empty frontier, and finishes in one dispatch
+        self.final_checkpoint = final_checkpoint
+        # same funnel as cli.py: silent on stdout by default, but the
+        # strings still mirror into the telemetry trace
+        self.log = log if log is not None else obs.Logger(quiet=True)
+        self.max_states = max_states
+        self.store_trace = store_trace
+        self.progress_every = progress_every
+        self.bounds = bounds or Bounds()
+        self.host_seen = host_seen
+        self.chunk = chunk
+        self.resident = resident
+        self.checkpoint_path = checkpoint_path
+        self.checkpoint_every = checkpoint_every
+        self.resume_from = resume_from
+        self.sample_cfg = sample_cfg
+        # ADAPTIVE RELAYOUT (hybrid, host_seen): when a compile-recovery
+        # demotion fires because a value SHAPE was never observed by the
+        # layout sampler (a deep model's rare message variant), the
+        # engine re-samples from the abort-time frontier, rebuilds the
+        # layout and kernels with the enriched observation set, and
+        # restarts COMPILED — falling back to whole-arm interpretation
+        # only after relayouts_left attempts.
+        self.extra_samples = list(extra_samples or [])
+        self.relayouts_left = relayouts_left
+        # expansion-mode pin (ISSUE 5): the corpus manifest knows this
+        # model's arms ALL demote to the interpreter — skip grounding +
+        # kernel construction + forced tracing entirely instead of
+        # paying minutes of futile XLA work (MCInnerSerial burned 213s
+        # building 13 kernels it then demoted, SWEEP_JAX_r05).
+        self.pin_interp_arms = pin_interp_arms
+        self._res_caps_hint = dict(res_caps) if res_caps else None
+        self.cap_profile = cap_profile
+        self._last_frontier_np: Optional[np.ndarray] = None
+
+        tel = obs.current()
+        base_ctx = model.ctx()
+        self.init_states = enumerate_init(model.init, base_ctx, model.vars)
+        bfs_n, walks, depth = sample_cfg
+        with tel.span("layout_sample", bfs_states=bfs_n, walks=walks,
+                      walk_depth=depth):
+            sampled = sample_states(model, bfs_states=bfs_n,
+                                    n_walks=walks, walk_depth=depth)
+        sampled = list(sampled) + self.extra_samples
+        # static bounds inference (ISSUE 9): a converged interval proof
+        # turns observed-range guarded int lanes into proven-width lanes
+        # — the OV_PACK re-sample cycle cannot fire on a proven lane.
+        # The fixpoint result is cached on the model so relayout
+        # restarts and mesh subclasses do not re-run it.
+        self._static_bounds = None
+        from .. import analyze as _analyze
+        if _analyze.bounds_enabled():
+            rep = getattr(model, "_bounds_report", _SENTINEL_NO_REPORT)
+            if rep is _SENTINEL_NO_REPORT:
+                with tel.span("analyze_bounds"):
+                    rep = _analyze.infer_state_bounds(model)
+                try:
+                    model._bounds_report = rep
+                except AttributeError:
+                    pass
+            if rep is not None:
+                self._static_bounds = rep.lane_bounds()
+                tel.gauge("analyze.bounds_converged",
+                          bool(rep.converged))
+        with tel.span("layout_build", samples=len(sampled)):
+            self.layout = build_layout2(model, sampled, self.bounds,
+                                        static_bounds=self._static_bounds)
+        self.kc = KernelCtx(model, self.layout, self.bounds)
+        # dynamic \E expansion applies to message tables AND to
+        # state-dependent intervals (\E i \in 1..Len(q), AlternatingBit's
+        # Lose); slots beyond the actual element count are mask-disabled.
+        #
+        # Hybrid execution (VERDICT r3 #2): Next splits into disjunct
+        # arms; an arm whose grounding or kernel compilation fails is
+        # demoted to exact interpreter enumeration over decoded frontier
+        # states (host_seen mode only) instead of rejecting the spec.
+        # Kernel CompileErrors surface lazily at jit-trace time, so each
+        # compiled unit is force-traced here with jax.eval_shape
+        # (abstract evaluation — no XLA compile cost).
+        row_spec = jax.ShapeDtypeStruct((self.layout.width,), jnp.int32)
+        slot_spec = jax.ShapeDtypeStruct((), jnp.int32)
+        self.arms = split_arms(model)
+        self.actions = []
+        self.compiled = []
+        self._ca_arm: List[int] = []  # arm index per compiled action
+        self.fb_arms: List[Tuple[Any, str]] = []  # (ActionArm, reason)
+        # per-arm compile introspection (ISSUE 2): jaxpr equation count
+        # and HLO flops/bytes per kernel, aggregated per arm label. The
+        # introspection trace replaces the eval_shape forced trace, so
+        # the only extra cost vs an untelemetered build is the lowering
+        # for cost_analysis (JAXMC_COMPILE_INTROSPECT=0 skips it).
+        arm_costs: Dict[str, Dict[str, int]] = {}
+        zero_row = jnp.zeros((self.layout.width,), jnp.int32)
+        zero_slot = jnp.zeros((), jnp.int32)
+        # transient per-arm compile failures (a flaky device link mid-
+        # lowering, injected compile_fail faults) get a bounded retry
+        # with backoff before the failure escapes to cli.py's demotion
+        # path; REAL CompileErrors are deterministic and still demote
+        # the arm to the interpreter immediately, as before
+        compile_retries = int(os.environ.get("JAXMC_COMPILE_RETRIES",
+                                             "2"))
+        from .. import faults as _faults
+        if self.pin_interp_arms:
+            self.fb_arms = [(arm, "pinned interp-arms (corpus "
+                                  "manifest): kernel construction "
+                                  "skipped") for arm in self.arms]
+        # statically-predicted demotions (ISSUE 9): arms the analyzer is
+        # CERTAIN compile_action2 would demote skip grounding + kernel
+        # construction + forced tracing outright — the derived
+        # generalization of the manifest's measured pin_interp_arms
+        # pins.  The verdict string IS the build-time reason string
+        # (kernel2's shared message constants), so the demotion table,
+        # the ModeError text and the sweep notes read identically on
+        # either path.
+        self.arm_verdicts: Dict[int, str] = {}
+        if not self.pin_interp_arms and _analyze.predict_enabled() \
+                and self.arms:
+            with tel.span("analyze_arms", arms=len(self.arms)):
+                self.arm_verdicts = _analyze.predict_arm_demotions(
+                    model, self.arms)
+            if self.arm_verdicts:
+                tel.counter("analyze.predicted_demotions",
+                            len(self.arm_verdicts))
+                tel.gauge("analyze.arm_verdicts",
+                          {(self.arms[i].label or "Next"): r
+                           for i, r in sorted(self.arm_verdicts.items())})
+        for ai, arm in enumerate(
+                () if self.pin_interp_arms else self.arms):
+            if ai in self.arm_verdicts:
+                # zero futile build attempts: the arm goes straight to
+                # the interpreter with the predicted (== build-time)
+                # reason
+                self.fb_arms.append((arm, self.arm_verdicts[ai]))
+                continue
+            try:
+                for attempt in range(compile_retries + 1):
+                    # per-ATTEMPT introspection buffer: the rollup
+                    # (arm_costs + the *_total counters) commits only
+                    # when the attempt succeeds, so a retried arm never
+                    # double-counts the kernels introspected before the
+                    # transient failure (the per-attempt span still
+                    # carries its own attrs — that is honest span data)
+                    att_costs: Dict[str, int] = {}
+                    try:
+                        # the span covers grounding + kernel build + the
+                        # forced abstract trace — the per-arm compile
+                        # cost the bench forensics need (BENCH_r05:
+                        # nothing said whether compile or BFS ate the
+                        # deadline)
+                        with tel.span("compile_arm",
+                                      arm=arm.label or "Next") as asp:
+                            _faults.inject("compile_fail",
+                                           arm=arm.label or "Next")
+                            gas = ground_arm(model, arm,
+                                             dyn_slots=self.bounds.kv_cap)
+                            cas = []
+                            for ga in gas:
+                                ca = compile_action2(self.kc, ga)
+                                if tel.enabled:
+                                    # the introspection trace IS the
+                                    # forced abstract trace (same lazy
+                                    # CompileError/RecursionError
+                                    # surface as eval_shape) — one
+                                    # trace per kernel either way
+                                    info = introspect_kernel(
+                                        ca.fn, (zero_row, zero_slot)
+                                        if ca.n_slots else (zero_row,))
+                                    for k, v in info.items():
+                                        att_costs[k] = \
+                                            att_costs.get(k, 0) + v
+                                        asp.attrs[k] = \
+                                            asp.attrs.get(k, 0) + v
+                                elif ca.n_slots:
+                                    jax.eval_shape(ca.fn, row_spec,
+                                                   slot_spec)
+                                else:
+                                    jax.eval_shape(ca.fn, row_spec)
+                                cas.append(ca)
+                        if att_costs:
+                            acc = arm_costs.setdefault(
+                                arm.label or "Next", {})
+                            for k, v in att_costs.items():
+                                acc[k] = acc.get(k, 0) + v
+                                tel.counter(
+                                    {"jaxpr_eqns":
+                                     "compile.jaxpr_eqns_total",
+                                     "hlo_flops":
+                                     "compile.hlo_flops_total",
+                                     "hlo_bytes":
+                                     "compile.hlo_bytes_total"}[k], v)
+                        break
+                    except RecursionError:
+                        raise  # deterministic (RuntimeError subclass)
+                    except (_faults.FaultInjected, OSError,
+                            RuntimeError) as ex:
+                        if attempt >= compile_retries:
+                            raise
+                        tel.counter("compile.retries")
+                        self.log(f"-- compile_arm "
+                                 f"{arm.label or 'Next'}: transient "
+                                 f"failure ({ex}); retrying "
+                                 f"({attempt + 1}/{compile_retries})")
+                        time.sleep(min(0.1 * (2 ** attempt), 2.0))
+            except CompileError as e:
+                self.fb_arms.append((arm, str(e)))
+                continue
+            except RecursionError:
+                # a RECURSIVE operator with symbolic arguments unrolls
+                # forever at trace time — demote the arm like any other
+                # uncompilable construct instead of crashing the build
+                self.fb_arms.append(
+                    (arm, "recursive operator expansion diverges at "
+                          "compile time (RecursionError)"))
+                continue
+            self.actions.extend(gas)
+            self.compiled.extend(cas)
+            self._ca_arm.extend([ai] * len(cas))
+        if arm_costs:
+            # machine-readable per-arm compile-cost map (schema v2):
+            # {arm label -> {jaxpr_eqns, hlo_flops?, hlo_bytes?}}
+            tel.gauge("compile.arm_cost", arm_costs)
+        # per-arm demotion reasons (ISSUE 5 / VERDICT r5 #4): the sweep
+        # log used to say only "13 arms interp-demoted" — name each arm
+        # and WHY, so a mechanical arm wrongly demoted (vs a genuinely
+        # recursive one) is visible instead of folded into a count
+        for _arm, _reason in self.fb_arms:
+            self.log(f"-- arm {_arm.label or 'Next'}: interp-demoted "
+                     f"({_reason})")
+        # kernels that compiled only by DEMOTING a guard conjunct (False
+        # + abort flag) under-approximate behind a runtime abort. Most
+        # demotions never fire (raft's Receive reads fields of message
+        # variants that never occur under the micro constraints); when
+        # one DOES fire, the host_seen engine demotes those arms to the
+        # interpreter and restarts the search (see run()) instead of
+        # reporting a spurious capacity overflow.
+        self._demotable = sorted({self._ca_arm[i]
+                                  for i, ca in enumerate(self.compiled)
+                                  if ca.demoted_guards})
+        # flat instance list: slotted kernels contribute n_slots rows
+        self.labels_flat = []
+        for ca in self.compiled:
+            if ca.n_slots:
+                self.labels_flat.extend(
+                    [ca.label] * ca.n_slots)
+            else:
+                self.labels_flat.append(ca.label)
+        # cfg SYMMETRY: canonicalize rows to their orbit representative
+        # before fingerprinting (same partition, hence same counts, as
+        # the interp's make_canonicalizer); encodings the transform
+        # builder rejects fall back to the unreduced search with the
+        # SYMMETRY warning
+        self.canon_fn = None
+        self._sym_fallback: Optional[str] = None
+        if model.symmetry is not None:
+            from ..compile.symmetry2 import build_canon2
+            try:
+                self.canon_fn = build_canon2(model, self.layout)
+            except CompileError as e:
+                self._sym_fallback = str(e)
+        # identity-group disclosure (ISSUE 5 satellite): build_canon2
+        # returns None BY DESIGN when every declared permutation is the
+        # identity — no reduction exists to diverge from, so no
+        # UNREDUCED-FALLBACK warning belongs here (the interp's
+        # make_canonicalizer returns None for the same group, so counts
+        # match TLC exactly). Only a genuine CompileError fallback
+        # (self._sym_fallback) reports divergence.
+        self.sym_identity = (model.symmetry is not None
+                             and self.canon_fn is None
+                             and self._sym_fallback is None)
+        # predicates likewise force-traced; uncompilable ones demote to
+        # host-side interpreter evaluation over decoded rows (hybrid).
+        # A TRACE-TIME BUDGET (JAXMC_PRED_TRACE_BUDGET seconds, default
+        # 15) also demotes predicates whose symbolic programs explode —
+        # MCVoting's inductive Inv unrolls its quantifier towers into a
+        # ~50k-op jaxpr whose XLA:CPU compile alone blew the r3 sweep's
+        # 900 s case timeout; the exact interpreter checks such
+        # predicates on new rows at negligible cost instead.
+        budget = float(os.environ.get("JAXMC_PRED_TRACE_BUDGET", "15"))
+
+        def _compile_preds(pairs, may_demote_on_budget):
+            """(compiled, demoted) for a predicate list. Uncompilable
+            predicates always demote (hybrid checks them exactly); a
+            predicate whose abstract trace exceeds the budget demotes
+            only when may_demote_on_budget — callers keep slow compiled
+            predicates when demotion would make the run unsupported
+            (non-host_seen modes; constraints under temporal/refinement
+            PROPERTYs), so a loaded box never REFUSES a spec an idle
+            box accepts."""
+            compiled, demoted = [], []
+            for nm, ex in pairs:
+                f = compile_predicate2(self.kc, ex)
+                t_tr = time.time()
+                try:
+                    jax.eval_shape(f, row_spec)
+                except CompileError as e:
+                    demoted.append((nm, ex, str(e)))
+                    continue
+                except RecursionError:
+                    demoted.append(
+                        (nm, ex, "recursive operator expansion diverges "
+                                 "at compile time (RecursionError)"))
+                    continue
+                t_tr = time.time() - t_tr
+                if t_tr > budget and may_demote_on_budget:
+                    demoted.append(
+                        (nm, ex,
+                         f"trace budget exceeded ({t_tr:.0f}s > "
+                         f"{budget:.0f}s [JAXMC_PRED_TRACE_BUDGET]; the "
+                         f"compiled program would dwarf the model)"))
+                    continue
+                compiled.append((nm, f))
+            return compiled, demoted
+
+        with tel.span("compile_predicates",
+                      invariants=len(model.invariants),
+                      constraints=len(model.constraints)):
+            self.inv_fns, self.fb_invs = _compile_preds(
+                model.invariants, host_seen)
+            self.constraint_fns, self.fb_cons = _compile_preds(
+                model.constraints, host_seen and not model.properties)
+        if model.action_constraints:
+            raise CompileError("action constraints not compiled yet - "
+                               "use the interp backend")
+        # cfg VIEW (ISSUE 6): compile V to its value lanes and key the
+        # dedup on them — TLC fingerprints the view, not the state
+        # (ConfigFileGrammar.tla:8-11); the kept rows stay full states
+        # so traces/decodes are unchanged.  An uncompilable view still
+        # refuses the spec (the interp backend remains its checker).
+        self.view_fn = None
+        self.view_width = 0
+        if getattr(model, "view", None) is not None:
+            try:
+                self.view_fn = compile_value2(self.kc, model.view)
+                vsh = jax.eval_shape(self.view_fn, row_spec)
+                self.view_width = int(np.prod(vsh.shape)) \
+                    if vsh.shape else 1
+            except RecursionError:
+                raise CompileError(
+                    "cfg VIEW expression recurses unboundedly at compile "
+                    "time - use --backend interp")
+            if self.view_width == 0:
+                raise CompileError(
+                    "cfg VIEW evaluates to zero lanes - use --backend "
+                    "interp")
+        # refinement PROPERTYs check stepwise on the host over the
+        # streamed candidate edges — same verdicts as the interp backend
+        from ..engine.refinement import build_refinement_checkers
+        self.refiners, self.unrefined = build_refinement_checkers(model)
+        self._ref_pair_cache: set = set()
+        # temporal (liveness) obligations check over the behavior graph
+        # after the search completes, exactly like the interp backend:
+        # kept states/edges stream to the host during the run and feed
+        # engine/liveness.py — same classifier, same checker, same verdict
+        from ..engine.liveness import collect_obligations
+        self.live_obligations, self.live_unsupported, self.collect_edges = \
+            collect_obligations(model, self.refiners)
+        self.hybrid = bool(self.fb_arms or self.fb_invs or self.fb_cons)
+        if self.hybrid:
+            reasons = "; ".join(
+                [f"action arm {a.label or 'Next'}: {r}"
+                   for a, r in self.fb_arms]
+                + [f"invariant {nm}: {r}" for nm, _, r in self.fb_invs]
+                + [f"constraint {nm}: {r}" for nm, _, r in self.fb_cons])
+            if not host_seen:
+                raise ModeError(
+                    "spec needs hybrid execution (uncompilable units "
+                    "demoted to the exact interpreter), which only the "
+                    "host_seen device mode runs — pass host_seen=True; "
+                    f"demoted units: {reasons}")
+            if self.fb_cons and (self.collect_edges or self.refiners):
+                raise CompileError(
+                    "uncompilable CONSTRAINT together with temporal/"
+                    "refinement PROPERTYs is not supported on the device "
+                    f"backend — use --backend interp; units: {reasons}")
+            if not self.compiled and self.fb_arms:
+                self.log("hybrid: EVERY action arm fell back to the "
+                         "interpreter — the device does hashing/dedup "
+                         "only on this model")
+        # device flat-instance count; fallback arm j takes provenance
+        # index A + j so traces and the behavior graph resolve labels
+        # through one table
+        self.A = len(self.labels_flat)
+        self.labels_flat = self.labels_flat + \
+            [arm.label or "Next" for arm, _ in self.fb_arms]
+        self.W = self.layout.width
+        # ENGINE storage format (ISSUE 6): rows cross the kernel/engine
+        # boundary BIT-PACKED (compile/pack.py) — the frontier, the seen
+        # table, trace levels, checkpoints and the candidate streams all
+        # hold [*, PW] packed rows; kernels unpack to [*, W] lanes at
+        # the top of each jitted step.  The exact-dedup/fp128 threshold
+        # is recomputed over the PACKED width (or the view width when
+        # cfg VIEW keys the dedup).
+        self.PW = self.layout.packed_width
+        self.plan = self.layout.plan
+        self.key_width = self.view_width if self.view_fn is not None \
+            else self.PW
+        self.fp_mode = self.key_width > FP_THRESHOLD
+        # expansion-mode disclosure, machine-readable (mirrors the sweep's
+        # per-case note): gauges overwrite on relayout restarts so the
+        # artifact reports the engine that actually ran
+        tel.gauge("expand.arms_total", len(self.arms))
+        tel.gauge("expand.arms_compiled",
+                  len(self.arms) - len(self.fb_arms))
+        tel.gauge("expand.arms_interp", len(self.fb_arms))
+        tel.gauge("expand.compiled_instances", self.A)
+        tel.gauge("expand.invariants_interp", len(self.fb_invs))
+        tel.gauge("expand.constraints_interp", len(self.fb_cons))
+        tel.gauge("expand.mode",
+                  "compiled" if not self.fb_arms
+                  else ("hybrid" if self.A else "interp-arms"))
+        tel.gauge("layout.width_lanes", self.W)
+        tel.gauge("layout.packed_width_lanes", self.PW)
+        # dedup key lanes: an explicit validity lane FIRST (0=valid row,
+        # 1=invalid) — validity must never be encoded in-band in hash
+        # output or state lanes, either could legitimately equal SENTINEL
+        self.K = (4 if self.fp_mode else self.key_width) + 1
+        tel.gauge("dedup.mode",
+                  ("fp128" if self.fp_mode else "exact")
+                  + ("-view" if self.view_fn is not None
+                     else ("-packed" if not self.plan.identity else "")))
+        # buffer donation (ISSUE 6): donate the seen table and frontier
+        # into the jitted steps so XLA updates them in place instead of
+        # allocating a copy per level.  XLA:CPU ignores donation (with a
+        # warning), so it defaults on only for accelerator backends;
+        # JAXMC_DONATE=1/0 forces it either way — the policy lives on
+        # the backend descriptor since ISSUE 11.
+        self.donate = bool(self.backend_desc.donate)
+        tel.gauge("device.donation", bool(self.donate))
+        tel.gauge("backend.platform", self.backend_desc.platform)
+        tel.gauge("backend.profile_ns", self.backend_desc.profile_ns)
+        self._step_cache: Dict[Tuple[int, int], Callable] = {}
+        self._hstep_cache: Dict[int, Callable] = {}
+        self._hstep_group_jits: Dict[int, List[Callable]] = {}
+        self._newcheck_cache: Dict[int, Callable] = {}
+        self._res_cache: Dict[Tuple[int, ...], Callable] = {}
+        self._hostkeys_cache: Dict[int, Callable] = {}
+        # capacities learned by previous resident runs on this instance:
+        # a warm-up run trains them so the timed run never overflows
+        # (and therefore never recompiles)
+        self._res_caps: Optional[Dict[str, int]] = None
+        self._res_maxlvl = 64  # levels per resident dispatch
+        if resident:
+            if host_seen:
+                raise ModeError(
+                    "resident and host_seen are mutually exclusive: "
+                    "resident keeps the seen-set on device, host_seen "
+                    "keeps it in the native host store")
+            if self.refiners:
+                raise ModeError(
+                    "resident mode cannot check refinement PROPERTYs "
+                    "(stepwise host checking needs the edge stream) - "
+                    "use the level/host_seen device modes")
+            if self.live_obligations:
+                raise ModeError(
+                    "resident mode cannot check temporal properties "
+                    "(the behavior graph stays on device) - use the "
+                    "level/host_seen device modes")
+            self.store_trace = False
+            # resident dedup keys are always 128-bit fingerprints: the
+            # rank-merge binary search and the LSD key sorts are built
+            # for a fixed 4-word key
+            if not self.fp_mode:
+                self.fp_mode = True
+                self.K = 4 + 1
+        if host_seen:
+            from .. import native_store
+            if not native_store.is_available():
+                raise CompileError(f"host_seen requires the native store: "
+                                   f"{native_store.build_error()}")
+            if not self.fp_mode:
+                # narrow layouts also hash fine; host store is fp-based
+                self.fp_mode = True
+                self.K = 4 + 1
+        # re-stamp after the resident/host_seen fp forcings so the
+        # artifact records the dedup mode that actually runs
+        tel.gauge("dedup.mode",
+                  ("fp128" if self.fp_mode else "exact")
+                  + ("-view" if self.view_fn is not None
+                     else ("-packed" if not self.plan.identity else "")))
+        # LEARNED CAPACITY PROFILE (ISSUE 6): resident runs start at the
+        # caps a previous completed run on this (module, layout) ended
+        # with — persisted next to the compile cache — so the one
+        # warm-up compile covers the whole run and window_recompiles
+        # reads 0 on a second run.  Max-merged with any caller hint
+        # (bench manifest caps); a stale/foreign profile is ignored with
+        # a named profile.status reason (cache.load_capacity_profile).
+        if resident and self.cap_profile:
+            from ..compile.cache import load_capacity_profile
+            prof = load_capacity_profile(
+                model.module.name, self._layout_sig(), tel=tel,
+                variant=self.backend_desc.profile_variant())
+            if prof:
+                hint = dict(self._res_caps_hint or {})
+                for kk, vv in prof.items():
+                    hint[kk] = max(int(hint.get(kk, 0)), vv)
+                self._res_caps_hint = hint
+
+    def _expand_fn(self):
+        """The (state x action) expansion closure shared by both step
+        builders; slotted kernels vmap over a traced slot index."""
+        acts = self.compiled
+        if not acts:
+            # hybrid with every arm demoted: a zero-instance expansion
+            # (jnp.stack refuses empty lists; shapes stay [0, FC(, W)])
+            W = self.W
+
+            def expand_none(frontier):
+                FC = frontier.shape[0]
+                z = jnp.zeros((0, FC), bool)
+                return (z, jnp.ones((0, FC), bool),
+                        jnp.zeros((0, FC), jnp.int32),
+                        jnp.zeros((0, FC, W), jnp.int32))
+
+            return expand_none
+
+        def expand(frontier):
+            ens, aoks, ovs, succs = [], [], [], []
+            for ca in acts:
+                if ca.n_slots:
+                    slots = jnp.arange(ca.n_slots, dtype=jnp.int32)
+                    en, aok, ov, succ = jax.vmap(
+                        jax.vmap(ca.fn, in_axes=(0, None)),
+                        in_axes=(None, 0))(frontier, slots)
+                    for si in range(ca.n_slots):
+                        ens.append(en[si])
+                        aoks.append(aok[si])
+                        ovs.append(ov[si])
+                        succs.append(succ[si])
+                else:
+                    en, aok, ov, succ = jax.vmap(ca.fn)(frontier)
+                    ens.append(en)
+                    aoks.append(aok)
+                    ovs.append(ov)
+                    succs.append(succ)
+            return (jnp.stack(ens), jnp.stack(aoks), jnp.stack(ovs),
+                    jnp.stack(succs))
+
+        return expand
+
+    def _candidate_block_fn(self, FC: int):
+        """Shared mesh-step prologue (ISSUE 8): expand one frontier
+        block of capacity FC and produce the flat candidate block with
+        its dedup keys, packed rows and fault scalars.  Both mesh step
+        builders (the legacy exchange step and the device-resident
+        level step, tpu/mesh.py) start from exactly this closure so the
+        candidate semantics — validity masking, pack-guard overflow
+        folding (OV_PACK under kernel codes), assert/deadlock
+        provenance — cannot drift between them.
+
+        Returns a closure (frontier_lanes, fvalid) -> dict with keys:
+          gen_local, overflow (max OV_* code, 0 = none),
+          ckeys [C,K], cand [C,PW] packed, cand_u [C,W], cvalid [C],
+          dead [FC] bool, dead_slot, assert_bad (scalar), asrt_a, asrt_f
+        where C = A * FC."""
+        A, W = self.A, self.W
+        C = A * FC
+        keys_of = self._keys_of
+        expand = self._expand_fn()
+
+        def block(frontier, fvalid):
+            en, aok, ov, succ = expand(frontier)
+            valid = en & fvalid[None, :]
+            abad = (~aok) & fvalid[None, :]
+            assert_bad = jnp.any(abad)
+            aflat = jnp.argmax(abad.reshape(-1))
+            asrt_a = (aflat // FC).astype(jnp.int32)
+            asrt_f = (aflat % FC).astype(jnp.int32)
+            overflow = jnp.max(jnp.where(fvalid[None, :], ov, 0)) \
+                .astype(jnp.int32)
+            dead = fvalid & ~jnp.any(en, axis=0)
+            dead_slot = jnp.argmax(dead).astype(jnp.int32)
+            gen_local = jnp.sum(valid)
+            cand_u = succ.reshape(C, W)
+            cvalid = valid.reshape(C)
+            cand_u = jnp.where(cvalid[:, None], cand_u, SENTINEL)
+            ckeys, cand, pack_ovf = keys_of(cand_u, cvalid)
+            overflow = jnp.where(
+                overflow != 0, overflow,
+                jnp.where(pack_ovf, OV_PACK, 0).astype(jnp.int32))
+            return dict(gen_local=gen_local, overflow=overflow,
+                        ckeys=ckeys, cand=cand, cand_u=cand_u,
+                        cvalid=cvalid, dead=dead, dead_slot=dead_slot,
+                        assert_bad=assert_bad, asrt_a=asrt_a,
+                        asrt_f=asrt_f)
+
+        return block
+
+    def _temporal_warnings(self) -> List[str]:
+        out = []
+        if self.live_unsupported:
+            out.append(
+                "temporal properties NOT checked (unsupported form): "
+                + ", ".join(self.live_unsupported))
+        for rc in self.refiners:
+            if rc.liveness_skipped:
+                out.append(
+                    f"property {rc.name}: refinement checked stepwise; "
+                    f"its fairness conjuncts are NOT checked")
+        return out
+
+    def _check_live(self, graph, warnings) -> Optional[Violation]:
+        """Run the temporal obligations over the accumulated behavior
+        graph (end of a completed search)."""
+        if not self.live_obligations:
+            return None
+        from ..engine.liveness import LivenessChecker
+        states = [self.layout.decode_packed(r) for r in graph.rows]
+        lc = LivenessChecker(self.model, states, graph.edges,
+                             graph.parents, graph.labels)
+        bad, live_warns = lc.check(self.live_obligations)
+        warnings.extend(live_warns)
+        if bad is None:
+            return None
+        pname, trace, msg = bad
+        return Violation("property", pname, trace, msg)
+
+    def _refine_init(self, init_rows, explored_init):
+        """check_init on kept init states; (rc_name, state) | None."""
+        if not self.refiners:
+            return None
+        for i in explored_init:
+            st = self.layout.decode(init_rows[i])
+            for rc in self.refiners:
+                if not rc.check_init(st):
+                    return rc.name, st
+        return None
+
+    def _refine_edges(self, frontier_rows, cand, cvalid, explore, FC):
+        """Stepwise refinement over this level's kept candidate edges
+        (decode on host, same check the interp engine runs). Returns
+        (action_idx, frontier_idx, succ_state, checker) or None.
+        Duplicate (parent, succ) pairs are checked once per run."""
+        if not self.refiners:
+            return None
+        idxs = np.nonzero(np.asarray(cvalid) & np.asarray(explore))[0]
+        if not len(idxs):
+            return None
+        cand = np.asarray(cand)
+        frontier_rows = np.asarray(frontier_rows)
+        parents: Dict[int, Any] = {}
+        if len(self._ref_pair_cache) > (1 << 20):
+            self._ref_pair_cache.clear()
+        for c in idxs:
+            f = int(c % FC)
+            a = int(c // FC)
+            key = (frontier_rows[f].tobytes(), cand[c].tobytes())
+            if key in self._ref_pair_cache:
+                continue
+            self._ref_pair_cache.add(key)
+            pst = parents.get(f)
+            if pst is None:
+                pst = self.layout.decode_packed(frontier_rows[f])
+                parents[f] = pst
+            sst = self.layout.decode_packed(cand[c])
+            for rc in self.refiners:
+                if not rc.check_edge(pst, sst):
+                    return a, f, sst, rc
+        return None
+
+    def _refine_msg(self, rc) -> str:
+        msg = (f"step is not a [{rc.name}-Next]_v step of the refined "
+               f"specification")
+        if rc.last_error:
+            msg += f"; while evaluating the property: {rc.last_error}"
+        return msg
+
+    def _refine_violation(self, rc, sst, a, trace):
+        trace = [x for x in trace if x[0] is not None]
+        trace.append((sst, self.labels_flat[a]))
+        return Violation("property", rc.name, trace, self._refine_msg(rc))
+
+    def _symmetry_warnings(self) -> List[str]:
+        if self.model.symmetry is None or self.canon_fn is not None \
+                or self.sym_identity:
+            # identity groups have no reduction to fall back FROM:
+            # counts match the (equally unreduced) TLC/interp search,
+            # so warning of divergence would be wrong in kind
+            return []
+        return [SYMMETRY_WARNING + (f" ({self._sym_fallback})"
+                                    if self._sym_fallback else "")]
+
+    def _keys_of(self, rows, valid):
+        """(keys, packed_rows, pack_ovf) for a block of UNPACKED rows.
+
+        keys: [N, K] dedup key lanes — an explicit validity lane FIRST
+        (0=valid, 1=invalid, sorting after all valid rows; SENTINEL
+        data), then the key basis: the cfg VIEW's value lanes when one
+        is declared, else the BIT-PACKED row (compile/pack.py) —
+        fingerprinted to 4 words in fp mode.
+
+        packed_rows: [N, PW] the packed rows for engine storage
+        (SENTINEL-filled where invalid).
+
+        pack_ovf: scalar bool — some VALID row had a guarded lane
+        outside its profiled bit range; the engines route it into the
+        overflow channel as kernel2.OV_PACK (an exact abort naming
+        JAXMC_PACK=0, never a silently wrong count).
+
+        With cfg SYMMETRY, the KEY basis is the orbit's canonical
+        representative (compile/symmetry2.py) while the stored packed
+        row keeps the original state — same partition, same traces, as
+        the unpacked engines."""
+        packed, povf = self.plan.pack_rows(rows)
+        pack_ovf = jnp.any(povf & valid)
+        packed = jnp.where(valid[:, None], packed, SENTINEL)
+        if self.view_fn is not None:
+            # SYMMETRY composes with VIEW exactly like the interp's
+            # state_fingerprint: the view evaluates over the orbit's
+            # CANONICAL representative (view of the raw row would count
+            # symmetric states as distinct — caught in review by a
+            # 2-process SYMMETRY+VIEW repro, 17/9 vs the interp's 12/6)
+            vrows = rows
+            if self.canon_fn is not None:
+                vrows = jnp.where(valid[:, None], self.canon_fn(rows),
+                                  rows)
+            kb = jax.vmap(self.view_fn)(vrows)
+            if kb.ndim == 1:
+                kb = kb[:, None]
+        elif self.canon_fn is not None:
+            crows = jnp.where(valid[:, None], self.canon_fn(rows), rows)
+            kb, cpovf = self.plan.pack_rows(crows)
+            kb = jnp.where(valid[:, None], kb, SENTINEL)
+            pack_ovf = pack_ovf | jnp.any(cpovf & valid)
+        else:
+            kb = packed
+        k = fingerprint128(kb) if self.fp_mode else kb
+        k = jnp.where(valid[:, None], k, SENTINEL)
+        vlane = jnp.where(valid, 0, 1).astype(jnp.int32)
+        return (jnp.concatenate([vlane[:, None], k], axis=1), packed,
+                pack_ovf)
+
+    def _host_keys(self, rows_np):
+        """Host-side (keys, packed, pack_ovf) over unpacked numpy rows —
+        the init/fallback boundary paths.  numpy in, numpy out.  Jitted
+        per power-of-two bucket: the eager op-by-op dispatch of the
+        pack + fingerprint chain costs ~20ms even for a handful of rows
+        (measured on viewtoy), which dominated warm whole-run walls."""
+        n = len(rows_np)
+        if n == 0:
+            return (np.zeros((0, self.K), np.int32),
+                    np.zeros((0, self.PW), np.int32), False)
+        cap = _pow2_at_least(n, lo=8)
+        jf = self._hostkeys_cache.get(cap)
+        if jf is None:
+            jf = jax.jit(lambda rows, valid: self._keys_of(rows, valid))
+            self._hostkeys_cache[cap] = jf
+        buf = np.repeat(np.asarray(rows_np[:1], np.int32), cap, axis=0)
+        buf[:n] = rows_np
+        k, p, o = jf(jnp.asarray(buf),
+                     jnp.asarray(np.arange(cap) < n))
+        return np.asarray(k)[:n], np.asarray(p)[:n], bool(o)
+
+    # ---- jitted level step, compiled per (seen_cap, frontier_cap) ----
+    def _get_step(self, SC: int, FC: int) -> Callable:
+        # rank-merge port (ISSUE 11 tentpole b): the level mode is the
+        # LEGACY host loop refinement/temporal-PROPERTY checking runs on
+        # (the resident loop cannot stream edges), and it full-sorted
+        # seen+candidates — a [SC+C, K+1]-key stable sort EVERY level —
+        # long after the resident engines went O(new).  The seen table
+        # already keeps a sorted valid prefix (init lexsorts, the merge
+        # writes sorted output), so bfs._rank_merge drops the per-level
+        # sort work to the C candidate keys alone.  Counts, traces and
+        # frontier order are bit-identical (pinned by tests);
+        # JAXMC_LEVEL_RANKMERGE=0 keeps the full-sort as the escape
+        # hatch / parity oracle.
+        rank = os.environ.get("JAXMC_LEVEL_RANKMERGE", "").strip() != "0"
+        key = (SC, FC, rank)
+        if key in self._step_cache:
+            obs.current().counter("compile.cache_hits")
+            return self._step_cache[key]
+        obs.current().counter("compile.cache_misses")
+        A, W, K, PW = self.A, self.W, self.K, self.PW
+        plan = self.plan
+        inv_fns = self.inv_fns
+        con_fns = self.constraint_fns
+        keys_of = self._keys_of
+        expand = self._expand_fn()
+        # stream candidates for stepwise refinement and/or the liveness
+        # behavior graph on the host (verdict parity with the interp)
+        need_edges = bool(self.refiners) or self.collect_edges
+        # FUSED + DONATED level step (ISSUE 6): the whole level —
+        # expansion, fingerprint/pack, dedup sort, CONSTRAINT and
+        # invariant evaluation — is ONE jitted dispatch, and the seen
+        # table (always) plus the frontier (unless the run streams
+        # edges, which reads the frontier after the step) are donated so
+        # XLA updates them in place instead of copying per level.
+        donate = (0, 2) if self.donate and not need_edges \
+            else ((0,) if self.donate else ())
+
+        @partial(jax.jit, donate_argnums=donate)
+        def step(seen_keys, seen_count, frontier_p, fcount):
+            frontier = plan.unpack_rows(frontier_p)
+            fvalid = jnp.arange(FC) < fcount
+            en, aok, ov, succ = expand(frontier)
+            valid = en & fvalid[None, :]
+            assert_bad = (~aok) & fvalid[None, :]
+            # ov carries the int overflow CODE (kernel2.OV_*): keep the
+            # max so the engine can tell demotion aborts from capacity
+            overflow = jnp.where(fvalid[None, :], ov, 0)
+            dead = fvalid & ~jnp.any(en, axis=0)
+            gen = jnp.sum(valid)
+
+            C = A * FC
+            cand_u = succ.reshape(C, W)
+            cvalid = valid.reshape(C)
+            prov = jnp.arange(C, dtype=jnp.int32)
+            cand_u = jnp.where(cvalid[:, None], cand_u, SENTINEL)
+            ckeys, cand, pack_ovf = keys_of(cand_u, cvalid)
+
+            if rank:
+                # O(new): sort only the C candidate keys, dedup against
+                # the sorted seen prefix with binary searches, scatter
+                # the new keys at their ranks.  nk_sidx is each new
+                # key's original candidate index in key-sorted order —
+                # exactly the full sort's new_cidx (stable ties keep
+                # first occurrence in both).  The caller pre-grows SC
+                # so seen_count + C <= SC: seen_count2 never overflows.
+                rm = _rank_merge(seen_keys, seen_count, ckeys, C, SC, K,
+                                 multikey=True)
+                new_count = rm["new_count"]
+                safe_cidx = jnp.clip(rm["nk_sidx"], 0, C - 1)
+                seen2 = rm["seen2"]
+                seen_count2 = rm["seen_count2"]
+            else:
+                # argsort on keys only, then gather payloads by
+                # permutation — a variadic sort carrying all W lanes
+                # compiles and runs far slower than sort(keys, index) +
+                # take
+                allk = jnp.concatenate([seen_keys, ckeys])   # [SC+C, K]
+                flag = jnp.concatenate([
+                    jnp.zeros(SC, jnp.int32), jnp.ones(C, jnp.int32)])
+                idx0 = jnp.arange(SC + C, dtype=jnp.int32)
+                ops = tuple(allk[:, i] for i in range(K)) + (flag, idx0)
+                sorted_ = lax.sort(ops, num_keys=K + 1, is_stable=True)
+                skeys = jnp.stack(sorted_[:K], axis=1)
+                sflag = sorted_[K]
+                perm = sorted_[K + 1]
+                # candidate payload indices: position in cand (<0: seen)
+                cidx = perm - SC  # >=0 only for candidate entries
+                rvalid = skeys[:, 0] == 0
+                neq_prev = jnp.concatenate([
+                    jnp.array([True]),
+                    jnp.any(skeys[1:] != skeys[:-1], axis=1)])
+                new = (sflag == 1) & rvalid & neq_prev
+                new_count = jnp.sum(new)
+
+                # compact new entries to the front (stable, keeps key
+                # order)
+                ops2 = ((1 - new.astype(jnp.int32)), cidx)
+                comp = lax.sort(ops2, num_keys=1, is_stable=True)
+                new_cidx = comp[1][:C]
+                safe_cidx = jnp.clip(new_cidx, 0, C - 1)
+
+                # merged seen keys, compacted and sorted
+                keep = ((sflag == 0) & rvalid) | new
+                ops3 = ((1 - keep.astype(jnp.int32)),) + \
+                    tuple(skeys[:, i] for i in range(K))
+                comp3 = lax.sort(ops3, num_keys=1, is_stable=True)
+                seen2 = jnp.stack(comp3[1:], axis=1)[:SC]
+                seen_count2 = jnp.sum(keep)
+
+            new_rows = jnp.take(cand, safe_cidx, axis=0)      # packed
+            new_rows_u = jnp.take(cand_u, safe_cidx, axis=0)  # lanes
+            new_prov = jnp.take(prov, safe_cidx)
+            nvalid = jnp.arange(C) < new_count
+            new_rows = jnp.where(nvalid[:, None], new_rows, SENTINEL)
+
+            # constraints FIRST: violating states are fingerprinted (they
+            # are in seen2 above) but discarded — never counted distinct,
+            # never invariant-checked, never explored. TLC semantics,
+            # pinned by the golden run (testout2:265, 195 distinct)
+            explore = nvalid
+            for nm, f in con_fns:
+                explore = explore & jax.vmap(f)(new_rows_u)
+            explore_count = jnp.sum(explore)
+            # the next frontier is ordered by PROVENANCE (frontier-slot
+            # major, action minor — the interpreter's discovery order),
+            # not by dedup-key order: key order depends on the packed
+            # encoding, so ordering by it would let the bit layout pick
+            # WHICH equally-short counterexample gets reported (packed
+            # and unpacked runs must produce identical traces)
+            fmaj = (new_prov % FC) * jnp.int32(max(A, 1)) + \
+                new_prov // FC
+            idx4 = jnp.arange(C, dtype=jnp.int32)
+            ops4 = ((1 - explore.astype(jnp.int32)), fmaj, idx4)
+            comp4 = lax.sort(ops4, num_keys=2, is_stable=True)
+            perm4 = comp4[2]
+            front_rows = jnp.take(new_rows, perm4, axis=0)
+            front_rows_u = jnp.take(new_rows_u, perm4, axis=0)
+            front_prov = jnp.take(new_prov, perm4)
+            frontvalid = jnp.arange(C) < explore_count
+
+            # invariants over the kept (explored) states only
+            inv_bad_any = jnp.asarray(False)
+            inv_bad_idx = jnp.asarray(0, jnp.int32)
+            inv_bad_which = jnp.asarray(-1, jnp.int32)
+            for wi, (nm, f) in enumerate(inv_fns):
+                ok = jax.vmap(f)(front_rows_u)
+                bad = frontvalid & ~ok
+                any_ = jnp.any(bad)
+                idx = jnp.argmax(bad)
+                first = jnp.logical_and(any_, ~inv_bad_any)
+                inv_bad_idx = jnp.where(first, idx, inv_bad_idx)
+                inv_bad_which = jnp.where(first, wi, inv_bad_which)
+                inv_bad_any = inv_bad_any | any_
+
+            # kernel overflow codes outrank the pack guard: OV_DEMOTED
+            # must reach the engine so the hybrid restart can fire
+            base_ov = jnp.max(overflow, initial=0)
+            ov_out = jnp.where(base_ov != 0, base_ov,
+                               jnp.where(pack_ovf, OV_PACK, 0))
+            out = dict(gen=gen, dead=dead, assert_bad=assert_bad,
+                       overflow=ov_out,
+                       seen=seen2, seen_count=seen_count2,
+                       front_rows=front_rows, front_prov=front_prov,
+                       front_count=explore_count,
+                       inv_bad_any=inv_bad_any, inv_bad_idx=inv_bad_idx,
+                       inv_bad_which=inv_bad_which)
+            if need_edges:
+                exp_all = cvalid
+                for nm, f in con_fns:
+                    exp_all = exp_all & jax.vmap(f)(cand_u)
+                out["cand"] = cand
+                out["cvalid"] = cvalid
+                out["explore_all"] = exp_all
+            return out
+
+        self._step_cache[key] = step
+        return step
+
+    def _get_hstep(self, FC: int) -> Callable:
+        """Expand-only step for host_seen mode: the seen-set lives in the
+        native C++ fingerprint store (native/fps_store.cc) — the spill
+        layer of SURVEY.md §7.5 — so the device does expansion, hashing,
+        and predicate checks while membership runs on the host."""
+        if FC in self._hstep_cache:
+            obs.current().counter("compile.cache_hits")
+            return self._hstep_cache[FC]
+        obs.current().counter("compile.cache_misses")
+        A, W, PW = self.A, self.W, self.PW
+        plan = self.plan
+        inv_fns = self.inv_fns
+        con_fns = self.constraint_fns
+        keys_of = self._keys_of
+
+        # SPLIT vs FUSED compilation (VERDICT r3 weak #3, retuned by
+        # ISSUE 6): one fused jit over all A kernels compiles
+        # superlinearly on XLA:CPU (MCVoting's 60 instances: >10 min
+        # fused vs ~2 min as 60 small programs + one tiny combine) — but
+        # always-split-on-CPU made every SMALL model pay A dispatches +
+        # a combine + deferred predicate dispatches per chunk, one of
+        # the constant factors behind the r04 kernel-slower-than-interp
+        # inversion.  The fused step (expansion + predicates + pack +
+        # fingerprint in ONE dispatch per chunk) is now the default
+        # whenever the instance count is modest; only many-instance
+        # models split on CPU (JAXMC_FUSED_MAX_INSTANCES, default 24).
+        fused_max = int(os.environ.get("JAXMC_FUSED_MAX_INSTANCES",
+                                       "24"))
+        split = jax.default_backend() == "cpu" and A > fused_max
+
+        if not split:
+            expand = self._expand_fn()
+
+            @jax.jit
+            def hstep(frontier_p, fcount):
+                frontier = plan.unpack_rows(frontier_p)
+                fvalid = jnp.arange(FC) < fcount
+                en, aok, ov, succ = expand(frontier)
+                valid = en & fvalid[None, :]
+                assert_bad = (~aok) & fvalid[None, :]
+                # int overflow CODE (kernel2.OV_*), max-reduced below
+                overflow = jnp.where(fvalid[None, :], ov, 0)
+                dead = fvalid & ~jnp.any(en, axis=0)
+                gen = jnp.sum(valid)
+                C = A * FC
+                cand_u = succ.reshape(C, W)
+                cvalid = valid.reshape(C)
+                cand_u = jnp.where(cvalid[:, None], cand_u, SENTINEL)
+                keys, cand, pack_ovf = keys_of(cand_u, cvalid)
+                inv_ok = jnp.ones(C, bool)
+                for nm, f in inv_fns:
+                    inv_ok = inv_ok & jax.vmap(f)(cand_u)
+                explore = jnp.ones(C, bool)
+                for nm, f in con_fns:
+                    explore = explore & jax.vmap(f)(cand_u)
+                base_ov = jnp.max(overflow, initial=0)
+                ov_out = jnp.where(base_ov != 0, base_ov,
+                                   jnp.where(pack_ovf, OV_PACK, 0))
+                return dict(cand=cand, cvalid=cvalid, keys=keys, gen=gen,
+                            dead=dead, assert_bad=assert_bad,
+                            overflow=ov_out,
+                            inv_ok=inv_ok, explore=explore)
+
+            hstep.is_async = True  # fused jit: dispatch is asynchronous
+            self._hstep_cache[FC] = hstep
+            return hstep
+
+        # ARM-GROUP fused jits (ISSUE 7 satellite, lifting the ROADMAP
+        # item-2 remainder): the old fallback compiled one jit PER
+        # ACTION (A dispatches + A host round-trips per chunk — pure
+        # overhead, the r04 inversion's constant factor writ large on
+        # many-instance models).  Instead, partition the compiled
+        # actions into groups of <= fused_max INSTANCES and fuse each
+        # group into ONE jit: XLA:CPU's superlinear fused-compile cost
+        # stays bounded by the group size while the dispatch count
+        # drops from A to ceil(A/fused_max).  Candidate order is
+        # preserved (groups are contiguous in self.compiled order and
+        # concatenate in order), so counts and traces stay identical
+        # to both the per-action and the fully-fused paths.
+        #
+        # Predicates are NOT evaluated per candidate here: the engine
+        # only consults inv_ok/explore on NEW rows (a handful per level)
+        # — MCVoting's quantifier-heavy Inv over every one of the
+        # A*CH = 123k padded candidates per chunk was the r3 sweep's
+        # >900 s timeout. The per-candidate explore mask is computed
+        # only when the edge stream needs it (refinement/liveness).
+        acts = self.compiled
+        need_edges = bool(self.refiners) or self.collect_edges
+
+        @jax.jit
+        def combine(cand_u, cvalid):
+            cand_u = jnp.where(cvalid[:, None], cand_u, SENTINEL)
+            keys, cand, pack_ovf = keys_of(cand_u, cvalid)
+            if not need_edges:
+                return cand, keys, pack_ovf, None
+            explore = jnp.ones(cand_u.shape[0], bool)
+            for nm, f in con_fns:
+                explore = explore & jax.vmap(f)(cand_u)
+            return cand, keys, pack_ovf, explore
+
+        unpack_j = jax.jit(plan.unpack_rows)
+
+        def hstep(frontier_p, fcount):
+            fvalid = np.arange(FC) < int(fcount)
+            if not acts:
+                # hybrid with every arm demoted: the device only hashes
+                z = np.zeros(0, bool)
+                out = dict(cand=jnp.zeros((0, PW), jnp.int32),
+                           cvalid=jnp.asarray(z),
+                           keys=jnp.zeros((0, self.K), jnp.int32),
+                           gen=0, dead=jnp.asarray(fvalid),
+                           assert_bad=jnp.zeros((0, FC), bool),
+                           overflow=0, deferred_preds=True)
+                if need_edges:
+                    out["explore"] = jnp.asarray(z)
+                return out
+            frontier = unpack_j(frontier_p)
+            ens, aoks, ovs, succs = [], [], [], []
+            for jf in self._hstep_groups(fused_max):
+                en, aok, ov, succ = jf(frontier)  # [a_g, FC(, W)]
+                ens.append(np.asarray(en))
+                aoks.append(np.asarray(aok))
+                ovs.append(np.asarray(ov))
+                succs.append(np.asarray(succ).reshape(-1, W))
+            en = np.concatenate(ens)          # [A, FC]
+            aok = np.concatenate(aoks)
+            ov = np.concatenate(ovs)
+            valid = en & fvalid[None, :]
+            assert_bad = (~aok) & fvalid[None, :]
+            overflow = int(np.where(fvalid[None, :], ov, 0).max(
+                initial=0))
+            dead = fvalid & ~en.any(axis=0)
+            gen = int(valid.sum())
+            cand_u = np.concatenate(succs).reshape(A * FC, W)
+            cvalid = valid.reshape(A * FC)
+            cand, keys, pack_ovf, explore = combine(
+                jnp.asarray(cand_u), jnp.asarray(cvalid))
+            if overflow == 0 and bool(pack_ovf):
+                overflow = OV_PACK
+            out = dict(cand=cand, cvalid=jnp.asarray(cvalid), keys=keys,
+                       gen=gen, dead=jnp.asarray(dead),
+                       assert_bad=jnp.asarray(assert_bad),
+                       overflow=overflow, deferred_preds=True)
+            if explore is not None:
+                out["explore"] = explore
+            return out
+
+        self._hstep_cache[FC] = hstep
+        return hstep
+
+    def _hstep_groups(self, fused_max: int) -> List[Callable]:
+        """The arm-group fused expansion jits for the many-instance
+        host_seen path: contiguous groups of compiled actions, each
+        holding at most `fused_max` kernel INSTANCES (a single action
+        whose slot fan-out alone exceeds the cap gets its own group —
+        the cap bounds the fused-compile blowup, and one slotted kernel
+        is a single program regardless of its slot count).  One jit per
+        group; instance order matches self.compiled flattening, so the
+        candidate stream is identical to the per-action and fully-fused
+        paths."""
+        cached = self._hstep_group_jits.get(fused_max)
+        if cached is not None:
+            obs.current().counter("compile.cache_hits")
+            return cached
+        obs.current().counter("compile.cache_misses")
+        groups: List[List[Any]] = []
+        cur: List[Any] = []
+        cur_w = 0
+        for ca in self.compiled:
+            w = max(1, ca.n_slots)
+            if cur and cur_w + w > fused_max:
+                groups.append(cur)
+                cur, cur_w = [], 0
+            cur.append(ca)
+            cur_w += w
+        if cur:
+            groups.append(cur)
+
+        def _mk(subset):
+            def gexpand(frontier):
+                ens, aoks, ovs, succs = [], [], [], []
+                for ca in subset:
+                    if ca.n_slots:
+                        slots = jnp.arange(ca.n_slots, dtype=jnp.int32)
+                        en, aok, ov, succ = jax.vmap(
+                            jax.vmap(ca.fn, in_axes=(0, None)),
+                            in_axes=(None, 0))(frontier, slots)
+                        for si in range(ca.n_slots):
+                            ens.append(en[si])
+                            aoks.append(aok[si])
+                            ovs.append(ov[si])
+                            succs.append(succ[si])
+                    else:
+                        en, aok, ov, succ = jax.vmap(ca.fn)(frontier)
+                        ens.append(en)
+                        aoks.append(aok)
+                        ovs.append(ov)
+                        succs.append(succ)
+                return (jnp.stack(ens), jnp.stack(aoks),
+                        jnp.stack(ovs), jnp.stack(succs))
+
+            return jax.jit(gexpand)
+
+        jits = [_mk(g) for g in groups]
+        obs.current().gauge("expand.fused_groups", len(jits))
+        self._hstep_group_jits[fused_max] = jits
+        return jits
+
+    def _check_new_rows(self, rows_np, skip_cons=False):
+        """Compiled invariant (+ constraint unless skip_cons — the edge
+        stream already computed per-candidate explore) checks over a
+        batch of NEW (packed) rows (split host_seen mode defers them
+        from the candidate stream). Pads to a power-of-two bucket (jit
+        per bucket, cached) by repeating the first row so the padding is
+        always a benign valid encoding."""
+        n = len(rows_np)
+        if n == 0:
+            return np.zeros(0, bool), np.zeros(0, bool)
+        cap = _pow2_at_least(n, lo=64)
+        ckey = (cap, skip_cons)
+        jf = self._newcheck_cache.get(ckey)
+        if jf is not None:
+            obs.current().counter("compile.cache_hits")
+        else:
+            obs.current().counter("compile.cache_misses")
+            inv_fns = self.inv_fns
+            con_fns = [] if skip_cons else self.constraint_fns
+            plan = self.plan
+
+            @jax.jit
+            def chk(rows_p):
+                rows = plan.unpack_rows(rows_p)
+                ok = jnp.ones(rows.shape[0], bool)
+                for nm, f in inv_fns:
+                    ok = ok & jax.vmap(f)(rows)
+                ex_ = jnp.ones(rows.shape[0], bool)
+                for nm, f in con_fns:
+                    ex_ = ex_ & jax.vmap(f)(rows)
+                return ok, ex_
+
+            self._newcheck_cache[ckey] = jf = chk
+        buf = np.repeat(rows_np[:1], cap, axis=0)
+        buf[:n] = rows_np
+        ok, ex_ = jf(jnp.asarray(buf))
+        return np.asarray(ok)[:n], np.asarray(ex_)[:n]
+
+    # ---- resident mode: the whole BFS inside one jitted while_loop ----
+    #
+    # Motivation (measured): the axon tunnel to the TPU has ~160ms
+    # round-trip latency and ~20MB/s effective host<->device bandwidth, so
+    # any per-chunk (or even per-level) host participation dominates wall
+    # time. Here the seen-set (fingerprint keys), the frontier, and the
+    # level loop itself are all device-resident inside lax.while_loop; the
+    # host sees one small summary vector per MAXLVL-level batch. Capacity
+    # overflows roll back to the last completed level (the carry keeps the
+    # pre-level state) and report a grow-and-redo status, so counts stay
+    # exact across regrowth.
+
+    def _get_resident_run(self, SC, FCap, AccCap, VC, CH):
+        # maxlvl (levels per dispatch) is a TRACED argument, not part of
+        # the compile key: the host adapts it to measured dispatch wall
+        # time (so --checkpoint/--progress-every fire at useful
+        # intervals, advisor r2) without recompiling
+        key = (SC, FCap, AccCap, VC, CH)
+        if key in self._res_cache:
+            obs.current().counter("compile.cache_hits")
+            return self._res_cache[key]
+        obs.current().counter("compile.cache_misses")
+        A, W, K, PW = self.A, self.W, self.K, self.PW
+        plan = self.plan
+        C = A * CH
+        inv_fns = self.inv_fns
+        con_fns = self.constraint_fns
+        keys_of = self._keys_of
+        expand = self._expand_fn()
+        check_deadlock = self.model.check_deadlock
+        assert FCap % CH == 0
+
+        def level(seen, seen_count, frontier, fcount):
+            # frontier is PACKED [FCap, PW]; each chunk unpacks to lanes
+            # right before expansion — the carry (and HBM residency) stay
+            # at the packed width
+            nchunks = (fcount + CH - 1) // CH
+
+            def chunk_body(carry):
+                (ci, acc_keys, acc_rows, acc_n, gen, stat,
+                 bad_row, ovcode) = carry
+                base = ci * CH
+                chunk_p = lax.dynamic_slice(frontier, (base, 0),
+                                            (CH, PW))
+                chunk = plan.unpack_rows(chunk_p)
+                fvalid = (jnp.arange(CH) + base) < fcount
+                en, aok, ov, succ = expand(chunk)
+                valid = en & fvalid[None, :]
+                gen = gen + jnp.sum(valid, dtype=jnp.int32)
+
+                # lane-capacity overflow inside an enabled action: abort.
+                # The max OV_* CODE rides along so the host can tell a
+                # compile-recovery demotion (OV_DEMOTED — raise no caps,
+                # run host_seen) from a real capacity overflow
+                ov_codes = jnp.where(fvalid[None, :], ov, 0)
+                ovf_lanes = jnp.any(ov_codes != 0)
+                ovcode = jnp.maximum(ovcode,
+                                     jnp.max(ov_codes).astype(jnp.int32))
+                # Assert(FALSE) inside an enabled action
+                abad = (~aok) & fvalid[None, :]
+                assert_any = jnp.any(abad)
+                a_f = jnp.argmax(abad.reshape(-1)) % CH
+                # deadlock: a frontier state with no enabled action at all
+                dead = fvalid & ~jnp.any(en, axis=0)
+                dead_any = check_deadlock & jnp.any(dead)
+                d_f = jnp.argmax(dead)
+
+                cand = succ.reshape(C, W)
+                cvalid = valid.reshape(C)
+                vcnt = jnp.sum(cvalid, dtype=jnp.int32)
+                # compact valid candidates to a VC-bounded block before
+                # hashing: ~95% of the dense (state x action) grid is
+                # disabled, so hashing only the survivors is the win
+                ops = ((1 - cvalid.astype(jnp.int32)),
+                       jnp.arange(C, dtype=jnp.int32))
+                comp = lax.sort(ops, num_keys=1, is_stable=True)
+                cidx = comp[1][:VC]
+                rows_cu = jnp.take(cand, jnp.clip(cidx, 0, C - 1),
+                                   axis=0)
+                vmask = jnp.arange(VC) < vcnt
+                rows_cu = jnp.where(vmask[:, None], rows_cu, SENTINEL)
+                keys_c, rows_c, pack_ovf = keys_of(rows_cu, vmask)
+                # pack-guard overflow aborts exactly like a lane
+                # overflow (OV_PACK: the host names JAXMC_PACK=0);
+                # kernel codes (esp. OV_DEMOTED) keep priority so the
+                # hybrid demote-restart advice survives
+                ovf_lanes = ovf_lanes | pack_ovf
+                ovcode = jnp.where(
+                    ovcode == 0,
+                    jnp.where(pack_ovf, OV_PACK, 0).astype(jnp.int32),
+                    ovcode)
+
+                # append the block at acc_n (clamped; overflow redoes the
+                # level so clobbered rows never count)
+                off = jnp.clip(acc_n, 0, AccCap - VC)
+                acc_keys = lax.dynamic_update_slice(acc_keys, keys_c,
+                                                    (off, 0))
+                acc_rows = lax.dynamic_update_slice(acc_rows, rows_c,
+                                                    (off, 0))
+                acc_n = acc_n + vcnt
+
+                stat = jnp.where(
+                    stat != ST_CONTINUE, stat,
+                    jnp.where(
+                        ovf_lanes, ST_OVF_LANES,
+                        jnp.where(
+                            vcnt > VC, ST_OVF_VC,
+                            jnp.where(acc_n + VC > AccCap, ST_OVF_ACC,
+                                      ST_CONTINUE))))
+                # stat is still CONTINUE iff no earlier chunk reported
+                # anything, so this is the first detection
+                first_bad = (stat == ST_CONTINUE) & \
+                    (assert_any | dead_any)
+                bad_f = jnp.where(assert_any, a_f, d_f)
+                brow = lax.dynamic_slice(frontier,
+                                         (base + bad_f.astype(jnp.int32), 0),
+                                         (1, PW))[0]
+                bad_row = jnp.where(first_bad, brow, bad_row)
+                stat = jnp.where(
+                    (stat == ST_CONTINUE) & assert_any, ST_ASSERT,
+                    jnp.where((stat == ST_CONTINUE) & dead_any,
+                              ST_DEADLOCK, stat))
+                return (ci + 1, acc_keys, acc_rows, acc_n, gen, stat,
+                        bad_row, ovcode)
+
+            def chunk_cond(carry):
+                # stop at the FIRST non-continue status: carrying on after
+                # an assert/deadlock would skip the accumulator-overflow
+                # checks (they only arm while stat == CONTINUE) and let
+                # clamped writes clobber earlier candidate blocks
+                ci, _, _, _, _, stat, _, _ = carry
+                return (ci < nchunks) & (stat == ST_CONTINUE)
+
+            acc_keys0 = jnp.full((AccCap, K), SENTINEL, jnp.int32)
+            acc_rows0 = jnp.full((AccCap, PW), SENTINEL, jnp.int32)
+            bad_row0 = jnp.full((PW,), SENTINEL, jnp.int32)
+            (_, acc_keys, acc_rows, acc_n, gen, stat, bad_row,
+             ovcode) = \
+                lax.while_loop(chunk_cond, chunk_body,
+                               (jnp.int32(0), acc_keys0, acc_rows0,
+                                jnp.int32(0), jnp.int32(0),
+                                jnp.int32(ST_CONTINUE), bad_row0,
+                                jnp.int32(0)))
+
+            # conservative seen-capacity check BEFORE the merge: every
+            # accumulated candidate could be new
+            stat = jnp.where((stat == ST_CONTINUE) &
+                             (seen_count + acc_n > SC), ST_OVF_SEEN, stat)
+
+            # ---- merge-dedup the level's candidates against seen ----
+            # The shared O(new) rank-merge core (_rank_merge, also the
+            # mesh engine's merge strategy): the candidate block is
+            # sorted by chained STABLE single-key passes and the
+            # seen-set is never re-sorted — new keys merge by rank (two
+            # vectorized binary searches + scatters), so the sort work
+            # is O(new), not O(seen), per level.
+            rm = _rank_merge(seen, seen_count, acc_keys, AccCap, SC, K)
+            new_count = rm["new_count"]
+            nvalid = jnp.arange(AccCap) < new_count
+            new_rows = jnp.take(acc_rows,
+                                jnp.clip(rm["nk_sidx"], 0, AccCap - 1),
+                                axis=0)
+            new_rows = jnp.where(nvalid[:, None], new_rows, SENTINEL)
+            seen2 = rm["seen2"]
+            seen_count2 = rm["seen_count2"]
+
+            # constraints: violating states stay fingerprinted in seen2
+            # but are discarded (not distinct / checked / explored).
+            # new_rows are PACKED; the predicate kernels read lanes
+            new_rows_u = plan.unpack_rows(new_rows) \
+                if (con_fns or inv_fns) else new_rows
+            explore = nvalid
+            for nm, f in con_fns:
+                explore = explore & jax.vmap(f)(new_rows_u)
+            explore_count = jnp.sum(explore, dtype=jnp.int32)
+            stat = jnp.where((stat == ST_CONTINUE) &
+                             (explore_count > FCap), ST_OVF_FRONT, stat)
+
+            idx4 = jnp.arange(AccCap, dtype=jnp.int32)
+            ops4 = ((1 - explore.astype(jnp.int32)), idx4)
+            comp4 = lax.sort(ops4, num_keys=1, is_stable=True)
+            fidx = comp4[1][:FCap]
+            front_rows = jnp.take(new_rows,
+                                  jnp.clip(fidx, 0, AccCap - 1), axis=0)
+            frontvalid = jnp.arange(FCap) < explore_count
+            front_rows = jnp.where(frontvalid[:, None], front_rows,
+                                   SENTINEL)
+
+            inv_bad_any = jnp.asarray(False)
+            inv_bad_idx = jnp.asarray(0, jnp.int32)
+            inv_bad_which = jnp.asarray(-1, jnp.int32)
+            front_rows_u = plan.unpack_rows(front_rows) if inv_fns \
+                else front_rows
+            for wi, (nm, f) in enumerate(inv_fns):
+                ok = jax.vmap(f)(front_rows_u)
+                bad = frontvalid & ~ok
+                any_ = jnp.any(bad)
+                idx = jnp.argmax(bad).astype(jnp.int32)
+                first = jnp.logical_and(any_, ~inv_bad_any)
+                inv_bad_idx = jnp.where(first, idx, inv_bad_idx)
+                inv_bad_which = jnp.where(first, wi, inv_bad_which)
+                inv_bad_any = inv_bad_any | any_
+            inv_row = lax.dynamic_slice(front_rows, (inv_bad_idx, 0),
+                                        (1, PW))[0]
+            bad_row = jnp.where(inv_bad_any & (stat == ST_CONTINUE),
+                                inv_row, bad_row)
+            stat = jnp.where((stat == ST_CONTINUE) & inv_bad_any,
+                             ST_INV, stat)
+
+            return (seen2, seen_count2, front_rows, explore_count, gen,
+                    explore_count, stat, inv_bad_which, bad_row, ovcode)
+
+        def run(seen, seen_count, frontier, fcount, distinct,
+                gen_lo, gen_hi, depth, max_states, maxlvl):
+            def cond(carry):
+                (_, _, _, _, _, _, _, _, lvls, stat, _, _, _) = carry
+                return (stat == ST_CONTINUE) & (lvls < maxlvl)
+
+            def body(carry):
+                (seen, seen_count, frontier, fcount, distinct,
+                 gen_lo, gen_hi, depth, lvls, stat, which, brow,
+                 ovcode) = carry
+                (seen2, seen_count2, front2, fcount2, gen_l, kept,
+                 lstat, lwhich, lbrow, lovcode) = level(seen, seen_count,
+                                                        frontier, fcount)
+                ovf = (lstat == ST_OVF_SEEN) | (lstat == ST_OVF_FRONT) | \
+                    (lstat == ST_OVF_ACC) | (lstat == ST_OVF_VC) | \
+                    (lstat == ST_OVF_LANES)
+                # overflow rolls the whole level back (growable caps are
+                # redone after growth; lane overflow aborts with the
+                # last completed level's exact counts)
+                seen2 = jnp.where(ovf, seen, seen2)
+                seen_count2 = jnp.where(ovf, seen_count, seen_count2)
+                front2 = jnp.where(ovf, frontier, front2)
+                fcount2 = jnp.where(ovf, fcount, fcount2)
+                distinct2 = jnp.where(ovf, distinct, distinct + kept)
+                lo = (gen_lo.astype(jnp.uint32) +
+                      gen_l.astype(jnp.uint32))
+                wrapped = lo < gen_lo.astype(jnp.uint32)
+                gen_lo2 = jnp.where(ovf, gen_lo, lo.astype(jnp.int32))
+                gen_hi2 = jnp.where(ovf, gen_hi,
+                                    gen_hi + wrapped.astype(jnp.int32))
+                # deadlock/assert states belong to the CURRENT frontier
+                # (depth d), unlike invariant violations which live in
+                # the newly found level (d+1) — don't advance depth for
+                # them, matching the interp/level/host_seen backends
+                keep_depth = ovf | (lstat == ST_DEADLOCK) | \
+                    (lstat == ST_ASSERT)
+                depth2 = jnp.where(keep_depth, depth, depth + 1)
+                stat2 = jnp.where(
+                    lstat != ST_CONTINUE, lstat,
+                    jnp.where(fcount2 == 0, ST_DONE,
+                              jnp.where((max_states > 0) &
+                                        (distinct2 >= max_states),
+                                        ST_TRUNC, ST_CONTINUE)))
+                return (seen2, seen_count2, front2, fcount2, distinct2,
+                        gen_lo2, gen_hi2, depth2, lvls + 1, stat2,
+                        jnp.where(lstat == ST_INV, lwhich, which), lbrow,
+                        jnp.where(lstat == ST_OVF_LANES, lovcode,
+                                  ovcode))
+
+            carry0 = (seen, seen_count, frontier, fcount, distinct,
+                      gen_lo, gen_hi, depth, jnp.int32(0),
+                      jnp.int32(ST_CONTINUE), jnp.int32(-1),
+                      jnp.full((PW,), SENTINEL, jnp.int32),
+                      jnp.int32(0))
+            (seen, seen_count, frontier, fcount, distinct, gen_lo,
+             gen_hi, depth, _, stat, which, brow, ovcode) = \
+                lax.while_loop(cond, body, carry0)
+            summary = jnp.stack([stat, seen_count, fcount, distinct,
+                                 gen_lo, gen_hi, depth, which, ovcode])
+            return seen, frontier, summary, brow
+
+        # DONATED dispatch (ISSUE 6): the seen table (arg 0) and the
+        # packed frontier (arg 2) — the two big device buffers — update
+        # in place across dispatches instead of copying per batch
+        donate = (0, 2) if self.donate else ()
+        jitted = jax.jit(run, static_argnames=(), donate_argnums=donate)
+        self._res_cache[key] = jitted
+        return jitted
+
+
+    def _save_caps_profile(self, caps: Dict[str, int],
+                           variant: str = "",
+                           keys: Optional[Tuple[str, ...]] = None,
+                           optional: Tuple[str, ...] = ()
+                           ) -> None:
+        """Persist the capacity profile a finished resident search ended
+        with (ISSUE 6): the next resident run on this (module, layout)
+        starts at these caps, so its warm-up compile covers the whole
+        run and `window_recompiles` reads 0.  Best-effort: a profile is
+        a hint, never allowed to fail a successful run.  `variant`/
+        `keys` let engine families persist their own cap shapes (the
+        mesh engine stores one profile per device count + exchange
+        strategy, ISSUE 8)."""
+        if not self.cap_profile:
+            return
+        try:
+            from ..compile.cache import save_capacity_profile
+            # profiles are NAMESPACED by backend platform (ISSUE 11):
+            # the default (resident single-chip) variant is the
+            # descriptor's namespace; engine families (mesh) pass their
+            # own pre-namespaced variant + key shape
+            kw = dict(chunk=int(self.chunk),
+                      variant=self.backend_desc.profile_variant())
+            if keys is not None:
+                kw = dict(variant=variant, keys=keys, optional=optional)
+            path = save_capacity_profile(
+                self.model.module.name, self._layout_sig(), dict(caps),
+                **kw)
+            if path:
+                self.log(f"-- capacity profile saved to {path}")
+        except Exception:  # noqa: BLE001 — hints never break runs
+            pass
+
+    def _pack_ovf_msg(self) -> str:
+        return ("a value escaped its bit-packed lane's profiled range "
+                "(compile/pack.py profiles raw-int lanes from sampled "
+                "states with a 3x margin): deepen --sample or rerun "
+                "with JAXMC_PACK=0 (unpacked lanes) — counts stay exact "
+                "either way")
+
+    def _caps_note(self) -> str:
+        """Which variable uses which bounded lane capacity — shown in
+        capacity-overflow violations so the user knows WHAT to raise
+        (the r3 MCraft_3s debugging pain: 'a container overflowed' with
+        no name). Renders inside error paths — never allowed to raise."""
+        try:
+            return self._caps_note_inner()
+        except Exception:  # noqa: BLE001 — diagnostics must not mask
+            return "raise --seq-cap/--grow-cap/--kv-cap"
+
+    def _caps_note_inner(self) -> str:
+        parts: Dict[str, None] = {}  # ordered dedupe (fcn repeats keys)
+
+        def walk(spec, path):
+            k = spec.kind
+            if k in ("seq", "growset", "kvtable"):
+                flag = {"seq": "--seq-cap", "growset": "--grow-cap",
+                        "kvtable": "--kv-cap"}[k]
+                parts.setdefault(f"{path}:{k}[cap {spec.cap}, {flag}]")
+            for sub in (spec.elems or ()):
+                walk(sub, path)
+            for sub in (spec.elem, spec.val):
+                if sub is not None:
+                    walk(sub, path)
+            for _fields, fspecs in (spec.variants or ()):
+                for sub in fspecs:
+                    walk(sub, path)
+
+        for v in self.layout.vars:
+            walk(self.layout.specs[v], v)
+        return "; ".join(parts) if parts else "no bounded containers"
+
+    def _prepare_init(self, t0, warnings):
+        """Shared init-state preparation for every device search mode:
+        encode + dedup the enumerated init states, run the init-state
+        invariant/refinement checks, log the TLC-format init line.
+
+        Returns (init_rows, explored_init, n_init, err): err is a
+        ready-to-return CheckResult when an initial state violates an
+        invariant or a refinement's initial predicate, else None.
+
+        The clean-path result is deterministic per engine, so it is
+        memoized: repeated run() calls (bench warm-up + timed re-runs)
+        skip the re-encode/canon/view work."""
+        cached = getattr(self, "_init_prep", None)
+        if cached is not None:
+            return cached + (None,)
+        layout = self.layout
+        raw = [layout.encode(st) for st in self.init_states]
+        if raw and self.canon_fn is not None:
+            # cfg SYMMETRY: dedup/count init states by their orbit's
+            # canonical representative, matching the interp's add_state
+            # (which canonicalizes BEFORE the seen probe). Without this,
+            # distinct init states sharing an orbit would inflate the
+            # device counts and seed `seen` with duplicate canonical
+            # fingerprints, breaking the sorted-unique invariant the
+            # resident rank-merge relies on.
+            raw = list(np.asarray(self.canon_fn(np.stack(raw))))
+        if raw and self.view_fn is not None:
+            # cfg VIEW: init states sharing a view value count ONCE
+            # (TLC fingerprints the view) — keep the first state per key
+            kb = np.asarray(jax.vmap(self.view_fn)(
+                jnp.asarray(np.stack(raw))))
+            if kb.ndim == 1:
+                kb = kb[:, None]
+            rows: Dict[bytes, np.ndarray] = {}
+            for i, rr in enumerate(raw):
+                rows.setdefault(np.ascontiguousarray(kb[i]).tobytes(),
+                                np.asarray(rr, np.int32))
+            init_rows = np.stack(list(rows.values()))
+        else:
+            rows = {}
+            for rr in raw:
+                rows[np.asarray(rr, np.int32).tobytes()] = True
+            init_rows = np.stack([np.frombuffer(kk, dtype=np.int32)
+                                  for kk in rows.keys()]) \
+                if rows else np.zeros((0, self.W), np.int32)
+        n_init = len(init_rows)
+        explored_init, init_viol = filter_init_states(self.model, layout,
+                                                      init_rows)
+        if init_viol is not None:
+            nm, st = init_viol
+            return init_rows, explored_init, n_init, self._mk_result(
+                False, len(explored_init) + 1, n_init, 0, t0, warnings,
+                Violation("invariant", nm, [(st, "Initial predicate")]))
+        rv = self._refine_init(init_rows, explored_init)
+        if rv is not None:
+            nm, st = rv
+            return init_rows, explored_init, n_init, self._mk_result(
+                False, len(explored_init), n_init, 0, t0, warnings,
+                Violation("property", nm, [(st, "Initial predicate")],
+                          f"initial state violates {nm}'s initial "
+                          f"predicate"))
+        distinct = len(explored_init)
+        self.log(f"Finished computing initial states: {distinct} distinct "
+                 f"state{'s' if distinct != 1 else ''} generated.")
+        self._init_prep = (init_rows, explored_init, n_init)
+        return init_rows, explored_init, n_init, None
+
+    # ---- checkpoint/resume (device backends) ----
+    #
+    # TLC checkpoints long runs to states/ (SURVEY.md §5, testout1:10);
+    # the interp engine mirrors that with --checkpoint/--resume. The
+    # device modes checkpoint BETWEEN levels (level and host_seen modes)
+    # or between dispatches (resident mode), so a checkpoint is always a
+    # consistent level boundary and resumed full-run counts stay exact.
+
+    def _layout_sig(self) -> str:
+        """Fingerprint of the lane encoding: a resume is only sound when
+        the resuming process rebuilds the IDENTICAL layout (layout
+        construction is deterministic for a given model + Bounds — BFS
+        prefix sampling, no RNG)."""
+        import hashlib
+        lay = self.layout
+        # the lane PLAN rides in the signature: checkpointed rows are
+        # stored packed, so a resume must rebuild the identical packing
+        # (it does: the plan derives deterministically from the same
+        # sampling; JAXMC_PACK toggles change the signature on purpose)
+        desc = repr((lay.vars, [lay.specs[v] for v in lay.vars],
+                     [str(v) for v in lay.uni.values],
+                     lay.plan.signature()))
+        return hashlib.sha256(desc.encode()).hexdigest()
+
+    def _write_ck(self, mode: str, **state) -> None:
+        # checksummed + schema-versioned container (engine/ckpt.py):
+        # resume refuses truncated/corrupt/mismatched files with a
+        # one-line CkptError instead of unpickling garbage
+        from ..engine import ckpt as _ckpt
+        payload = dict(mode=mode, module=self.model.module.name,
+                       vars=list(self.model.vars),
+                       layout_sig=self._layout_sig(), **state)
+        try:
+            with obs.current().span("checkpoint.write", mode=mode):
+                _ckpt.write_checkpoint(
+                    self.checkpoint_path, "device",
+                    {"module": self.model.module.name, "mode": mode},
+                    payload)
+        except _ckpt.CkptError as ex:
+            # a failed periodic write must not kill the search: keep
+            # running on the previous checkpoint
+            obs.current().counter("checkpoint.write_failures")
+            self.log(f"WARNING: checkpoint write failed ({ex}); the run "
+                     f"continues on the previous checkpoint")
+            return
+        self.log(f"Checkpointing run to {self.checkpoint_path}")
+
+    def _load_ck(self, mode: str) -> dict:
+        from ..engine.ckpt import CkptError, load_checkpoint
+        _, ck = load_checkpoint(self.resume_from, kind="device")
+        if ck.get("module") != self.model.module.name or \
+                ck.get("vars") != list(self.model.vars):
+            raise CkptError(
+                f"cannot resume: checkpoint is for module "
+                f"{ck.get('module')!r} with variables {ck.get('vars')}, "
+                f"not {self.model.module.name!r}")
+        if ck.get("mode") != mode:
+            raise CkptError(
+                f"cannot resume: checkpoint was written by the "
+                f"{ck.get('mode')!r} device mode, this run uses {mode!r} "
+                f"(re-run with the matching flags)")
+        if ck.get("layout_sig") != self._layout_sig():
+            raise CkptError(
+                "cannot resume: the lane layout differs from the "
+                "checkpoint's (different --seq-cap/--grow-cap/--kv-cap "
+                "or a changed model?)")
+        return ck
+
+    def _restore_ck_state(self, ck, graph):
+        """Shared level/host_seen resume restore: validates trace and
+        behavior-graph compatibility with THIS run's needs, then returns
+        (distinct, generated, depth, trace_levels, frontier_maps, graph,
+        frontier_sids) — the trace pair is None when store_trace is
+        off."""
+        if self.store_trace and ck.get("trace_levels") is None:
+            raise ValueError(
+                "cannot resume with traces: the checkpoint was written "
+                "with --no-trace")
+        frontier_sids = None
+        if graph is not None:
+            ckg = ck.get("graph")
+            if ckg is None:
+                raise ValueError(
+                    "cannot resume with temporal properties: the "
+                    "checkpoint has no behavior graph")
+            if graph.collect_edges and not ckg.collect_edges:
+                # mirror engine/explore.py's interp-resume guard: an
+                # edge log cannot be reconstructed after the fact
+                raise ValueError(
+                    "cannot resume with this PROPERTY set: the "
+                    "checkpoint's behavior graph has no edge log (it "
+                    "was written for 'always'-form obligations only)")
+            graph = ckg
+            frontier_sids = ck["frontier_sids"]
+        trace_levels = ck["trace_levels"] if self.store_trace else None
+        frontier_maps = ck["frontier_maps"] if self.store_trace else None
+        self.log(f"Resumed from {self.resume_from}: {ck['distinct']} "
+                 f"distinct states, {len(ck['frontier'])} on queue.")
+        return (ck["distinct"], ck["generated"], ck["depth"],
+                trace_levels, frontier_maps, graph, frontier_sids)
+
+    def _ck_state_kwargs(self, distinct, generated, depth, trace_levels,
+                         frontier_maps, graph, frontier_sids):
+        """Shared level/host_seen checkpoint payload fields."""
+        return dict(
+            distinct=distinct, generated=generated, depth=depth,
+            trace_levels=trace_levels if self.store_trace else None,
+            frontier_maps=frontier_maps if self.store_trace else None,
+            graph=graph, frontier_sids=frontier_sids)
+
+    def _write_host_snapshot(self, trace_levels, frontier_maps, graph,
+                             depth, generated) -> None:
+        """Demotion snapshot: an INTERP-format checkpoint (engine/ckpt.py
+        payload, `<checkpoint>.host`) rebuilt from the host-side trace
+        levels, so when the device path dies terminally the parallel CPU
+        engine resumes from the last level barrier instead of restarting
+        from scratch (cli.py owns the fallback).
+
+        Exactness: every kept state of every level is decoded and
+        re-fingerprinted with the interp's own state_fingerprint, so the
+        resumed dedup set is exact.  Constraint-DISCARDED fingerprints
+        are not reconstructible from rows the device never kept — their
+        absence is count-equivalent: the resumed engine re-generates and
+        re-discards such a state on first contact, exactly what the
+        serial engine counts.  Skipped (with one log line) when traces
+        are off (--no-trace), in resident mode (no host rows), or when
+        cfg SYMMETRY ran UNREDUCED on the device (the interp would
+        reduce, so the carried counts would not be comparable)."""
+        if not self.store_trace or not self.checkpoint_path:
+            return
+        if self.model.symmetry is not None and self.canon_fn is None \
+                and not self.sym_identity:
+            # identity groups excepted: the interp reduces them to the
+            # same (unreduced) partition, so the snapshot stays exact
+            if not getattr(self, "_host_snap_skip_logged", False):
+                self._host_snap_skip_logged = True
+                self.log("-- no host snapshot: SYMMETRY ran unreduced on "
+                         "the device (interp counts would differ)")
+            return
+        from ..engine import ckpt as _ckpt
+        from ..engine.explore import make_canonicalizer, state_fingerprint
+        model = self.model
+        vars = model.vars
+        canon = make_canonicalizer(model)
+        view_expr = getattr(model, "view", None)  # None on device paths
+        states: List[Dict[str, Any]] = []
+        parents: List[Optional[int]] = []
+        labels: List[str] = []
+        depth_of: List[int] = []
+        seen: Dict[Any, int] = {}
+        level_sids: List[List[int]] = []
+        for lvl, (rows, prov, par_div) in enumerate(trace_levels):
+            sids: List[int] = []
+            for ridx in frontier_maps[lvl]:
+                ridx = int(ridx)
+                st = self.layout.decode_packed(np.asarray(rows[ridx]))
+                sid = len(states)
+                if prov is None:
+                    parents.append(None)
+                    labels.append("Initial predicate")
+                else:
+                    p = int(prov[ridx])
+                    a, pf = p // par_div, p % par_div
+                    parents.append(level_sids[lvl - 1][pf])
+                    labels.append(self.labels_flat[a])
+                states.append(st)
+                depth_of.append(lvl)
+                key = state_fingerprint(model, canon, view_expr, vars, st)
+                # an fp128 collision may have collapsed two interp-
+                # distinct states device-side; keep the first sid — the
+                # resumed run stays exact going forward
+                seen.setdefault(key, sid)
+                sids.append(sid)
+            level_sids.append(sids)
+        collect_edges = graph is not None and graph.collect_edges
+        payload = _ckpt.interp_payload(
+            model, vars, states, parents, labels, depth_of,
+            level_sids[-1] if level_sids else [], generated,
+            max(depth - 1, 0), seen,
+            graph.edges if collect_edges else None, collect_edges, [])
+        snap = self.checkpoint_path + ".host"
+        try:
+            with obs.current().span("checkpoint.host_snapshot",
+                                    states=len(states)):
+                _ckpt.write_checkpoint(
+                    snap, "interp",
+                    {"module": model.module.name,
+                     "engine": "device-snapshot"},
+                    payload)
+        except _ckpt.CkptError as ex:
+            obs.current().counter("checkpoint.write_failures")
+            self.log(f"WARNING: host snapshot write failed ({ex}); the "
+                     f"run continues on the previous snapshot")
+            return
+        obs.current().counter("checkpoint.host_snapshots")
+        self.log(f"Host snapshot (CPU-resumable) written to {snap}")
+
+    def _run_resident(self) -> CheckResult:
+        t0 = time.time()
+        tel = obs.current()
+        layout = self.layout
+        W, K = self.W, self.K
+        warnings = ["resident mode: search runs device-side end to end; "
+                    "no counterexample traces (rerun with the level/"
+                    "host_seen device modes or the interp for a trace)",
+                    "resident mode (W={}): dedup on 128-bit fingerprints; "
+                    "collision probability < n^2 * 2^-129".format(W)]
+        warnings.extend(self._temporal_warnings())
+        warnings.extend(self._symmetry_warnings())
+
+        init_rows, explored_init, n_init, err = \
+            self._prepare_init(t0, warnings)
+        if err is not None:
+            return err
+        generated = n_init
+        distinct = len(explored_init)
+
+        CH = _pow2_at_least(self.chunk, lo=64)
+        # every overflow-growth costs a full XLA recompile (minutes on
+        # the big while_loop program), while capacity is cheap device
+        # memory (seen keys at SC=1<<20 are 20MB) - so on an accelerator
+        # start generous; on CPU (tests) stay small to keep compiles fast
+        on_accel = jax.devices()[0].platform != "cpu"
+        if self._res_caps is not None:
+            caps = self._res_caps
+        elif self._res_caps_hint:
+            # caller-supplied steady-state caps (the corpus manifest's
+            # res_caps record, bench.py's bench-model sizes, or a
+            # persisted capacity profile) are the BASE, not a floor
+            # merged into the platform defaults: a small model's hint
+            # must be allowed to SHRINK the buckets (the capacity-sized
+            # sorts/gathers inside the level step are exactly what made
+            # the r04 kernel lose to the interpreter on small models).
+            # A wrong hint only costs an overflow-growth recompile.
+            h = self._res_caps_hint
+            caps = {
+                "SC": _pow2_at_least(int(h.get("SC", 1)), lo=256),
+                "FCap": _pow2_at_least(int(h.get("FCap", 1)), lo=64),
+                "AccCap": _pow2_at_least(int(h.get("AccCap", 1)),
+                                         lo=128),
+                "VC": _pow2_at_least(int(h.get("VC", 1)), lo=64)}
+        else:
+            caps = ({"SC": 1 << 20, "FCap": max(1 << 16, CH),
+                     "AccCap": 1 << 17, "VC": 1 << 14} if on_accel else {
+                "SC": _pow2_at_least(max(4 * n_init, 1), lo=1 << 15),
+                "FCap": CH, "AccCap": 1 << 15, "VC": 1 << 13})
+        # floors no hint may undercut: the seen table must seat every
+        # init key and the frontier every init row (a 256-cap hint on a
+        # 1600-init model would otherwise crash the seeding, not grow)
+        caps["SC"] = max(caps["SC"],
+                         _pow2_at_least(max(4 * n_init, 1), lo=256))
+        caps["FCap"] = max(caps["FCap"], _pow2_at_least(max(n_init, 1),
+                                                        lo=CH))
+        # VC can never usefully exceed the dense candidate-grid size
+        # A*CH (and must not: [:VC] slices of C-row arrays assume VC<=C);
+        # AccCap must cover both one VC block past acc_n and the [:FCap]
+        # slice of the accumulator taken for the next frontier
+        caps["VC"] = min(caps["VC"], self.A * CH)
+        caps["AccCap"] = max(caps["AccCap"], 2 * caps["VC"], caps["FCap"])
+        # levels per dispatch: the host only sees status (and can only
+        # checkpoint / log progress) between dispatches, so maxlvl adapts
+        # to measured dispatch wall time — targeting the tighter of
+        # progress_every/checkpoint_every — instead of a fixed 64 that
+        # could run for hours on a large model (advisor r2)
+        # start SMALL and double up: the first dispatches are the ones
+        # with no timing evidence, and a 64-level opener on a big model
+        # could run for hours before the host could checkpoint or log
+        # progress (review r3) — a few extra cheap dispatches at the
+        # start cost almost nothing
+        # ...unless a PREVIOUS run on this engine already learned the
+        # model's depth/dispatch timing: warm re-runs (bench timed
+        # windows) then cover the whole search in as few dispatches as
+        # the adaptive controller settled on, instead of re-ramping
+        # 4 -> 8 -> 16 every run
+        maxlvl = min(getattr(self, "_res_maxlvl_warm", 4),
+                     self._res_maxlvl)
+        target_s = max(1.0, min(
+            self.progress_every or 30.0,
+            (self.checkpoint_every or 1e9) if self.checkpoint_path
+            else 1e9))
+
+        # packed init boundary: keys + packed rows in one pass; a pack
+        # overflow at init is an observation gap (abort exactly)
+        init_keys, init_packed, init_povf = self._host_keys(init_rows)
+        if init_povf:
+            return self._mk_result(
+                False, distinct, generated, 0, t0, warnings,
+                Violation("error", "capacity overflow", [],
+                          self._pack_ovf_msg()))
+        frontier = np.full((caps["FCap"], self.PW), SENTINEL, np.int32)
+        frontier[:distinct] = init_packed[explored_init]
+        frontier = jnp.asarray(frontier)
+        fcount = distinct
+
+        seen = np.full((caps["SC"], K), SENTINEL, np.int32)
+        if n_init:
+            order = np.lexsort(tuple(init_keys[:, i]
+                                     for i in reversed(range(K))))
+            seen[:n_init] = init_keys[order]
+        seen = jnp.asarray(seen)
+        seen_count = n_init
+
+        depth = 0
+        if self.resume_from:
+            ck = self._load_ck("resident")
+            for kk in caps:
+                caps[kk] = max(caps[kk], ck.get("caps", {}).get(kk, 0))
+            # re-apply the cap invariants: the checkpointing run may have
+            # used a different --chunk, and VC must never exceed A*CH
+            caps["VC"] = min(caps["VC"], self.A * CH)
+            caps["AccCap"] = max(caps["AccCap"], 2 * caps["VC"],
+                                 caps["FCap"])
+            cs, fr = ck["seen"], ck["frontier"]
+            seen_np = np.full((caps["SC"], K), SENTINEL, np.int32)
+            seen_np[:len(cs)] = cs
+            seen = jnp.asarray(seen_np)
+            seen_count = len(cs)
+            fr_np = np.full((caps["FCap"], self.PW), SENTINEL, np.int32)
+            fr_np[:len(fr)] = fr
+            frontier = jnp.asarray(fr_np)
+            fcount = len(fr)
+            distinct = ck["distinct"]
+            generated = ck["generated"]
+            depth = ck["depth"]
+            self.log(f"Resumed from {self.resume_from}: {distinct} "
+                     f"distinct states, {fcount} on queue.")
+            if fcount == 0:
+                # a COMPLETED-run checkpoint (final_checkpoint, the
+                # serve daemon's warm-resume source): nothing left to
+                # explore — replay the stored verdict with ZERO kernel
+                # dispatches (and therefore zero window recompiles)
+                self.log("Model checking completed. No error has been "
+                         "found.")
+                self.log(f"{generated} states generated, {distinct} "
+                         f"distinct states found, 0 states left on "
+                         f"queue.")
+                self.log(f"The depth of the complete state graph search "
+                         f"is {depth}.")
+                if self.checkpoint_path and self.final_checkpoint and \
+                        self.checkpoint_path != self.resume_from:
+                    self._write_ck(
+                        "resident", caps=dict(caps),
+                        seen=np.asarray(seen[:seen_count]),
+                        frontier=np.zeros((0, self.PW), np.int32),
+                        distinct=distinct, generated=generated,
+                        depth=depth)
+                return self._mk_result(True, distinct, generated,
+                                       depth - 1, t0, warnings)
+
+        max_states = jnp.int32(self.max_states or 0)
+        gen_lo = int(np.int32(np.uint32(generated & 0xFFFFFFFF)))
+        gen_hi = generated >> 32
+        state = (seen, jnp.int32(seen_count), frontier, jnp.int32(fcount),
+                 jnp.int32(distinct), jnp.int32(gen_lo), jnp.int32(gen_hi),
+                 jnp.int32(depth))
+        grow_flag = {ST_OVF_SEEN: "SC", ST_OVF_FRONT: "FCap",
+                     ST_OVF_ACC: "AccCap", ST_OVF_VC: "VC"}
+        # first progress line immediately (ISSUE 2): short runs get at
+        # least one record; same format as the interval lines below
+        self.log(f"Progress({depth}): {generated} states generated, "
+                 f"{distinct} distinct states found, "
+                 f"{fcount} states left on queue.")
+        last_progress = last_ck = time.time()
+        while True:
+            # chaos sites: crash / device failure between dispatches
+            # (the only host-attention points resident mode has)
+            from .. import faults
+            faults.kill_self("run_kill", level=depth, engine="resident")
+            faults.inject("device_run_fail", level=depth)
+            if self._drain_requested(warnings, "resident"):
+                if self.checkpoint_path:
+                    self._write_ck(
+                        "resident", caps=dict(caps),
+                        seen=np.asarray(seen[:seen_count]),
+                        frontier=np.asarray(frontier[:fcount]),
+                        distinct=distinct, generated=generated,
+                        depth=depth)
+                return self._mk_result(True, distinct, generated, depth,
+                                       t0, warnings, None,
+                                       truncated=True, drained=True)
+            ck_key = (caps["SC"], caps["FCap"], caps["AccCap"],
+                      caps["VC"], CH)
+            fresh_compile = ck_key not in self._res_cache
+            runf = self._get_resident_run(*ck_key)
+            t_disp = time.time()
+            seen, frontier, summary, brow = runf(*state, max_states,
+                                                 jnp.int32(maxlvl))
+            jax.block_until_ready(summary)
+            disp_wall = time.time() - t_disp
+            # adapt levels-per-dispatch toward the host-attention target;
+            # a dispatch that just paid an XLA recompile (cap growth) is
+            # not evidence about execution speed — skip it
+            if fresh_compile:
+                pass
+            elif disp_wall > 1.5 * target_s and maxlvl > 1:
+                maxlvl = max(1, maxlvl // 2)
+            elif disp_wall < target_s / 4 and \
+                    maxlvl < self._res_maxlvl:
+                maxlvl = min(self._res_maxlvl, maxlvl * 2)
+            summary = np.asarray(summary)
+            fcount_in, gen_in, dist_in = fcount, generated, distinct
+            stat = int(summary[0])
+            seen_count = int(summary[1])
+            fcount = int(summary[2])
+            distinct = int(summary[3])
+            generated = (int(np.uint32(summary[5])) << 32) | \
+                int(np.uint32(summary[4]))
+            depth = int(summary[6])
+            which = int(summary[7])
+            ovcode = int(summary[8])
+            self._res_caps = dict(caps)
+            # one record per DISPATCH (the host only sees level batches
+            # in resident mode): `level` is the depth reached, so indices
+            # stay monotone — equal across an overflow-redo dispatch.
+            # frontier/generated/new keep the other paths' semantics:
+            # frontier going IN, per-dispatch generated/new deltas (so
+            # summing `generated` across records gives the run total)
+            tel.level(depth, dispatch=True, frontier=fcount_in,
+                      generated=generated - gen_in,
+                      new=distinct - dist_in, distinct=distinct,
+                      seen=seen_count, status=stat,
+                      fresh_compile=fresh_compile,
+                      wall_s=round(disp_wall, 6))
+            self._fp_occupancy = seen_count
+
+            if stat in grow_flag:
+                what = grow_flag[stat]
+                old = caps[what]
+                # x4: each growth recompiles the whole program, so
+                # over-shooting is much cheaper than growing twice
+                caps[what] = old * 4
+                if what == "VC":
+                    caps[what] = min(caps[what], self.A * CH)
+                if what == "SC":
+                    pad = jnp.full((caps[what] - old, K), SENTINEL,
+                                   jnp.int32)
+                    seen = jnp.concatenate([seen, pad])
+                elif what == "FCap":
+                    pad = jnp.full((caps[what] - old, self.PW), SENTINEL,
+                                   jnp.int32)
+                    frontier = jnp.concatenate([frontier, pad])
+                # keep the cap invariants: AccCap >= 2*VC (block-append
+                # headroom) and AccCap >= FCap ([:FCap] frontier slice of
+                # the accumulator)
+                caps["AccCap"] = max(caps["AccCap"], 2 * caps["VC"],
+                                     caps["FCap"])
+                self.log(f"-- resident: growing {what} to {caps[what]} "
+                         f"(level {depth} redone)")
+            elif stat == ST_CONTINUE:
+                now = time.time()
+                if now - last_progress >= self.progress_every:
+                    last_progress = now
+                    self.log(f"Progress({depth}): {generated} states "
+                             f"generated, {distinct} distinct states "
+                             f"found, {fcount} states left on queue.")
+                if self.checkpoint_path and \
+                        now - last_ck >= self.checkpoint_every:
+                    last_ck = now
+                    self._write_ck(
+                        "resident", caps=dict(caps),
+                        seen=np.asarray(seen[:seen_count]),
+                        frontier=np.asarray(frontier[:fcount]),
+                        distinct=distinct, generated=generated,
+                        depth=depth)
+            elif stat == ST_DONE:
+                # remember enough levels-per-dispatch to cover the whole
+                # search in ONE dispatch on a warm re-run (tiny models:
+                # per-dispatch overhead dominated the r04 inversion)
+                self._res_maxlvl_warm = min(
+                    max(depth + 1, maxlvl), self._res_maxlvl)
+                self.log("Model checking completed. No error has been "
+                         "found.")
+                self.log(f"{generated} states generated, {distinct} "
+                         f"distinct states found, 0 states left on queue.")
+                self.log(f"The depth of the complete state graph search "
+                         f"is {depth}.")
+                self._save_caps_profile(caps)
+                if self.checkpoint_path and self.final_checkpoint:
+                    # COMPLETED-run checkpoint (serve warm resume): an
+                    # empty frontier over the full seen set — resuming
+                    # it replays the stored totals in one dispatch
+                    self._write_ck(
+                        "resident", caps=dict(caps),
+                        seen=np.asarray(seen[:seen_count]),
+                        frontier=np.zeros((0, self.PW), np.int32),
+                        distinct=distinct, generated=generated,
+                        depth=depth)
+                return self._mk_result(True, distinct, generated,
+                                       depth - 1, t0, warnings)
+            elif stat == ST_TRUNC:
+                self.log("-- state limit reached, search truncated")
+                self._save_caps_profile(caps)
+                if self.checkpoint_path:
+                    # a truncated resident run is RESUMABLE (ISSUE 5):
+                    # truncation lands on a level boundary inside the
+                    # device loop, so this is exactly the periodic-
+                    # checkpoint state — the warm-start bench resumes it
+                    # for a steady-state window, and a resumed run's
+                    # final counts are bit-identical to an unbounded
+                    # cold run (tests/test_warm_bench.py pins it)
+                    self._write_ck(
+                        "resident", caps=dict(caps),
+                        seen=np.asarray(seen[:seen_count]),
+                        frontier=np.asarray(frontier[:fcount]),
+                        distinct=distinct, generated=generated,
+                        depth=depth)
+                return self._mk_result(True, distinct, generated, depth,
+                                       t0, warnings, None, truncated=True)
+            elif stat == ST_OVF_LANES:
+                if ovcode == OV_DEMOTED:
+                    msg = ("a demoted compile-recovery fired (the "
+                           "kernel under-approximates here): run the "
+                           "host_seen mode, which demotes the arm to "
+                           "the interpreter and restarts — raising "
+                           "caps cannot help")
+                elif ovcode == OV_PACK:
+                    msg = self._pack_ovf_msg()
+                else:
+                    msg = ("a container exceeded its lane capacity "
+                           f"({self._caps_note()})")
+                return self._mk_result(
+                    False, distinct, generated, depth, t0, warnings,
+                    Violation("error", "capacity overflow", [], msg))
+            else:
+                st = layout.decode_packed(np.asarray(brow))
+                note = "state reached by resident-mode search (no trace)"
+                if stat == ST_INV:
+                    nm = self.inv_fns[which][0] if 0 <= which < \
+                        len(self.inv_fns) else "invariant"
+                    v = Violation("invariant", nm, [(st, note)])
+                elif stat == ST_DEADLOCK:
+                    v = Violation("deadlock", "deadlock", [(st, note)])
+                else:
+                    v = Violation("assert", "Assert", [(st, note)],
+                                  "assertion failed in an enabled action")
+                return self._mk_result(False, distinct, generated, depth,
+                                       t0, warnings, v)
+            state = (seen, jnp.int32(seen_count), frontier,
+                     jnp.int32(fcount), jnp.int32(distinct),
+                     jnp.int32(summary[4]), jnp.int32(summary[5]),
+                     jnp.int32(depth))
+
+    def _run_host_seen(self) -> CheckResult:
+        from .. import native_store
+        t0 = time.time()
+        tel = obs.current()
+        model = self.model
+        layout = self.layout
+        warnings = ["seen-set resident in the native host fingerprint "
+                    "store (host_seen); dedup on 128-bit fingerprints"]
+        warnings.extend(self._temporal_warnings())
+        warnings.extend(self._symmetry_warnings())
+
+        init_rows, explored_init, n_init, err = \
+            self._prepare_init(t0, warnings)
+        if err is not None:
+            return err
+        generated = n_init
+        distinct = len(explored_init)
+
+        store = native_store.FingerprintStore()
+        init_keys, init_packed, init_povf = self._host_keys(init_rows)
+        if init_povf:
+            return self._mk_result(
+                False, distinct, generated, 0, t0, warnings,
+                Violation("error", "capacity overflow", [],
+                          self._pack_ovf_msg()))
+        store.insert(init_keys[:, 1:])  # drop the validity lane
+
+        # the frontier lives host-side as a dense PACKED row matrix; each
+        # level is processed in fixed-size chunks so the [A, chunk, W]
+        # expand tensor is memory-bounded and the jit compiles ONE shape
+        CH = _pow2_at_least(self.chunk, lo=64)
+        frontier_np = np.ascontiguousarray(init_packed[explored_init])
+
+        graph = _LiveGraph(self.labels_flat, self.collect_edges) \
+            if self.live_obligations else None
+        frontier_sids = graph.add_inits(init_packed, explored_init) \
+            if graph is not None else None
+
+        trace_levels = [(np.asarray(init_packed), None, 0)]
+        frontier_maps = [np.asarray(explored_init, dtype=np.int64)]
+        depth = 0
+        if self.resume_from:
+            ck = self._load_ck("host_seen")
+            (distinct, generated, depth, tl, fm, graph,
+             fsids) = self._restore_ck_state(ck, graph)
+            if self.store_trace:
+                trace_levels, frontier_maps = tl, fm
+            if graph is not None:
+                frontier_sids = fsids
+            store.load(ck["store"])
+            frontier_np = np.ascontiguousarray(ck["frontier"])
+        # first progress line immediately (ISSUE 2), in this engine's own
+        # interval-line format (see the loop's progress_every site)
+        self.log(f"Progress({depth}): {generated} generated, "
+                 f"{distinct} distinct, {len(frontier_np)} on "
+                 f"queue.")
+        last_progress = last_ck = time.time()
+        hstep = self._get_hstep(CH)
+        while len(frontier_np) > 0:
+            # chaos sites: simulated hard crash / terminal device failure
+            # entering a level (no-ops unless JAXMC_FAULTS names them)
+            from .. import faults
+            faults.kill_self("run_kill", level=depth, engine="host_seen")
+            faults.inject("device_run_fail", level=depth)
+            if self._drain_requested(warnings, "host_seen"):
+                if self.checkpoint_path:
+                    self._write_ck(
+                        "host_seen", store=store.dump(),
+                        frontier=frontier_np,
+                        **self._ck_state_kwargs(distinct, generated,
+                                                depth, trace_levels,
+                                                frontier_maps, graph,
+                                                frontier_sids))
+                    self._write_host_snapshot(trace_levels, frontier_maps,
+                                              graph, depth, generated)
+                return self._mk_result(True, distinct, generated, depth,
+                                       t0, warnings, None,
+                                       truncated=True, drained=True)
+            L = len(frontier_np)
+            lvl_t0 = time.time()
+            lvl_gen0 = generated
+            lvl_new_rows: List[np.ndarray] = []
+            lvl_new_prov: List[np.ndarray] = []
+            lvl_explore: List[np.ndarray] = []
+            lvl_edges: List[Tuple[np.ndarray, np.ndarray]] = []
+            lvl_dead = np.zeros(L, bool)  # deferred when fb arms exist
+            inv_hit = None
+
+            # SURVEY §2.3 pipeline overlap: chunk i+1 is DISPATCHED to
+            # the device before chunk i's outputs are forced, so
+            # successor generation overlaps the host-side spill (native
+            # store insert), deferred predicate checks, and trace
+            # bookkeeping. Exact: the device step depends only on its
+            # own chunk, and host processing stays in chunk order.
+            # Only when the step actually dispatches asynchronously
+            # (the fused jit path — _get_hstep tags it): prefetching a
+            # synchronous split step yields no overlap and pays one
+            # full wasted chunk on every early exit (OV_DEMOTED
+            # restarts included). Cost when active: TWO chunks'
+            # [A*CH, W] outputs live at once — size --chunk with that
+            # 2x in mind, or set JAXMC_NO_PREFETCH=1 to restore the
+            # sequential loop when the doubled working set won't fit
+            prefetch = getattr(hstep, "is_async", False) and \
+                os.environ.get("JAXMC_NO_PREFETCH") != "1"
+
+            def _dispatch(b, fnp=frontier_np, ll=L):
+                c = min(CH, ll - b)
+                bf = np.full((CH, self.PW), SENTINEL, np.int32)
+                bf[:c] = fnp[b:b + c]
+                return b, c, bf, hstep(jnp.asarray(bf), c)
+
+            nxt = None  # one-slot prefetch: the chunk dispatched early
+            for base in range(0, L, CH):
+                _b, cn, buf, out = nxt if nxt is not None \
+                    else _dispatch(base)
+                nxt = _dispatch(base + CH) \
+                    if prefetch and base + CH < L else None
+                ovc = int(out["overflow"])
+                if ovc:
+                    self._last_ovf_code = ovc
+                    self._last_frontier_np = frontier_np
+                    if ovc == OV_DEMOTED:
+                        msg = ("a demoted compile-recovery fired (the "
+                               "kernel under-approximates here); the "
+                               "hybrid engine demotes the arm and "
+                               "restarts")
+                    elif ovc == OV_PACK:
+                        msg = self._pack_ovf_msg()
+                    else:
+                        msg = ("a container exceeded its lane capacity "
+                               f"({self._caps_note()})")
+                    return self._mk_result(
+                        False, distinct, generated, depth, t0, warnings,
+                        Violation("error", "capacity overflow", [], msg))
+                if bool(jnp.any(out["assert_bad"])):
+                    ab = np.asarray(out["assert_bad"])
+                    ai, f = np.unravel_index(np.argmax(ab), ab.shape)
+                    trace = self._trace_to(trace_levels, frontier_maps,
+                                           depth, base + int(f))
+                    return self._mk_result(
+                        False, distinct, generated, depth, t0, warnings,
+                        Violation("assert", "Assert",
+                                  [x for x in trace if x[0] is not None],
+                                  f"assertion in "
+                                  f"{self.labels_flat[int(ai)]}"))
+                if model.check_deadlock and bool(jnp.any(out["dead"])):
+                    if self.fb_arms:
+                        # a device-dead state may still have fallback-arm
+                        # successors: defer the verdict to after the
+                        # interpreter expansion of this level
+                        lvl_dead[base:base + cn] = \
+                            np.asarray(out["dead"])[:cn]
+                    else:
+                        f = int(jnp.argmax(out["dead"]))
+                        trace = self._trace_to(trace_levels,
+                                               frontier_maps,
+                                               depth, base + f)
+                        return self._mk_result(
+                            False, distinct, generated, depth, t0,
+                            warnings,
+                            Violation("deadlock", "deadlock", trace))
+
+                generated += int(out["gen"])
+                cvalid = np.asarray(out["cvalid"])
+                keys = np.asarray(out["keys"])
+                deferred = out.get("deferred_preds", False)
+                explore = np.asarray(out["explore"]) \
+                    if "explore" in out else None
+                if self.refiners:
+                    # need_edges implies explore is present in both modes
+                    rviol = self._refine_edges(buf, out["cand"], cvalid,
+                                               explore, CH)
+                    if rviol is not None:
+                        a, f, sst, rc = rviol
+                        trace = self._trace_to(trace_levels,
+                                               frontier_maps,
+                                               depth, base + f)
+                        return self._mk_result(
+                            False, distinct, generated, depth, t0,
+                            warnings,
+                            self._refine_violation(rc, sst, a, trace))
+                if graph is not None and graph.collect_edges:
+                    # keep only the masked kept-candidate rows (the full
+                    # [A*CH, W] tensor per chunk would hold the whole
+                    # level expansion in host RAM)
+                    eidx = np.nonzero(cvalid & explore)[0]
+                    erows = np.asarray(jnp.take(
+                        out["cand"], jnp.asarray(eidx, dtype=jnp.int32),
+                        axis=0)) if len(eidx) \
+                        else np.zeros((0, self.PW), np.int32)
+                    lvl_edges.append((erows, base + eidx % CH))
+                valid_idx = np.nonzero(cvalid)[0]
+                new_mask = store.insert(keys[valid_idx][:, 1:])
+                new_idx = valid_idx[new_mask]
+                if not len(new_idx):
+                    continue
+                rows_np = np.asarray(jnp.take(
+                    out["cand"], jnp.asarray(new_idx, dtype=np.int32),
+                    axis=0))
+                # predicate checks run on NEW rows only (TLC checks each
+                # state once): the split hstep defers them entirely —
+                # evaluating MCVoting's quantifier-heavy Inv over every
+                # one of the A*CH padded candidates was the r3 sweep's
+                # compile timeout
+                if deferred:
+                    inv_okn, exploren = self._check_new_rows(
+                        rows_np, skip_cons=explore is not None)
+                    if explore is not None:  # need_edges: cons per cand
+                        exploren = explore[new_idx]
+                else:
+                    inv_okn = np.asarray(out["inv_ok"])[new_idx]
+                    exploren = explore[new_idx]
+                if self.fb_cons:
+                    # hybrid: uncompilable CONSTRAINTs evaluate on the
+                    # host over decoded new rows (same discard semantics)
+                    for k in range(len(rows_np)):
+                        if not exploren[k]:
+                            continue
+                        cctx = model.ctx(
+                            state=layout.decode_packed(rows_np[k]))
+                        for cnm, cex, _r in self.fb_cons:
+                            if not _bool(eval_expr(cex, cctx),
+                                         f"constraint {cnm}"):
+                                exploren[k] = False
+                                break
+                # discarded (constraint-violating) states are in the store
+                # (fingerprinted) but never counted distinct, checked, or
+                # explored — TLC semantics (testout2:265)
+                distinct += int(exploren.sum())
+                # global provenance: action a, parent base+f within the
+                # level's full frontier of length L (cand index = a*CH + f)
+                a_ids = new_idx // CH
+                f_ids = new_idx % CH
+                prov_global = a_ids * L + (base + f_ids)
+                bad_mask = (~inv_okn) & exploren
+                if inv_hit is None and bad_mask.any():
+                    off = sum(len(r) for r in lvl_new_rows)
+                    badpos = int(np.nonzero(bad_mask)[0][0])
+                    inv_hit = off + badpos
+                lvl_new_rows.append(rows_np)
+                lvl_new_prov.append(prov_global.astype(np.int64))
+                lvl_explore.append(exploren)
+                if inv_hit is not None:
+                    # the violation is already in hand: skip the rest of
+                    # the level's chunks
+                    break
+
+            if self.fb_arms and inv_hit is None:
+                # hybrid: interpreter-enumerate the fallback arms over
+                # this level's frontier and splice the results into the
+                # same level streams (rows/prov/explore/edges)
+                fb_enabled = np.zeros(L, bool)
+                gen_inc, dist_inc, fbv = self._fb_expand_level(
+                    frontier_np, L, store, lvl_new_rows, lvl_new_prov,
+                    lvl_explore, lvl_edges, fb_enabled,
+                    trace_levels, frontier_maps, depth, t0, warnings,
+                    distinct, generated)
+                if fbv is not None:
+                    return fbv
+                generated += gen_inc
+                distinct += dist_inc
+                if model.check_deadlock:
+                    dead_final = lvl_dead & ~fb_enabled
+                    if dead_final.any():
+                        f = int(np.nonzero(dead_final)[0][0])
+                        trace = self._trace_to(trace_levels,
+                                               frontier_maps, depth, f)
+                        return self._mk_result(
+                            False, distinct, generated, depth, t0,
+                            warnings,
+                            Violation("deadlock", "deadlock", trace))
+
+            new_rows_np = np.concatenate(lvl_new_rows) if lvl_new_rows \
+                else np.zeros((0, self.PW), np.int32)
+            new_prov_np = np.concatenate(lvl_new_prov) if lvl_new_prov \
+                else np.zeros(0, np.int64)
+            explore_mask = np.concatenate(lvl_explore) if lvl_explore \
+                else np.zeros(0, bool)
+
+            if inv_hit is None and self.fb_invs:
+                # hybrid: uncompilable INVARIANTs evaluate on the host
+                # over this level's kept (explored) new states
+                for pos in np.nonzero(explore_mask)[0]:
+                    ictx = model.ctx(state=layout.decode_packed(
+                        new_rows_np[pos]))
+                    bad = False
+                    for inm, iex, _r in self.fb_invs:
+                        if not _bool(eval_expr(iex, ictx),
+                                     f"invariant {inm}"):
+                            bad = True
+                            break
+                    if bad:
+                        inv_hit = int(pos)
+                        break
+
+            if self.store_trace:
+                trace_levels.append((new_rows_np, new_prov_np, L))
+            if inv_hit is not None:
+                st = layout.decode_packed(new_rows_np[inv_hit])
+                ctx = model.ctx(state=st)
+                nm = next((n for n, ex in model.invariants
+                           if not _bool(eval_expr(ex, ctx), n)),
+                          model.invariants[0][0] if model.invariants
+                          else "invariant")
+                trace = self._trace_to(trace_levels, frontier_maps,
+                                       depth + 1, inv_hit,
+                                       from_new=True) \
+                    if self.store_trace else [(st, "?")]
+                return self._mk_result(
+                    False, distinct, generated, depth + 1, t0, warnings,
+                    Violation("invariant", nm, trace))
+
+            sel = np.nonzero(explore_mask)[0]
+            if graph is not None:
+                new_sids = graph.add_level(new_rows_np[sel],
+                                           new_prov_np[sel], L,
+                                           frontier_sids)
+                for erows, eparents in lvl_edges:
+                    graph.add_edges(erows, eparents, frontier_sids)
+                frontier_sids = new_sids
+            if self.store_trace:
+                frontier_maps.append(sel.astype(np.int64))
+            tel.level(depth, frontier=L, generated=generated - lvl_gen0,
+                      new=len(sel), distinct=distinct, seen=len(store),
+                      wall_s=round(time.time() - lvl_t0, 6))
+            self._fp_occupancy = len(store)
+            depth += 1
+            if self.max_states and distinct >= self.max_states:
+                self.log("-- state limit reached, search truncated")
+                return self._mk_result(True, distinct, generated, depth,
+                                       t0, warnings, None, truncated=True)
+            frontier_np = new_rows_np[sel]
+
+            now = time.time()
+            if self.checkpoint_path and \
+                    now - last_ck >= self.checkpoint_every:
+                last_ck = now
+                self._write_ck(
+                    "host_seen", store=store.dump(), frontier=frontier_np,
+                    **self._ck_state_kwargs(distinct, generated, depth,
+                                            trace_levels, frontier_maps,
+                                            graph, frontier_sids))
+                self._write_host_snapshot(trace_levels, frontier_maps,
+                                          graph, depth, generated)
+            if now - last_progress >= self.progress_every:
+                last_progress = now
+                self.log(f"Progress({depth}): {generated} generated, "
+                         f"{distinct} distinct, {len(frontier_np)} on "
+                         f"queue.")
+
+        if graph is not None:
+            viol = self._check_live(graph, warnings)
+            if viol is not None:
+                return self._mk_result(False, distinct, generated,
+                                       depth - 1, t0, warnings, viol)
+        self.log("Model checking completed. No error has been found.")
+        self.log(f"{generated} states generated, {distinct} distinct "
+                 f"states found, 0 states left on queue.")
+        if self.checkpoint_path and self.final_checkpoint:
+            # COMPLETED-run checkpoint (serve warm resume): an empty
+            # frontier over the full store — resuming it skips the
+            # level loop and replays the stored totals
+            self._write_ck(
+                "host_seen", store=store.dump(),
+                frontier=np.zeros((0, self.PW), np.int32),
+                **self._ck_state_kwargs(distinct, generated, depth,
+                                        trace_levels, frontier_maps,
+                                        graph, frontier_sids))
+        return self._mk_result(True, distinct, generated, depth - 1, t0,
+                               warnings)
+
+    def _fb_expand_level(self, frontier_np, L, store, lvl_new_rows,
+                         lvl_new_prov, lvl_explore, lvl_edges, fb_enabled,
+                         trace_levels, frontier_maps, depth, t0, warnings,
+                         distinct, generated):
+        """Hybrid execution, action side (VERDICT r3 #2): enumerate the
+        fallback arms with the EXACT interpreter over this level's
+        decoded frontier states, encode the successors, dedup them
+        through the native store, and splice rows/provenance into the
+        level streams so traces, refinement, and the liveness behavior
+        graph see one uniform level. Fallback arm j uses provenance
+        action index A + j (labels_flat is extended accordingly).
+
+        Returns (generated_inc, distinct_inc, violation CheckResult |
+        None); mutates lvl_* and fb_enabled in place."""
+        model = self.model
+        layout = self.layout
+        base_ctx = model.ctx()
+        gen_inc = 0
+        cand_rows: List[np.ndarray] = []
+        cand_prov: List[int] = []
+
+        def _mk(viol):
+            return self._mk_result(False, distinct, generated + gen_inc,
+                                   depth, t0, warnings, viol)
+
+        decoded = [layout.decode_packed(frontier_np[f]) for f in range(L)]
+        for j, (arm, _reason) in enumerate(self.fb_arms):
+            ctx = base_ctx.with_bound(arm.bound)
+            for f in range(L):
+                pst = decoded[f]
+                try:
+                    succs = [s for s, _ in enumerate_next(
+                        arm.expr, ctx, model.vars, pst)]
+                except TLCAssertFailure as ex:
+                    trace = self._trace_to(trace_levels, frontier_maps,
+                                           depth, f)
+                    return gen_inc, 0, _mk(Violation(
+                        "assert", "Assert",
+                        [x for x in trace if x[0] is not None],
+                        str(ex.out)))
+                if succs:
+                    fb_enabled[f] = True
+                gen_inc += len(succs)
+                for sst in succs:
+                    # constraint check FIRST: a discarded successor is
+                    # never explored, counted, or edge-checked, so it
+                    # needs no encoding at all — its value shapes may
+                    # legitimately be absent from the sampled layout
+                    # (skew_fast's cfg discards abort histories, so no
+                    # sampled state holds an abort record). Dropping it
+                    # here is count-equivalent to fingerprint-and-
+                    # discard: satisfaction is state-determined, so the
+                    # state can never reappear in an explored context.
+                    if not satisfies_constraints(model, sst):
+                        continue
+                    try:
+                        row = np.asarray(layout.encode(sst), np.int32)
+                    except (CompileError, EvalError) as ex:
+                        # ANY fallback-encode failure is an OBSERVATION
+                        # gap relayout can fix: missing variants get
+                        # their union slot, and capacity shortfalls grow
+                        # because build_layout2 re-derives caps from the
+                        # enriched observations. The failing state rides
+                        # along so recovery is deterministic even when
+                        # the frontier outgrows the enrichment cap.
+                        self._last_ovf_code = OV_DEMOTED
+                        self._relayout_hint = True
+                        self._last_frontier_np = frontier_np
+                        self._relayout_states = [sst]
+                        return gen_inc, 0, _mk(Violation(
+                            "error", "capacity overflow", [],
+                            "a fallback successor exceeded its lane "
+                            f"capacity ({ex}; {self._caps_note()}); "
+                            "counts would no longer be exact"))
+                    # EVERY invariant (compiled and demoted alike)
+                    # checks host-side on fallback successors: the
+                    # device inv pass only sees device candidates
+                    ictx = model.ctx(state=sst)
+                    for inm, iex in model.invariants:
+                        if not _bool(eval_expr(iex, ictx),
+                                     f"invariant {inm}"):
+                            trace = self._trace_to(
+                                trace_levels, frontier_maps, depth, f)
+                            trace = [x for x in trace
+                                     if x[0] is not None]
+                            trace.append(
+                                (sst, self.labels_flat[self.A + j]))
+                            return gen_inc, 0, _mk(Violation(
+                                "invariant", inm, trace))
+                    if self.refiners:
+                        for rc in self.refiners:
+                            if not rc.check_edge(pst, sst):
+                                trace = self._trace_to(
+                                    trace_levels, frontier_maps, depth, f)
+                                return gen_inc, 0, _mk(
+                                    self._refine_violation(
+                                        rc, sst, self.A + j, trace))
+                    cand_rows.append(row)
+                    cand_prov.append((self.A + j) * L + f)
+
+        if not cand_rows:
+            return gen_inc, 0, None
+        # every row collected above is constraint-satisfying (discarded
+        # successors were dropped before encoding — they are never
+        # counted, checked, or explored, so the drop is count-equivalent
+        # to TLC's fingerprint-and-discard)
+        rows_mat = np.stack(cand_rows)
+        keys, packed_mat, povf = self._host_keys(rows_mat)
+        if povf:
+            # a packed-lane overflow on a fallback successor is the same
+            # OBSERVATION-GAP class as an encode failure: relayout
+            # re-profiles the lane ranges from the enriched samples
+            self._last_ovf_code = OV_DEMOTED
+            self._relayout_hint = True
+            self._last_frontier_np = frontier_np
+            self._relayout_states = []
+            return gen_inc, 0, _mk(Violation(
+                "error", "capacity overflow", [],
+                f"a fallback successor escaped its packed lane range "
+                f"({self._pack_ovf_msg()})"))
+        if self.collect_edges:
+            # every explored successor EDGE (revisits included) feeds the
+            # behavior graph, mirroring the device candidate stream
+            lvl_edges.append(
+                (packed_mat, np.asarray([p % L for p in cand_prov])))
+        new_mask = store.insert(keys[:, 1:])
+        new_idx = np.nonzero(new_mask)[0]
+        dist_inc = len(new_idx)
+        if len(new_idx):
+            lvl_new_rows.append(packed_mat[new_idx])
+            lvl_new_prov.append(np.asarray(
+                [cand_prov[i] for i in new_idx], np.int64))
+            lvl_explore.append(np.ones(len(new_idx), bool))
+        return gen_inc, dist_inc, None
+
+    def _relayout_and_restart(self) -> Optional[CheckResult]:
+        """Adaptive relayout (hybrid): decode the abort-time frontier,
+        interp-enumerate one exact level of its successors, and build a
+        FRESH engine whose layout sampling includes those states — the
+        value shape that fired the demotion is then observed, its union
+        variant exists, and the restarted search stays compiled.
+        Returns the fresh engine's result, or None when enrichment
+        fails (caller falls back to arm demotion)."""
+        model = self.model
+        cap = 20000
+        rows = self._last_frontier_np
+        if len(rows) > cap:
+            if self.relayouts_left <= 1 and len(rows) <= 10 * cap:
+                # last attempt: pay for the FULL frontier (bounded at
+                # 10x the per-attempt cap) — a sample that misses the
+                # offending parent row would repeat the same abort and
+                # waste the attempt. Frontiers beyond the bound stay
+                # strided; arm demotion remains the exact safety valve
+                self.log(f"hybrid: final relayout attempt — enriching "
+                         f"from ALL {len(rows)} abort-frontier rows")
+            else:
+                # stride over the WHOLE frontier (not a prefix: the
+                # missing variant's parent can sit anywhere), with a
+                # per-attempt offset so a repeated abort at the same
+                # frontier enriches from DIFFERENT rows each time
+                stride = -(-len(rows) // cap)
+                off = self.relayouts_left % stride
+                self.log(f"hybrid: relayout enrichment strided (rows "
+                         f"{off}::{stride} of {len(rows)} in the abort "
+                         f"frontier)")
+                rows = rows[off::stride]
+        # states whose encode failed are known exactly — include them
+        # directly so recovery never depends on the cap
+        enrich: List[Dict[str, Any]] = list(self._relayout_states)
+        base_ctx = model.ctx()
+        enrich_cap = 400_000  # hard memory ceiling on successor dicts
+        try:
+            for row in rows:
+                # frontier states themselves are already encodable (they
+                # were just decoded from this layout): only their
+                # SUCCESSORS can carry unobserved shapes
+                st = self.layout.decode_packed(np.asarray(row))
+                for succ, _ in enumerate_next(model.next, base_ctx,
+                                              model.vars, st):
+                    enrich.append(succ)
+                if len(enrich) >= enrich_cap:
+                    self.log(f"hybrid: relayout enrichment truncated "
+                             f"at {len(enrich)} successor states "
+                             f"(memory ceiling)")
+                    break
+        except (EvalError, TLCAssertFailure):
+            return None
+        self.log(f"hybrid: adaptive relayout — re-sampling with "
+                 f"{len(enrich)} abort-frontier states, rebuilding "
+                 f"kernels, restarting compiled "
+                 f"({self.relayouts_left - 1} attempts left)")
+        obs.current().counter("expand.relayouts")
+        obs.current().reset_levels("adaptive relayout restart")
+        if self.checkpoint_path:
+            # a checkpoint written under the enriched layout could not
+            # be resumed (the resume path re-derives the layout from
+            # plain sampling, so the layout signature would mismatch):
+            # disable checkpointing rather than strand the user with an
+            # unresumable file. Persisting enrichment states in the
+            # checkpoint is the known follow-up (ROADMAP).
+            self.log("hybrid: relayout disables checkpointing for the "
+                     "restarted run (the enriched layout would make "
+                     "checkpoints unresumable)")
+        try:
+            ex2 = TpuExplorer(
+                model, log=self.log, max_states=self.max_states,
+                store_trace=self.store_trace,
+                progress_every=self.progress_every, bounds=self.bounds,
+                sample_cfg=self.sample_cfg, host_seen=True,
+                chunk=self.chunk,
+                extra_samples=self.extra_samples + enrich,
+                relayouts_left=self.relayouts_left - 1)
+        except (CompileError, ModeError):
+            return None
+        return ex2.run()
+
+    def _demote_arms(self, arm_idxs) -> List[str]:
+        """Hybrid runtime demotion: move the given arms' compiled
+        kernels to the interpreter-fallback list and clear the step
+        caches. Called when a demoted guard conjunct's abort flag fires
+        (see __init__._demotable); the caller restarts the search."""
+        idxset = set(arm_idxs)
+        reasons: Dict[int, List[str]] = {ai: [] for ai in idxset}
+        labels: List[str] = []
+        for i, ca in enumerate(self.compiled):
+            ai = self._ca_arm[i]
+            if ai in idxset:
+                reasons[ai].extend(ca.demoted_guards)
+                labels.append(ca.label)
+        keep = [(ga, ca, ai) for ga, ca, ai in
+                zip(self.actions, self.compiled, self._ca_arm)
+                if ai not in idxset]
+        self.actions = [g for g, _, _ in keep]
+        self.compiled = [c for _, c, _ in keep]
+        self._ca_arm = [a for _, _, a in keep]
+        self.labels_flat = []
+        for ca in self.compiled:
+            if ca.n_slots:
+                self.labels_flat.extend([ca.label] * ca.n_slots)
+            else:
+                self.labels_flat.append(ca.label)
+        self.A = len(self.labels_flat)
+        for ai in sorted(idxset):
+            why = "; ".join(dict.fromkeys(reasons[ai])) or \
+                "demoted guard conjunct"
+            self.fb_arms.append((self.arms[ai], f"guard demoted: {why}"))
+        self.labels_flat = self.labels_flat + \
+            [arm.label or "Next" for arm, _ in self.fb_arms]
+        self.hybrid = True
+        self._demotable = []
+        self._step_cache.clear()
+        self._hstep_cache.clear()
+        self._res_cache.clear()
+        obs.current().counter("expand.recovery_demotions", len(idxset))
+        return labels
+
+    # ---- host-side search loop ----
+    def run(self) -> CheckResult:
+        if self.resident:
+            return self._run_resident()
+        if self.host_seen:
+            self._last_ovf_code = 0
+            self._relayout_hint = False
+            self._relayout_states: List[Dict[str, Any]] = []
+            r = self._run_host_seen()
+            while not r.ok and r.violation is not None \
+                    and r.violation.kind == "error" \
+                    and self._last_ovf_code in (OV_DEMOTED, OV_PACK):
+                # a compile-recovery demotion fired (never a true lane
+                # overflow — that keeps code OV_CAPACITY). First choice:
+                # ADAPTIVE RELAYOUT — when the cause is an OBSERVATION
+                # gap (a value shape the sampler missed), re-sampling
+                # from the abort frontier and rebuilding the kernels
+                # keeps the model fully COMPILED. Structural compiler
+                # limitations (extensional-set equality, unbounded
+                # CHOOSE, Lambda, unsupported binders) can never be
+                # fixed by observation — those demote the arms to the
+                # interpreter (exact, slower).
+                # OV_PACK (a value escaped its packed lane's profiled
+                # range) is ALWAYS an observation gap: the relayout's
+                # enriched samples re-profile the lane ranges.
+                def _structural(why):
+                    return ("extensional" in why or
+                            "unbounded CHOOSE" in why or
+                            "Lambda" in why or "not supported" in why)
+                fixable = (self._last_ovf_code == OV_PACK or
+                           self._relayout_hint or any(
+                               not _structural(why)
+                               for ca in self.compiled
+                               for why in ca.demoted_guards))
+                if fixable and self.relayouts_left > 0 and \
+                        self._last_frontier_np is not None and \
+                        len(self._last_frontier_np):
+                    r2 = self._relayout_and_restart()
+                    if r2 is not None:
+                        return r2
+                if not self._demotable:
+                    break
+                demoted = self._demote_arms(self._demotable)
+                obs.current().reset_levels("hybrid demotion restart")
+                self.log(f"hybrid: demotion abort — falling "
+                         f"{demoted} back to the interpreter and "
+                         f"restarting")
+                self._last_ovf_code = 0
+                self._relayout_hint = False
+                self._relayout_states = []
+                r = self._run_host_seen()
+            return r
+        t0 = time.time()
+        tel = obs.current()
+        model = self.model
+        W, K = self.W, self.K
+        warnings = []
+        warnings.extend(self._temporal_warnings())
+        warnings.extend(self._symmetry_warnings())
+        if self.fp_mode:
+            warnings.append(
+                "wide state (W={}): dedup on 128-bit fingerprints; "
+                "collision probability < n^2 * 2^-129".format(W))
+
+        init_rows, explored_init, n_init, err = \
+            self._prepare_init(t0, warnings)
+        if err is not None:
+            return err
+        generated = n_init
+        distinct = len(explored_init)
+
+        init_keys, init_packed, init_povf = self._host_keys(init_rows)
+        if init_povf:
+            return self._mk_result(
+                False, distinct, generated, 0, t0, warnings,
+                Violation("error", "capacity overflow", [],
+                          self._pack_ovf_msg()))
+        graph = _LiveGraph(self.labels_flat, self.collect_edges) \
+            if self.live_obligations else None
+        frontier_sids = graph.add_inits(init_packed, explored_init) \
+            if graph is not None else None
+
+        FC = _pow2_at_least(max(n_init, 1))
+        SC = _pow2_at_least(4 * max(n_init, 1))
+
+        front_init = init_packed[explored_init] if n_init else init_packed
+        n_front = len(front_init)
+        frontier = np.full((FC, self.PW), SENTINEL, np.int32)
+        frontier[:n_front] = front_init
+        frontier = jnp.asarray(frontier)
+        fcount = n_front
+
+        seen = np.full((SC, K), SENTINEL, np.int32)
+        if n_init:
+            order = np.lexsort(tuple(init_keys[:, i]
+                                     for i in reversed(range(K))))
+            seen[:n_init] = init_keys[order]
+        seen = jnp.asarray(seen)
+        seen_count = n_init
+
+        trace_levels: List[Tuple[np.ndarray, Optional[np.ndarray], int]] = []
+        trace_levels.append((np.asarray(init_packed), None, 0))
+        frontier_maps: List[np.ndarray] = [np.asarray(explored_init,
+                                                      dtype=np.int64)]
+
+        depth = 0
+        if self.resume_from:
+            ck = self._load_ck("level")
+            (distinct, generated, depth, tl, fm, graph,
+             fsids) = self._restore_ck_state(ck, graph)
+            if self.store_trace:
+                trace_levels, frontier_maps = tl, fm
+            if graph is not None:
+                frontier_sids = fsids
+            cs, fr = ck["seen"], ck["frontier"]
+            SC = _pow2_at_least(len(cs), SC)
+            seen_np = np.full((SC, K), SENTINEL, np.int32)
+            seen_np[:len(cs)] = cs
+            seen = jnp.asarray(seen_np)
+            seen_count = len(cs)
+            FC = _pow2_at_least(max(len(fr), 1), FC)
+            fr_np = np.full((FC, self.PW), SENTINEL, np.int32)
+            fr_np[:len(fr)] = fr
+            frontier = jnp.asarray(fr_np)
+            fcount = len(fr)
+
+        self.log(f"Progress({depth}): {generated} states generated, "
+                 f"{distinct} distinct states found, "
+                 f"{fcount} states left on queue.")
+        last_progress = last_ck = time.time()
+        while fcount > 0:
+            # chaos sites (see _run_host_seen): crash / device failure
+            # entering a level
+            from .. import faults
+            faults.kill_self("run_kill", level=depth, engine="level")
+            faults.inject("device_run_fail", level=depth)
+            if self._drain_requested(warnings, "level"):
+                if self.checkpoint_path:
+                    self._write_ck(
+                        "level", seen=np.asarray(seen[:seen_count]),
+                        frontier=np.asarray(frontier[:fcount]),
+                        **self._ck_state_kwargs(distinct, generated,
+                                                depth, trace_levels,
+                                                frontier_maps, graph,
+                                                frontier_sids))
+                    self._write_host_snapshot(trace_levels, frontier_maps,
+                                              graph, depth, generated)
+                return self._mk_result(True, distinct, generated, depth,
+                                       t0, warnings, None,
+                                       truncated=True, drained=True)
+            lvl_t0 = time.time()
+            C = self.A * FC
+            if seen_count + C > SC:
+                SC2 = _pow2_at_least(seen_count + C, SC)
+                pad = jnp.full((SC2 - SC, K), SENTINEL, jnp.int32)
+                seen = jnp.concatenate([seen, pad])
+                SC = SC2
+            step = self._get_step(SC, FC)
+            out = step(seen, seen_count, frontier, fcount)
+
+            ovc = int(out["overflow"])
+            if ovc:
+                if ovc == OV_DEMOTED:
+                    msg = ("a demoted compile-recovery fired (the kernel "
+                           "under-approximates here): run the host_seen "
+                           "mode, which demotes the arm to the "
+                           "interpreter and restarts")
+                elif ovc == OV_PACK:
+                    msg = self._pack_ovf_msg()
+                else:
+                    msg = ("a container exceeded its lane capacity "
+                           f"({self._caps_note()}); "
+                           "counts would no longer be exact")
+                return self._mk_result(
+                    False, distinct, generated, depth, t0, warnings,
+                    Violation("error", "capacity overflow", [], msg))
+            if bool(jnp.any(out["assert_bad"])):
+                ab = np.asarray(out["assert_bad"])
+                a, f = np.unravel_index(np.argmax(ab), ab.shape)
+                trace = self._trace_to(trace_levels, frontier_maps,
+                                       depth, int(f))
+                return self._mk_result(
+                    False, distinct, generated, depth, t0, warnings,
+                    Violation("assert", "Assert",
+                              [x for x in trace if x[0] is not None],
+                              f"assertion in {self.labels_flat[int(a)]}"))
+            if model.check_deadlock and bool(jnp.any(out["dead"])):
+                f = int(jnp.argmax(out["dead"]))
+                trace = self._trace_to(trace_levels, frontier_maps,
+                                       depth, f)
+                return self._mk_result(
+                    False, distinct, generated, depth, t0, warnings,
+                    Violation("deadlock", "deadlock", trace))
+
+            if self.refiners:
+                rviol = self._refine_edges(frontier, out["cand"],
+                                           out["cvalid"],
+                                           out["explore_all"], FC)
+                if rviol is not None:
+                    a, f, sst, rc = rviol
+                    trace = self._trace_to(trace_levels, frontier_maps,
+                                           depth, f)
+                    return self._mk_result(
+                        False, distinct, generated, depth, t0, warnings,
+                        self._refine_violation(rc, sst, a, trace))
+
+            front_count = int(out["front_count"])
+            generated += int(out["gen"])
+            distinct += front_count  # kept states only (discards excluded)
+            seen = out["seen"]
+            seen_count = int(out["seen_count"])
+            tel.level(depth, frontier=fcount, generated=int(out["gen"]),
+                      new=front_count, distinct=distinct, seen=seen_count,
+                      wall_s=round(time.time() - lvl_t0, 6))
+            self._fp_occupancy = seen_count
+
+            if graph is not None:
+                new_sids = graph.add_level(
+                    np.asarray(out["front_rows"][:front_count]),
+                    np.asarray(out["front_prov"][:front_count]),
+                    FC, frontier_sids)
+                if graph.collect_edges:
+                    # the step emits cand/explore_all iff need_edges —
+                    # which collect_edges implies
+                    mask = np.asarray(out["cvalid"]) & np.asarray(
+                        out["explore_all"])
+                    idx = np.nonzero(mask)[0]
+                    rows = np.asarray(jnp.take(
+                        out["cand"], jnp.asarray(idx, dtype=jnp.int32),
+                        axis=0)) if len(idx) \
+                        else np.zeros((0, self.PW), np.int32)
+                    graph.add_edges(rows, idx % FC, frontier_sids)
+                frontier_sids = new_sids
+
+            if self.store_trace:
+                # trace levels hold the kept states; every kept state is
+                # explored, so the frontier map is the identity
+                fr_h = np.asarray(out["front_rows"][:max(front_count, 1)])
+                fp_h = np.asarray(out["front_prov"][:max(front_count, 1)])
+                trace_levels.append(
+                    (fr_h[:front_count], fp_h[:front_count], FC))
+                frontier_maps.append(
+                    np.arange(front_count, dtype=np.int64))
+            if bool(out["inv_bad_any"]):
+                idx = int(out["inv_bad_idx"])
+                which = int(out["inv_bad_which"])
+                nm = self.inv_fns[which][0]
+                trace = self._trace_to(trace_levels, frontier_maps,
+                                       depth + 1, idx, from_new=True)
+                return self._mk_result(
+                    False, distinct, generated, depth + 1, t0, warnings,
+                    Violation("invariant", nm, trace))
+            depth += 1
+
+            if self.max_states and distinct >= self.max_states:
+                self.log("-- state limit reached, search truncated")
+                return self._mk_result(True, distinct, generated, depth, t0,
+                                       warnings, None, truncated=True)
+
+            if front_count > FC:
+                FC = _pow2_at_least(front_count, FC)
+            nf = jnp.full((FC, self.PW), SENTINEL, jnp.int32)
+            nf = nf.at[:min(front_count, FC)].set(
+                out["front_rows"][:min(front_count, FC)])
+            frontier = nf
+            fcount = front_count
+
+            now = time.time()
+            if self.checkpoint_path and \
+                    now - last_ck >= self.checkpoint_every:
+                last_ck = now
+                self._write_ck(
+                    "level", seen=np.asarray(seen[:seen_count]),
+                    frontier=np.asarray(frontier[:fcount]),
+                    **self._ck_state_kwargs(distinct, generated, depth,
+                                            trace_levels, frontier_maps,
+                                            graph, frontier_sids))
+                self._write_host_snapshot(trace_levels, frontier_maps,
+                                          graph, depth, generated)
+            if now - last_progress >= self.progress_every:
+                last_progress = now
+                self.log(f"Progress({depth}): {generated} states generated, "
+                         f"{distinct} distinct states found, "
+                         f"{fcount} states left on queue.")
+
+        if graph is not None:
+            viol = self._check_live(graph, warnings)
+            if viol is not None:
+                return self._mk_result(False, distinct, generated,
+                                       depth - 1, t0, warnings, viol)
+        self.log("Model checking completed. No error has been found.")
+        self.log(f"{generated} states generated, {distinct} distinct states "
+                 f"found, 0 states left on queue.")
+        self.log(f"The depth of the complete state graph search is "
+                 f"{depth}.")
+        if self.checkpoint_path and self.final_checkpoint:
+            # COMPLETED-run checkpoint (serve warm resume): an empty
+            # frontier over the full seen table — resuming it skips the
+            # level loop and replays the stored totals
+            self._write_ck(
+                "level", seen=np.asarray(seen[:seen_count]),
+                frontier=np.zeros((0, self.PW), np.int32),
+                **self._ck_state_kwargs(distinct, generated, depth,
+                                        trace_levels, frontier_maps,
+                                        graph, frontier_sids))
+        return self._mk_result(True, distinct, generated, depth - 1, t0,
+                               warnings)
+
+    def _mk_result(self, ok, distinct, generated, diameter, t0, warnings,
+                   violation=None, truncated=False,
+                   drained=False) -> CheckResult:
+        tel = obs.current()
+        tel.high_water("device.mem_high_water_bytes",
+                       obs.device_mem_high_water())
+        occ = getattr(self, "_fp_occupancy", None)
+        if occ is not None:
+            tel.gauge("fingerprint.occupancy", occ)
+        if truncated and self.live_obligations:
+            warnings.append("temporal properties NOT checked: the "
+                            "search was truncated (behavior graph "
+                            "incomplete)")
+        return CheckResult(ok=ok, distinct=distinct, generated=generated,
+                           diameter=max(diameter, 0), violation=violation,
+                           wall_s=time.time() - t0, truncated=truncated,
+                           warnings=warnings, drained=drained)
+
+    def _drain_requested(self, warnings, engine: str) -> bool:
+        """Cooperative drain poll at a device-safe boundary (between
+        dispatches / at a level barrier).  Appends the named warning and
+        emits the trace event; the CALLER writes its own mode-specific
+        checkpoint and returns a drained result."""
+        from .. import drain as _drain
+        if not _drain.requested():
+            return False
+        why = _drain.reason()
+        self.log(f"-- drain requested ({why}): stopping at a safe "
+                 f"boundary")
+        obs.current().event("drain", reason=why, engine=engine)
+        warnings.append(
+            f"run drained before completion ({why})"
+            + (f"; resume with --resume {self.checkpoint_path}"
+               if self.checkpoint_path else "; no checkpoint was "
+               "configured — progress was discarded"))
+        return True
+
+    def _trace_to(self, trace_levels, frontier_maps, level: int, idx: int,
+                  from_new: bool = False) -> List[Tuple[Dict, str]]:
+        if not self.store_trace:
+            return []
+        out = []
+        lvl = level
+        cur = idx
+        if not from_new and lvl < len(frontier_maps):
+            cur = int(frontier_maps[lvl][cur])
+        while lvl >= 0:
+            rows, prov, par_FC = trace_levels[lvl]
+            row = rows[cur]
+            st = self.layout.decode_packed(row)
+            if prov is None:
+                out.append((st, "Initial predicate"))
+                break
+            p = int(prov[cur])
+            a, f = p // par_FC, p % par_FC
+            out.append((st, self.labels_flat[a]))
+            lvl -= 1
+            cur = int(frontier_maps[lvl][f]) if lvl < len(frontier_maps) \
+                else f
+        out.reverse()
+        return out

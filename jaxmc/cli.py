@@ -1,6 +1,7 @@
 r"""jaxmc command-line interface.
 
-    python -m jaxmc check SPEC.tla [--cfg F.cfg] [--backend interp|jax]
+    python -m jaxmc check SPEC.tla [--cfg F.cfg]
+        [--backend interp|jax|auto|cpu|gpu|tpu]
     python -m jaxmc simulate SPEC.tla [--walks N --depth N --coverage]
     python -m jaxmc info SPEC.tla
     python -m jaxmc.serve ...       (checking-as-a-service daemon)
@@ -234,6 +235,7 @@ def cmd_info(args) -> int:
 
 def main(argv=None) -> int:
     from .compile.vspec import Bounds  # no jax dependency
+    from .backend import BACKEND_CHOICES  # no jax dependency
     ap = argparse.ArgumentParser(prog="jaxmc")
     sub = ap.add_subparsers(dest="cmd", required=True)
 
@@ -243,7 +245,16 @@ def main(argv=None) -> int:
     c.add_argument("-I", "--include", action="append", default=[],
                    help="extra module search directories (MC shims "
                         "extending reference specs)")
-    c.add_argument("--backend", choices=["interp", "jax"], default="interp")
+    c.add_argument("--backend", choices=list(BACKEND_CHOICES),
+                   default="interp",
+                   help="interp = the exact Python engine; jax = the "
+                        "XLA engine on whatever platform jax picks "
+                        "(honors --platform); cpu|gpu|tpu = the XLA "
+                        "engine PINNED to that platform; auto = probe "
+                        "the visible platforms with the preflight "
+                        "oracle (seconds, hang-proof) and run on the "
+                        "best live one (verdict in the metrics "
+                        "artifact as backend.oracle_choice)")
     c.add_argument("--platform", default=os.environ.get("JAXMC_PLATFORM"),
                    help="pin the jax platform (e.g. 'cpu', 'tpu') before "
                         "device init - 'cpu' keeps --backend jax usable "

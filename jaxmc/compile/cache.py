@@ -487,7 +487,8 @@ def profile_path(module: str, layout_sig: str, variant: str = "") -> str:
 
 def load_capacity_profile(module: str, layout_sig: str, tel=None,
                           variant: str = "",
-                          keys: Tuple[str, ...] = _PROFILE_CAP_KEYS
+                          keys: Tuple[str, ...] = _PROFILE_CAP_KEYS,
+                          optional: Tuple[str, ...] = ()
                           ) -> Optional[dict]:
     """The validated caps dict, or None with a NAMED degrade reason in
     the `profile.status` gauge (absent / unreadable / foreign schema /
@@ -541,16 +542,27 @@ def load_capacity_profile(module: str, layout_sig: str, tel=None,
         return None
     tel.gauge("profile.status", "loaded")
     tel.counter("profile.hits")
-    return {k: int(caps[k]) for k in keys}
+    out = {k: int(caps[k]) for k in keys}
+    # `optional` names caps newer engines persist but older profiles
+    # (or strategy configurations that never learn them — ISSUE 11's
+    # mesh VC under the fullsort escape hatch) may lack: validated the
+    # same way when present, silently absent otherwise
+    for k in optional:
+        if isinstance(caps.get(k), int) and 0 < caps[k] < (1 << 31):
+            out[k] = int(caps[k])
+    return out
 
 
 def save_capacity_profile(module: str, layout_sig: str,
                           caps: dict, tel=None, variant: str = "",
                           keys: Tuple[str, ...] = _PROFILE_CAP_KEYS,
+                          optional: Tuple[str, ...] = (),
                           **extra) -> Optional[str]:
     """Persist the caps a completed resident run ended with (atomic
     write; max-merged over any existing valid profile so alternating
-    workloads never thrash each other downward).  Never raises."""
+    workloads never thrash each other downward).  Never raises.
+    `optional` caps persist when the run learned them and are dropped
+    (without vetoing the save) when it did not."""
     from .. import obs
     tel = tel if tel is not None else obs.current()
     if not profiles_enabled():
@@ -558,14 +570,22 @@ def save_capacity_profile(module: str, layout_sig: str,
     try:
         prev = load_capacity_profile(module, layout_sig,
                                      tel=obs.NullTelemetry(),
-                                     variant=variant, keys=keys)
+                                     variant=variant, keys=keys,
+                                     optional=optional)
         merged = {k: int(caps[k]) for k in keys
                   if isinstance(caps.get(k), int)}
         if len(merged) != len(keys):
             return None
+        for k in optional:
+            if isinstance(caps.get(k), int):
+                merged[k] = int(caps[k])
         if prev:
-            for k in keys:
-                merged[k] = max(merged[k], prev[k])
+            for k in list(merged):
+                if k in prev:
+                    merged[k] = max(merged[k], prev[k])
+            for k in optional:
+                if k in prev and k not in merged:
+                    merged[k] = prev[k]
         d = profile_dir()
         os.makedirs(d, exist_ok=True)
         path = profile_path(module, layout_sig, variant)

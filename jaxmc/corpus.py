@@ -366,7 +366,7 @@ def run_case(case: Case, backend: str = "interp"):
     note = ""
     mode = None
     if backend == "jax":
-        from .tpu.bfs import TpuExplorer
+        from .backend.bfs import TpuExplorer
         from .compile.vspec import Bounds, CompileError, ModeError
         from . import native_store
         b = Bounds()

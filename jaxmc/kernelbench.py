@@ -87,7 +87,7 @@ def run_leg(spec: str, cfg: Optional[str], out_dir: str,
     """Measure both engines, write the two artifacts, run the gate.
     Returns the gate's exit status (0 ok, 1 kernel lost)."""
     from .engine.explore import Explorer
-    from .tpu.bfs import TpuExplorer
+    from .backend.bfs import TpuExplorer
 
     name = os.path.splitext(os.path.basename(spec))[0]
 

@@ -51,7 +51,7 @@ import os
 import subprocess
 import sys
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _RESULT_TAG = "MESHBENCH_RESULT "
@@ -95,8 +95,26 @@ def _run_child(spec: str, cfg: Optional[str], devices: int,
                log=print) -> Dict:
     name = _leg_name(spec, cfg)
     suffix = f"_{merge}" if merge else ""
+    # artifacts (and therefore the saved baselines _gate snapshots) are
+    # NAMESPACED by platform (ISSUE 11): a cpu virtual-device baseline
+    # must never gate a real-chip run — each backend regates its own
+    plat = os.environ.get("JAXMC_MESHBENCH_PLATFORM", "cpu")
     metrics = os.path.join(
-        out_dir, f"jaxmc_multichip_{name}_d{devices}{suffix}.json")
+        out_dir,
+        f"jaxmc_multichip_{plat}_{name}_d{devices}{suffix}.json")
+    # pre-ISSUE-11 baselines had no platform segment; those were all
+    # measured on cpu virtual devices, so migrate them into the cpu
+    # namespace instead of silently re-seeding the gate from current
+    # performance (which would wave a regression through once)
+    base = metrics.replace(".json", ".baseline.json")
+    legacy = os.path.join(
+        out_dir,
+        f"jaxmc_multichip_{name}_d{devices}{suffix}.baseline.json")
+    if plat == "cpu" and not os.path.exists(base) \
+            and os.path.exists(legacy):
+        os.replace(legacy, base)
+        log(f"meshbench: migrated pre-backend baseline -> "
+            f"{os.path.basename(base)}")
     cmd = [sys.executable, "-m", "jaxmc.meshbench", "child",
            "--spec", spec, "--devices", str(devices),
            "--metrics-out", metrics]
@@ -134,10 +152,13 @@ def _run_child(spec: str, cfg: Optional[str], devices: int,
                      + " | ".join(t[:160] for t in tail)}
 
 
-def _gate(metrics_path: str, log=print) -> int:
+def _gate(metrics_path: str, log=print,
+          ignore_phases: Tuple[str, ...] = ()) -> int:
     """Gate one leg's artifact against its saved baseline via
     `python -m jaxmc.obs diff --fail-on-regress` (first run snapshots
-    the baseline, like make bench-check)."""
+    the baseline, like make bench-check).  `ignore_phases` passes
+    through to the diff (the backend-check leg excludes its cold-start
+    compile walls — see jaxmc/backend/check.py)."""
     base = metrics_path.replace(".json", ".baseline.json")
     if not os.path.exists(metrics_path):
         return 0
@@ -149,8 +170,10 @@ def _gate(metrics_path: str, log=print) -> int:
     from .obs.report import main as obs_main
     log(f"meshbench: gating {os.path.basename(metrics_path)} vs "
         f"saved baseline")
-    return obs_main(["diff", "--fail-on-regress", "--threshold", "25",
-                     base, metrics_path])
+    argv = ["diff", "--fail-on-regress", "--threshold", "25"]
+    if ignore_phases:
+        argv += ["--ignore-phases", ",".join(ignore_phases)]
+    return obs_main(argv + [base, metrics_path])
 
 
 def cmd_check(args) -> int:
@@ -313,7 +336,7 @@ def cmd_child(args) -> int:
     from .front.cfg import ModelConfig, parse_cfg
     from .sem.modules import Loader, bind_model
     from .corpus import case_for_cfg
-    from .tpu.mesh import MeshExplorer
+    from .backend.mesh import MeshExplorer
 
     spec = os.path.join(_REPO, args.spec) \
         if not os.path.isabs(args.spec) else args.spec

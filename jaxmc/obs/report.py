@@ -202,12 +202,25 @@ def cmd_report(args, out=sys.stdout) -> int:
             if pt.get("merge"):
                 bits.append(f"merge={pt['merge']}")
             pw = pt.get("phase_walls")
-            if pw:
+            if isinstance(pw, dict):
+                # tolerate missing-phase rows: a probe that hit its cap
+                # early (or an older artifact) reports what it measured
                 bits.append(
-                    f"walls expand={pw.get('expand_s')}s "
-                    f"exchange={pw.get('exchange_s')}s "
-                    f"merge(rank)={pw.get('merge_rank_s')}s "
-                    f"merge(fullsort)={pw.get('merge_fullsort_s')}s")
+                    f"walls expand={pw.get('expand_s', '-')}s "
+                    f"exchange={pw.get('exchange_s', '-')}s "
+                    f"merge(rank)={pw.get('merge_rank_s', '-')}s "
+                    f"merge(fullsort)="
+                    f"{pw.get('merge_fullsort_s', '-')}s")
+                # ISSUE 11 acceptance metric: (expand+merge)/step — the
+                # fused one-level step timed by the same probe
+                if isinstance(pw.get("hot_share"), (int, float)):
+                    bits.append(
+                        f"hot_share={pw['hot_share']:.0%} of "
+                        f"step={pw.get('step_s', '-')}s")
+            elif pw is not None:
+                # a malformed row is a fact about the artifact, not a
+                # rendering crash
+                bits.append(f"walls=(malformed: {type(pw).__name__})")
             print(f"  {key:<28} " + "  ".join(bits), file=out)
         return 0
     env = rec["env"]
@@ -281,15 +294,49 @@ def cmd_report(args, out=sys.stdout) -> int:
               "mesh.phase_expand_s", "mesh.phase_exchange_s",
               "mesh.phase_merge_s", "mesh.phase_merge_rank_s",
               "mesh.phase_merge_fullsort_s",
+              "mesh.phase_step_s", "mesh.phase_hot_share",
+              "backend.oracle_choice", "backend.oracle_wall_s",
               "device.mem_high_water_bytes", "watchdog.max_stall_s"):
         if k in g:
             hl.append(f"{k}={g[k]}")
+    # preflight oracle probes (ISSUE 11 satellite): one cell per
+    # candidate platform — live probes show their dispatch wall, dead
+    # ones the first words of why
+    op = g.get("backend.oracle_probe")
+    if isinstance(op, dict):
+        cells = []
+        for plat, pr in op.items():
+            if isinstance(pr, dict) and pr.get("live"):
+                cells.append(f"{plat}={pr.get('dispatch_s')}s")
+            else:
+                why = (pr or {}).get("error", "?") \
+                    if isinstance(pr, dict) else "?"
+                cells.append(f"{plat}=dead({str(why)[:40]})")
+        hl.append("backend.oracle_probe[" + " ".join(cells) + "]")
     if hl:
         print("highlights: " + "  ".join(hl), file=out)
     return 0 if rows else 1
 
 
 # ------------------------------------------------------------------ diff
+
+def _effective_env(rec: Dict[str, Any]) -> Dict[str, Any]:
+    """The record's env dict with platform/device_count backfilled from
+    the record itself (ISSUE 11 satellite): metrics artifacts written
+    by interp runs (and multichip artifacts, which carry the platform
+    top-level) leave env.platform None, so a backend swap between two
+    artifacts used to surface as an unexplained REGRESS instead of an
+    attributed environment change."""
+    env = dict(rec.get("env") or {})
+    if env.get("platform") is None and rec.get("platform"):
+        env["platform"] = rec["platform"]
+    if env.get("device_count") is None:
+        g = (rec.get("summary") or {}).get("gauges") or {}
+        dc = g.get("mesh.devices") or g.get("device.count")
+        if dc is not None:
+            env["device_count"] = dc
+    return env
+
 
 def _env_changes(a: Dict[str, Any], b: Dict[str, Any]) -> List[str]:
     out = []
@@ -301,10 +348,16 @@ def _env_changes(a: Dict[str, Any], b: Dict[str, Any]) -> List[str]:
 
 
 def find_regressions(prev: Dict[str, Any], cur: Dict[str, Any],
-                     threshold_pct: float) -> List[str]:
+                     threshold_pct: float,
+                     ignore_phases: frozenset = frozenset()
+                     ) -> List[str]:
     """Regression flags between two consecutive records. Environment
     changes are reported alongside each flag so a demotion caused by a
-    jax upgrade (or a dead tunnel) reads as such."""
+    jax upgrade (or a dead tunnel) reads as such.  `ignore_phases`
+    names phases excluded from the per-phase wall gate (cold-start
+    one-shot walls like compile_arm are load-sensitive in a way the
+    measured search window is not — the backend-check gate skips
+    them); the states/sec and demotion gates always apply."""
     flags = []
     step = f"{prev['label']} -> {cur['label']}"
     d = _pct(cur["states_per_sec"], prev["states_per_sec"])
@@ -326,6 +379,8 @@ def find_regressions(prev: Dict[str, Any], cur: Dict[str, Any],
             f"terminally, run completed on the CPU fallback "
             f"({cur['demoted']})")
     for name in sorted(set(prev["phases"]) & set(cur["phases"])):
+        if name in ignore_phases:
+            continue
         pw, cw = prev["phases"][name], cur["phases"][name]
         pd = _pct(cw, pw)
         # absolute floor: a 3 ms parse doubling is noise, not a flag
@@ -334,7 +389,7 @@ def find_regressions(prev: Dict[str, Any], cur: Dict[str, Any],
                 f"REGRESS phase {name} {step}: {_fmt_s(pw)} -> "
                 f"{_fmt_s(cw)} ({pd:+.1f}%)")
     if flags:
-        env = _env_changes(prev["env"], cur["env"])
+        env = _env_changes(_effective_env(prev), _effective_env(cur))
         if env:
             flags.append(f"  note {step}: environment changed "
                          f"({'; '.join(env)})")
@@ -369,6 +424,7 @@ def _diff_multichip(recs: List[Dict[str, Any]], threshold: float,
     flags: List[str] = []
     for prev, cur in zip(recs, recs[1:]):
         step = f"{prev['label']} -> {cur['label']}"
+        step_flagged = False
         for k in keys:
             a, b = prev["curve"].get(k), cur["curve"].get(k)
             if not a or not b:
@@ -376,11 +432,21 @@ def _diff_multichip(recs: List[Dict[str, Any]], threshold: float,
             d = _pct(b.get("states_per_sec_per_chip"),
                      a.get("states_per_sec_per_chip"))
             if d is not None and d < -threshold:
+                step_flagged = True
                 flags.append(
                     f"REGRESS states/sec/chip {k} {step}: "
                     f"{_fmt_rate(a['states_per_sec_per_chip'])} -> "
                     f"{_fmt_rate(b['states_per_sec_per_chip'])} "
                     f"({d:+.1f}%)")
+        if step_flagged:
+            # attribute a platform/device swap (ISSUE 11 satellite): a
+            # cpu-virtual-device baseline diffed against a real-chip
+            # artifact is an environment change, not a bare REGRESS
+            env = _env_changes(_effective_env(prev),
+                               _effective_env(cur))
+            if env:
+                flags.append(f"  note {step}: environment changed "
+                             f"({'; '.join(env)})")
     print("", file=out)
     if flags:
         print("regressions:", file=out)
@@ -415,9 +481,12 @@ def cmd_diff(args, out=sys.stdout) -> int:
         print(f"{r['label']:<{lw}}  "
               f"{_fmt_rate(r['states_per_sec']):>12}  "
               f"{r['platform']:>8}  {cells}", file=out)
+    ignore = frozenset(
+        p for p in (args.ignore_phases or "").split(",") if p)
     flags: List[str] = []
     for prev, cur in zip(recs, recs[1:]):
-        flags.extend(find_regressions(prev, cur, args.threshold))
+        flags.extend(find_regressions(prev, cur, args.threshold,
+                                      ignore_phases=ignore))
     print("", file=out)
     if flags:
         print("regressions:", file=out)
@@ -453,6 +522,11 @@ def main(argv: Optional[List[str]] = None, out=sys.stdout) -> int:
     d.add_argument("--fail-on-regress", action="store_true",
                    help="exit 1 when any REGRESS flag fired (bench/CI "
                         "gate)")
+    d.add_argument("--ignore-phases", default="", metavar="P1,P2",
+                   help="comma-separated phase names excluded from "
+                        "the per-phase wall gate (cold-start compile "
+                        "walls flap with box load; states/sec and "
+                        "demotion gates always apply)")
     args = ap.parse_args(argv)
     try:
         if args.cmd == "report":

@@ -33,7 +33,12 @@ from .schema import SCHEMA  # one source of truth for the artifact schema
 
 def write_json_atomic(path: str, obj) -> None:
     """Dump `obj` as JSON via a sibling tmp file + os.replace, so a
-    crash mid-write never leaves a truncated artifact."""
+    crash mid-write never leaves a truncated artifact.  Creates the
+    parent directory: a bench leg must not burn minutes of measurement
+    and then die because --out-dir didn't exist yet."""
+    parent = os.path.dirname(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
     tmp = path + ".tmp"
     with open(tmp, "w", encoding="utf-8") as fh:
         json.dump(obj, fh, indent=1, sort_keys=False)

@@ -397,8 +397,14 @@ class ServeDaemon:
         — the warm engine's own caps stay valid)."""
         if sess.layout_sig and sess.model is not None:
             from ..compile.cache import load_capacity_profile
+            # profiles are namespaced by backend platform (ISSUE 11):
+            # ask the warm engine's descriptor for the variant the
+            # profile was saved under
+            desc = getattr(sess.engine, "backend_desc", None)
+            variant = desc.profile_variant() if desc is not None else ""
             load_capacity_profile(sess.model.module.name,
-                                  sess.layout_sig, tel=job_tel)
+                                  sess.layout_sig, tel=job_tel,
+                                  variant=variant)
 
     def _run_batch(self, job: Dict[str, Any],
                    followers: List[Dict[str, Any]]) -> None:

@@ -303,6 +303,32 @@ class CheckSession:
         return diags
 
     # ---- stage: compile -----------------------------------------------
+    def resolve_platform(self) -> Optional[str]:
+        """The jax platform this session's device backend should pin
+        (ISSUE 11).  `--backend cpu|gpu|tpu` names it outright;
+        `--backend auto` asks the preflight oracle (jaxmc/backend/
+        oracle.py — tiny compile+dispatch probe per visible platform,
+        seconds, hang-proof) and records the verdict in telemetry;
+        `--backend jax` keeps the historical meaning: --platform /
+        JAXMC_PLATFORM if given, else whatever jax initializes."""
+        b = self.cfg.backend
+        if b in ("cpu", "gpu", "tpu"):
+            return b
+        if b == "auto":
+            from .backend.oracle import preflight
+            with self.tel.span("preflight_oracle"):
+                v = preflight(tel=self.tel)
+            if v["platform"] is None:
+                errs = "; ".join(
+                    f"{p}: {pr.get('error')}"
+                    for p, pr in v["probes"].items())
+                raise RuntimeError(
+                    f"backend oracle found no live platform ({errs})")
+            self.log(f"-- backend oracle: {v['platform']} "
+                     f"({v['reason']}; {v['wall_s']}s)")
+            return v["platform"]
+        return self.cfg.platform
+
     def device_init(self) -> Optional[str]:
         """Device/plugin init with bounded retries + backoff
         (JAXMC_DEVICE_RETRIES, default 2): a flaky accelerator tunnel
@@ -312,16 +338,17 @@ class CheckSession:
         dir (or None)."""
         from . import faults
         cfg, tel = self.cfg, self.tel
+        platform = self.resolve_platform()  # oracle verdict is cached
         retries = int(os.environ.get("JAXMC_DEVICE_RETRIES", "2"))
         for attempt in range(retries + 1):
             try:
                 with tel.span("device_init",
-                              platform=cfg.platform or "default",
+                              platform=platform or "default",
                               attempt=attempt):
                     import jax
                     faults.inject("device_init_fail")
-                    if cfg.platform:
-                        jax.config.update("jax_platforms", cfg.platform)
+                    if platform:
+                        jax.config.update("jax_platforms", platform)
                     # persistent XLA compile cache (repeat runs skip the
                     # per-arm compiles): opt-in via --compile-cache /
                     # JAXMC_COMPILE_CACHE, but GUARDED (ISSUE 5): a
@@ -393,7 +420,7 @@ class CheckSession:
                 self.engine = Explorer(self.model, **kw)
         else:
             self.cache_dir = self.device_init()
-            from .tpu.bfs import TpuExplorer
+            from .backend.bfs import TpuExplorer
             bounds = Bounds(seq_cap=cfg.seq_cap, grow_cap=cfg.grow_cap,
                             kv_cap=cfg.kv_cap)
             with self.tel.span("engine_build"):

@@ -1,1 +1,8 @@
+"""Compatibility shims: the engines moved to jaxmc/backend (ISSUE 11).
 
+`jaxmc/tpu/` was a misnomer the moment the engines ran on cpu-XLA —
+the device layer is now the backend-portable package jaxmc/backend
+({bfs,mesh,multihost} parameterized over a BackendDescriptor).  These
+modules re-export the public surface so existing imports keep working;
+new code should import from jaxmc.backend.
+"""

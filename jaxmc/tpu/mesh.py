@@ -1,2099 +1,7 @@
-r"""Multi-chip BFS over a jax.sharding.Mesh (SURVEY.md §2.3, §5).
+"""Compatibility shim: jaxmc.tpu.mesh moved to jaxmc.backend.mesh
+(ISSUE 11 — the engine layer is backend-portable now).  Import from
+jaxmc.backend.mesh in new code."""
 
-Frontier data-parallelism + fingerprint-space sharding: each device owns
-(a) a shard of the frontier, expanded with the SAME compiled kernels as
-the single-chip path (compile/kernel2.py — wide layouts, slotted dynamic
-\E, capacity buckets), and (b) a hash range of the seen-set, held as
-128-bit fingerprints with an explicit validity lane (never in-band
-sentinels — a valid state's lane can legitimately equal SENTINEL).
+from ..backend.mesh import MeshExplorer  # noqa: F401
 
-Two exchange strategies route each level's candidates to their owner
-shard (chosen per run; `a2a` is the DEFAULT for D > 1,
-JAXMC_MESH_EXCHANGE overrides):
-
-  a2a     hash-routes each candidate straight to its owner via
-          all_to_all with per-peer buckets of B = C*gamma/D (traffic
-          ~C*gamma per device).  Hash skew past gamma lands overflow
-          rows in a small per-peer SPILL bucket drained by a second
-          all_to_all pass (mesh.a2a_spill); only when the spill also
-          overflows is the level rerun with gamma doubled (ISSUE 8).
-  gather  all_gathers every candidate to every device (traffic C*D per
-          device, no routing state); each device keeps the rows whose
-          fingerprint lands in its range — the structural analogue of
-          ring-partitioned attention state (SURVEY.md §5).
-
-MESH-RESIDENT superstep loop (ISSUE 8 tentpole; ISSUE 10 made the hot
-path O(new) and multi-level): the seen shards, the packed frontier and
-the per-level trace ring all stay ON DEVICE across levels; one jitted
-shard_map dispatch runs up to maxlvl levels in a lax.while_loop — each
-level expands, exchanges, RANK-MERGES against the sorted seen shards
-(only the <=R incoming keys are sorted; two binary searches + scatters
-shared with the single-chip resident engine, bfs._rank_merge — sort
-work no longer scales with the seen set; JAXMC_MESH_RANKMERGE=0 keeps
-the PR-8 full-sort as a bit-identical escape hatch, pinned to one
-level per dispatch), appends the trace ring and pushes one replicated
-[16]-i32 scalar vector into a device-side ring.  The host drains that
-ring once per superstep (mesh.host_syncs counts SUPERSTEPS, < level
-count — no row traffic), pre-sizes nothing, and only pulls rows on a
-violation (trace assembly), at a checkpoint, or never.  The loop exits
-early on violation / deadlock / assert / kernel overflow / truncation
-/ empty frontier, so violation localization, SIGTERM drain and
-checkpointing keep their exact level-boundary semantics; capacity
-overflows (seen / frontier / trace ring / a2a bucket) roll the
-offending level back inside the step, so the host can grow the named
-capacity and redo it.  JAXMC_MESH_SUPERSTEP pins the level budget per
-dispatch (1 = the one-level escape hatch); unset, it adapts to
-measured dispatch wall like the single-chip resident controller.
-Learned capacities (and the settled levels-per-dispatch, MSL) persist
-as a profile keyed by (module, layout_sig, D, exchange)
-(compile/cache.py variants), so a second mesh run compiles once and
-reports window_recompiles == 0.
-
-Refinement and temporal PROPERTYs still check on the mesh via the
-LEGACY host loop (the exchanged-candidate stream feeds the same
-host-side stepwise refinement and behavior-graph liveness checkers as
-the single-chip device modes; store_trace required, resume with
-PROPERTYs rejected) — JAXMC_MESH_RESIDENT=0 forces that loop for
-diagnosis.
-
-Parity features (VERDICT r2 #5, preserved by the resident loop):
-  * counterexample TRACES with action provenance: each kept new-frontier
-    row carries its global candidate index (the src lane of the trace
-    ring); a violation replays the shortest path exactly like the
-    single-chip level mode (store_trace=True, default);
-  * NAMED violations: which invariant failed, plus the violating row;
-    deadlock/assert report the offending state row the same way;
-  * checkpoint/resume at level boundaries (--checkpoint/--resume), the
-    TLC states/ equivalent, with full-run count exactness.
-
-The driver validates this path with N virtual CPU devices via
-__graft_entry__.dryrun_multichip (no multi-chip hardware needed) on the
-raft workload; `make multichip-check` / `make multichip-bench`
-(jaxmc/meshbench.py) run the parity and scaling legs.
-"""
-
-from __future__ import annotations
-
-import os
-import time
-from typing import Callable, Dict, List, Optional, Tuple
-
-import numpy as np
-import jax
-import jax.numpy as jnp
-from jax import lax
-from jax.sharding import Mesh, PartitionSpec as P
-
-from .. import obs
-from .. import faults
-from ..sem.modules import Model
-from ..engine.explore import CheckResult, Violation
-from ..compile.vspec import ModeError
-from ..compile.kernel2 import OV_DEMOTED, OV_PACK
-from .bfs import (SENTINEL, TpuExplorer, _LiveGraph, _pow2_at_least,
-                  _rank_merge)
-
-_BIG = np.int32(2 ** 31 - 1)
-
-# device-side scalar ring capacity: the superstep while_loop writes one
-# [_NS] scalar vector per level into a [_SS_RINGCAP, _NS] ring the host
-# drains once per dispatch — the cap bounds levels-per-dispatch (a ring
-# entry is 64 bytes, so the whole ring stays trivially small)
-_SS_RINGCAP = 64
-
-# the mesh capacity-profile shape (compile/cache.py variant
-# "mesh-d<D>-<exchange>"): per-shard seen keys, per-shard frontier rows,
-# trace-ring levels, the a2a bucket factor gamma stored as
-# round(gamma * 16) so the profile stays integer-valued, and MSL — the
-# levels-per-dispatch the superstep controller settled on (ISSUE 10),
-# so a fresh engine skips the 1 -> 2 -> 4 ramp.  Profiles saved before
-# PR 10 simply lack MSL (hints max-merge, absent keys default).
-_MESH_PROFILE_KEYS = ("SC", "FC", "TRL", "GAM16", "MSL")
-
-# resident-step scalar vector layout (one replicated [NS] i32 vector is
-# ALL the host reads per level)
-_S_GEN = 0        # psum generated this level
-_S_NEW = 1        # psum kept-new (post-constraint) this level
-_S_FRONT = 2      # psum next-frontier occupancy
-_S_MAXF = 3       # pmax per-shard next-frontier occupancy (true need)
-_S_MAXS = 4       # pmax per-shard seen occupancy (true need)
-_S_SUMS = 5       # psum seen occupancy
-_S_OVC = 6        # pmax kernel overflow code (OV_*; 0 = none)
-_S_DEAD = 7       # any deadlocked row (int)
-_S_ASSERT = 8     # any failed Assert (int)
-_S_INVMIN = 9     # pmin first-violated invariant index (_BIG = none)
-_S_FOVF = 10      # frontier outgrew FC (redo after growth)
-_S_SOVF = 11      # a seen shard outgrew SC (redo after growth)
-_S_TOVF = 12      # trace ring outgrew TRL (redo after growth)
-_S_AOVF = 13      # a2a bucket AND spill overflowed (redo, gamma grows)
-_S_SPILL = 14     # psum rows drained through the spill pass
-_S_MAXDEST = 15   # pmax per-destination bucket occupancy (a2a)
-_NS = 16
-
-# per-device violation-localization vector (fetched only on violation)
-_A_INVW = 0
-_A_INVSLOT = 1
-_A_DEAD = 2
-_A_DEADSLOT = 3
-_A_ASSERT = 4
-_A_ASRTA = 5
-_A_ASRTF = 6
-_NA = 7
-
-
-class MeshExplorer(TpuExplorer):
-    """BFS with the frontier and seen-set sharded across a device mesh.
-
-    Shares TpuExplorer's whole compile pipeline (layout sampling, slotted
-    kernels, compiled invariants/constraints); only the search loop is
-    mesh-sharded. Dedup is always on 128-bit fingerprints (the key layout
-    the seen shards store)."""
-
-    def __init__(self, model: Model, mesh: Optional[Mesh] = None,
-                 log: Callable[[str], None] = None,
-                 max_states: Optional[int] = None,
-                 progress_every: float = 30.0, store_trace: bool = True,
-                 exchange: Optional[str] = None,
-                 mesh_caps: Optional[Dict[str, int]] = None, **kw):
-        super().__init__(model, log=log, max_states=max_states,
-                         progress_every=progress_every,
-                         store_trace=store_trace, **kw)
-        if mesh is None:
-            mesh = Mesh(np.array(jax.devices()), ("d",))
-        self.mesh = mesh
-        self.D = mesh.devices.size
-        # seen shards store fingerprint keys: force fp mode on any width
-        self.fp_mode = True
-        self.K = 4 + 1
-        # ICI exchange strategy (SURVEY.md §2.3 "communication
-        # scheduling"): a2a is the default whenever the mesh has more
-        # than one device — its traffic is ~C*gamma per device instead
-        # of gather's C*D, and the spill pass makes hash skew cheap.
-        # JAXMC_MESH_EXCHANGE overrides; an explicit constructor arg
-        # outranks both (tests pin each strategy).
-        self._exchange_src = "explicit"
-        if exchange is None:
-            env = os.environ.get("JAXMC_MESH_EXCHANGE", "").strip()
-            if env:
-                exchange, self._exchange_src = env, "JAXMC_MESH_EXCHANGE"
-            else:
-                exchange = "a2a" if self.D > 1 else "gather"
-                self._exchange_src = "default"
-        if exchange not in ("gather", "a2a"):
-            raise ValueError(f"exchange must be 'gather' or 'a2a', "
-                             f"got {exchange!r}")
-        self.exchange = exchange
-        # shard-local merge strategy (ISSUE 10): "rank" keeps each seen
-        # shard's valid prefix SORTED as an invariant and merges only
-        # the ≤R incoming keys by rank (the single-chip resident
-        # engine's O(new) binary-search scatter, shared via
-        # bfs._rank_merge); "fullsort" is the PR-8 full
-        # [SC+R, K+1]-key stable sort, kept as the JAXMC_MESH_RANKMERGE=0
-        # escape hatch (bit-identical counts/traces, pinned by tests).
-        self.merge = "fullsort" \
-            if os.environ.get("JAXMC_MESH_RANKMERGE", "").strip() == "0" \
-            else "rank"
-        # levels per resident dispatch (ISSUE 10 supersteps):
-        # JAXMC_MESH_SUPERSTEP=<n> pins it (1 = the one-level-per-
-        # dispatch escape hatch); unset/auto adapts to measured
-        # dispatch wall like the single-chip resident maxlvl
-        # controller.  The fullsort merge cannot run under the
-        # superstep while_loop (multi-key sort comparators explode XLA
-        # compile time there), so it always runs one level per
-        # dispatch.
-        ss = os.environ.get("JAXMC_MESH_SUPERSTEP", "").strip().lower()
-        self._ss_fixed: Optional[int] = None
-        if ss not in ("", "0", "auto"):
-            try:
-                self._ss_fixed = max(1, min(int(ss), _SS_RINGCAP))
-            except ValueError:
-                self._ss_fixed = None
-        if self.merge == "fullsort":
-            self._ss_fixed = 1
-        self._mesh_maxlvl_warm = 1  # learned levels-per-dispatch ramp
-        self._ss_shrunk = False     # controller ever had to halve?
-        self._supersteps = 0
-        self._superstep_levels_max = 0
-        self._a2a_gamma = 2.0
-        self._mesh_step_cache: Dict[Tuple, Callable] = {}
-        # skewed-hash fault site (ISSUE 8 satellite): when armed, EVERY
-        # state hashes to shard 0 — on both the host init-shard path and
-        # the device routing (one owner formula, so they cannot
-        # disagree) — forcing the a2a spill pass (and, once the spill
-        # overflows, the gamma-doubling rerun) on models far too small
-        # to skew naturally.  Counts/traces must stay exact throughout;
-        # tests/test_mesh_resident.py pins it.
-        self._skew = faults.fire("mesh_skew", devices=self.D) is not None
-        # resident-loop accounting (ISSUE 8 obs satellite)
-        self._spill_rows = 0
-        self._max_bucket = 0
-        self._shard_balance: Optional[float] = None
-        self._lvl_FC: List[int] = []   # expanding FC per ring level
-        # learned mesh capacity profile, keyed (module, layout_sig, D,
-        # exchange): a second mesh run starts at the learned caps and
-        # gamma, so its one warm-up compile covers the run
-        # (window_recompiles == 0).  Max-merged with the caller's
-        # manifest hint (corpus.Case.mesh_caps).
-        self._mesh_caps_hint: Dict[str, int] = dict(mesh_caps or {})
-        if self.cap_profile:
-            from ..compile.cache import load_capacity_profile
-            prof = load_capacity_profile(
-                model.module.name, self._layout_sig(),
-                variant=self._profile_variant(), keys=_MESH_PROFILE_KEYS)
-            if prof:
-                for kk, vv in prof.items():
-                    self._mesh_caps_hint[kk] = max(
-                        int(self._mesh_caps_hint.get(kk, 0)), int(vv))
-        if self._mesh_caps_hint.get("GAM16"):
-            self._a2a_gamma = max(
-                self._a2a_gamma, self._mesh_caps_hint["GAM16"] / 16.0)
-        if self._mesh_caps_hint.get("MSL"):
-            self._mesh_maxlvl_warm = max(
-                self._mesh_maxlvl_warm,
-                min(int(self._mesh_caps_hint["MSL"]), _SS_RINGCAP))
-
-    def _profile_variant(self) -> str:
-        return f"mesh-d{self.D}-{self.exchange}"
-
-    # ---- the sharded level step ----
-    def _a2a_bucket(self, C: int, FC: int) -> int:
-        import math
-        # floor: R = D*B must cover the frontier capacity FC, or a
-        # sparse no-overflow level could hand the next step a frontier
-        # narrower than its compiled shape (review r3)
-        return max(1, math.ceil(C * self._a2a_gamma / self.D),
-                   math.ceil(FC / self.D))
-
-    def _a2a_spill_bucket(self, B: int) -> int:
-        # the spill bucket is deliberately small: it exists to absorb
-        # ordinary hash skew (a few rows past B on a hot shard), not to
-        # double capacity — B//4 keeps the second all_to_all cheap
-        return max(1, B // 4)
-
-    def _owner_from_keys(self, keys: np.ndarray) -> np.ndarray:
-        """THE ownership formula (keys lane 1 mod D) — one definition
-        for every host path; _owner_jnp is its device-side twin (both
-        routes call it, so host and device can never disagree).  The
-        mesh_skew fault collapses it to shard 0 on BOTH paths."""
-        if self._skew:
-            return np.zeros(len(keys), np.int64)
-        return (keys[:, 1].astype(np.uint32) % np.uint32(self.D)) \
-            .astype(np.int64)
-
-    def _owner_jnp(self, key_lane1):
-        """Device-side twin of _owner_from_keys over the keys' lane-1
-        column (traced int32 [N]) — the ONLY place the exchange
-        closures compute ownership."""
-        if self._skew:
-            return jnp.zeros(key_lane1.shape[0], jnp.int32)
-        return (key_lane1.astype(jnp.uint32)
-                % jnp.uint32(self.D)).astype(jnp.int32)
-
-    def _route_fn(self, C: int, FC: int) -> Tuple[Callable, int, int, int]:
-        """Build the exchange closure shared by the legacy and resident
-        steps: route(ckeys, cand, cvalid, me) ->
-        (gkeys [R,K], gcand [R,PW], gsrc [R], spill_local,
-        a2a_ovf_local, maxdest_local, evalid [R]).
-        `evalid` is the EDGE-STREAM validity — every valid exchanged
-        row BEFORE ownership masking (gather replicates the full
-        candidate set, so the host's device-0 read must not lose
-        foreign-owned rows; a2a buckets are disjoint per device and the
-        host concatenates all of them, so per-device validity is
-        already complete).  Returns (route, R, B, SB); B/SB are 0 in
-        gather mode."""
-        D, K, PW = self.D, self.K, self.PW
-        a2a = self.exchange == "a2a"
-        Pw = K + PW + 1  # a2a payload: [keys | packed row | src-index]
-        invalid_key_np = np.concatenate(
-            [np.ones(1, np.int32), np.full(K - 1, SENTINEL, np.int32)])
-        if not a2a:
-            R = D * C
-
-            def route_gather(ckeys, cand, cvalid, me):
-                invalid_key = jnp.asarray(invalid_key_np)
-                # ICI exchange: gather all candidates + keys, keep my
-                # range
-                gcand = lax.all_gather(cand, "d", tiled=True)   # [R, PW]
-                gkeys = lax.all_gather(ckeys, "d", tiled=True)  # [R, K]
-                gsrc = jnp.arange(R, dtype=jnp.int32)
-                gvalid = gkeys[:, 0] == 0     # explicit validity lane
-                owner = self._owner_jnp(gkeys[:, 1])
-                mine = gvalid & (owner == me)
-                # foreign/invalid rows: validity lane 1 (sorts last),
-                # data lanes sentinel so equal keys cannot straddle the
-                # mask
-                gkeys = jnp.where(mine[:, None], gkeys, invalid_key)
-                zero = jnp.zeros((), jnp.int32)
-                return (gkeys, gcand, gsrc, zero, jnp.asarray(False),
-                        zero, gvalid)
-
-            return route_gather, R, 0, 0
-
-        B = self._a2a_bucket(C, FC)
-        SB = self._a2a_spill_bucket(B)
-        R = D * (B + SB)
-
-        def route_a2a(ckeys, cand, cvalid, me):
-            invalid_key = jnp.asarray(invalid_key_np)
-            # hash-route each candidate straight to its owner:
-            # bucket-sort by destination, scatter into [D, B] slots,
-            # one all_to_all; rows past B land in the [D, SB] SPILL
-            # buckets drained by a second all_to_all (ISSUE 8) —
-            # traffic per device: D*(B+SB) = ~C*gamma rows instead of
-            # gather's C*D.
-            dest = jnp.where(cvalid, self._owner_jnp(ckeys[:, 1]), D)
-            sperm = lax.sort(
-                (dest, jnp.arange(C, dtype=jnp.int32)),
-                num_keys=1, is_stable=True)[1]
-            sdest = jnp.take(dest, sperm)
-            counts = jnp.zeros((D + 1,), jnp.int32).at[dest].add(1)
-            excl = jnp.concatenate(
-                [jnp.zeros(1, jnp.int32), jnp.cumsum(counts)[:-1]])
-            pos = jnp.arange(C, dtype=jnp.int32) - jnp.take(excl, sdest)
-            # overflow only when bucket AND spill are exhausted; the
-            # max per-destination occupancy rides the scalar vector so
-            # the host can grow gamma straight to the observed need
-            # (one rerun, not log2 doublings)
-            a2a_ovf = jnp.any(counts[:D] > B + SB)
-            spill_local = jnp.sum(
-                jnp.clip(counts[:D] - B, 0, SB)).astype(jnp.int32)
-            maxdest_local = jnp.max(counts[:D]).astype(jnp.int32)
-            srcid = me.astype(jnp.int32) * C + sperm
-            payload = jnp.concatenate(
-                [jnp.take(ckeys, sperm, axis=0),
-                 jnp.take(cand, sperm, axis=0),
-                 srcid[:, None]], axis=1)              # [C, Pw]
-            slot1 = jnp.where((sdest < D) & (pos < B),
-                              sdest * B + pos, D * B)
-            spos = pos - B
-            slot2 = jnp.where((sdest < D) & (spos >= 0) & (spos < SB),
-                              sdest * SB + spos, D * SB)
-            b1 = jnp.full((D * B + 1, Pw), SENTINEL, jnp.int32)
-            b1 = b1.at[:, 0].set(1)  # invalid slots
-            b1 = b1.at[slot1].set(payload, mode="drop")
-            b2 = jnp.full((D * SB + 1, Pw), SENTINEL, jnp.int32)
-            b2 = b2.at[:, 0].set(1)
-            b2 = b2.at[slot2].set(payload, mode="drop")
-            recv1 = lax.all_to_all(
-                b1[:D * B].reshape(D, B, Pw), "d",
-                split_axis=0, concat_axis=0).reshape(D * B, Pw)
-            recv2 = lax.all_to_all(
-                b2[:D * SB].reshape(D, SB, Pw), "d",
-                split_axis=0, concat_axis=0).reshape(D * SB, Pw)
-            recv = jnp.concatenate([recv1, recv2])     # [R, Pw]
-            gkeys = recv[:, :K]
-            gcand = recv[:, K:K + PW]
-            gsrc = recv[:, K + PW]
-            gvalid = gkeys[:, 0] == 0
-            # routed rows are mine by construction; invalid slots keep
-            # the sorts-last key shape
-            gkeys = jnp.where(gvalid[:, None], gkeys, invalid_key)
-            return (gkeys, gcand, gsrc, spill_local, a2a_ovf,
-                    maxdest_local, gvalid)
-
-        return route_a2a, R, B, SB
-
-    def _exchange_bytes(self, C: int, B: int, SB: int) -> int:
-        """Whole-mesh bytes moved by one level's exchange (host-side,
-        from the static shapes): a2a moves D*(B+SB) payload rows of
-        K+PW+1 words per device; gather replicates C candidate+key rows
-        to every device."""
-        D, K, PW = self.D, self.K, self.PW
-        if self.exchange == "a2a":
-            return D * D * (B + SB) * (K + PW + 1) * 4
-        return D * D * C * (K + PW) * 4
-
-    def _merge_fn(self, SC: int, R: int) -> Callable:
-        """The shard-local merge-dedup shared by both step builders:
-        (seen_keys [SC,K], seen_count scalar, gkeys [R,K], gcand [R,PW],
-        gsrc [R]) -> dict(seen2, seen_count2, front_rows [R,PW],
-        front_rows_u, front_src [R], front_count, new_count).
-
-        Two strategies, bit-identical counts/traces (ISSUE 10, pinned
-        by tests): "rank" (default) shares bfs._rank_merge — the seen
-        shard's sorted-prefix invariant means only the ≤R incoming keys
-        are sorted per level; "fullsort" (JAXMC_MESH_RANKMERGE=0) is
-        the PR-8 full stable sort over [SC+R, K+1] keys.  Both report
-        seen_count2 as the TRUE per-shard need BEFORE any [:SC] crop,
-        so the resident loop's grow-and-rerun path is strategy-blind;
-        both leave constraint-discarded states fingerprinted but never
-        counted, checked, or explored (TLC semantics)."""
-        if self.merge == "rank":
-            return self._merge_rank_fn(SC, R)
-        return self._merge_fullsort_fn(SC, R)
-
-    def _merge_finish_fn(self, R: int):
-        """Shared merge epilogue: constraint-mask the compacted new
-        rows and compact the explore-kept ones to the frontier front.
-        Constraints FIRST: violating states stay fingerprinted in the
-        seen shard but are discarded — not distinct, not checked, not
-        explored (TLC semantics, testout2:265)."""
-        plan = self.plan
-        con_fns = self.constraint_fns
-        inv_fns = self.inv_fns
-
-        def finish(new_rows, new_src, nvalid):
-            new_rows_u = plan.unpack_rows(new_rows) \
-                if (con_fns or inv_fns) else new_rows
-            explore = nvalid
-            for nm, f in con_fns:
-                explore = explore & jax.vmap(f)(new_rows_u)
-            idx4 = jnp.arange(R, dtype=jnp.int32)
-            ops4 = ((1 - explore.astype(jnp.int32)), idx4)
-            comp4 = lax.sort(ops4, num_keys=1, is_stable=True)
-            front_rows = jnp.take(new_rows, comp4[1], axis=0)
-            front_rows_u = jnp.take(new_rows_u, comp4[1], axis=0)
-            front_src = jnp.take(new_src, comp4[1])
-            front_count = jnp.sum(explore)
-            return front_rows, front_rows_u, front_src, front_count
-
-        return finish
-
-    def _merge_rank_fn(self, SC: int, R: int) -> Callable:
-        """O(new) rank-merge (ISSUE 10 tentpole): sort only the ≤R
-        exchanged keys, dedup against the sorted seen prefix with
-        binary searches, scatter the new keys at their ranks — the
-        single-chip resident engine's merge (bfs._rank_merge), shared
-        rather than duplicated.  Sort work no longer scales with the
-        size of the seen shard; single-key stable sorts only, so the
-        superstep while_loop can wrap it."""
-        K = self.K
-        finish = self._merge_finish_fn(R)
-
-        def merge(seen_keys, seen_count, gkeys, gcand, gsrc):
-            rm = _rank_merge(seen_keys, seen_count, gkeys, R, SC, K,
-                             multikey=True)
-            new_count = rm["new_count"]
-            nvalid = jnp.arange(R) < new_count
-            safe = jnp.clip(rm["nk_sidx"], 0, R - 1)
-            new_rows = jnp.take(gcand, safe, axis=0)
-            new_src = jnp.take(gsrc, safe)
-            new_rows = jnp.where(nvalid[:, None], new_rows, SENTINEL)
-            front_rows, front_rows_u, front_src, front_count = \
-                finish(new_rows, new_src, nvalid)
-            return dict(seen2=rm["seen2"],
-                        seen_count2=rm["seen_count2"],
-                        front_rows=front_rows, front_rows_u=front_rows_u,
-                        front_src=front_src, front_count=front_count,
-                        new_count=new_count)
-
-        return merge
-
-    def _merge_fullsort_fn(self, SC: int, R: int) -> Callable:
-        """The PR-8 full-sort merge (JAXMC_MESH_RANKMERGE=0 escape
-        hatch): one stable [SC+R, K+1]-key sort with the seen-first
-        flag tiebreaker, then stable compactions.  The seen INPUT is
-        masked to its valid prefix [0:seen_count) and the OUTPUT tail
-        re-masked invalid, so the shard always satisfies the rank
-        strategy's sorted-valid-prefix invariant (a checkpoint written
-        by either strategy resumes under the other) and stale tail
-        rows can never re-enter the occupancy count."""
-        K = self.K
-        finish = self._merge_finish_fn(R)
-        invalid_key_np = np.concatenate(
-            [np.ones(1, np.int32), np.full(K - 1, SENTINEL, np.int32)])
-
-        def merge(seen_keys, seen_count, gkeys, gcand, gsrc):
-            invalid_key = jnp.asarray(invalid_key_np)
-            srow_valid = jnp.arange(SC) < seen_count
-            seen_keys = jnp.where(srow_valid[:, None], seen_keys,
-                                  invalid_key)
-            allk = jnp.concatenate([seen_keys, gkeys])    # [SC+R, K]
-            flag = jnp.concatenate([jnp.zeros(SC, jnp.int32),
-                                    jnp.ones(R, jnp.int32)])
-            idx0 = jnp.arange(SC + R, dtype=jnp.int32)
-            ops = tuple(allk[:, i] for i in range(K)) + (flag, idx0)
-            sorted_ = lax.sort(ops, num_keys=K + 1, is_stable=True)
-            skeys = jnp.stack(sorted_[:K], axis=1)
-            sflag = sorted_[K]
-            perm = sorted_[K + 1]
-            cidx = perm - SC              # candidate position (<0: seen)
-            rvalid = skeys[:, 0] == 0
-            neq_prev = jnp.concatenate([
-                jnp.array([True]),
-                jnp.any(skeys[1:] != skeys[:-1], axis=1)])
-            new = (sflag == 1) & rvalid & neq_prev
-            new_count = jnp.sum(new)
-
-            # compact the new rows (gather payload by sorted position);
-            # new_src is each new row's GLOBAL candidate index (gsrc
-            # lane) — the provenance the host needs for traces
-            ops2 = ((1 - new.astype(jnp.int32)), cidx)
-            comp = lax.sort(ops2, num_keys=1, is_stable=True)
-            new_cidx = comp[1][:R]
-            safe = jnp.clip(new_cidx, 0, R - 1)
-            new_rows = jnp.take(gcand, safe, axis=0)
-            new_src = jnp.take(gsrc, safe)
-            nvalid = jnp.arange(R) < new_count
-            new_rows = jnp.where(nvalid[:, None], new_rows, SENTINEL)
-
-            # merged seen keys, compacted (keeps key order).  NOTE
-            # seen_count2 counts BEFORE the [:SC] crop, so it reports
-            # the TRUE per-shard need — the resident loop grows SC to
-            # exactly this on overflow
-            keep = ((sflag == 0) & rvalid) | new
-            ops3 = ((1 - keep.astype(jnp.int32)),) + \
-                tuple(skeys[:, i] for i in range(K))
-            comp3 = lax.sort(ops3, num_keys=1, is_stable=True)
-            seen2 = jnp.stack(comp3[1:], axis=1)[:SC]
-            seen_count2 = jnp.sum(keep)
-            out_valid = jnp.arange(SC) < seen_count2
-            seen2 = jnp.where(out_valid[:, None], seen2, invalid_key)
-
-            front_rows, front_rows_u, front_src, front_count = \
-                finish(new_rows, new_src, nvalid)
-            return dict(seen2=seen2, seen_count2=seen_count2,
-                        front_rows=front_rows, front_rows_u=front_rows_u,
-                        front_src=front_src, front_count=front_count,
-                        new_count=new_count)
-
-        return merge
-
-    def _inv_scan(self, front_rows_u, front_count, R: int):
-        """Named invariants: index of the FIRST cfg invariant any kept
-        row violates, plus the first violating slot."""
-        frontvalid = jnp.arange(R) < front_count
-        inv_which = jnp.int32(_BIG)
-        inv_slot = jnp.int32(-1)
-        for i, (nm, f) in enumerate(self.inv_fns):
-            bad = frontvalid & ~jax.vmap(f)(front_rows_u)
-            anyb = jnp.any(bad)
-            hit = anyb & (inv_which == _BIG)
-            inv_which = jnp.where(hit, jnp.int32(i), inv_which)
-            inv_slot = jnp.where(hit,
-                                 jnp.argmax(bad).astype(jnp.int32),
-                                 inv_slot)
-        return inv_which, inv_slot
-
-    def _shard_map(self):
-        try:
-            from jax import shard_map
-        except ImportError:  # older jax
-            from jax.experimental.shard_map import shard_map
-        return shard_map
-
-    def _get_mesh_step(self, SC: int, FC: int,
-                       out_cap: Optional[int] = None) -> Callable:
-        """The LEGACY exchange step: out_cap=None drives the host-loop
-        modes (refinement/temporal PROPERTYs — _run_hostloop); out_cap
-        set is the MULTI-HOST variant (tpu/multihost.py): the new
-        frontier is cropped on device to a fixed [out_cap] shard so the
-        host never needs non-addressable remote rows, and extra
-        REPLICATED flags (psum'd over the DCN+ICI axis) are appended to
-        the outputs: any_inv, fixed_ovf (a frontier/seen shard outgrew
-        its fixed capacity, incl. a2a bucket+spill overflow), any_dead,
-        any_assert."""
-        C = self.A * FC
-        route, R, B, SB = self._route_fn(C, FC)
-        key = (SC, FC, B, SB, out_cap)
-        if key in self._mesh_step_cache:
-            return self._mesh_step_cache[key]
-        K, D, PW = self.K, self.D, self.PW
-        plan = self.plan
-        con_fns = self.constraint_fns
-        block_fn = self._candidate_block_fn(FC)
-        merge_fn = self._merge_fn(SC, R)
-        # refinement/temporal PROPERTYs: stream every exchanged
-        # candidate (revisits included) to the host, which runs the SAME
-        # stepwise refinement and behavior-graph checkers as the
-        # single-chip device modes (r4; closes VERDICT r3 #9)
-        need_edges = (out_cap is None and
-                      (bool(self.refiners) or self.collect_edges))
-
-        def device_step(seen_keys, seen_count, frontier_p, fcount):
-            # per-device blocks: seen_keys [SC,K], seen_count [1],
-            # frontier [FC,PW], fcount [1]
-            seen_keys = seen_keys.reshape(SC, K)
-            frontier = plan.unpack_rows(frontier_p.reshape(FC, PW))
-            me = lax.axis_index("d")
-            fvalid = jnp.arange(FC) < fcount[0]
-            blk = block_fn(frontier, fvalid)
-            overflow = blk["overflow"]
-            dead = blk["dead"]
-            dead_local = jnp.any(dead)
-            dead_slot = blk["dead_slot"]
-            assert_bad = blk["assert_bad"]
-            asrt_a, asrt_f = blk["asrt_a"], blk["asrt_f"]
-            gen_local = blk["gen_local"]
-
-            (gkeys, gcand, gsrc, spill_local, a2a_ovf, _maxdest,
-             evalid) = route(blk["ckeys"], blk["cand"], blk["cvalid"],
-                             me)
-
-            mg = merge_fn(seen_keys, seen_count[0], gkeys, gcand, gsrc)
-            seen2 = mg["seen2"]
-            seen_count2 = mg["seen_count2"]
-            front_rows = mg["front_rows"]
-            front_rows_u = mg["front_rows_u"]
-            front_src = mg["front_src"]
-            front_count = mg["front_count"]
-            inv_which, inv_slot = self._inv_scan(front_rows_u,
-                                                 front_count, R)
-
-            # global totals over ICI; violation flags stay PER-DEVICE so
-            # the host can locate the offending device's row/provenance
-            tot_gen = lax.psum(gen_local, "d")
-            tot_new = lax.psum(front_count, "d")
-            any_ovf = lax.pmax(overflow, "d")  # 0 = none, else max OV_*
-            tot_front = lax.psum(front_count, "d")
-            tot_spill = lax.psum(spill_local, "d")
-
-            any_a2a_ovf = lax.psum(a2a_ovf.astype(jnp.int32), "d") > 0
-            if out_cap is not None:
-                # multi-host: fixed-capacity frontier shard + replicated
-                # abort flags — the host loop reads ONLY replicated
-                # scalars and its own addressable shards. a2a bucket+
-                # spill overflow folds into the fixed-capacity abort
-                # (the multi-host loop cannot re-run a level, so it
-                # aborts loudly instead of retrying with a larger
-                # gamma).
-                fixed_ovf = lax.psum(
-                    ((front_count > out_cap) | (seen_count2 > SC) |
-                     a2a_ovf).astype(jnp.int32), "d") > 0
-                any_inv = lax.psum(
-                    (inv_which != _BIG).astype(jnp.int32), "d") > 0
-                any_dead = lax.psum(
-                    dead_local.astype(jnp.int32), "d") > 0
-                any_assert = lax.psum(
-                    assert_bad.astype(jnp.int32), "d") > 0
-                # indices 0-11 are the r4 surface; 12-19 add PER-DEVICE
-                # provenance (each process reads only its own shards) so
-                # the multi-host loop can assemble exact counterexample
-                # traces via the process-allgather protocol
-                # (multihost.py, VERDICT r4 #7); 20 is the psum'd spill
-                # row count (ISSUE 8)
-                return (seen2.reshape(1, SC, K), seen_count2.reshape(1),
-                        front_rows[:out_cap].reshape(1, out_cap, PW),
-                        front_count.reshape(1),
-                        tot_gen.reshape(1), tot_new.reshape(1),
-                        any_ovf.reshape(1), tot_front.reshape(1),
-                        fixed_ovf.reshape(1), any_inv.reshape(1),
-                        any_dead.reshape(1), any_assert.reshape(1),
-                        front_src[:out_cap].reshape(1, out_cap),
-                        inv_which.reshape(1), inv_slot.reshape(1),
-                        dead_local.reshape(1), dead_slot.reshape(1),
-                        assert_bad.reshape(1), asrt_a.reshape(1),
-                        asrt_f.reshape(1), tot_spill.reshape(1))
-            out = (seen2.reshape(1, SC, K), seen_count2.reshape(1),
-                   front_rows.reshape(1, R, PW), front_count.reshape(1),
-                   front_src.reshape(1, R),
-                   tot_gen.reshape(1), tot_new.reshape(1),
-                   dead_local.reshape(1), dead_slot.reshape(1),
-                   assert_bad.reshape(1), asrt_a.reshape(1),
-                   asrt_f.reshape(1), any_ovf.reshape(1),
-                   inv_which.reshape(1), inv_slot.reshape(1),
-                   tot_front.reshape(1), any_a2a_ovf.reshape(1),
-                   tot_spill.reshape(1))
-            if need_edges:
-                # every exchanged candidate row + its explore mask +
-                # global source index — the host-side edge stream.
-                # gather mode: identical on every device (host reads
-                # device 0); a2a: each device holds its own bucket.
-                # `evalid` is the PRE-ownership validity from the
-                # route: gkeys is already masked to owner-local rows,
-                # and recomputing validity from it would silently drop
-                # foreign-owned edges from the device-0 read
-                # (review r8).
-                exp_all = evalid
-                gcand_u = plan.unpack_rows(gcand)
-                for nm, f in con_fns:
-                    exp_all = exp_all & jax.vmap(f)(gcand_u)
-                out = out + (gcand.reshape(1, R, PW),
-                             exp_all.reshape(1, R),
-                             gsrc.reshape(1, R))
-            return out
-
-        shard_map = self._shard_map()
-        n_out = 21 if out_cap is not None else \
-            (21 if need_edges else 18)
-        step = jax.jit(shard_map(
-            device_step, mesh=self.mesh,
-            in_specs=(P("d"), P("d"), P("d"), P("d")),
-            out_specs=tuple([P("d")] * n_out)))
-        self._mesh_step_cache[key] = step
-        return step
-
-    def _get_mesh_resident_step(self, SC: int, FC: int,
-                                TRL: int) -> Callable:
-        """The MESH-RESIDENT superstep (ISSUE 8 tentpole, ISSUE 10
-        multi-level fusion): one jitted shard_map dispatch that runs UP
-        TO `maxlvl` levels in a lax.while_loop — each level expands,
-        exchanges, merge-dedups against the seen shards and appends the
-        per-level trace ring IN PLACE — and returns the full device
-        state plus a device-side RING of per-level scalar vectors the
-        host drains once per superstep (the only thing it reads on the
-        clean path).  The loop exits early on violation / deadlock /
-        assert / kernel overflow / truncation / empty frontier, and on
-        any capacity overflow (seen / frontier / trace ring / a2a
-        bucket+spill) the offending level rolls back inside the step
-        (its outputs == its inputs), so rollback, violation
-        localization, drain and checkpointing keep their exact
-        one-level-per-dispatch semantics.
-
-        maxlvl, the level budget per dispatch, is a TRACED argument
-        (like the single-chip resident maxlvl) so the host adapts it
-        without recompiling.  The "fullsort" merge strategy cannot live
-        inside a while_loop (multi-key sort comparators explode XLA
-        compile time there), so it compiles the single-level body
-        applied once — the one-level-per-dispatch escape-hatch program
-        — with the identical ring-of-one output surface."""
-        C = self.A * FC
-        route, R, B, SB = self._route_fn(C, FC)
-        with_trace = self.store_trace
-        superstep = self.merge == "rank"
-        key = ("res", SC, FC, TRL, B, SB, with_trace, self.merge)
-        if key in self._mesh_step_cache:
-            return self._mesh_step_cache[key]
-        K, D, PW = self.K, self.D, self.PW
-        plan = self.plan
-        block_fn = self._candidate_block_fn(FC)
-        merge_fn = self._merge_fn(SC, R)
-        check_deadlock = self.model.check_deadlock
-
-        def device_step(seen_keys, seen_count, frontier_p, fcount,
-                        *rest):
-            if with_trace:
-                tr_rows = rest[0].reshape(TRL, FC, PW)
-                tr_src = rest[1].reshape(TRL, FC)
-                lvl0, maxlvl, dist0, max_states = rest[2:]
-            else:
-                tr_rows = tr_src = None
-                lvl0, maxlvl, dist0, max_states = rest
-            seen_keys = seen_keys.reshape(SC, K)
-            frontier_p = frontier_p.reshape(FC, PW)
-            seen_count0 = seen_count[0]
-            fcount0 = fcount[0]
-            me = lax.axis_index("d")
-
-            def one_level(seen_keys, seen_count, frontier_p, fcount,
-                          tr_rows, tr_src, lvl, dist):
-                """One BFS level (the PR-8 step body): returns the
-                committed-or-rolled-back state, the level's scalar
-                vector, the localization vector, and the replicated
-                stop verdict."""
-                frontier = plan.unpack_rows(frontier_p)
-                fvalid = jnp.arange(FC) < fcount
-                blk = block_fn(frontier, fvalid)
-                dead_local = (jnp.any(blk["dead"]) if check_deadlock
-                              else jnp.asarray(False))
-
-                (gkeys, gcand, gsrc, spill_local, a2a_ovf, maxdest,
-                 _evalid) = route(blk["ckeys"], blk["cand"],
-                                  blk["cvalid"], me)
-
-                mg = merge_fn(seen_keys, seen_count, gkeys, gcand, gsrc)
-                front_rows = mg["front_rows"]
-                front_count = mg["front_count"]
-                front_src = mg["front_src"]
-                seen_count2 = mg["seen_count2"]
-                inv_which, inv_slot = self._inv_scan(mg["front_rows_u"],
-                                                     front_count, R)
-
-                # ---- capacity verdicts (replicated) ----
-                f_ovf = lax.psum((front_count > FC).astype(jnp.int32),
-                                 "d") > 0
-                s_ovf = lax.psum((seen_count2 > SC).astype(jnp.int32),
-                                 "d") > 0
-                t_ovf = (jnp.asarray(with_trace) & (lvl >= TRL)) \
-                    if with_trace else jnp.asarray(False)
-                any_a2a_ovf = lax.psum(a2a_ovf.astype(jnp.int32),
-                                       "d") > 0
-                grow = f_ovf | s_ovf | t_ovf | any_a2a_ovf
-                commit = ~grow
-
-                # ---- commit or roll back the device state ----
-                seen_out = jnp.where(commit, mg["seen2"], seen_keys)
-                seen_count_out = jnp.where(commit, seen_count2,
-                                           seen_count)
-                new_frontier = front_rows[:FC]   # R >= FC by the floors
-                # ring src rows keep the documented -1-means-empty
-                # convention: slots past front_count hold compaction
-                # leftovers (nonnegative), and an unmasked write would
-                # make _ring_levels' occupied-prefix trim inert
-                # (review r8)
-                new_src_fc = jnp.where(
-                    jnp.arange(FC) < front_count,
-                    front_src[:FC], -1).astype(jnp.int32)
-                frontier_out = jnp.where(commit, new_frontier,
-                                         frontier_p)
-                fcount_out = jnp.where(commit, front_count, fcount)
-                if with_trace:
-                    wl = jnp.clip(lvl, 0, TRL - 1)
-                    tr_rows2 = lax.dynamic_update_slice(
-                        tr_rows, new_frontier[None], (wl, 0, 0))
-                    tr_src2 = lax.dynamic_update_slice(
-                        tr_src, new_src_fc[None], (wl, 0))
-                    tr_rows_out = jnp.where(commit, tr_rows2, tr_rows)
-                    tr_src_out = jnp.where(commit, tr_src2, tr_src)
-                else:
-                    tr_rows_out = tr_src_out = None
-
-                # ---- the per-level scalar vector (replicated) ----
-                tot_new = lax.psum(front_count, "d")
-                ovc = lax.pmax(blk["overflow"], "d")
-                tot_dead = lax.psum(dead_local.astype(jnp.int32), "d")
-                tot_assert = lax.psum(
-                    blk["assert_bad"].astype(jnp.int32), "d")
-                inv_min = lax.pmin(inv_which, "d")
-                scal = jnp.zeros((_NS,), jnp.int32)
-                scal = scal.at[_S_GEN].set(
-                    lax.psum(blk["gen_local"], "d"))
-                scal = scal.at[_S_NEW].set(tot_new)
-                scal = scal.at[_S_FRONT].set(tot_new)
-                scal = scal.at[_S_MAXF].set(lax.pmax(front_count, "d"))
-                scal = scal.at[_S_MAXS].set(lax.pmax(seen_count2, "d"))
-                scal = scal.at[_S_SUMS].set(lax.psum(seen_count2, "d"))
-                scal = scal.at[_S_OVC].set(ovc)
-                scal = scal.at[_S_DEAD].set(tot_dead)
-                scal = scal.at[_S_ASSERT].set(tot_assert)
-                scal = scal.at[_S_INVMIN].set(inv_min)
-                scal = scal.at[_S_FOVF].set(f_ovf.astype(jnp.int32))
-                scal = scal.at[_S_SOVF].set(s_ovf.astype(jnp.int32))
-                scal = scal.at[_S_TOVF].set(t_ovf.astype(jnp.int32))
-                scal = scal.at[_S_AOVF].set(
-                    any_a2a_ovf.astype(jnp.int32))
-                scal = scal.at[_S_SPILL].set(
-                    lax.psum(spill_local, "d"))
-                scal = scal.at[_S_MAXDEST].set(lax.pmax(maxdest, "d"))
-
-                # per-device localization vector (fetched only on
-                # violation — always the LAST executed level's, because
-                # every violation stops the superstep)
-                aux = jnp.zeros((_NA,), jnp.int32)
-                aux = aux.at[_A_INVW].set(inv_which)
-                aux = aux.at[_A_INVSLOT].set(inv_slot)
-                aux = aux.at[_A_DEAD].set(dead_local.astype(jnp.int32))
-                aux = aux.at[_A_DEADSLOT].set(blk["dead_slot"])
-                aux = aux.at[_A_ASSERT].set(
-                    blk["assert_bad"].astype(jnp.int32))
-                aux = aux.at[_A_ASRTA].set(blk["asrt_a"])
-                aux = aux.at[_A_ASRTF].set(blk["asrt_f"])
-
-                # ---- superstep exit verdict (replicated) ----
-                dist2 = jnp.where(commit, dist + tot_new, dist)
-                viol = (inv_min != _BIG) | (tot_dead > 0) | \
-                    (tot_assert > 0) | (ovc != 0)
-                trunc = commit & (max_states > 0) & \
-                    (dist2 >= max_states)
-                done = commit & (tot_new == 0)
-                stop = grow | viol | trunc | done
-                lvl2 = jnp.where(commit, lvl + 1, lvl)
-                return (seen_out, seen_count_out, frontier_out,
-                        fcount_out, tr_rows_out, tr_src_out, lvl2,
-                        dist2, scal, aux, stop)
-
-            ring0 = jnp.zeros((_SS_RINGCAP, _NS), jnp.int32)
-            aux0 = jnp.zeros((_NA,), jnp.int32)
-
-            if superstep:
-                # one body serves both trace configurations: without
-                # tracing the two trace-ring carry slots hold scalar
-                # dummies that thread through unchanged (while_loop
-                # carries need consistent pytrees; one_level never
-                # touches its tr args when with_trace is False)
-                def body(carry):
-                    (sk, sc_, fp, fc_, trr, trs, lvl, dist, nlv, ring,
-                     aux, stop) = carry
-                    (sk, sc_, fp, fc_, trr2, trs2, lvl, dist, scal,
-                     aux, stop) = one_level(
-                        sk, sc_, fp, fc_,
-                        trr if with_trace else None,
-                        trs if with_trace else None, lvl, dist)
-                    if with_trace:
-                        trr, trs = trr2, trs2
-                    ring = lax.dynamic_update_slice(ring, scal[None],
-                                                    (nlv, 0))
-                    return (sk, sc_, fp, fc_, trr, trs, lvl, dist,
-                            nlv + 1, ring, aux, stop)
-
-                def cond(carry):
-                    nlv, stop = carry[8], carry[11]
-                    return (~stop) & (nlv < jnp.minimum(
-                        maxlvl, jnp.int32(_SS_RINGCAP)))
-
-                dummy = jnp.int32(0)
-                carry0 = (seen_keys, seen_count0, frontier_p, fcount0,
-                          tr_rows if with_trace else dummy,
-                          tr_src if with_trace else dummy,
-                          lvl0, dist0, jnp.int32(0), ring0, aux0,
-                          jnp.asarray(False))
-                carry = lax.while_loop(cond, body, carry0)
-                (seen_f, seen_count_f, frontier_f, fcount_f) = carry[:4]
-                tr_rows_f, tr_src_f = (carry[4], carry[5]) \
-                    if with_trace else (None, None)
-                nlv_f, ring_f, aux_f = carry[8], carry[9], carry[10]
-            else:
-                # fullsort escape hatch: the identical body, applied
-                # once outside any while_loop — a ring of one entry
-                (seen_f, seen_count_f, frontier_f, fcount_f, tr_rows_f,
-                 tr_src_f, _lvl, _dist, scal, aux_f, _stop) = one_level(
-                    seen_keys, seen_count0, frontier_p, fcount0,
-                    tr_rows, tr_src, lvl0, dist0)
-                ring_f = lax.dynamic_update_slice(ring0, scal[None],
-                                                  (0, 0))
-                nlv_f = jnp.int32(1)
-
-            outs = [seen_f.reshape(1, SC, K),
-                    seen_count_f.reshape(1),
-                    frontier_f.reshape(1, FC, PW),
-                    fcount_f.reshape(1)]
-            if with_trace:
-                outs.append(tr_rows_f.reshape(1, TRL, FC, PW))
-                outs.append(tr_src_f.reshape(1, TRL, FC))
-            outs.append(ring_f.reshape(1, _SS_RINGCAP, _NS))
-            outs.append(nlv_f.reshape(1))
-            outs.append(aux_f.reshape(1, _NA))
-            return tuple(outs)
-
-        shard_map = self._shard_map()
-        n_in = 10 if with_trace else 8
-        n_out = 9 if with_trace else 7
-        in_specs = tuple([P("d")] * (n_in - 4)) + (P(), P(), P(), P())
-        # donate the big device buffers — seen, frontier, trace ring —
-        # so XLA updates them in place across levels (accelerators;
-        # XLA:CPU ignores donation with a warning, JAXMC_DONATE forces)
-        donate = ((0, 2, 4, 5) if with_trace else (0, 2)) \
-            if self.donate else ()
-        # check_rep=False: shard_map's replication checker has no rule
-        # for lax.while_loop (the superstep level loop); every output
-        # is P("d")-sharded anyway, so nothing relied on inferred
-        # replication
-        step = jax.jit(shard_map(
-            device_step, mesh=self.mesh,
-            in_specs=in_specs,
-            out_specs=tuple([P("d")] * n_out),
-            check_rep=False),
-            donate_argnums=donate)
-        self._mesh_step_cache[key] = step
-        return step
-
-    def _init_shards(self, init_rows: np.ndarray, explored_idx,
-                     D: int, SC: int, FC: int,
-                     keys=None, packed=None, owner=None):
-        """Host-side initial shard construction shared by the
-        single-controller run() and the multi-host loop
-        (tpu/multihost.py): per-owner frontier fill and lexsorted seen
-        keys with the validity-lane-1 empty-slot convention. One layout
-        rule, so host and device dedup can never diverge. Returns
-        (seen [D,SC,K], frontier [D,FC,PW], fcount [D],
-        seen_counts [D]) as numpy — the per-shard valid-prefix lengths
-        the merge strategies key on, returned here so no caller
-        re-derives them from the validity lane."""
-        K = self.K
-        if keys is None:
-            keys, packed, povf = self._host_keys(init_rows)
-            if povf:
-                from ..compile.vspec import CompileError
-                raise CompileError(self._pack_ovf_msg())
-            owner = self._owner_from_keys(keys)
-        exp = np.zeros(len(init_rows), bool)
-        exp[np.asarray(explored_idx, int)] = True
-        frontier = np.full((D, FC, self.PW), SENTINEL, np.int32)
-        seen = np.full((D, SC, K), SENTINEL, np.int32)
-        seen[:, :, 0] = 1  # empty slots: validity lane 1
-        fcount = np.zeros((D,), np.int32)
-        seen_counts = np.zeros((D,), np.int32)
-        for d in range(D):
-            p = packed[(owner == d) & exp]
-            frontier[d, :len(p)] = p
-            k = keys[owner == d]
-            if len(k):
-                order = np.lexsort(tuple(k[:, i]
-                                         for i in reversed(range(K))))
-                seen[d, :len(k)] = k[order]
-            fcount[d] = len(p)
-            seen_counts[d] = len(k)
-        return seen, frontier, fcount, seen_counts
-
-    # ---- trace reconstruction (host side) ----
-    #
-    # self._levels[L] = (rows [D, cap_L, W] np, src [D, cap_L] np | None).
-    # Level 0 holds the initial frontier (src None). For L >= 1, slot i on
-    # device d holds global candidate index g = src[d][i]; with C_L =
-    # A * FC_L (the expanding level's capacity): source device g // C_L,
-    # candidate c = g % C_L, action c // FC_L, parent slot c % FC_L.
-    # The resident loop materializes _levels lazily from the device
-    # trace ring (one pull, only on a violation or checkpoint).
-
-    def _mesh_trace_to(self, dev: int, slot: int, depth: int,
-                       extra: Optional[Tuple[Dict, str]] = None):
-        if not self.store_trace:
-            return None
-        out = []
-        d, i = dev, slot
-        for lvl in range(depth, -1, -1):
-            rows, src, FC = self._levels[lvl]
-            st = self.layout.decode_packed(np.asarray(rows[d][i]))
-            if lvl == 0:
-                out.append((st, "Initial predicate"))
-            else:
-                g = int(src[d][i])
-                C = self.A * FC
-                a = (g % C) // FC
-                out.append((st, self.labels_flat[a]))
-                d, i = g // C, (g % C) % FC
-        out.reverse()
-        if extra is not None:
-            out.append(extra)
-        return out
-
-    def _mesh_refine_edges(self, frontier_np, ecand, eexp, esrc,
-                           FC, depth):
-        """Stepwise refinement over this level's explored candidate
-        edges — the host runs the SAME checkers as the single-chip
-        modes, with parents resolved through the global source index
-        (g -> source device, action, frontier slot)."""
-        C = self.A * FC
-        idxs = np.nonzero(eexp)[0]
-        if not len(idxs):
-            return None
-        parents: Dict[Tuple[int, int], dict] = {}
-        if len(self._ref_pair_cache) > (1 << 20):
-            self._ref_pair_cache.clear()
-        for c in idxs:
-            g = int(esrc[c])
-            d_src, cc = g // C, g % C
-            a, f = cc // FC, cc % FC
-            key = (frontier_np[d_src, f].tobytes(), ecand[c].tobytes())
-            if key in self._ref_pair_cache:
-                continue
-            self._ref_pair_cache.add(key)
-            pst = parents.get((d_src, f))
-            if pst is None:
-                pst = self.layout.decode_packed(frontier_np[d_src, f])
-                parents[(d_src, f)] = pst
-            sst = self.layout.decode_packed(ecand[c])
-            for rc in self.refiners:
-                if not rc.check_edge(pst, sst):
-                    trace = self._mesh_trace_to(
-                        d_src, f, depth,
-                        extra=(sst, self.labels_flat[a]))
-                    return self._viol("property", rc.name, trace,
-                                      self._refine_msg(rc))
-        return None
-
-    def _viol(self, kind, name, trace, msg=None):
-        if trace is None:
-            note = (f"{kind} found (mesh traces disabled by "
-                    f"store_trace=False)")
-            return Violation(kind, name, [], msg or note)
-        return Violation(kind, name, trace, msg)
-
-    # ---- checkpoint/resume (level boundaries) ----
-
-    def _mesh_ck(self, seen, seen_counts, frontier, fcount, FC, SC,
-                 depth, generated, distinct):
-        self._write_ck(
-            "mesh", D=self.D, FC=FC, SC=SC, depth=depth,
-            generated=generated, distinct=distinct,
-            seen=np.asarray(seen), seen_counts=np.asarray(seen_counts),
-            frontier=np.asarray(frontier), fcount=np.asarray(fcount),
-            levels=self._levels if self.store_trace else None)
-
-    def run(self) -> CheckResult:
-        # the edge stream feeds refiners and non-[]P liveness; []P-only
-        # obligations still need the behavior-graph STATES (per-level
-        # kept rows), so the mode guards key on the wider condition
-        need_edges = bool(self.refiners) or self.collect_edges
-        need_props = bool(self.refiners) or bool(self.live_obligations)
-        # per-RUN accounting: the final gauges (_mk) must describe THIS
-        # run — a warm re-run (bench timed window) must not inherit the
-        # warm-up's spill/bucket peaks (review r8).  Learned caps and
-        # gamma deliberately persist on the instance.
-        self._spill_rows = 0
-        self._max_bucket = 0
-        self._shard_balance = None
-        self._supersteps = 0
-        self._superstep_levels_max = 0
-        self._ss_shrunk = False
-        # chosen strategy + gamma, once per run (ISSUE 8 satellite)
-        resident = not (need_props or need_edges or
-                        os.environ.get("JAXMC_MESH_RESIDENT", "1")
-                        == "0")
-        self.log(f"-- mesh: {self.D} device(s), exchange="
-                 f"{self.exchange} ({self._exchange_src}), "
-                 f"gamma={self._a2a_gamma:g}, merge={self.merge}, "
-                 f"loop={'resident' if resident else 'host'}"
-                 + (" [mesh_skew fault armed]" if self._skew else ""))
-        tel = obs.current()
-        tel.gauge("mesh.exchange", self.exchange)
-        tel.gauge("mesh.devices", self.D)
-        # the mesh engine's own strategy stamps (ISSUE 10 satellite):
-        # TpuExplorer.__init__ gauges dedup.mode BEFORE the mesh
-        # subclass forces fp128 keys, so multichip artifacts carried a
-        # stale (or, under serve/bench telemetry scoping, no) value —
-        # re-stamp both here so `obs report` highlights name the dedup
-        # and merge strategy that actually ran
-        tel.gauge("dedup.mode",
-                  "fp128" + ("-view" if self.view_fn is not None
-                             else ("-packed" if not self.plan.identity
-                                   else "")))
-        tel.gauge("mesh.merge", self.merge)
-        if resident:
-            return self._run_mesh_resident()
-        return self._run_hostloop(need_edges, need_props)
-
-    # ------------------------------------------------------------------
-    # the MESH-RESIDENT loop (ISSUE 8 tentpole)
-    # ------------------------------------------------------------------
-
-    def _pad_dev(self, arr, axis: int, newdim: int, fill: int,
-                 lane1: bool = False):
-        """Grow a [D, ...] device array along `axis` with constant fill
-        (validity-lane-1 empty-slot convention for seen shards)."""
-        shape = list(arr.shape)
-        shape[axis] = newdim - shape[axis]
-        pad = np.full(shape, fill, np.int32)
-        if lane1:
-            pad[..., 0] = 1
-        return jnp.concatenate([arr, jnp.asarray(pad)], axis=axis)
-
-    def _ring_levels(self, tr_rows, tr_src, upto: int) -> None:
-        """Materialize self._levels[1..upto] from the device trace ring
-        — the ONE row pull a violating/checkpointing resident run pays
-        (mesh.row_syncs)."""
-        if not self.store_trace or upto <= 0:
-            return
-        tel = obs.current()
-        tel.counter("mesh.row_syncs")
-        rows_np = np.asarray(tr_rows)   # [D, TRL, FC, PW]
-        src_np = np.asarray(tr_src)     # [D, TRL, FC]
-        del self._levels[1:]
-        for l in range(upto):
-            # trim to the occupied prefix (src == -1 marks empty slots)
-            occ = np.nonzero((src_np[:, l] >= 0).any(axis=0))[0]
-            keep = int(occ.max()) + 1 if len(occ) else 1
-            self._levels.append((rows_np[:, l, :keep].copy(),
-                                 src_np[:, l, :keep].copy(),
-                                 self._lvl_FC[l]))
-
-    def _run_mesh_resident(self) -> CheckResult:
-        t0 = time.time()
-        tel = obs.current()
-        model = self.model
-        D, K, PW = self.D, self.K, self.PW
-        warnings = ["mesh backend: dedup on 128-bit fingerprints; "
-                    "collision probability < n^2 * 2^-129"]
-        warnings.extend(self._temporal_warnings())
-        warnings.extend(self._symmetry_warnings())
-
-        init_rows, explored_init, n_init, err = \
-            self._prepare_init(t0, warnings)
-        if err is not None:
-            return err
-        generated = n_init
-        explored_mask = np.zeros(n_init, bool)
-        explored_mask[explored_init] = True
-        distinct = int(explored_mask.sum())
-
-        self._levels: List[Tuple[np.ndarray, Optional[np.ndarray], int]] \
-            = []
-        self._lvl_FC = []
-        hint = self._mesh_caps_hint
-
-        if self.resume_from:
-            ck = self._load_ck("mesh")
-            if ck["D"] != D:
-                raise ValueError(
-                    f"cannot resume: checkpoint has {ck['D']} devices, "
-                    f"mesh has {D}")
-            FC = max(ck["FC"], _pow2_at_least(
-                int(hint.get("FC", 1)), lo=64))
-            SC = max(ck["SC"], _pow2_at_least(
-                int(hint.get("SC", 1)), lo=256))
-            depth = ck["depth"]
-            generated = ck["generated"]
-            distinct = ck["distinct"]
-            seen_np = np.full((D, SC, K), SENTINEL, np.int32)
-            seen_np[:, :, 0] = 1
-            seen_np[:, :ck["SC"]] = ck["seen"]
-            seen = jnp.asarray(seen_np)
-            seen_count = jnp.asarray(
-                ck["seen_counts"].astype(np.int32))
-            fr_np = np.full((D, FC, PW), SENTINEL, np.int32)
-            fr_np[:, :ck["FC"]] = ck["frontier"]
-            frontier = jnp.asarray(fr_np)
-            fcount = jnp.asarray(ck["fcount"].astype(np.int32))
-            if ck.get("levels") is not None:
-                self._levels = list(ck["levels"])
-            elif self.store_trace:
-                # advisor r3: match _restore_ck_state — a user expecting
-                # traces must hear it up front, not get an empty-trace
-                # violation later
-                raise ValueError(
-                    "cannot resume with traces: the checkpoint was "
-                    "written with --no-trace")
-            self._lvl_FC = [lv[2] for lv in self._levels[1:]]
-            TRL = _pow2_at_least(
-                max(depth + 1, int(hint.get("TRL", 1)), 16), lo=16)
-            self.log(f"Resuming mesh run at depth {depth} "
-                     f"({distinct} distinct states)")
-        else:
-            init_keys, init_packed, init_povf = \
-                self._host_keys(init_rows)
-            if init_povf:
-                from ..compile.vspec import CompileError
-                raise CompileError(self._pack_ovf_msg())
-            owner = self._owner_from_keys(init_keys)
-            per_dev = [init_rows[(owner == d) & explored_mask]
-                       for d in range(D)]
-            FC = _pow2_at_least(
-                max(max((len(p) for p in per_dev), default=1), 1,
-                    int(hint.get("FC", 1))), lo=64)
-            SC = _pow2_at_least(max(4 * FC, int(hint.get("SC", 1))),
-                                lo=256)
-            TRL = _pow2_at_least(max(int(hint.get("TRL", 1)), 16),
-                                 lo=16)
-            explored_idx = np.nonzero(explored_mask)[0]
-            seen_np, frontier_np, fcount_np, scount_np = \
-                self._init_shards(
-                    init_rows, explored_idx, D, SC, FC,
-                    keys=init_keys, packed=init_packed, owner=owner)
-            if self.store_trace:
-                self._levels.append((frontier_np.copy(), None, FC))
-            seen = jnp.asarray(seen_np)
-            frontier = jnp.asarray(frontier_np)
-            fcount = jnp.asarray(fcount_np.astype(np.int32))
-            seen_count = jnp.asarray(scount_np)
-            depth = 0
-
-        tr_rows = tr_src = None
-        if self.store_trace:
-            ring_np = np.full((D, TRL, FC, PW), SENTINEL, np.int32)
-            src_np_ = np.full((D, TRL, FC), -1, np.int32)
-            for l, (rows, src, _fcl) in enumerate(self._levels[1:]):
-                k = min(rows.shape[1], FC)
-                ring_np[:, l, :k] = rows[:, :k]
-                src_np_[:, l, :k] = src[:, :k]
-            tr_rows = jnp.asarray(ring_np)
-            tr_src = jnp.asarray(src_np_)
-            # _levels beyond the init level will be re-materialized from
-            # the ring on demand; keep only level 0 host-side
-            del self._levels[1:]
-
-        last_progress = last_ck = time.time()
-        lvl_frontier = int(np.sum(np.asarray(fcount)))
-        # superstep controller (ISSUE 10): JAXMC_MESH_SUPERSTEP pins
-        # the level budget per dispatch; auto starts at the learned
-        # warm value (1 on a cold engine — the first dispatch is
-        # exactly the one-level program run) and adapts to measured
-        # dispatch wall so progress, checkpoint and drain attention
-        # keep their cadence, like the single-chip resident maxlvl
-        # controller (tpu/bfs.py)
-        maxlvl = self._ss_fixed or min(self._mesh_maxlvl_warm,
-                                       _SS_RINGCAP)
-        target_s = max(1.0, min(
-            self.progress_every or 30.0,
-            (self.checkpoint_every or 1e9) if self.checkpoint_path
-            else 1e9))
-        while lvl_frontier > 0:
-            lvl_t0 = time.time()
-            # chaos sites: crash / drain between dispatches — with
-            # supersteps these are SUPERSTEP boundaries, the only
-            # host-attention points the resident mesh loop has
-            # (jaxmc/faults.py)
-            faults.kill_self("run_kill", level=depth, engine="mesh")
-            faults.inject("device_run_fail", level=depth, engine="mesh")
-            if self._drain_requested(warnings, "mesh"):
-                if self.checkpoint_path:
-                    self._ring_levels(tr_rows, tr_src, depth)
-                    self._mesh_ck(seen, np.asarray(seen_count),
-                                  frontier, fcount, FC, SC, depth,
-                                  generated, distinct)
-                return self._mk(True, distinct, generated, depth, t0,
-                                warnings, truncated=True, drained=True)
-
-            C = self.A * FC
-            B = self._a2a_bucket(C, FC) if self.exchange == "a2a" else 0
-            SB = self._a2a_spill_bucket(B) if B else 0
-            step_key = ("res", SC, FC, TRL, B, SB, self.store_trace,
-                        self.merge)
-            fresh_compile = step_key not in self._mesh_step_cache
-            step = self._get_mesh_resident_step(SC, FC, TRL)
-            args = (seen, seen_count, frontier, fcount)
-            if self.store_trace:
-                args = args + (tr_rows, tr_src)
-            args = args + (jnp.int32(depth), jnp.int32(maxlvl),
-                           jnp.int32(distinct),
-                           jnp.int32(self.max_states or 0))
-            outs = step(*args)
-            if self.store_trace:
-                (seen2, seen_count2, frontier2, fcount2, tr_rows2,
-                 tr_src2, ring_d, nlv_d, aux_d) = outs
-            else:
-                (seen2, seen_count2, frontier2, fcount2, ring_d,
-                 nlv_d, aux_d) = outs
-                tr_rows2 = tr_src2 = None
-            # THE one host sync of the superstep: the replicated
-            # per-level scalar ring + its occupancy (every per-device
-            # row is identical; tiny).  mesh.host_syncs therefore
-            # counts SUPERSTEPS, not levels (obs/schema.py PR-10).
-            ring = np.asarray(ring_d)[0]
-            nlv = max(1, int(np.asarray(nlv_d)[0]))
-            disp_wall = time.time() - lvl_t0
-            tel.counter("mesh.host_syncs")
-            tel.counter("mesh.exchange_bytes",
-                        self._exchange_bytes(C, B, SB) * nlv)
-            self._supersteps += 1
-            self._superstep_levels_max = max(self._superstep_levels_max,
-                                             nlv)
-            # adopt the device state: levels before a rolled-back or
-            # violating level committed inside the dispatch, the
-            # offending level itself rolled back (outputs == inputs)
-            seen, seen_count = seen2, seen_count2
-            frontier, fcount = frontier2, fcount2
-            if self.store_trace:
-                tr_rows, tr_src = tr_rows2, tr_src2
-            # adapt the level budget toward the host-attention target;
-            # a dispatch that just paid an XLA recompile is not
-            # evidence about execution speed — skip it.  The warm
-            # value tracks the SETTLED budget (it follows halvings
-            # down), not the running max: a budget the controller
-            # judged too slow must not come back on warm runs, where
-            # it would stall drain/checkpoint attention for the whole
-            # oversized dispatch (review r10)
-            if self._ss_fixed is None:
-                if fresh_compile:
-                    pass
-                elif disp_wall > 1.5 * target_s and maxlvl > 1:
-                    maxlvl = max(1, maxlvl // 2)
-                    self._ss_shrunk = True
-                elif disp_wall < target_s / 4 and maxlvl < _SS_RINGCAP:
-                    maxlvl = min(_SS_RINGCAP, maxlvl * 2)
-                self._mesh_maxlvl_warm = maxlvl
-            lwall = round(disp_wall / nlv, 6)
-
-            # ---- drain the ring: one record per executed level, the
-            # exact PR-8 one-level host sequence replayed per entry ----
-            for li in range(nlv):
-                scal = ring[li]
-                fresh = fresh_compile and li == 0
-                ovc = int(scal[_S_OVC])
-                if ovc:
-                    if ovc == OV_DEMOTED:
-                        msg = ("a demoted compile-recovery fired (the "
-                               "kernel under-approximates here): run "
-                               "the host_seen mode, which demotes the "
-                               "arm to the interpreter and restarts — "
-                               "raising caps cannot help")
-                    elif ovc == OV_PACK:
-                        msg = self._pack_ovf_msg()
-                    else:
-                        msg = ("a container exceeded its lane capacity "
-                               f"({self._caps_note()}); counts would "
-                               "no longer be exact")
-                    return self._mk(False, distinct, generated, depth,
-                                    t0, warnings, Violation(
-                                        "error", "capacity overflow",
-                                        [], msg))
-
-                if scal[_S_FOVF] or scal[_S_SOVF] or scal[_S_TOVF] or \
-                        scal[_S_AOVF]:
-                    # the step rolled this level back on device (and
-                    # stopped the superstep, so it is the ring's LAST
-                    # entry): grow every flagged capacity at once
-                    # (each growth recompiles the step, so batching
-                    # growths minimizes recompiles), then redo the
-                    # level in the next dispatch
-                    grew = []
-                    if scal[_S_AOVF]:
-                        # grow gamma straight to the OBSERVED per-peer
-                        # need (the max bucket occupancy rode the
-                        # scalar vector) instead of blind doubling:
-                        # one rerun covers even pathological skew, and
-                        # the spill bucket keeps absorbing
-                        # between-level drift afterwards
-                        need_g = int(scal[_S_MAXDEST]) * self.D \
-                            / max(C, 1)
-                        self._a2a_gamma = max(self._a2a_gamma * 2,
-                                              need_g)
-                        grew.append(f"gamma->{self._a2a_gamma:g}")
-                    if scal[_S_SOVF]:
-                        SC2 = _pow2_at_least(int(scal[_S_MAXS]),
-                                             lo=2 * SC)
-                        seen = self._pad_dev(seen, 1, SC2, SENTINEL,
-                                             lane1=True)
-                        SC = SC2
-                        grew.append(f"SC->{SC}")
-                    if scal[_S_FOVF]:
-                        FC2 = _pow2_at_least(int(scal[_S_MAXF]),
-                                             lo=2 * FC)
-                        frontier = self._pad_dev(frontier, 1, FC2,
-                                                 SENTINEL)
-                        if self.store_trace:
-                            tr_rows = self._pad_dev(tr_rows, 2, FC2,
-                                                    SENTINEL)
-                            tr_src = self._pad_dev(tr_src, 2, FC2, -1)
-                        FC = FC2
-                        grew.append(f"FC->{FC}")
-                    if scal[_S_TOVF]:
-                        TRL2 = _pow2_at_least(depth + 1, lo=2 * TRL)
-                        tr_rows = self._pad_dev(tr_rows, 1, TRL2,
-                                                SENTINEL)
-                        tr_src = self._pad_dev(tr_src, 1, TRL2, -1)
-                        TRL = TRL2
-                        grew.append(f"TRL->{TRL}")
-                    self._remember_caps(SC, FC, TRL)
-                    self.log(f"-- mesh: growing {', '.join(grew)} "
-                             f"(level {depth} redone)")
-                    tel.level(depth, frontier=lvl_frontier, generated=0,
-                              new=0, distinct=distinct, devices=D,
-                              redo=",".join(grew),
-                              fresh_compile=fresh,
-                              wall_s=lwall)
-                    break
-
-                # committed level
-                if self.store_trace:
-                    self._lvl_FC.append(FC)
-                self._spill_rows += int(scal[_S_SPILL])
-                self._max_bucket = max(self._max_bucket,
-                                       int(scal[_S_MAXDEST]))
-
-                # deadlock/assert live in the CURRENT frontier (depth
-                # d): totals exclude the partial level, like the host
-                # loop
-                if model.check_deadlock and scal[_S_DEAD]:
-                    aux = np.asarray(aux_d)
-                    dv = int(np.argmax(aux[:, _A_DEAD]))
-                    ds = int(aux[dv, _A_DEADSLOT])
-                    self._ring_levels(tr_rows, tr_src, depth)
-                    trace = self._mesh_trace_to(dv, ds, depth)
-                    return self._mk(False, distinct, generated, depth,
-                                    t0, warnings,
-                                    self._viol("deadlock", "deadlock",
-                                               trace))
-                if scal[_S_ASSERT]:
-                    aux = np.asarray(aux_d)
-                    av = int(np.argmax(aux[:, _A_ASSERT]))
-                    aa = int(aux[av, _A_ASRTA])
-                    af = int(aux[av, _A_ASRTF])
-                    self._ring_levels(tr_rows, tr_src, depth)
-                    trace = self._mesh_trace_to(av, af, depth)
-                    return self._mk(
-                        False, distinct, generated, depth, t0,
-                        warnings,
-                        self._viol("assert", "Assert", trace,
-                                   f"assertion in "
-                                   f"{self.labels_flat[aa]}"))
-
-                generated += int(scal[_S_GEN])
-                distinct += int(scal[_S_NEW])
-                sum_seen = int(scal[_S_SUMS])
-                max_seen = int(scal[_S_MAXS])
-                self._fp_occupancy = sum_seen
-                if sum_seen:
-                    self._shard_balance = max_seen / (sum_seen / D)
-                tel.level(depth, frontier=lvl_frontier,
-                          generated=int(scal[_S_GEN]),
-                          new=int(scal[_S_NEW]), distinct=distinct,
-                          seen=sum_seen, devices=D, fc=FC,
-                          spill=int(scal[_S_SPILL]),
-                          max_bucket=int(scal[_S_MAXDEST]),
-                          superstep=self._supersteps,
-                          fresh_compile=fresh,
-                          wall_s=lwall)
-
-                which = int(scal[_S_INVMIN])
-                if which != _BIG:
-                    # invariant violations live in the NEW frontier
-                    # (depth+1); the globally LOWEST violated
-                    # cfg-invariant index wins, then the first device
-                    # holding it
-                    aux = np.asarray(aux_d)
-                    nm = self.inv_fns[which][0]
-                    iv_dev = int(np.argmax(aux[:, _A_INVW] == which))
-                    iv_slot = int(aux[iv_dev, _A_INVSLOT])
-                    self._ring_levels(tr_rows, tr_src, depth + 1)
-                    trace = self._mesh_trace_to(iv_dev, iv_slot,
-                                                depth + 1)
-                    return self._mk(False, distinct, generated,
-                                    depth + 1, t0, warnings,
-                                    self._viol("invariant", nm, trace))
-                depth += 1
-                lvl_frontier = int(scal[_S_FRONT])
-
-                if self.max_states and distinct >= self.max_states:
-                    # a truncation point IS a level boundary: leave a
-                    # checkpoint so the run can be resumed past the
-                    # limit
-                    if self.checkpoint_path:
-                        self._ring_levels(tr_rows, tr_src, depth)
-                        self._mesh_ck(seen, np.asarray(seen_count),
-                                      frontier, fcount, FC, SC, depth,
-                                      generated, distinct)
-                    self._save_mesh_profile(SC, FC, TRL)
-                    self.log("-- state limit reached, search truncated")
-                    return self._mk(True, distinct, generated, depth,
-                                    t0, warnings, truncated=True)
-
-            now = time.time()
-            if now - last_progress >= self.progress_every:
-                last_progress = now
-                self.log(f"Progress({depth}): {generated} generated, "
-                         f"{distinct} distinct, "
-                         f"{lvl_frontier} on queue.")
-            if self.checkpoint_path and \
-                    now - last_ck >= self.checkpoint_every:
-                last_ck = now
-                self._ring_levels(tr_rows, tr_src, depth)
-                self._mesh_ck(seen, np.asarray(seen_count), frontier,
-                              fcount, FC, SC, depth, generated,
-                              distinct)
-
-        if self._ss_fixed is None and not self._ss_shrunk:
-            # fast models: remember enough budget to cover the whole
-            # search in ONE dispatch on a warm re-run (the early exit
-            # stops at the empty frontier, so over-budget is free) —
-            # but never after the controller had to shrink: a budget
-            # it judged too slow must stay retired
-            self._mesh_maxlvl_warm = min(
-                max(depth + 1, self._mesh_maxlvl_warm), _SS_RINGCAP)
-        self._save_mesh_profile(SC, FC, TRL)
-        if self.checkpoint_path and self.final_checkpoint:
-            # COMPLETED-run checkpoint (serve warm resume): an empty
-            # frontier over the full seen set
-            self._ring_levels(tr_rows, tr_src, depth)
-            self._mesh_ck(seen, np.asarray(seen_count),
-                          jnp.asarray(np.zeros((D, FC, PW), np.int32)),
-                          jnp.asarray(np.zeros(D, np.int32)),
-                          FC, SC, depth, generated, distinct)
-        self.log("Model checking completed. No error has been found.")
-        self.log(f"{generated} states generated, {distinct} distinct "
-                 f"states found, 0 states left on queue.")
-        return self._mk(True, distinct, generated, depth - 1, t0,
-                        warnings)
-
-    def _remember_caps(self, SC: int, FC: int, TRL: int) -> None:
-        """Keep the learned caps on the INSTANCE so warm re-runs (bench
-        timed windows) start at them — zero growth redos, zero
-        recompiles — exactly like the single-chip resident engine's
-        _res_caps."""
-        h = self._mesh_caps_hint
-        h["SC"] = max(int(h.get("SC", 0)), SC)
-        h["FC"] = max(int(h.get("FC", 0)), FC)
-        h["TRL"] = max(int(h.get("TRL", 0)), TRL)
-        h["GAM16"] = max(int(h.get("GAM16", 0)),
-                         int(round(self._a2a_gamma * 16)))
-        # MSL is the SETTLED levels-per-dispatch, not a floor: it must
-        # follow the controller down when a budget proved too slow
-        h["MSL"] = max(1, int(self._mesh_maxlvl_warm))
-
-    def _save_mesh_profile(self, SC: int, FC: int, TRL: int) -> None:
-        self._remember_caps(SC, FC, TRL)
-        self._save_caps_profile(
-            {"SC": SC, "FC": FC, "TRL": TRL,
-             "GAM16": max(1, int(round(self._a2a_gamma * 16))),
-             "MSL": max(1, int(self._mesh_maxlvl_warm))},
-            variant=self._profile_variant(), keys=_MESH_PROFILE_KEYS)
-
-    # ------------------------------------------------------------------
-    # phase-wall probe (ISSUE 10 obs satellite)
-    # ------------------------------------------------------------------
-
-    def probe_phase_walls(self, max_levels: int = 4
-                          ) -> Optional[Dict[str, float]]:
-        """Measured expand / exchange / merge wall breakdown.
-
-        The fused superstep makes the hot path unobservable from the
-        host (one dispatch covers many levels), so the breakdown comes
-        from a PROBE: the three phases built as SEPARATE jitted
-        shard_map programs at the run's learned capacities, driven a
-        few levels over the real initial shards, each phase timed with
-        block_until_ready (compile excluded by an untimed warm-up
-        pass).  BOTH merge strategies are timed on identical inputs
-        every level, so the artifact shows the rank-vs-fullsort merge
-        wall directly — the merge win lands in the obs artifact, not
-        just the scaling curve.  Best-effort perf probe only (stops if
-        the probe outgrows its fixed caps); counts are never consumed.
-
-        Gauges: mesh.phase_levels, mesh.phase_expand_s,
-        mesh.phase_exchange_s, mesh.phase_merge_rank_s,
-        mesh.phase_merge_fullsort_s, mesh.phase_merge_s (the active
-        strategy's total); one `mesh.phase_walls` trace event per
-        probed level."""
-        tel = obs.current()
-        t_all = time.time()
-        init_rows, explored_init, n_init, err = \
-            self._prepare_init(t_all, [])
-        if err is not None:
-            return None
-        D, K, PW = self.D, self.K, self.PW
-        hint = self._mesh_caps_hint
-        explored_mask = np.zeros(n_init, bool)
-        explored_mask[explored_init] = True
-        FC = _pow2_at_least(
-            max(int(hint.get("FC", 1)), max(1,
-                                            int(explored_mask.sum()))),
-            lo=64)
-        SC = _pow2_at_least(max(4 * FC, int(hint.get("SC", 1))),
-                            lo=256)
-        seen_np, frontier_np, fcount_np, scount_np = self._init_shards(
-            init_rows, np.nonzero(explored_mask)[0], D, SC, FC)
-        C = self.A * FC
-        route, R, B, SB = self._route_fn(C, FC)
-        block_fn = self._candidate_block_fn(FC)
-        plan = self.plan
-        shard_map = self._shard_map()
-
-        def expand_step(frontier_p, fcount):
-            frontier = plan.unpack_rows(frontier_p.reshape(FC, PW))
-            fvalid = jnp.arange(FC) < fcount[0]
-            blk = block_fn(frontier, fvalid)
-            return (blk["ckeys"].reshape(1, C, K),
-                    blk["cand"].reshape(1, C, PW),
-                    blk["cvalid"].reshape(1, C))
-
-        def route_step(ckeys, cand, cvalid):
-            me_ = lax.axis_index("d")
-            gkeys, gcand, gsrc = route(ckeys.reshape(C, K),
-                                       cand.reshape(C, PW),
-                                       cvalid.reshape(C), me_)[:3]
-            return (gkeys.reshape(1, R, K), gcand.reshape(1, R, PW),
-                    gsrc.reshape(1, R))
-
-        def mk_merge(strategy):
-            mfn = (self._merge_rank_fn if strategy == "rank"
-                   else self._merge_fullsort_fn)(SC, R)
-
-            def merge_step(seen_keys, seen_count, gkeys, gcand, gsrc):
-                mg = mfn(seen_keys.reshape(SC, K), seen_count[0],
-                         gkeys.reshape(R, K), gcand.reshape(R, PW),
-                         gsrc.reshape(R))
-                return (mg["seen2"].reshape(1, SC, K),
-                        mg["seen_count2"].reshape(1),
-                        mg["front_rows"][:FC].reshape(1, FC, PW),
-                        mg["front_count"].reshape(1))
-
-            return merge_step
-
-        jexp = jax.jit(shard_map(
-            expand_step, mesh=self.mesh,
-            in_specs=(P("d"), P("d")), out_specs=(P("d"),) * 3))
-        jrt = jax.jit(shard_map(
-            route_step, mesh=self.mesh,
-            in_specs=(P("d"),) * 3, out_specs=(P("d"),) * 3))
-        jmg = {s: jax.jit(shard_map(
-            mk_merge(s), mesh=self.mesh,
-            in_specs=(P("d"),) * 5, out_specs=(P("d"),) * 4))
-            for s in ("rank", "fullsort")}
-
-        seen = jnp.asarray(seen_np)
-        scount = jnp.asarray(scount_np)
-        frontier = jnp.asarray(frontier_np)
-        fcount = jnp.asarray(fcount_np.astype(np.int32))
-
-        def timed(f, *a):
-            t0 = time.time()
-            out = f(*a)
-            jax.block_until_ready(out)
-            return out, time.time() - t0
-
-        # untimed warm-up pass: compile all four programs once
-        o1 = jexp(frontier, fcount)
-        jax.block_until_ready(o1)
-        o2 = jrt(*o1)
-        jax.block_until_ready(o2)
-        for s in jmg:
-            jax.block_until_ready(jmg[s](seen, scount, *o2))
-
-        walls = {"expand": 0.0, "exchange": 0.0,
-                 "merge_rank": 0.0, "merge_fullsort": 0.0}
-        lv = 0
-        while lv < max_levels and int(np.sum(np.asarray(fcount))) > 0:
-            o1, w_e = timed(jexp, frontier, fcount)
-            walls["expand"] += w_e
-            o2, w_x = timed(jrt, *o1)
-            walls["exchange"] += w_x
-            outs = {}
-            w_m = {}
-            for s in ("fullsort", "rank"):
-                outs[s], w_m[s] = timed(jmg[s], seen, scount, *o2)
-                walls["merge_" + s] += w_m[s]
-            seen2, scount2, frontier2, fcount2 = outs["rank"]
-            tel.event("mesh.phase_walls", level=lv,
-                      expand_s=round(w_e, 6), exchange_s=round(w_x, 6),
-                      merge_rank_s=round(w_m["rank"], 6),
-                      merge_fullsort_s=round(w_m["fullsort"], 6))
-            if int(np.max(np.asarray(scount2))) > SC or \
-                    int(np.max(np.asarray(fcount2))) > FC:
-                break  # probe caps outgrown: keep what we measured
-            seen, scount = seen2, scount2
-            frontier, fcount = frontier2, fcount2
-            lv += 1
-        out = {"levels": lv,
-               "expand_s": round(walls["expand"], 6),
-               "exchange_s": round(walls["exchange"], 6),
-               "merge_rank_s": round(walls["merge_rank"], 6),
-               "merge_fullsort_s": round(walls["merge_fullsort"], 6)}
-        out["merge_s"] = out["merge_rank_s"] if self.merge == "rank" \
-            else out["merge_fullsort_s"]
-        tel.gauge("mesh.phase_levels", lv)
-        tel.gauge("mesh.phase_expand_s", out["expand_s"])
-        tel.gauge("mesh.phase_exchange_s", out["exchange_s"])
-        tel.gauge("mesh.phase_merge_s", out["merge_s"])
-        tel.gauge("mesh.phase_merge_rank_s", out["merge_rank_s"])
-        tel.gauge("mesh.phase_merge_fullsort_s",
-                  out["merge_fullsort_s"])
-        return out
-
-    # ------------------------------------------------------------------
-    # the LEGACY host loop (refinement/temporal PROPERTYs; the
-    # JAXMC_MESH_RESIDENT=0 diagnosis escape hatch)
-    # ------------------------------------------------------------------
-
-    def _run_hostloop(self, need_edges: bool,
-                      need_props: bool) -> CheckResult:
-        t0 = time.time()
-        tel = obs.current()
-        model = self.model
-        D, W, K = self.D, self.W, self.K
-        warnings = ["mesh backend: dedup on 128-bit fingerprints; "
-                    "collision probability < n^2 * 2^-129"]
-        warnings.extend(self._temporal_warnings())
-        if need_props and not self.store_trace:
-            raise ModeError(
-                "mesh refinement/temporal checking needs the per-level "
-                "row stream: run with store_trace=True (default)")
-        if need_props and self.resume_from:
-            raise ModeError(
-                "mesh resume with refinement/temporal PROPERTYs is not "
-                "supported - use the single-chip device modes")
-        warnings.extend(self._symmetry_warnings())
-
-        init_rows, explored_init, n_init, err = \
-            self._prepare_init(t0, warnings)
-        if err is not None:
-            return err
-        generated = n_init
-        explored_mask = np.zeros(n_init, bool)
-        explored_mask[explored_init] = True
-        distinct = int(explored_mask.sum())
-
-        self._levels: List[Tuple[np.ndarray, Optional[np.ndarray], int]] \
-            = []
-        graph = None   # behavior graph (temporal PROPERTYs)
-        fsids = None   # flat (d*FC + slot) -> graph state id
-
-        if self.resume_from:
-            ck = self._load_ck("mesh")
-            if ck["D"] != D:
-                raise ValueError(
-                    f"cannot resume: checkpoint has {ck['D']} devices, "
-                    f"mesh has {D}")
-            FC, SC = ck["FC"], ck["SC"]
-            depth = ck["depth"]
-            generated = ck["generated"]
-            distinct = ck["distinct"]
-            seen = jnp.asarray(ck["seen"])
-            seen_counts = ck["seen_counts"].astype(np.int64)
-            frontier = jnp.asarray(ck["frontier"])
-            fcount = jnp.asarray(ck["fcount"])
-            if ck.get("levels") is not None:
-                self._levels = ck["levels"]
-            elif self.store_trace:
-                # advisor r3: match _restore_ck_state — a user expecting
-                # traces must hear it up front, not get an empty-trace
-                # violation later
-                raise ValueError(
-                    "cannot resume with traces: the checkpoint was "
-                    "written with --no-trace")
-            self.log(f"Resuming mesh run at depth {depth} "
-                     f"({distinct} distinct states)")
-        else:
-            init_keys, init_packed, init_povf = \
-                self._host_keys(init_rows)
-            if init_povf:
-                from ..compile.vspec import CompileError
-                raise CompileError(self._pack_ovf_msg())
-            owner = self._owner_from_keys(init_keys)
-            per_dev = [init_rows[(owner == d) & explored_mask]
-                       for d in range(D)]
-            FC = _pow2_at_least(
-                max(max((len(p) for p in per_dev), default=1), 1), lo=64)
-            SC = _pow2_at_least(4 * FC, lo=256)
-            explored_idx = np.nonzero(explored_mask)[0]
-            seen, frontier, fcount, init_scounts = self._init_shards(
-                init_rows, explored_idx, D, SC, FC,
-                keys=init_keys, packed=init_packed, owner=owner)
-            if self.live_obligations:
-                graph = _LiveGraph(self.labels_flat, self.collect_edges)
-                graph.add_inits(init_packed, explored_idx)
-                # (d, slot) -> behavior-graph state id, flat [D*FC]
-                fsids = np.full(D * FC, -1, np.int64)
-                for d in range(D):
-                    for i in range(int(fcount[d])):
-                        fsids[d * FC + i] = graph.sid_by_key[
-                            frontier[d, i].tobytes()]
-            if self.store_trace:
-                self._levels.append((frontier.copy(), None, FC))
-            frontier = jnp.asarray(frontier)
-            seen = jnp.asarray(seen)
-            fcount = jnp.asarray(fcount)
-            seen_counts = init_scounts.astype(np.int64)
-            depth = 0
-
-        last_progress = last_ck = time.time()
-        lvl_frontier = int(np.sum(np.asarray(fcount)))
-        while lvl_frontier > 0:
-            lvl_t0 = time.time()
-            lvl_gen0 = generated
-            C = self.A * FC
-            need = int(seen_counts.max(initial=0)) + D * C
-            if need > SC:
-                SC2 = _pow2_at_least(need, SC)
-                pad = np.full((D, SC2 - SC, K), SENTINEL, np.int32)
-                pad[:, :, 0] = 1
-                seen = jnp.concatenate([seen, jnp.asarray(pad)], axis=1)
-                SC = SC2
-            expanding_FC = FC
-            while True:
-                step = self._get_mesh_step(SC, FC)
-                outs = step(seen,
-                            jnp.asarray(seen_counts.astype(np.int32)),
-                            frontier, fcount)
-                # count THIS attempt's exchange with the gamma it ran
-                # at: gamma-doubling reruns each pay a full exchange
-                # (review r8)
-                B_att = self._a2a_bucket(C, FC) \
-                    if self.exchange == "a2a" else 0
-                tel.counter("mesh.exchange_bytes", self._exchange_bytes(
-                    C, B_att,
-                    self._a2a_spill_bucket(B_att) if B_att else 0))
-                (seen2_, seen_cnt, front_rows, front_cnt, front_src,
-                 tot_gen, tot_new, dead_local, dead_slot, assert_local,
-                 asrt_a, asrt_f, any_ovf, inv_which, inv_slot,
-                 tot_front, a2a_ovf, tot_spill) = outs[:18]
-                if self.exchange == "a2a" and \
-                        bool(np.asarray(a2a_ovf)[0]):
-                    # hash skew exceeded the per-peer bucket AND the
-                    # spill pass: rerun the level with doubled capacity
-                    # factor (inputs are untouched — the step is
-                    # functional)
-                    self._a2a_gamma *= 2
-                    self.log(f"-- mesh: a2a bucket+spill overflow, "
-                             f"gamma -> {self._a2a_gamma}")
-                    continue
-                seen = seen2_
-                break
-            self._spill_rows += int(np.asarray(tot_spill)[0])
-
-            ovc = int(np.asarray(any_ovf)[0])
-            if ovc:
-                if ovc == OV_DEMOTED:
-                    msg = ("a demoted compile-recovery fired (the "
-                           "kernel under-approximates here): run the "
-                           "host_seen mode, which demotes the arm to "
-                           "the interpreter and restarts — raising "
-                           "caps cannot help")
-                elif ovc == OV_PACK:
-                    msg = self._pack_ovf_msg()
-                else:
-                    msg = ("a container exceeded its lane capacity "
-                           f"({self._caps_note()}); counts would no "
-                           "longer be exact")
-                return self._mk(False, distinct, generated, depth, t0,
-                                warnings, Violation(
-                                    "error", "capacity overflow", [],
-                                    msg))
-            dead_np = np.asarray(dead_local)
-            if model.check_deadlock and dead_np.any():
-                dv = int(np.argmax(dead_np))
-                ds = int(np.asarray(dead_slot)[dv])
-                trace = self._mesh_trace_to(dv, ds, depth)
-                return self._mk(False, distinct, generated, depth, t0,
-                                warnings,
-                                self._viol("deadlock", "deadlock", trace))
-            assert_np = np.asarray(assert_local)
-            if assert_np.any():
-                av = int(np.argmax(assert_np))
-                aa = int(np.asarray(asrt_a)[av])
-                af = int(np.asarray(asrt_f)[av])
-                trace = self._mesh_trace_to(av, af, depth)
-                return self._mk(
-                    False, distinct, generated, depth, t0, warnings,
-                    self._viol("assert", "Assert", trace,
-                               f"assertion in {self.labels_flat[aa]}"))
-
-            ecand = eexp = esrc = None
-            if need_edges:
-                # the exchanged candidate stream (revisits included):
-                # gather mode replicates it on every device (read device
-                # 0); a2a routes disjoint buckets (concatenate all)
-                if self.exchange == "a2a":
-                    ecand = np.asarray(outs[18]).reshape(-1, self.PW)
-                    eexp = np.asarray(outs[19]).reshape(-1)
-                    esrc = np.asarray(outs[20]).reshape(-1)
-                else:
-                    ecand = np.asarray(outs[18][0])
-                    eexp = np.asarray(outs[19][0])
-                    esrc = np.asarray(outs[20][0])
-                if self.refiners:
-                    fr_np = np.asarray(frontier)
-                    rv = self._mesh_refine_edges(fr_np, ecand, eexp,
-                                                 esrc, expanding_FC,
-                                                 depth)
-                    if rv is not None:
-                        return self._mk(False, distinct, generated,
-                                        depth, t0, warnings, rv)
-
-            generated += int(np.asarray(tot_gen)[0])
-            distinct += int(np.asarray(tot_new)[0])
-            seen_counts = np.asarray(seen_cnt).astype(np.int64)
-            tel.level(depth, frontier=lvl_frontier,
-                      generated=generated - lvl_gen0,
-                      new=int(np.asarray(tot_new)[0]), distinct=distinct,
-                      seen=int(seen_counts.sum()), devices=D,
-                      wall_s=round(time.time() - lvl_t0, 6))
-            self._fp_occupancy = int(seen_counts.sum())
-            if seen_counts.sum():
-                self._shard_balance = float(
-                    seen_counts.max() / (seen_counts.sum() / D))
-            max_front = int(np.asarray(front_cnt).max(initial=0))
-            # device->host frontier copies only when something needs
-            # them (tracing, a violation to localize, or FC regrowth):
-            # in the perf configuration (store_trace=False, clean level)
-            # the frontier never leaves the device
-            iw = np.asarray(inv_which)
-            which = int(iw.min())
-            need_host_rows = (self.store_trace or max_front > FC or
-                              which != _BIG or graph is not None)
-            front_rows_np = np.asarray(front_rows) if need_host_rows \
-                else None
-            if self.store_trace:
-                # trim to the occupied prefix: keeping full G = D*A*FC
-                # capacity per level would hold the padded expansion of
-                # the whole search in host RAM
-                keep = max(max_front, 1)
-                self._levels.append(
-                    (front_rows_np[:, :keep],
-                     np.asarray(front_src)[:, :keep], expanding_FC))
-
-            sids_per_dev = None
-            if graph is not None:
-                # behavior-graph bookkeeping: kept new rows register with
-                # provenance a*(D*FCprev) + (d_src*FCprev + f) so
-                # labels_flat and the flat parent-sid table resolve them;
-                # then every explored candidate edge (revisits included)
-                front_src_np = np.asarray(front_src)
-                fcnt_np = np.asarray(front_cnt)
-                Cprev = self.A * expanding_FC
-                flat_rows, flat_prov, row_counts = [], [], []
-                for d in range(D):
-                    n = int(fcnt_np[d])
-                    row_counts.append(n)
-                    for i in range(n):
-                        g = int(front_src_np[d, i])
-                        d_src, cc = g // Cprev, g % Cprev
-                        a, f = cc // expanding_FC, cc % expanding_FC
-                        flat_rows.append(front_rows_np[d, i])
-                        flat_prov.append(
-                            a * (D * expanding_FC)
-                            + d_src * expanding_FC + f)
-                new_sids = graph.add_level(
-                    np.asarray(flat_rows) if flat_rows
-                    else np.zeros((0, self.PW), np.int32),
-                    np.asarray(flat_prov, np.int64),
-                    D * expanding_FC, fsids)
-                if graph.collect_edges and ecand is not None:
-                    eidx = np.nonzero(eexp)[0]
-                    epar = np.empty(len(eidx), np.int64)
-                    for k, c in enumerate(eidx):
-                        g = int(esrc[c])
-                        d_src, cc = g // Cprev, g % Cprev
-                        epar[k] = d_src * expanding_FC + cc % expanding_FC
-                    graph.add_edges(ecand[eidx], epar, fsids)
-                sids_per_dev = []
-                off = 0
-                for d in range(D):
-                    sids_per_dev.append(new_sids[off:off + row_counts[d]])
-                    off += row_counts[d]
-
-            if which != _BIG:
-                nm = self.inv_fns[which][0]
-                iv_dev = int(np.argmax(iw == which))
-                iv_slot = int(np.asarray(inv_slot)[iv_dev])
-                trace = self._mesh_trace_to(iv_dev, iv_slot, depth + 1)
-                return self._mk(False, distinct, generated, depth + 1, t0,
-                                warnings,
-                                self._viol("invariant", nm, trace))
-            depth += 1
-
-            # next frontier: per-device kept rows; capacity grows to the
-            # max shard (hash skew can route up to G rows to one device)
-            fcount = front_cnt
-            if max_front > FC:
-                FC = _pow2_at_least(max_front, FC)
-                k = min(front_rows_np.shape[1], FC)
-                nf = np.full((D, FC, self.PW), SENTINEL, np.int32)
-                nf[:, :k] = front_rows_np[:, :k]
-                frontier = jnp.asarray(nf)
-            else:
-                frontier = front_rows[:, :FC]
-            if graph is not None:
-                # flat sid table for the NEXT level's frontier slots
-                # (kept-row order is preserved by the compactions above)
-                fsids = np.full(D * FC, -1, np.int64)
-                for d in range(D):
-                    for i, sid in enumerate(sids_per_dev[d]):
-                        fsids[d * FC + i] = sid
-
-            if self.max_states and distinct >= self.max_states:
-                # a truncation point IS a level boundary: leave a
-                # checkpoint so the run can be resumed past the limit
-                if self.checkpoint_path:
-                    self._mesh_ck(seen, seen_counts, frontier, fcount,
-                                  FC, SC, depth, generated, distinct)
-                self.log("-- state limit reached, search truncated")
-                return self._mk(True, distinct, generated, depth, t0,
-                                warnings, truncated=True)
-
-            now = time.time()
-            if now - last_progress >= self.progress_every:
-                last_progress = now
-                self.log(f"Progress({depth}): {generated} generated, "
-                         f"{distinct} distinct, "
-                         f"{int(np.asarray(tot_front)[0])} on queue.")
-            if self.checkpoint_path and \
-                    now - last_ck >= self.checkpoint_every:
-                last_ck = now
-                self._mesh_ck(seen, seen_counts, frontier, fcount, FC,
-                              SC, depth, generated, distinct)
-            lvl_frontier = int(np.sum(np.asarray(fcount)))
-
-        if graph is not None:
-            viol = self._check_live(graph, warnings)
-            if viol is not None:
-                return self._mk(False, distinct, generated, depth - 1,
-                                t0, warnings, viol)
-        self.log("Model checking completed. No error has been found.")
-        self.log(f"{generated} states generated, {distinct} distinct "
-                 f"states found, 0 states left on queue.")
-        return self._mk(True, distinct, generated, depth - 1, t0, warnings)
-
-    def _mk(self, ok, distinct, generated, diameter, t0, warnings,
-            violation=None, truncated=False, drained=False):
-        tel = obs.current()
-        tel.high_water("device.mem_high_water_bytes",
-                       obs.device_mem_high_water())
-        occ = getattr(self, "_fp_occupancy", None)
-        if occ is not None:
-            tel.gauge("fingerprint.occupancy", occ)
-        if self.exchange == "a2a":
-            tel.gauge("mesh.a2a_gamma", round(self._a2a_gamma, 4))
-            tel.gauge("mesh.a2a_spill", self._spill_rows)
-            if self._max_bucket:
-                tel.gauge("mesh.a2a_max_bucket", self._max_bucket)
-        if self._shard_balance is not None:
-            tel.gauge("mesh.shard_balance",
-                      round(self._shard_balance, 4))
-        if self._supersteps:
-            # host_syncs counts SUPERSTEPS (one scalar-ring read per
-            # dispatch); the gauge records the deepest fused dispatch
-            tel.gauge("mesh.supersteps", self._supersteps)
-            tel.gauge("mesh.superstep_levels",
-                      self._superstep_levels_max)
-        return CheckResult(ok=ok, distinct=distinct, generated=generated,
-                           diameter=max(diameter, 0), violation=violation,
-                           wall_s=time.time() - t0, truncated=truncated,
-                           warnings=warnings, drained=drained)
+__all__ = ["MeshExplorer"]

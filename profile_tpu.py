@@ -6,7 +6,7 @@ import jax, jax.numpy as jnp
 
 from jaxmc.sem.modules import Loader, bind_model
 from jaxmc.front.cfg import parse_cfg
-from jaxmc.tpu.bfs import TpuExplorer, SENTINEL
+from jaxmc.backend.bfs import TpuExplorer, SENTINEL
 from jaxmc import native_store
 
 _REPO = os.path.dirname(os.path.abspath(__file__))

@@ -579,6 +579,107 @@ JumpSpec == HCini /\\ [][Jump]_hr
         assert len(r.violation.trace) >= 2
 
 
+class TestLevelRankMergeParity:
+    """Parity pins for the host-loop rank-merge port (ISSUE 11
+    tentpole b): the level mode — the LEGACY host loop refinement and
+    temporal PROPERTY checking runs on — merges each level's candidates
+    into the sorted seen prefix by rank instead of full-sorting
+    seen+candidates.  JAXMC_LEVEL_RANKMERGE=0 keeps the full sort as
+    the parity oracle; counts, verdicts and traces must be
+    bit-identical either way."""
+
+    REFINE_OK = """---- MODULE rmhc ----
+EXTENDS Naturals
+VARIABLE hr
+HCini == hr \\in 1..12
+HCnxt == hr' = IF hr = 12 THEN 1 ELSE hr + 1
+HC == HCini /\\ [][HCnxt]_hr
+====
+"""
+    REFINE_BAD = """---- MODULE rmbad ----
+EXTENDS Naturals
+VARIABLE hr
+HCini == hr \\in 1..12
+HCnxt == hr' = IF hr >= 11 THEN 1 ELSE hr + 2
+HC == HCini /\\ [][HCnxt]_hr
+Jump == hr' = IF hr = 12 THEN 1 ELSE hr + 1
+JumpSpec == HCini /\\ [][Jump]_hr
+====
+"""
+    TEMPORAL = """---- MODULE rmlive ----
+EXTENDS Naturals
+VARIABLE hr
+Init == hr \\in 1..4
+Next == hr' = (hr %% 12) + 1
+Spec == Init /\\ [][Next]_hr /\\ WF_hr(Next)
+Cycles == []<><<Next>>_hr
+====
+""".replace("%%", "%")
+
+    def _pair(self, monkeypatch, mk):
+        """One run per merge strategy on fresh explorers."""
+        out = []
+        for flag in ("0", "1"):
+            monkeypatch.setenv("JAXMC_LEVEL_RANKMERGE", flag)
+            out.append(mk().run())
+        return out
+
+    def _write(self, tmp_path, name, text):
+        p = tmp_path / name
+        p.write_text(text)
+        return str(p)
+
+    def test_refinement_counts_identical(self, tmp_path, monkeypatch):
+        from jaxmc.tpu.bfs import TpuExplorer
+        spec = self._write(tmp_path, "rmhc.tla", self.REFINE_OK)
+        cfg = ModelConfig(specification="HC", properties=["HC"],
+                          check_deadlock=False)
+        full, rank = self._pair(
+            monkeypatch, lambda: TpuExplorer(load(spec, cfg)))
+        assert full.ok and rank.ok
+        assert (full.distinct, full.generated, full.diameter) == \
+            (rank.distinct, rank.generated, rank.diameter)
+
+    def test_refinement_violation_trace_identical(self, tmp_path,
+                                                  monkeypatch):
+        from jaxmc.tpu.bfs import TpuExplorer
+        spec = self._write(tmp_path, "rmbad.tla", self.REFINE_BAD)
+        cfg = ModelConfig(specification="HC", properties=["JumpSpec"],
+                          check_deadlock=False)
+        full, rank = self._pair(
+            monkeypatch, lambda: TpuExplorer(load(spec, cfg)))
+        assert not full.ok and not rank.ok
+        assert full.violation.name == rank.violation.name == "JumpSpec"
+        # bit-identical trace: same states, same action labels
+        assert full.violation.trace == rank.violation.trace
+
+    def test_temporal_counts_identical(self, tmp_path, monkeypatch):
+        # the behavior-graph liveness path streams every level's edges
+        # through the same merged frontier the rank merge produces
+        from jaxmc.tpu.bfs import TpuExplorer
+        spec = self._write(tmp_path, "rmlive.tla", self.TEMPORAL)
+        cfg = ModelConfig(specification="Spec", properties=["Cycles"],
+                          check_deadlock=False)
+        full, rank = self._pair(
+            monkeypatch, lambda: TpuExplorer(load(spec, cfg)))
+        assert full.ok and rank.ok
+        assert (full.distinct, full.generated, full.diameter) == \
+            (rank.distinct, rank.generated, rank.diameter)
+
+    def test_temporal_violation_parity(self, tmp_path, monkeypatch):
+        # without fairness the cycle property fails: both merges must
+        # agree on the verdict and the counterexample prefix
+        from jaxmc.tpu.bfs import TpuExplorer
+        spec = self._write(tmp_path, "rmlive.tla", self.TEMPORAL)
+        cfg = ModelConfig(init="Init", next="Next",
+                          properties=["Cycles"], check_deadlock=False)
+        full, rank = self._pair(
+            monkeypatch, lambda: TpuExplorer(load(spec, cfg)))
+        assert not full.ok and not rank.ok
+        assert full.violation.name == rank.violation.name
+        assert full.violation.trace == rank.violation.trace
+
+
 @pytest.mark.slow
 def test_mesh_raft_micro_counts():
     # the flagship wide-state workload shards: MCraftMicro on an 8-device

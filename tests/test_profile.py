@@ -100,9 +100,13 @@ def test_foreign_schema_and_garbage_degrade(store):
     p["schema"] = "somebody.else/9"
     json.dump(p, open(path, "w"))
     from jaxmc.compile.cache import load_capacity_profile
+    # single-chip resident profiles live under the backend-platform
+    # namespace since ISSUE 11 (variant "cpu" on this box): the load
+    # must name the same variant the engine saved
+    variant = p.get("variant", "")
     tel = obs.Telemetry()
     assert load_capacity_profile("constoy", p["layout_sig"],
-                                 tel=tel) is None
+                                 tel=tel, variant=variant) is None
     assert str(tel.gauges.get("profile.status")).startswith(
         "degraded:foreign schema")
     _ex2, r2 = _run_resident(obs.Telemetry())
@@ -112,7 +116,7 @@ def test_foreign_schema_and_garbage_degrade(store):
         fh.write("{not json")
     tel = obs.Telemetry()
     assert load_capacity_profile("constoy", p["layout_sig"],
-                                 tel=tel) is None
+                                 tel=tel, variant=variant) is None
     assert str(tel.gauges.get("profile.status")).startswith(
         "degraded:unreadable")
 
