@@ -190,6 +190,9 @@ bench-check:
 	# backend-portability leg (ISSUE 11): preflight oracle smoke +
 	# per-live-platform baseline gate (SKIP lines for dead platforms)
 	$(MAKE) backend-check
+	# out-of-core leg (ISSUE 12): capped exhaustive run via tier spill
+	# + fingerprint parity — see ooc-check below
+	$(MAKE) ooc-check
 	# static-analysis legs (ISSUE 9): an analyzer regression gates the
 	# same way perf regressions do — the corpus must stay lint-clean
 	# (modulo manifest waivers) and jaxmc's own Python must stay free
@@ -244,6 +247,21 @@ multichip-check:
 #      alike; live platforms must agree on reachable-state counts.
 backend-check:
 	$(PY) -m jaxmc.backend.check --out-dir $(BENCH_CHECK_DIR)
+
+# out-of-core seen-set gate (ISSUE 12): on the repo-local overflow
+# fixture (specs/ooc_scaled.tla) — (1) uncapped exact run == manifest
+# pins; (2) JAXMC_SEEN_CAP forces the device seen table to ~17% of the
+# state count and a tiny host budget forces the disk tier: the run
+# must complete EXHAUSTIVELY via hierarchical tier spill with
+# bit-identical counts, gated via `python -m jaxmc.obs diff
+# --fail-on-regress` against its saved baseline; (3) --seen
+# fingerprint parity + the measured >=4x states-per-device-tier ratio
+# (BASELINE.md "Out-of-core"); (4) capped-vs-uncapped violation
+# traces byte-identical.  A jax-less container prints `OOC-CHECK
+# SKIP ...` and exits 0.
+ooc-check:
+	JAX_PLATFORMS=cpu $(PY) -m jaxmc.oocbench \
+	    --out-dir $(BENCH_CHECK_DIR)
 
 # the published scaling curve (ISSUE 8/10): per-rung, per-D warm-up +
 # timed fully-warm mesh runs over D in {1,2,4,8} virtual devices

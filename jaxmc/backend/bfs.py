@@ -361,7 +361,11 @@ class TpuExplorer:
                  res_caps: Optional[Dict[str, int]] = None,
                  cap_profile: bool = True,
                  final_checkpoint: bool = False,
-                 backend: Optional["BackendDescriptor"] = None):
+                 backend: Optional["BackendDescriptor"] = None,
+                 seen_mode: str = "auto",
+                 seen_cap: Optional[int] = None,
+                 spill_dir: Optional[str] = None,
+                 host_tier_keys: Optional[int] = None):
         self.model = model
         # the device layer this engine is compiled FOR (ISSUE 11): one
         # descriptor instead of per-engine re-derivation from global
@@ -810,6 +814,7 @@ class TpuExplorer:
         self._newcheck_cache: Dict[int, Callable] = {}
         self._res_cache: Dict[Tuple[int, ...], Callable] = {}
         self._hostkeys_cache: Dict[int, Callable] = {}
+        self._pkeys_cache: Dict[int, Callable] = {}
         # capacities learned by previous resident runs on this instance:
         # a warm-up run trains them so the timed run never overflows
         # (and therefore never recompiles)
@@ -847,12 +852,59 @@ class TpuExplorer:
                 # narrow layouts also hash fine; host store is fp-based
                 self.fp_mode = True
                 self.K = 4 + 1
+        # EXPLICIT seen-key mode (ISSUE 12): --seen fingerprint trades
+        # exact dedup keys for 128-bit fingerprints on ANY layout (the
+        # machinery that always kicked in past FP_THRESHOLD), shrinking
+        # the per-state tier footprint (K+1 -> 5 words) by the
+        # key-width ratio; the collision-probability bound rides the
+        # result.  --seen exact REFUSES configurations that cannot
+        # honor it instead of silently fingerprinting.
+        if seen_mode not in ("auto", "exact", "fingerprint"):
+            raise ModeError(f"unknown --seen mode {seen_mode!r} "
+                            f"(expected auto, exact or fingerprint)")
+        self.seen_mode_req = seen_mode
+        if seen_mode == "fingerprint" and not self.fp_mode:
+            self.fp_mode = True
+            self.K = 4 + 1
+        elif seen_mode == "exact" and self.fp_mode:
+            if resident or host_seen:
+                raise ModeError(
+                    "--seen exact is incompatible with the resident/"
+                    "host_seen modes (their dedup machinery is "
+                    "fingerprint-based) — use the level device mode")
+            raise ModeError(
+                f"--seen exact refused: the dedup key is "
+                f"{self.key_width} lanes wide (> FP_THRESHOLD="
+                f"{FP_THRESHOLD}); exact keys at this width would "
+                f"dominate device memory — use --seen fingerprint "
+                f"(collision probability is reported) or --backend "
+                f"interp")
         # re-stamp after the resident/host_seen fp forcings so the
         # artifact records the dedup mode that actually runs
         tel.gauge("dedup.mode",
                   ("fp128" if self.fp_mode else "exact")
                   + ("-view" if self.view_fn is not None
                      else ("-packed" if not self.plan.identity else "")))
+        tel.gauge("seen.mode",
+                  "fingerprint" if self.fp_mode else "exact")
+        # HIERARCHICAL SEEN SET (ISSUE 12 tentpole): a device seen cap
+        # (rows of the key table; --seen-cap, JAXMC_SEEN_CAP is the
+        # test knob) turns would-be unbounded device growth into tier
+        # SPILL — the sorted device prefix compacts out to host RAM and
+        # then disk as immutable sorted runs (backend/tiers.py), and
+        # per-level survivors of the device rank-merge binary-search
+        # the cold runs before they are counted or explored.  Counts
+        # and traces stay bit-identical to the uncapped run.  None =
+        # today's grow-forever behavior (no cap, no tiers).
+        env_cap = os.environ.get("JAXMC_SEEN_CAP")
+        self.seen_cap = int(seen_cap if seen_cap is not None
+                            else (env_cap if env_cap else 0)) or None
+        if self.seen_cap is not None:
+            self.seen_cap = _pow2_at_least(self.seen_cap, lo=64)
+            tel.gauge("tier.device_cap", self.seen_cap)
+        self.spill_dir = spill_dir or os.environ.get("JAXMC_SPILL_DIR")
+        self.host_tier_keys = host_tier_keys
+        self._tiers = None  # created lazily at the first spill
         # LEARNED CAPACITY PROFILE (ISSUE 6): resident runs start at the
         # caps a previous completed run on this (module, layout) ended
         # with — persisted next to the compile cache — so the one
@@ -864,12 +916,24 @@ class TpuExplorer:
             from ..compile.cache import load_capacity_profile
             prof = load_capacity_profile(
                 model.module.name, self._layout_sig(), tel=tel,
-                variant=self.backend_desc.profile_variant())
+                variant=self.backend_desc.profile_variant(),
+                optional=("TIERK",))
             if prof:
                 hint = dict(self._res_caps_hint or {})
                 for kk, vv in prof.items():
                     hint[kk] = max(int(hint.get(kk, 0)), vv)
                 self._res_caps_hint = hint
+                if prof.get("TIERK") and self.seen_cap is not None:
+                    # learned tier size (ISSUE 12): a previous
+                    # completed run on this (module, layout, platform)
+                    # spilled ~TIERK keys — surface the expected
+                    # out-of-core magnitude up front so operators and
+                    # bench artifacts see it before the first spill
+                    tel.gauge("tier.predicted_keys",
+                              int(prof["TIERK"]))
+                    self.log(f"-- tier: capacity profile predicts an "
+                             f"out-of-core run (~{int(prof['TIERK'])} "
+                             f"cold-tier keys at the last completion)")
 
     def _expand_fn(self):
         """The (state x action) expansion closure shared by both step
@@ -1126,6 +1190,63 @@ class TpuExplorer:
                      jnp.asarray(np.arange(cap) < n))
         return np.asarray(k)[:n], np.asarray(p)[:n], bool(o)
 
+    # ---- hierarchical seen set (ISSUE 12): spill + cold-tier probes --
+
+    def _ensure_tiers(self):
+        """The cold-tier store, created at the first spill (zero cost —
+        and zero behavior change — for runs that never overflow)."""
+        if self._tiers is None:
+            from .tiers import TieredSeen
+            self._tiers = TieredSeen(
+                self.K - 1, host_budget_keys=self.host_tier_keys,
+                spill_dir=self.spill_dir, log=self.log)
+        return self._tiers
+
+    def _tier_spill_prefix(self, seen_np: np.ndarray, count: int) -> None:
+        """Compact the device table's sorted valid prefix out as ONE
+        immutable sorted run (the validity lane is stripped — cold runs
+        hold data words only)."""
+        if count <= 0:
+            return
+        t = self._ensure_tiers()
+        t.spill(np.ascontiguousarray(seen_np[:count, 1:]))
+        obs.current().counter("tier.spilled_keys", int(count))
+
+    def _packed_keys(self, packed_np: np.ndarray) -> np.ndarray:
+        """Dedup-key DATA words ([n, K-1], validity lane stripped) for a
+        block of PACKED rows — the cold-tier probe basis for frontier
+        rows pulled back from the device.  Jitted per power-of-two
+        bucket like _host_keys."""
+        n = len(packed_np)
+        if n == 0:
+            return np.zeros((0, self.K - 1), np.int32)
+        cap = _pow2_at_least(n, lo=64)
+        jf = self._pkeys_cache.get(cap)
+        if jf is None:
+            plan = self.plan
+            keys_of = self._keys_of
+
+            @jax.jit
+            def pk(packed, valid):
+                rows = plan.unpack_rows(packed)
+                return keys_of(rows, valid)[0]
+
+            self._pkeys_cache[cap] = jf = pk
+        buf = np.repeat(np.asarray(packed_np[:1], np.int32), cap, axis=0)
+        buf[:n] = packed_np
+        k = jf(jnp.asarray(buf), jnp.asarray(np.arange(cap) < n))
+        return np.asarray(k)[:n, 1:]
+
+    def _tier_keep_mask(self, rows_np: np.ndarray) -> np.ndarray:
+        """[n] bool keep-mask over packed rows: False where the row's
+        dedup key already lives in a cold tier (it was admitted before
+        the spill, so the uncapped run would never have re-frontiered
+        it)."""
+        if self._tiers is None or not self._tiers.active \
+                or len(rows_np) == 0:
+            return np.ones(len(rows_np), bool)
+        return ~self._tiers.probe(self._packed_keys(rows_np))
+
     # ---- jitted level step, compiled per (seen_cap, frontier_cap) ----
     def _get_step(self, SC: int, FC: int) -> Callable:
         # rank-merge port (ISSUE 11 tentpole b): the level mode is the
@@ -1140,7 +1261,12 @@ class TpuExplorer:
         # JAXMC_LEVEL_RANKMERGE=0 keeps the full-sort as the escape
         # hatch / parity oracle.
         rank = os.environ.get("JAXMC_LEVEL_RANKMERGE", "").strip() != "0"
-        key = (SC, FC, rank)
+        # tiered runs (ISSUE 12) also stream each kept row's dedup key
+        # to the host, so the cold-tier membership probe never
+        # recomputes keys; the flag joins the compile key — the one
+        # recompile it costs happens at the first spill
+        tiered = self._tiers is not None
+        key = (SC, FC, rank, tiered)
         if key in self._step_cache:
             obs.current().counter("compile.cache_hits")
             return self._step_cache[key]
@@ -1265,6 +1391,10 @@ class TpuExplorer:
             front_rows_u = jnp.take(new_rows_u, perm4, axis=0)
             front_prov = jnp.take(new_prov, perm4)
             frontvalid = jnp.arange(C) < explore_count
+            front_keys = None
+            if tiered:
+                new_keys = jnp.take(ckeys, safe_cidx, axis=0)
+                front_keys = jnp.take(new_keys, perm4, axis=0)
 
             # invariants over the kept (explored) states only
             inv_bad_any = jnp.asarray(False)
@@ -1292,6 +1422,8 @@ class TpuExplorer:
                        front_count=explore_count,
                        inv_bad_any=inv_bad_any, inv_bad_idx=inv_bad_idx,
                        inv_bad_which=inv_bad_which)
+            if front_keys is not None:
+                out["front_keys"] = front_keys
             if need_edges:
                 exp_all = cvalid
                 for nm, f in con_fns:
@@ -1857,6 +1989,8 @@ class TpuExplorer:
                       variant=self.backend_desc.profile_variant())
             if keys is not None:
                 kw = dict(variant=variant, keys=keys, optional=optional)
+            elif optional:
+                kw["optional"] = optional
             path = save_capacity_profile(
                 self.model.module.name, self._layout_sig(), dict(caps),
                 **kw)
@@ -2003,6 +2137,11 @@ class TpuExplorer:
         payload = dict(mode=mode, module=self.model.module.name,
                        vars=list(self.model.vars),
                        layout_sig=self._layout_sig(), **state)
+        if self._tiers is not None and self._tiers.active:
+            # the FULL tier hierarchy rides every checkpoint (ISSUE 12):
+            # kill/resume mid-spill restores host and disk runs, so the
+            # resumed dedup set is exactly the crashed run's
+            payload["tiers"] = self._tiers.dump()
         try:
             with obs.current().span("checkpoint.write", mode=mode):
                 _ckpt.write_checkpoint(
@@ -2037,6 +2176,11 @@ class TpuExplorer:
                 "cannot resume: the lane layout differs from the "
                 "checkpoint's (different --seq-cap/--grow-cap/--kv-cap "
                 "or a changed model?)")
+        if ck.get("tiers") is not None:
+            # restore the cold tiers BEFORE any step compiles, so the
+            # resumed engine probes (and its steps stream keys) from
+            # the first level on
+            self._ensure_tiers().load(ck["tiers"])
         return ck
 
     def _restore_ck_state(self, ck, graph):
@@ -2217,6 +2361,12 @@ class TpuExplorer:
                      "AccCap": 1 << 17, "VC": 1 << 14} if on_accel else {
                 "SC": _pow2_at_least(max(4 * n_init, 1), lo=1 << 15),
                 "FCap": CH, "AccCap": 1 << 15, "VC": 1 << 13})
+        # a device seen cap (ISSUE 12) bounds the hot tier from the
+        # start: defaults/hints/profiles above it would keep the run
+        # from ever spilling (the floors below may still soft-breach a
+        # cap too small to seat the init keys)
+        if self.seen_cap is not None:
+            caps["SC"] = min(caps["SC"], self.seen_cap)
         # floors no hint may undercut: the seen table must seat every
         # init key and the frontier every init row (a 256-cap hint on a
         # 1600-init model would otherwise crash the seeding, not grow)
@@ -2356,8 +2506,13 @@ class TpuExplorer:
             fresh_compile = ck_key not in self._res_cache
             runf = self._get_resident_run(*ck_key)
             t_disp = time.time()
+            # once the run has spilled (ISSUE 12), every level needs a
+            # cold-tier probe at the host boundary: pin the dispatch to
+            # ONE level so the host sees each committed frontier
+            eff_maxlvl = 1 if (self._tiers is not None
+                               and self._tiers.active) else maxlvl
             seen, frontier, summary, brow = runf(*state, max_states,
-                                                 jnp.int32(maxlvl))
+                                                 jnp.int32(eff_maxlvl))
             jax.block_until_ready(summary)
             disp_wall = time.time() - t_disp
             # adapt levels-per-dispatch toward the host-attention target;
@@ -2381,6 +2536,34 @@ class TpuExplorer:
             depth = int(summary[6])
             which = int(summary[7])
             ovcode = int(summary[8])
+            # cold-tier filter (ISSUE 12): after a spill the device
+            # table restarted empty, so a committed level's frontier
+            # may hold rows whose keys live in the host/disk runs —
+            # exactly the rows the uncapped table would have deduped.
+            # Probe and drop them (order-preserving) before counts,
+            # truncation decisions, or the next dispatch see them.
+            # Rolled-back levels (grow statuses) keep their frontier —
+            # it was already filtered when it was admitted.
+            if self._tiers is not None and self._tiers.active and \
+                    fcount > 0 and stat not in grow_flag and \
+                    stat not in (ST_OVF_LANES, ST_DONE):
+                fr_np = np.asarray(frontier[:fcount])
+                keep = self._tier_keep_mask(fr_np)
+                n_dup = int((~keep).sum())
+                if n_dup:
+                    kept_rows = np.ascontiguousarray(fr_np[keep])
+                    distinct -= n_dup
+                    fcount = len(kept_rows)
+                    fr_full = np.full((int(frontier.shape[0]), self.PW),
+                                      SENTINEL, np.int32)
+                    fr_full[:fcount] = kept_rows
+                    frontier = jnp.asarray(fr_full)
+                if stat == ST_TRUNC and self.max_states and \
+                        distinct < self.max_states:
+                    stat = ST_CONTINUE  # phantom limit: dups un-counted
+                if fcount == 0 and stat == ST_CONTINUE:
+                    stat = ST_DONE  # the whole level was cold dups
+                self._tiers.publish_gauges(seen_count)
             self._res_caps = dict(caps)
             # one record per DISPATCH (the host only sees level batches
             # in resident mode): `level` is the depth reached, so indices
@@ -2399,11 +2582,54 @@ class TpuExplorer:
             if stat in grow_flag:
                 what = grow_flag[stat]
                 old = caps[what]
+                if what == "SC" and self.seen_cap is not None and \
+                        old >= self.seen_cap and seen_count > 0:
+                    # device tier full (ISSUE 12): instead of growing
+                    # past the cap, compact the sorted prefix out to
+                    # the cold tiers, restart the device table empty,
+                    # and redo the level (the rollback preserved the
+                    # pre-level state); subsequent dispatches run one
+                    # level at a time with a cold-tier probe each
+                    with tel.span("tier.spill", keys=seen_count):
+                        self._tier_spill_prefix(np.asarray(seen),
+                                                seen_count)
+                    seen = jnp.asarray(
+                        np.full((old, K), SENTINEL, np.int32))
+                    seen_count = 0
+                    self.log(f"-- tier: device seen cap "
+                             f"{self.seen_cap} reached; spilled the "
+                             f"device tier to "
+                             f"host={self._tiers.host_keys}/"
+                             f"disk={self._tiers.disk_keys} keys "
+                             f"(level {depth} redone)")
+                    state = (seen, jnp.int32(seen_count), frontier,
+                             jnp.int32(fcount), jnp.int32(distinct),
+                             jnp.int32(summary[4]),
+                             jnp.int32(summary[5]), jnp.int32(depth))
+                    continue
                 # x4: each growth recompiles the whole program, so
                 # over-shooting is much cheaper than growing twice
                 caps[what] = old * 4
                 if what == "VC":
                     caps[what] = min(caps[what], self.A * CH)
+                if what == "SC" and self.seen_cap is not None:
+                    if old < self.seen_cap:
+                        # grow the device tier all the way TO the cap
+                        # before spilling (the x4 overshoot must not
+                        # spill at a fraction of the configured cap)
+                        caps[what] = min(caps[what], self.seen_cap)
+                    else:
+                        # at/above the cap with nothing left to spill
+                        # (the rolled-back table is empty): one
+                        # level's new keys alone exceed the cap — grow
+                        # past it, named, exactly like the level
+                        # engine's soft breach (a clamp here would be
+                        # zero growth: an infinite redo of the same
+                        # dispatch)
+                        self.log(f"-- tier: device cap "
+                                 f"{self.seen_cap} < one level's new "
+                                 f"keys; growing to {caps[what]} "
+                                 f"anyway (soft cap)")
                 if what == "SC":
                     pad = jnp.full((caps[what] - old, K), SENTINEL,
                                    jnp.int32)
@@ -2447,7 +2673,17 @@ class TpuExplorer:
                          f"distinct states found, 0 states left on queue.")
                 self.log(f"The depth of the complete state graph search "
                          f"is {depth}.")
-                self._save_caps_profile(caps)
+                if self._tiers is not None and self._tiers.active:
+                    # tier sizes are LEARNED per (module, layout_sig,
+                    # platform) like SC/FCap: persist the cold-tier
+                    # key total so the next run on this engine knows
+                    # the out-of-core magnitude up front
+                    self._save_caps_profile(
+                        dict(caps, TIERK=_pow2_at_least(
+                            max(len(self._tiers), 1), lo=256)),
+                        optional=("TIERK",))
+                else:
+                    self._save_caps_profile(caps)
                 if self.checkpoint_path and self.final_checkpoint:
                     # COMPLETED-run checkpoint (serve warm resume): an
                     # empty frontier over the full seen set — resuming
@@ -2477,8 +2713,11 @@ class TpuExplorer:
                         frontier=np.asarray(frontier[:fcount]),
                         distinct=distinct, generated=generated,
                         depth=depth)
-                return self._mk_result(True, distinct, generated, depth,
-                                       t0, warnings, None, truncated=True)
+                return self._mk_result(
+                    True, distinct, generated, depth, t0, warnings,
+                    None, truncated=True,
+                    trunc_reason=f"max_states: distinct {distinct} >= "
+                                 f"limit {self.max_states}")
             elif stat == ST_OVF_LANES:
                 if ovcode == OV_DEMOTED:
                     msg = ("a demoted compile-recovery fired (the "
@@ -2523,6 +2762,14 @@ class TpuExplorer:
                     "store (host_seen); dedup on 128-bit fingerprints"]
         warnings.extend(self._temporal_warnings())
         warnings.extend(self._symmetry_warnings())
+        if self.seen_cap is not None:
+            # the native store is already host-resident (its growth IS
+            # the host tier): name the dropped option instead of
+            # silently ignoring it (ISSUE 12)
+            self.log("-- host_seen: --seen-cap/JAXMC_SEEN_CAP is "
+                     "ignored here (the native fingerprint store is "
+                     "host-resident; tier spill applies to the "
+                     "device-table modes)")
 
         init_rows, explored_init, n_init, err = \
             self._prepare_init(t0, warnings)
@@ -2842,8 +3089,11 @@ class TpuExplorer:
             depth += 1
             if self.max_states and distinct >= self.max_states:
                 self.log("-- state limit reached, search truncated")
-                return self._mk_result(True, distinct, generated, depth,
-                                       t0, warnings, None, truncated=True)
+                return self._mk_result(
+                    True, distinct, generated, depth, t0, warnings,
+                    None, truncated=True,
+                    trunc_reason=f"max_states: distinct {distinct} >= "
+                                 f"limit {self.max_states}")
             frontier_np = new_rows_np[sel]
 
             now = time.time()
@@ -3294,9 +3544,31 @@ class TpuExplorer:
             C = self.A * FC
             if seen_count + C > SC:
                 SC2 = _pow2_at_least(seen_count + C, SC)
-                pad = jnp.full((SC2 - SC, K), SENTINEL, jnp.int32)
-                seen = jnp.concatenate([seen, pad])
-                SC = SC2
+                if self.seen_cap is not None and SC2 > self.seen_cap \
+                        and seen_count > 0:
+                    # device tier full (ISSUE 12): compact the sorted
+                    # prefix out to the cold tiers and restart the
+                    # device table empty, instead of growing past the
+                    # cap — kept rows are cold-probed after each step
+                    with tel.span("tier.spill", keys=seen_count):
+                        self._tier_spill_prefix(np.asarray(seen),
+                                                seen_count)
+                    seen = jnp.asarray(
+                        np.full((SC, K), SENTINEL, np.int32))
+                    seen_count = 0
+                    SC2 = _pow2_at_least(C, SC)
+                    if SC2 > max(SC, self.seen_cap):
+                        # the per-level candidate block alone exceeds
+                        # the cap: the rank-merge no-overflow invariant
+                        # (seen_count + C <= SC) forces a soft breach
+                        self.log(f"-- tier: device cap "
+                                 f"{self.seen_cap} < one level's "
+                                 f"candidate block ({C}); growing "
+                                 f"anyway (soft cap)")
+                if SC2 > SC:
+                    pad = jnp.full((SC2 - SC, K), SENTINEL, jnp.int32)
+                    seen = jnp.concatenate([seen, pad])
+                    SC = SC2
             step = self._get_step(SC, FC)
             out = step(seen, seen_count, frontier, fcount)
 
@@ -3348,17 +3620,40 @@ class TpuExplorer:
 
             front_count = int(out["front_count"])
             generated += int(out["gen"])
-            distinct += front_count  # kept states only (discards excluded)
+            # cold-tier membership filter (ISSUE 12): rows the device
+            # rank-merge called new may duplicate keys spilled to the
+            # host/disk tiers — drop them (order-preserving) before
+            # they are counted, traced, or explored: exactly the rows
+            # the uncapped run's device merge would have dropped, so
+            # counts and traces stay bit-identical
+            tier_keep = None
+            fr_host = fp_host = None
+            if self._tiers is not None and self._tiers.active \
+                    and front_count:
+                fkeys = np.asarray(out["front_keys"][:front_count, 1:])
+                dup = self._tiers.probe(fkeys)
+                if dup.any():
+                    tier_keep = ~dup
+                    fr_host = np.ascontiguousarray(np.asarray(
+                        out["front_rows"][:front_count])[tier_keep])
+                    fp_host = np.ascontiguousarray(np.asarray(
+                        out["front_prov"][:front_count])[tier_keep])
+                self._tiers.publish_gauges(int(out["seen_count"]))
+            kept_count = len(fr_host) if fr_host is not None \
+                else front_count
+            distinct += kept_count  # kept states only (discards excluded)
             seen = out["seen"]
             seen_count = int(out["seen_count"])
             tel.level(depth, frontier=fcount, generated=int(out["gen"]),
-                      new=front_count, distinct=distinct, seen=seen_count,
+                      new=kept_count, distinct=distinct, seen=seen_count,
                       wall_s=round(time.time() - lvl_t0, 6))
             self._fp_occupancy = seen_count
 
             if graph is not None:
                 new_sids = graph.add_level(
+                    fr_host if fr_host is not None else
                     np.asarray(out["front_rows"][:front_count]),
+                    fp_host if fp_host is not None else
                     np.asarray(out["front_prov"][:front_count]),
                     FC, frontier_sids)
                 if graph.collect_edges:
@@ -3377,14 +3672,25 @@ class TpuExplorer:
             if self.store_trace:
                 # trace levels hold the kept states; every kept state is
                 # explored, so the frontier map is the identity
-                fr_h = np.asarray(out["front_rows"][:max(front_count, 1)])
-                fp_h = np.asarray(out["front_prov"][:max(front_count, 1)])
-                trace_levels.append(
-                    (fr_h[:front_count], fp_h[:front_count], FC))
+                if fr_host is not None:
+                    trace_levels.append((fr_host, fp_host, FC))
+                else:
+                    fr_h = np.asarray(
+                        out["front_rows"][:max(front_count, 1)])
+                    fp_h = np.asarray(
+                        out["front_prov"][:max(front_count, 1)])
+                    trace_levels.append(
+                        (fr_h[:front_count], fp_h[:front_count], FC))
                 frontier_maps.append(
-                    np.arange(front_count, dtype=np.int64))
+                    np.arange(kept_count, dtype=np.int64))
             if bool(out["inv_bad_any"]):
                 idx = int(out["inv_bad_idx"])
+                if tier_keep is not None:
+                    # a tier-duplicate row can never violate (its state
+                    # was invariant-checked when first admitted), so
+                    # the violating row survives the filter: re-index
+                    # it into the filtered level
+                    idx = int(np.sum(tier_keep[:idx]))
                 which = int(out["inv_bad_which"])
                 nm = self.inv_fns[which][0]
                 trace = self._trace_to(trace_levels, frontier_maps,
@@ -3396,16 +3702,24 @@ class TpuExplorer:
 
             if self.max_states and distinct >= self.max_states:
                 self.log("-- state limit reached, search truncated")
-                return self._mk_result(True, distinct, generated, depth, t0,
-                                       warnings, None, truncated=True)
+                return self._mk_result(
+                    True, distinct, generated, depth, t0, warnings,
+                    None, truncated=True,
+                    trunc_reason=f"max_states: distinct {distinct} >= "
+                                 f"limit {self.max_states}")
 
-            if front_count > FC:
-                FC = _pow2_at_least(front_count, FC)
-            nf = jnp.full((FC, self.PW), SENTINEL, jnp.int32)
-            nf = nf.at[:min(front_count, FC)].set(
-                out["front_rows"][:min(front_count, FC)])
-            frontier = nf
-            fcount = front_count
+            if kept_count > FC:
+                FC = _pow2_at_least(kept_count, FC)
+            if fr_host is not None:
+                nf_np = np.full((FC, self.PW), SENTINEL, np.int32)
+                nf_np[:kept_count] = fr_host
+                frontier = jnp.asarray(nf_np)
+            else:
+                nf = jnp.full((FC, self.PW), SENTINEL, jnp.int32)
+                nf = nf.at[:min(front_count, FC)].set(
+                    out["front_rows"][:min(front_count, FC)])
+                frontier = nf
+            fcount = kept_count
 
             now = time.time()
             if self.checkpoint_path and \
@@ -3450,7 +3764,8 @@ class TpuExplorer:
 
     def _mk_result(self, ok, distinct, generated, diameter, t0, warnings,
                    violation=None, truncated=False,
-                   drained=False) -> CheckResult:
+                   drained=False,
+                   trunc_reason: Optional[str] = None) -> CheckResult:
         tel = obs.current()
         tel.high_water("device.mem_high_water_bytes",
                        obs.device_mem_high_water())
@@ -3461,10 +3776,35 @@ class TpuExplorer:
             warnings.append("temporal properties NOT checked: the "
                             "search was truncated (behavior graph "
                             "incomplete)")
+        # ISSUE 12 result surface: the dedup-key mode, the fingerprint
+        # collision-probability bound over every ADMITTED key (device
+        # occupancy + cold tiers — discarded states hold keys too), the
+        # tier-hierarchy summary, and the named exhausted resource on
+        # truncations (a bare `truncated` flag cannot tell a deliberate
+        # --max-states from a capacity wall)
+        tiers_stats = None
+        if self._tiers is not None and self._tiers.active:
+            tiers_stats = self._tiers.stats()
+            self._tiers.publish_gauges(occ or 0)
+        seen_mode = "fingerprint" if self.fp_mode else "exact"
+        collision_p = None
+        if self.fp_mode:
+            n = float((occ or 0) +
+                      (len(self._tiers) if self._tiers is not None
+                       else 0))
+            collision_p = n * n * 2.0 ** -129
+            tel.gauge("fingerprint.collision_p", collision_p)
+        if truncated and trunc_reason is None:
+            trunc_reason = "drain" if drained else "unattributed"
+        if trunc_reason:
+            tel.gauge("truncation.reason", trunc_reason)
         return CheckResult(ok=ok, distinct=distinct, generated=generated,
                            diameter=max(diameter, 0), violation=violation,
                            wall_s=time.time() - t0, truncated=truncated,
-                           warnings=warnings, drained=drained)
+                           warnings=warnings, drained=drained,
+                           trunc_reason=trunc_reason,
+                           seen_mode=seen_mode, collision_p=collision_p,
+                           tiers=tiers_stats)
 
     def _drain_requested(self, warnings, engine: str) -> bool:
         """Cooperative drain poll at a device-safe boundary (between

@@ -178,6 +178,15 @@ class MeshExplorer(TpuExplorer):
         self.backend_desc = describe_backend(
             platform=self.backend_desc.platform, device_count=self.D)
         # seen shards store fingerprint keys: force fp mode on any width
+        # — which means --seen exact cannot be honored here (ISSUE 12):
+        # refuse it the way bfs refuses resident/host_seen, instead of
+        # silently fingerprinting past the requested contract
+        if getattr(self, "seen_mode_req", "auto") == "exact":
+            from ..compile.vspec import ModeError
+            raise ModeError(
+                "--seen exact is incompatible with the mesh engine "
+                "(seen shards store 128-bit fingerprints) — use the "
+                "single-device level mode or --backend interp")
         self.fp_mode = True
         self.K = 4 + 1
         # ICI exchange strategy (SURVEY.md §2.3 "communication
@@ -303,6 +312,86 @@ class MeshExplorer(TpuExplorer):
         # learned caps must never warm a cpu-XLA virtual-device run
         return self.backend_desc.profile_variant(
             f"mesh-d{self.D}-{self.exchange}")
+
+    # ---- hierarchical seen set (ISSUE 12): per-shard tiering ----
+
+    def _mesh_shard_cap(self) -> Optional[int]:
+        """Per-shard device seen cap: the engine cap (--seen-cap /
+        JAXMC_SEEN_CAP, TOTAL device key rows) divided across the D
+        owner-routed shards."""
+        if self.seen_cap is None:
+            return None
+        return _pow2_at_least(max(self.seen_cap // self.D, 64), lo=64)
+
+    def _mesh_tier_spill(self, seen, seen_count, SC: int):
+        """Spill every shard's sorted valid prefix into the cold tiers
+        as one immutable run each — owner-routed keys PARTITION the key
+        space, so a single combined store answers membership for every
+        shard — and restart the shards empty.  Returns the reset
+        (seen, seen_count) device pair."""
+        tel = obs.current()
+        scounts = np.asarray(seen_count)
+        total = int(scounts.sum())
+        seen_np = np.asarray(seen)
+        with tel.span("tier.spill", keys=total, shards=self.D):
+            t = self._ensure_tiers()
+            for dd in range(self.D):
+                cnt = int(scounts[dd])
+                if cnt:
+                    t.spill(np.ascontiguousarray(
+                        seen_np[dd, :cnt, 1:]))
+            tel.counter("tier.spilled_keys", total)
+        empty = np.full((self.D, SC, self.K), SENTINEL, np.int32)
+        empty[:, :, 0] = 1
+        return jnp.asarray(empty), jnp.asarray(
+            np.zeros(self.D, np.int32))
+
+    def _mesh_tier_filter(self, frontier, fcount, tr_rows, tr_src,
+                          depth: int, FC: int):
+        """Post-commit cold-tier filter for one mesh level (supersteps
+        are pinned to 1 while tiering is active): drop frontier rows
+        whose keys live in the host/disk runs — per shard, order-
+        preserving — and rewrite the level's trace-ring slot with the
+        SAME compaction so parent indices recorded by the next level
+        keep resolving.  Returns (frontier, fcount, tr_rows, tr_src,
+        n_dup)."""
+        fr_np = np.asarray(frontier)          # [D, FC, PW]
+        fc_np = np.asarray(fcount).astype(np.int32).copy()
+        keeps = []
+        n_dup = 0
+        for dd in range(self.D):
+            c = int(fc_np[dd])
+            if c == 0:
+                keeps.append(None)
+                continue
+            keep = self._tier_keep_mask(fr_np[dd, :c])
+            keeps.append(keep)
+            n_dup += int((~keep).sum())
+        if n_dup == 0:
+            return frontier, fcount, tr_rows, tr_src, 0
+        new_fr = np.full_like(fr_np, SENTINEL)
+        new_src = None
+        src_slot = None
+        if self.store_trace:
+            src_slot = np.asarray(tr_src[:, depth - 1])
+            new_src = np.full((self.D, FC), -1, np.int32)
+            obs.current().counter("mesh.row_syncs")
+        for dd in range(self.D):
+            c = int(fc_np[dd])
+            if c == 0:
+                continue
+            keep = keeps[dd]
+            k = int(keep.sum())
+            new_fr[dd, :k] = fr_np[dd, :c][keep]
+            if new_src is not None:
+                new_src[dd, :k] = src_slot[dd, :c][keep]
+            fc_np[dd] = k
+        frontier = jnp.asarray(new_fr)
+        fcount = jnp.asarray(fc_np)
+        if self.store_trace:
+            tr_rows = tr_rows.at[:, depth - 1].set(jnp.asarray(new_fr))
+            tr_src = tr_src.at[:, depth - 1].set(jnp.asarray(new_src))
+        return frontier, fcount, tr_rows, tr_src, n_dup
 
     # ---- the sharded level step ----
     def _a2a_bucket(self, C: int, FC: int) -> int:
@@ -1536,9 +1625,20 @@ class MeshExplorer(TpuExplorer):
                   "fp128" + ("-view" if self.view_fn is not None
                              else ("-packed" if not self.plan.identity
                                    else "")))
+        # likewise seen.mode (ISSUE 12): the base constructor stamped
+        # it before the mesh subclass forced fp128 keys
+        tel.gauge("seen.mode", "fingerprint")
         tel.gauge("mesh.merge", self.merge)
         if resident:
             return self._run_mesh_resident()
+        if self.seen_cap is not None:
+            # the legacy host loop (refinement/temporal PROPERTYs)
+            # keeps the historical grow-forever behavior: name it
+            # instead of silently ignoring the cap
+            self.log("-- mesh host loop: --seen-cap/JAXMC_SEEN_CAP is "
+                     "ignored here (tier spill runs on the resident "
+                     "mesh loop; refinement/temporal PROPERTYs force "
+                     "the host loop)")
         return self._run_hostloop(need_edges, need_props)
 
     # ------------------------------------------------------------------
@@ -1650,6 +1750,15 @@ class MeshExplorer(TpuExplorer):
                     int(hint.get("FC", 1))), lo=64)
             SC = _pow2_at_least(max(4 * FC, int(hint.get("SC", 1))),
                                 lo=256)
+            shard_cap = self._mesh_shard_cap()
+            if shard_cap is not None:
+                # device seen cap (ISSUE 12): bound each shard's hot
+                # tier from the start, floored so every shard seats
+                # its init keys (a too-small cap soft-breaches)
+                SC = min(SC, shard_cap)
+                SC = max(SC, _pow2_at_least(
+                    max(int(np.bincount(owner, minlength=D).max()), 1),
+                    lo=64))
             TRL = _pow2_at_least(max(int(hint.get("TRL", 1)), 16),
                                  lo=16)
             explored_idx = np.nonzero(explored_mask)[0]
@@ -1724,7 +1833,11 @@ class MeshExplorer(TpuExplorer):
             args = (seen, seen_count, frontier, fcount)
             if self.store_trace:
                 args = args + (tr_rows, tr_src)
-            args = args + (jnp.int32(depth), jnp.int32(maxlvl),
+            # once spilled (ISSUE 12) every level needs a cold-tier
+            # probe at the host boundary: pin supersteps to one level
+            eff_maxlvl = 1 if (self._tiers is not None
+                               and self._tiers.active) else maxlvl
+            args = args + (jnp.int32(depth), jnp.int32(eff_maxlvl),
                            jnp.int32(distinct),
                            jnp.int32(self.max_states or 0))
             outs = step(*args)
@@ -1822,10 +1935,27 @@ class MeshExplorer(TpuExplorer):
                     if scal[_S_SOVF]:
                         SC2 = _pow2_at_least(int(scal[_S_MAXS]),
                                              lo=2 * SC)
-                        seen = self._pad_dev(seen, 1, SC2, SENTINEL,
-                                             lane1=True)
-                        SC = SC2
-                        grew.append(f"SC->{SC}")
+                        shard_cap = self._mesh_shard_cap()
+                        scounts_now = np.asarray(seen_count)
+                        if shard_cap is not None and SC2 > shard_cap \
+                                and scounts_now.sum() > 0:
+                            # per-shard device tier full (ISSUE 12):
+                            # spill every shard's sorted prefix to the
+                            # cold tiers and redo the level against
+                            # empty shards instead of growing past the
+                            # cap
+                            seen, seen_count = self._mesh_tier_spill(
+                                seen, seen_count, SC)
+                            grew.append(
+                                f"seen->tier-spill("
+                                f"{int(scounts_now.sum())} keys, "
+                                f"host={self._tiers.host_keys} "
+                                f"disk={self._tiers.disk_keys})")
+                        else:
+                            seen = self._pad_dev(seen, 1, SC2, SENTINEL,
+                                                 lane1=True)
+                            SC = SC2
+                            grew.append(f"SC->{SC}")
                     if scal[_S_FOVF]:
                         FC2 = _pow2_at_least(int(scal[_S_MAXF]),
                                              lo=2 * FC)
@@ -1936,6 +2066,21 @@ class MeshExplorer(TpuExplorer):
                                     self._viol("invariant", nm, trace))
                 depth += 1
                 lvl_frontier = int(scal[_S_FRONT])
+                if self._tiers is not None and self._tiers.active and \
+                        lvl_frontier > 0:
+                    # cold-tier filter (ISSUE 12; supersteps pinned to
+                    # 1): drop frontier rows whose keys were spilled —
+                    # the rows the uncapped shards would have deduped —
+                    # and rewrite the trace-ring slot to match, so the
+                    # next level's parent indices keep resolving
+                    (frontier, fcount, tr_rows, tr_src, n_dup) = \
+                        self._mesh_tier_filter(frontier, fcount,
+                                               tr_rows, tr_src,
+                                               depth, FC)
+                    if n_dup:
+                        distinct -= n_dup
+                        lvl_frontier -= n_dup
+                    self._tiers.publish_gauges(sum_seen)
 
                 if self.max_states and distinct >= self.max_states:
                     # a truncation point IS a level boundary: leave a
@@ -1948,8 +2093,11 @@ class MeshExplorer(TpuExplorer):
                                       generated, distinct)
                     self._save_mesh_profile(SC, FC, TRL, VC)
                     self.log("-- state limit reached, search truncated")
-                    return self._mk(True, distinct, generated, depth,
-                                    t0, warnings, truncated=True)
+                    return self._mk(
+                        True, distinct, generated, depth, t0, warnings,
+                        truncated=True,
+                        trunc_reason=f"max_states: distinct {distinct} "
+                                     f">= limit {self.max_states}")
 
             now = time.time()
             if now - last_progress >= self.progress_every:
@@ -2569,8 +2717,11 @@ class MeshExplorer(TpuExplorer):
                     self._mesh_ck(seen, seen_counts, frontier, fcount,
                                   FC, SC, depth, generated, distinct)
                 self.log("-- state limit reached, search truncated")
-                return self._mk(True, distinct, generated, depth, t0,
-                                warnings, truncated=True)
+                return self._mk(
+                    True, distinct, generated, depth, t0, warnings,
+                    truncated=True,
+                    trunc_reason=f"max_states: distinct {distinct} >= "
+                                 f"limit {self.max_states}")
 
             now = time.time()
             if now - last_progress >= self.progress_every:
@@ -2596,7 +2747,8 @@ class MeshExplorer(TpuExplorer):
         return self._mk(True, distinct, generated, depth - 1, t0, warnings)
 
     def _mk(self, ok, distinct, generated, diameter, t0, warnings,
-            violation=None, truncated=False, drained=False):
+            violation=None, truncated=False, drained=False,
+            trunc_reason=None):
         tel = obs.current()
         tel.high_water("device.mem_high_water_bytes",
                        obs.device_mem_high_water())
@@ -2617,7 +2769,24 @@ class MeshExplorer(TpuExplorer):
             tel.gauge("mesh.supersteps", self._supersteps)
             tel.gauge("mesh.superstep_levels",
                       self._superstep_levels_max)
+        # ISSUE 12 result surface (mirrors bfs._mk_result): tier
+        # summary, fingerprint collision bound, named truncations
+        tiers_stats = None
+        if self._tiers is not None and self._tiers.active:
+            tiers_stats = self._tiers.stats()
+            self._tiers.publish_gauges(occ or 0)
+        n = float((occ or 0) + (len(self._tiers)
+                                if self._tiers is not None else 0))
+        collision_p = n * n * 2.0 ** -129
+        tel.gauge("fingerprint.collision_p", collision_p)
+        if truncated and trunc_reason is None:
+            trunc_reason = "drain" if drained else "unattributed"
+        if trunc_reason:
+            tel.gauge("truncation.reason", trunc_reason)
         return CheckResult(ok=ok, distinct=distinct, generated=generated,
                            diameter=max(diameter, 0), violation=violation,
                            wall_s=time.time() - t0, truncated=truncated,
-                           warnings=warnings, drained=drained)
+                           warnings=warnings, drained=drained,
+                           trunc_reason=trunc_reason,
+                           seen_mode="fingerprint",
+                           collision_p=collision_p, tiers=tiers_stats)

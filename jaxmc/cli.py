@@ -164,6 +164,16 @@ def _run_check(args, tel, log, t0) -> int:
                   "warnings": list(getattr(res, "warnings", []))}
         if getattr(res, "drained", False):
             result["drained"] = True
+        # ISSUE 12 result surface: seen-key mode, the fingerprint
+        # collision bound, the named exhausted resource on truncation,
+        # and the tier-hierarchy summary when the run spilled
+        result["seen_mode"] = getattr(res, "seen_mode", "exact")
+        if getattr(res, "collision_p", None) is not None:
+            result["collision_p"] = res.collision_p
+        if getattr(res, "trunc_reason", None):
+            result["trunc_reason"] = res.trunc_reason
+        if getattr(res, "tiers", None):
+            result["tiers"] = res.tiers
         if res.violation is not None:
             result["violation"] = {"kind": res.violation.kind,
                                    "name": res.violation.name}
@@ -310,6 +320,29 @@ def main(argv=None) -> int:
                    help="jax backend: keep the seen-set in the native C++ "
                         "fingerprint store (state spaces beyond device "
                         "memory; usually faster)")
+    c.add_argument("--seen", choices=("auto", "exact", "fingerprint"),
+                   default="auto",
+                   help="jax backend: dedup-key mode. auto = exact keys "
+                        "on narrow layouts, 128-bit fingerprints past "
+                        "FP_THRESHOLD (today's default); fingerprint = "
+                        "force fingerprints on ANY layout (4-8x the "
+                        "states per seen tier; the collision-"
+                        "probability bound is reported in the result); "
+                        "exact = refuse to fingerprint (errors on wide "
+                        "layouts / resident / host-seen)")
+    c.add_argument("--seen-cap", type=int, default=None, metavar="ROWS",
+                   help="jax backend: device seen-table cap in key "
+                        "rows (env: JAXMC_SEEN_CAP). On overflow the "
+                        "sorted device prefix SPILLS to host-RAM and "
+                        "then disk tiers (out-of-core checking) "
+                        "instead of growing device memory — counts and "
+                        "traces stay bit-identical to the uncapped "
+                        "run. Default: no cap (grow on device)")
+    c.add_argument("--seen-spill", default=None, metavar="DIR",
+                   help="jax backend: disk-tier directory for spilled "
+                        "seen-set runs (env: JAXMC_SPILL_DIR; default "
+                        "a temp dir). Host-RAM tier budget: "
+                        "JAXMC_TIER_HOST_KEYS keys")
     c.add_argument("--sample", type=int, nargs=3,
                    default=[800, 40, 60],
                    metavar=("BFS", "WALKS", "DEPTH"),

@@ -265,6 +265,18 @@ CASES: List[Case] = [
          # (SC grew 256 -> 65536 over 9 redo recompiles without it)
          mesh_caps={"SC": 1 << 16, "FC": 1 << 11, "TRL": 32,
                     "GAM16": 32, "MSL": 32}),
+    # out-of-core overflow fixture (ISSUE 12): a wide-state rung whose
+    # exact dedup keys cost >7x a fingerprint; `make ooc-check` forces
+    # a device seen cap at ~17% of its state count and pins the capped
+    # (tier-spilling) and fingerprint-mode runs bit-identical to this
+    # uncapped record.  NoMeet (the ooc_scaled_bad.cfg violation rung)
+    # is deliberately unused here — JMC301 waived.
+    Case("specs/ooc_scaled.tla", root="repo",
+         cfg="specs/ooc_scaled.cfg",
+         distinct=3072, generated=12289, jax="yes", mode="compiled",
+         lint_waive=("JMC301",),
+         res_caps={"SC": 1 << 13, "FCap": 256, "AccCap": 1 << 10,
+                   "VC": 512, "chunk": 256}),
     Case("specs/symtoy_scaled.tla", root="repo",
          cfg="specs/symtoy_scaled.cfg", no_deadlock=True,
          distinct=10725, generated=65365, jax="yes", mode="compiled",

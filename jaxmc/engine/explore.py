@@ -45,6 +45,21 @@ class CheckResult:
     # checkpoint; implies truncated=True — the explored prefix is clean
     # but incomplete, and the run is resumable
     drained: bool = False
+    # truncation ATTRIBUTION (ISSUE 12 satellite): which resource ran
+    # out — "max_states: distinct N >= limit M", a named tier/cap with
+    # the observed need, a drain reason — so `obs diff` can tell a
+    # capacity regression from a deliberate limit.  None on complete
+    # runs.
+    trunc_reason: Optional[str] = None
+    # dedup-key mode the run actually used ("exact" | "fingerprint")
+    # and, in fingerprint mode, the reported collision-probability
+    # bound (< n^2 * 2^-129 over n admitted keys) — TLC reports the
+    # same estimate for its 64-bit fingerprints
+    seen_mode: str = "exact"
+    collision_p: Optional[float] = None
+    # hierarchical seen-set summary when the run spilled (tiers.py
+    # stats(): host/disk keys, spills, compactions, probe wall)
+    tiers: Optional[Dict[str, Any]] = None
 
     @property
     def states_per_sec(self) -> float:
@@ -318,7 +333,8 @@ class Explorer:
                       wall_s=round(time.time() - lv["t0"], 6))
             lv.update(frontier=0, generated=0, new=0, t0=time.time())
 
-        def result(ok, violation=None, truncated=False, drained=False):
+        def result(ok, violation=None, truncated=False, drained=False,
+                   trunc_reason=None):
             if truncated and live_obligations:
                 warnings.append("temporal properties NOT checked: the "
                                 "search was truncated (behavior graph "
@@ -329,11 +345,20 @@ class Explorer:
                 tel.gauge("memo.hits", mst.hits)
                 tel.gauge("memo.misses", mst.misses)
             tel.gauge("fingerprint.occupancy", len(seen))
+            if truncated and trunc_reason is None:
+                # name the exhausted resource (ISSUE 12 satellite) —
+                # the serial engine truncates on max_states or a drain
+                trunc_reason = (f"drain" if drained else
+                                f"max_states: distinct {len(states)} "
+                                f">= limit {self.max_states}")
+            if trunc_reason:
+                tel.gauge("truncation.reason", trunc_reason)
             return CheckResult(ok=ok, distinct=len(states),
                                generated=generated, diameter=diameter,
                                violation=violation, wall_s=time.time() - t0,
                                prints=self.prints, truncated=truncated,
-                               warnings=warnings, drained=drained)
+                               warnings=warnings, drained=drained,
+                               trunc_reason=trunc_reason)
 
         def drain_out():
             # cooperative drain (jaxmc/drain.py): checkpoint at this

@@ -722,12 +722,20 @@ class ParallelExplorer(Explorer):
                 tel.gauge("memo.misses", mst.misses)
             tel.gauge("fingerprint.occupancy", len(seen))
             tel.gauge("parallel.workers", self.workers)
+            trunc_reason = None
+            if truncated:
+                # name the exhausted resource (ISSUE 12 satellite)
+                trunc_reason = ("drain" if drained else
+                                f"max_states: distinct {len(states)} "
+                                f">= limit {self.max_states}")
+                tel.gauge("truncation.reason", trunc_reason)
             return CheckResult(ok=ok, distinct=len(states),
                                generated=generated, diameter=diameter,
                                violation=violation,
                                wall_s=time.time() - t0,
                                prints=self.prints, truncated=truncated,
-                               warnings=warnings, drained=drained)
+                               warnings=warnings, drained=drained,
+                               trunc_reason=trunc_reason)
 
         # checkpoint plumbing: level-barrier (and truncation) writes in
         # the serial engine's payload format, with the serial engine's

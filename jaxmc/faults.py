@@ -49,6 +49,11 @@ Sites wired in this PR:
                       (tpu/bfs.py retries)
     device_run_fail   the device search loop raises entering a level
                       (cli.py demotes to the parallel CPU engine)
+    tier_io_error     a hierarchical-seen-set disk write fails
+                      (backend/tiers.py, ISSUE 12): the tier store
+                      must DEGRADE to host-tier-only with a named
+                      `tier.io_degraded` event — counts stay exact,
+                      the run never crashes (ctx: op=write)
 
 Persistent-compile-cache guard sites (ISSUE 5, jaxmc/compile/cache.py —
 each must degrade to COLD compilation with the run intact, pinned by

@@ -108,6 +108,10 @@ def _from_metrics(s: Dict[str, Any], path: str, label: str
         # a terminal device failure that completed on the CPU fallback
         # (session.demote_to_cpu); find_regressions flags its appearance
         "demoted": s.get("gauges", {}).get("device.demoted"),
+        # a disk-tier write failure that degraded the seen-set
+        # hierarchy to host-tier-only (ISSUE 12): counts stayed exact,
+        # but the out-of-core ceiling shrank — flagged like a demotion
+        "io_degraded": s.get("gauges", {}).get("tier.io_degraded"),
         "mode": s.get("gauges", {}).get("expand.mode"),
         "wall_s": s.get("wall_s"),
         "phases": {p["name"]: p["wall_s"] for p in s.get("phases", [])},
@@ -273,9 +277,18 @@ def cmd_report(args, out=sys.stdout) -> int:
               "compile.hlo_flops_total", "watchdog.stalls",
               "mesh.host_syncs", "mesh.row_syncs",
               "mesh.exchange_bytes", "analyze.predicted_demotions",
-              "analyze.lint_diags"):
+              "analyze.lint_diags", "tier.spills",
+              "tier.spilled_keys", "tier.compactions"):
         if k in c:
             hl.append(f"{k}={c[k]}")
+    # out-of-core highlight row (ISSUE 12): one cell naming each tier's
+    # key occupancy, so a spilling run's artifact reads
+    # tier[device=… host=… disk=…] at a glance
+    occ = g.get("tier.occupancy")
+    if isinstance(occ, dict):
+        hl.append("tier[" + " ".join(
+            f"{t}={occ.get(t, 0)}" for t in ("device", "host", "disk"))
+            + "]")
     # proven-lane ratio (ISSUE 9): how much of the int-lane surface the
     # static analyzer proved vs what stayed sampled+guarded
     pv, gd = g.get("analyze.proven_lanes"), \
@@ -284,7 +297,11 @@ def cmd_report(args, out=sys.stdout) -> int:
         hl.append(f"analyze.proven_lanes={pv}/{pv + gd} "
                   f"({100.0 * pv / (pv + gd):.0f}% of int lanes "
                   f"proven)")
-    for k in ("expand.mode", "dedup.mode", "layout.width_lanes",
+    for k in ("expand.mode", "dedup.mode", "seen.mode",
+              "tier.device_cap", "tier.probe_wall_s",
+              "tier.io_degraded", "truncation.reason",
+              "fingerprint.collision_p",
+              "layout.width_lanes",
               "layout.packed_width_lanes", "layout.bits_per_state",
               "device.donation", "profile.status",
               "fingerprint.occupancy", "mesh.exchange", "mesh.devices",
@@ -378,6 +395,14 @@ def find_regressions(prev: Dict[str, Any], cur: Dict[str, Any],
             f"REGRESS device demotion {step}: device backend failed "
             f"terminally, run completed on the CPU fallback "
             f"({cur['demoted']})")
+    if cur.get("io_degraded") and not prev.get("io_degraded"):
+        # counts stayed exact (the store fell back to host-tier-only)
+        # but the disk tier died mid-run — the out-of-core capacity
+        # ceiling regressed even though the search survived
+        flags.append(
+            f"REGRESS tier io degradation {step}: disk-tier write "
+            f"failed, seen-set hierarchy ran host-tier-only "
+            f"({cur['io_degraded']})")
     for name in sorted(set(prev["phases"]) & set(cur["phases"])):
         if name in ignore_phases:
             continue

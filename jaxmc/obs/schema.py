@@ -272,6 +272,38 @@ jaxmc.metrics/2 artifact minus the new optional surface, so readers and
       mesh-d<D>-<exchange>) gain the MSL key — the superstep
       controller's learned levels-per-dispatch — alongside
       SC/FC/TRL/GAM16.
+
+  (PR 12, still jaxmc.metrics/2 — all additive/optional; the
+   out-of-core hierarchical seen set, backend/tiers.py + ISSUE 12:)
+    - seen-key mode: gauge `seen.mode` ("exact" | "fingerprint") — the
+      dedup-key mode that actually ran (--seen forces it; auto keeps
+      the width-based default); gauge `fingerprint.collision_p` — the
+      reported n^2 * 2^-129 bound over every admitted key (device +
+      cold tiers).  `result` gains `seen_mode` and (fingerprint runs)
+      `collision_p`.
+    - tier hierarchy: gauge `tier.occupancy` ({device, host, disk}
+      keys), gauge `tier.probe_wall_s` (cumulative cold-probe wall),
+      gauge `tier.device_cap` (the configured device cap, rows),
+      counters `tier.spills` / `tier.spilled_keys` /
+      `tier.compactions`; phase span `tier.spill {keys[, shards]}`
+      per device-prefix spill; `result.tiers` carries the final
+      stats() summary {host_keys, disk_keys, host_runs, disk_runs,
+      spills, compactions, probe_wall_s[, io_degraded]}.
+    - tier fault containment: trace event + gauge `tier.io_degraded
+      {error}` when a disk-tier write fails (ENOSPC, the
+      tier_io_error fault site) and the store degrades to
+      host-tier-only — counts stay exact; `obs diff` treats its
+      appearance like `device.demoted` (a named degradation).
+    - truncation attribution: gauge `truncation.reason` and
+      `result.trunc_reason` — the EXHAUSTED resource by name
+      ("max_states: distinct N >= limit M", "drain", a tier/cap with
+      the observed need) so capacity regressions are attributable;
+      a bare `truncated` flag no longer ships alone.
+    - capacity profiles: resident runs that spilled persist the
+      optional TIERK key (cold-tier key total, pow2) alongside
+      SC/FCap/AccCap/VC; a capped run that loads one stamps gauge
+      `tier.predicted_keys` (the expected out-of-core magnitude)
+      before the first spill.
 """
 
 from __future__ import annotations

@@ -1,0 +1,230 @@
+r"""`make ooc-check` (ISSUE 12): the out-of-core seen-set gate.
+
+Four legs over the repo-local overflow fixture (specs/ooc_scaled.tla —
+wide packed rows, 3072 states, seconds-scale), one parseable
+`OOC-CHECK …` line each:
+
+  1. UNCAPPED   the exact (level-mode) run; counts must equal the
+                corpus manifest pins.
+  2. CAPPED     JAXMC_SEEN_CAP forces the device seen table to ~17% of
+                the state count and a tiny host budget forces the disk
+                tier: the run must complete EXHAUSTIVELY via tier
+                spill (no truncation), with counts bit-identical to
+                leg 1 and both cold tiers exercised.  The artifact
+                gates against its saved baseline via `python -m
+                jaxmc.obs diff --fail-on-regress` (first run snapshots
+                it, like every bench-check leg).
+  3. FINGERPRINT the same capped run under --seen fingerprint: counts
+                must stay bit-identical, the result must report its
+                collision probability, and the measured
+                states-per-device-tier ratio (exact key words /
+                fingerprint key words, from the artifacts' layout
+                gauges) must be >= 4x — the BASELINE.md claim,
+                measured every run.
+  4. TRACE      the violation rung (ooc_scaled_bad.cfg) capped vs
+                uncapped: the rendered counterexample must be
+                byte-identical.
+
+A container without the jax backend prints `OOC-CHECK SKIP …` and
+exits 0 — parseable, never a silent pass.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_SPEC = "specs/ooc_scaled.tla"
+_CFG = "specs/ooc_scaled.cfg"
+_CFG_BAD = "specs/ooc_scaled_bad.cfg"
+#: ~17% of the rung's 3072 states (acceptance: <= 25%), still >= one
+#: level's dense candidate block so the cap is never soft-breached
+_SEEN_CAP = "512"
+#: host-tier key budget small enough that the capped run flushes to disk
+_HOST_KEYS = "1024"
+_FP_WORDS = 5  # fingerprint dedup key words (4 fp words + validity)
+
+
+def _run(cfg: str, metrics: Optional[str], capped: bool,
+         seen: str = "auto", timeout_s: float = 600.0) -> Dict:
+    cmd = [sys.executable, "-m", "jaxmc", "check",
+           os.path.join(_REPO, _SPEC),
+           "--cfg", os.path.join(_REPO, cfg),
+           "--backend", "jax", "--platform", "cpu", "--quiet",
+           "--seen", seen]
+    if metrics:
+        cmd += ["--metrics-out", metrics]
+    env = dict(os.environ, PYTHONPATH=_REPO, JAX_PLATFORMS="cpu")
+    if capped:
+        env["JAXMC_SEEN_CAP"] = _SEEN_CAP
+        env["JAXMC_TIER_HOST_KEYS"] = _HOST_KEYS
+    else:
+        env.pop("JAXMC_SEEN_CAP", None)
+    t0 = time.time()
+    try:
+        p = subprocess.run(cmd, capture_output=True, text=True,
+                           cwd=_REPO, env=env, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return {"error": f"leg timed out after {timeout_s:.0f}s"}
+    out = {"rc": p.returncode, "stdout": p.stdout, "stderr": p.stderr,
+           "wall_s": round(time.time() - t0, 3)}
+    if "is not available" in (p.stderr or ""):
+        out["skip"] = p.stderr.strip().splitlines()[-1]
+        return out
+    if metrics:
+        try:
+            with open(metrics, encoding="utf-8") as fh:
+                out["summary"] = json.load(fh)
+        except (OSError, ValueError) as ex:
+            out["error"] = f"no metrics artifact ({ex})"
+    return out
+
+
+def _trace_lines(stdout: str) -> List[str]:
+    """The rendered counterexample: everything from the violation
+    banner on (timings stripped by taking whole lines only)."""
+    lines = stdout.splitlines()
+    for i, ln in enumerate(lines):
+        if "is violated" in ln or "Error:" in ln:
+            return lines[i:]
+    return []
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m jaxmc.oocbench",
+        description="out-of-core seen-set gate (capped exhaustive + "
+                    "fingerprint parity)")
+    ap.add_argument("--out-dir", default="/tmp")
+    ap.add_argument("--leg-timeout", type=float, default=float(
+        os.environ.get("JAXMC_OOC_CHECK_TIMEOUT", "600")))
+    args = ap.parse_args(argv)
+
+    from .corpus import case_for_cfg
+    case = case_for_cfg(os.path.basename(_CFG))
+    want = (case.generated, case.distinct) if case else (12289, 3072)
+
+    # leg 1: uncapped exact
+    m_exact = os.path.join(args.out_dir, "jaxmc_ooc_exact.json")
+    r = _run(_CFG, m_exact, capped=False, timeout_s=args.leg_timeout)
+    if r.get("skip"):
+        print(f"OOC-CHECK SKIP: {r['skip']}")
+        return 0
+    res = (r.get("summary") or {}).get("result") or {}
+    if r.get("rc") != 0 or not res.get("ok") or r.get("error"):
+        print(f"OOC-CHECK FAIL uncapped: rc={r.get('rc')} "
+              f"{r.get('error', '')} {(r.get('stderr') or '')[-200:]}",
+              file=sys.stderr)
+        return 1
+    got = (res.get("generated"), res.get("distinct"))
+    if got != want:
+        print(f"OOC-CHECK FAIL uncapped: counts {got} != manifest "
+              f"pins {want}", file=sys.stderr)
+        return 1
+    if res.get("seen_mode") != "exact":
+        print(f"OOC-CHECK FAIL uncapped: seen_mode="
+              f"{res.get('seen_mode')} (the rung must stay under "
+              f"FP_THRESHOLD so exact is the auto default)",
+              file=sys.stderr)
+        return 1
+    print(f"OOC-CHECK ok uncapped: {got[0]} gen / {got[1]} distinct "
+          f"exact ({r['wall_s']}s)")
+
+    failures = 0
+    # leg 2: capped exhaustive via tier spill
+    m_cap = os.path.join(args.out_dir, "jaxmc_ooc_capped.json")
+    r2 = _run(_CFG, m_cap, capped=True, timeout_s=args.leg_timeout)
+    res2 = (r2.get("summary") or {}).get("result") or {}
+    tiers = res2.get("tiers") or {}
+    if r2.get("rc") != 0 or not res2.get("ok") or \
+            res2.get("truncated") or \
+            (res2.get("generated"), res2.get("distinct")) != want:
+        print(f"OOC-CHECK FAIL capped: rc={r2.get('rc')} "
+              f"truncated={res2.get('truncated')} "
+              f"reason={res2.get('trunc_reason')} counts="
+              f"{(res2.get('generated'), res2.get('distinct'))} != "
+              f"{want}", file=sys.stderr)
+        failures += 1
+    elif not tiers.get("spills") or not tiers.get("disk_keys"):
+        print(f"OOC-CHECK FAIL capped: expected spill through BOTH "
+              f"cold tiers, got {tiers}", file=sys.stderr)
+        failures += 1
+    else:
+        print(f"OOC-CHECK ok capped: exhaustive at seen_cap="
+              f"{_SEEN_CAP} ({tiers['spills']} spills, "
+              f"host={tiers['host_keys']} disk={tiers['disk_keys']} "
+              f"keys, probe={tiers['probe_wall_s']}s; {r2['wall_s']}s)")
+        from .meshbench import _gate as gate
+        # cold-start compile walls swing with box load; gate the
+        # search/throughput surface like backend-check does
+        if gate(m_cap, log=print,
+                ignore_phases=("device_init", "engine_build",
+                               "layout_sample", "compile_arm",
+                               "tier.spill")):
+            failures += 1
+
+    # leg 3: fingerprint-mode parity + the measured per-tier ratio
+    m_fp = os.path.join(args.out_dir, "jaxmc_ooc_fp.json")
+    r3 = _run(_CFG, m_fp, capped=True, seen="fingerprint",
+              timeout_s=args.leg_timeout)
+    res3 = (r3.get("summary") or {}).get("result") or {}
+    if r3.get("rc") != 0 or not res3.get("ok") or \
+            (res3.get("generated"), res3.get("distinct")) != want:
+        print(f"OOC-CHECK FAIL fingerprint: rc={r3.get('rc')} counts="
+              f"{(res3.get('generated'), res3.get('distinct'))} != "
+              f"{want}", file=sys.stderr)
+        failures += 1
+    elif res3.get("seen_mode") != "fingerprint" or \
+            res3.get("collision_p") is None:
+        print(f"OOC-CHECK FAIL fingerprint: result must report "
+              f"seen_mode=fingerprint + collision_p, got "
+              f"{res3.get('seen_mode')}/{res3.get('collision_p')}",
+              file=sys.stderr)
+        failures += 1
+    else:
+        # measured states-per-device-tier ratio: tier rows cost
+        # (key_words)*4 bytes, so the ratio is exact key words over
+        # fingerprint key words — from the artifacts' layout gauges
+        pw = ((r.get("summary") or {}).get("gauges") or {}) \
+            .get("layout.packed_width_lanes")
+        ratio = (pw + 1) / _FP_WORDS if isinstance(pw, int) else None
+        if ratio is None or ratio < 4.0:
+            print(f"OOC-CHECK FAIL fingerprint: states/tier ratio "
+                  f"{ratio} < 4x (packed_width={pw})", file=sys.stderr)
+            failures += 1
+        else:
+            print(f"OOC-CHECK ok fingerprint: parity at "
+                  f"{ratio:.1f}x states/device-tier, "
+                  f"collision_p={res3['collision_p']:.3g} "
+                  f"({r3['wall_s']}s)")
+
+    # leg 4: violation-trace parity, capped vs uncapped
+    rb0 = _run(_CFG_BAD, None, capped=False,
+               timeout_s=args.leg_timeout)
+    rb1 = _run(_CFG_BAD, None, capped=True, timeout_s=args.leg_timeout)
+    t0_, t1_ = _trace_lines(rb0.get("stdout", "")), \
+        _trace_lines(rb1.get("stdout", ""))
+    if rb0.get("rc") != 1 or rb1.get("rc") != 1 or not t0_ or \
+            t0_ != t1_:
+        print(f"OOC-CHECK FAIL trace: capped trace differs from "
+              f"uncapped (rc {rb0.get('rc')}/{rb1.get('rc')}, "
+              f"{len(t0_)} vs {len(t1_)} lines)", file=sys.stderr)
+        failures += 1
+    else:
+        print(f"OOC-CHECK ok trace: capped counterexample "
+              f"byte-identical ({len(t0_)} lines)")
+
+    print(f"ooc-check: {'FAIL' if failures else 'ok'} "
+          f"({failures} failing legs)")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

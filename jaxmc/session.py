@@ -119,6 +119,16 @@ class SessionConfig:
     sample: Tuple[int, int, int] = (800, 40, 60)
     chunk: int = 2048
     resident: bool = False
+    # hierarchical seen set (ISSUE 12): dedup-key mode ("auto" keeps
+    # the width-based default; "fingerprint" trades exact keys for
+    # 128-bit fingerprints — 4-8x the states per tier, collision
+    # probability reported; "exact" refuses to fingerprint), the
+    # device seen cap (key rows; overflow spills to host/disk tiers
+    # instead of growing; env JAXMC_SEEN_CAP), and the disk-tier
+    # spill directory (default: a temp dir)
+    seen: str = "auto"
+    seen_cap: Optional[int] = None
+    seen_spill: Optional[str] = None
     checkpoint: Optional[str] = None
     checkpoint_every: float = 600.0
     resume: Optional[str] = None
@@ -159,6 +169,7 @@ class SessionConfig:
             "kv_cap": self.kv_cap, "no_trace": self.no_trace,
             "host_seen": self.host_seen, "sample": list(self.sample),
             "chunk": self.chunk, "resident": self.resident,
+            "seen": self.seen, "seen_cap": self.seen_cap,
         }
 
 
@@ -437,7 +448,10 @@ class CheckSession:
                     resume_from=cfg.resume,
                     max_states=cfg.max_states,
                     res_caps=cfg.res_caps,
-                    final_checkpoint=cfg.final_checkpoint)
+                    final_checkpoint=cfg.final_checkpoint,
+                    seen_mode=cfg.seen,
+                    seen_cap=cfg.seen_cap,
+                    spill_dir=cfg.seen_spill)
             self.layout_sig = self.engine._layout_sig()
         self.stage = "compile"
         return self

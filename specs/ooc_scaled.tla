@@ -1,0 +1,49 @@
+---------------------------- MODULE ooc_scaled ----------------------------
+(* Out-of-core overflow fixture (ISSUE 12): a WIDE-state rung sized so a
+   tiny forced device seen cap (JAXMC_SEEN_CAP / --seen-cap) drives the
+   hierarchical seen set through BOTH the host-RAM and disk tiers in
+   seconds, with counts/traces pinned bit-identical against the
+   uncapped run.
+
+   Shape: a (clock, x) product chain gives C * M = 3072 distinct states
+   over a shallow-but-wide BFS, while `mem` — N cells whose values
+   churn over 0..Span-1 as a pure function of clock — makes the PACKED
+   row deliberately wide: ~37 packed words at N = 18, wide enough that
+   exact dedup keys cost >7x a 128-bit fingerprint (the measurable
+   4-8x states-per-tier trade the ooc-check leg and BASELINE.md
+   record) yet still under FP_THRESHOLD, so exact keys stay the auto
+   default.  Because mem is derived from clock, the wide lanes add
+   width without adding states: the fixture stays a seconds-scale
+   rung. *)
+EXTENDS Naturals
+
+CONSTANTS C, M, K, N, Span
+
+VARIABLES clock, x, mem
+
+vars == <<clock, x, mem>>
+
+Cells == 1..N
+
+Init == clock = 0 /\ x = 0 /\ mem = [i \in Cells |-> 0]
+
+Tick == /\ clock' = (clock + 1) % C
+        /\ x' = x
+        /\ mem' = [i \in Cells |-> (clock' * (137 + i * 59)) % Span]
+
+Bump == \E k \in 1..K :
+          /\ x' = (x + k) % M
+          /\ clock' = clock
+          /\ mem' = mem
+
+Next == Tick \/ Bump
+
+Spec == Init /\ [][Next]_vars
+
+XBounded == x < M
+
+\* violation rung (ooc_scaled_bad.cfg): first reached at depth 15
+\* (12 ticks + 3 max-stride bumps), deep enough that the capped run
+\* must spill before the trace is found
+NoMeet == ~(clock = 12 /\ x = 9)
+=============================================================================

@@ -1,0 +1,600 @@
+r"""Out-of-core hierarchical seen set (ISSUE 12): device -> host -> disk
+tiered rank-merge + fingerprint-only mode.
+
+Pins, on repo-local models only (no reference corpus needed):
+  * backend/tiers.py unit contract: `_np_rank_merge` is a set-union of
+    sorted runs (vs a tuple-set oracle, negative words included),
+    `_keyview` maps signed row order onto unsigned byte order, spill /
+    host-compaction / disk-flush / LSM disk compaction preserve exact
+    membership, and `dump`/`load` round-trips the whole hierarchy;
+  * a failed disk write (the `tier_io_error` fault site, or ENOSPC)
+    DEGRADES the store to host-tier-only with the named
+    `tier.io_degraded` event — counts stay exact, nothing crashes;
+    an unreadable run mid-search (wrong counts, not a degraded mode)
+    raises instead;
+  * the capped engine run on specs/ooc_scaled.tla (device seen table
+    forced to ~17% of the state count, host budget forcing the disk
+    tier) completes EXHAUSTIVELY with counts bit-identical to the
+    manifest pins, on the single-chip level mode AND the mesh-resident
+    loop (per-shard tiering, D=2);
+  * truncation results name the exhausted resource (trunc_reason) on
+    the serial and device engines;
+  * --seen fingerprint parity against the manifest pins on EVERY
+    repo-local rung (bench-scale rungs marked slow), with the
+    collision-probability bound reported in the result; --seen exact
+    refuses modes that cannot honor it;
+  * chaos (mid-spill robustness, `-m chaos`): SIGKILL + resume and a
+    SIGTERM drain + resume both land bit-identical to the clean capped
+    run — the checkpoint carries the full tier hierarchy.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from jaxmc import faults, obs
+from jaxmc.backend.tiers import TieredSeen, _keyview, _np_rank_merge
+from jaxmc.front.cfg import ModelConfig, parse_cfg
+from jaxmc.sem.modules import Loader, bind_model
+
+SPECS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "specs")
+REPO = os.path.dirname(SPECS)
+
+#: the ooc_scaled fixture's manifest pins (jaxmc/corpus.py)
+OOC_WANT = (12289, 3072)
+#: ~17% of the rung's 3072 states — the acceptance cap (<= 25%)
+OOC_CAP = 512
+#: host-tier key budget small enough that the capped run hits disk
+OOC_HOST_KEYS = 1024
+
+
+@pytest.fixture(autouse=True)
+def _isolated(tmp_path, monkeypatch):
+    # per-test capacity-profile store + no ambient tier/fault knobs
+    monkeypatch.setenv("JAXMC_PROFILE_STORE", str(tmp_path / "prof"))
+    for k in ("JAXMC_SEEN_CAP", "JAXMC_TIER_HOST_KEYS",
+              "JAXMC_SPILL_DIR", "JAXMC_FAULTS", "JAXMC_FAULTS_STATE"):
+        monkeypatch.delenv(k, raising=False)
+    faults._CACHE = None
+    yield
+    faults._CACHE = None
+
+
+def load(name, cfg_name=None, no_deadlock=False):
+    m = Loader([SPECS]).load_path(os.path.join(SPECS, name + ".tla"))
+    cfgp = os.path.join(SPECS, (cfg_name or name) + ".cfg")
+    if os.path.exists(cfgp):
+        cfg = parse_cfg(open(cfgp).read())
+    else:
+        cfg = ModelConfig(specification="Spec")
+    if no_deadlock:
+        cfg.check_deadlock = False
+    return bind_model(m, cfg)
+
+
+def _sorted_rows(rows):
+    a = np.asarray(rows, np.int32)
+    return a[np.argsort(_keyview(a))]
+
+
+def _rand_runs(rng, n_a, n_b, kd=3, lo=-(1 << 30), hi=1 << 30):
+    a = np.unique(rng.integers(lo, hi, (n_a, kd), dtype=np.int64)
+                  .astype(np.int32), axis=0)
+    b = np.unique(rng.integers(lo, hi, (n_b, kd), dtype=np.int64)
+                  .astype(np.int32), axis=0)
+    # force overlap so the dedup path is exercised
+    if len(a) and len(b):
+        k = min(len(a), len(b) // 3)
+        b[:k] = a[:k]
+    return _sorted_rows(a), _sorted_rows(np.unique(b, axis=0))
+
+
+# ------------------------------------------------ numpy merge primitives
+
+class TestRankMergePrimitives:
+    def test_keyview_orders_signed_rows(self):
+        rng = np.random.default_rng(7)
+        rows = rng.integers(-(1 << 31), 1 << 31, (500, 4),
+                            dtype=np.int64).astype(np.int32)
+        rows[:4] = [[-(1 << 31), 0, 0, 0], [(1 << 31) - 1, 0, 0, 0],
+                    [0, -1, 5, 5], [0, 1, -5, -5]]
+        got = np.argsort(_keyview(rows), kind="stable")
+        want = np.lexsort(rows[:, ::-1].T)  # signed lexicographic
+        assert np.array_equal(rows[got], rows[want])
+
+    def test_rank_merge_is_sorted_set_union(self):
+        rng = np.random.default_rng(11)
+        for n_a, n_b in ((0, 9), (9, 0), (1, 1), (64, 17), (33, 400)):
+            a, b = _rand_runs(rng, n_a, n_b)
+            m = _np_rank_merge(a, b)
+            want = {tuple(r) for r in a} | {tuple(r) for r in b}
+            assert {tuple(r) for r in m} == want
+            assert len(m) == len(want), "merged run kept a duplicate"
+            assert np.array_equal(m, _sorted_rows(m)), "merge unsorted"
+
+    def test_rank_merge_idempotent(self):
+        rng = np.random.default_rng(3)
+        a, _ = _rand_runs(rng, 80, 0)
+        assert np.array_equal(_np_rank_merge(a, a), a)
+
+
+# ------------------------------------------------ TieredSeen unit layer
+
+class TestTieredSeen:
+    KD = 3
+
+    def _store(self, tmp_path, budget=10 ** 9):
+        return TieredSeen(self.KD, host_budget_keys=budget,
+                          spill_dir=str(tmp_path / "spill"))
+
+    def test_spill_probe_membership(self, tmp_path):
+        rng = np.random.default_rng(5)
+        a, b = _rand_runs(rng, 200, 150, kd=self.KD)
+        t = self._store(tmp_path)
+        assert not t.active and len(t) == 0
+        t.spill(a)
+        t.spill(b)
+        assert t.active
+        inside = np.vstack([a[::7], b[::5]])
+        outside = _sorted_rows(rng.integers(1 << 30, (1 << 31) - 1,
+                                            (40, self.KD),
+                                            dtype=np.int64)
+                               .astype(np.int32))
+        hits = t.probe(np.vstack([inside, outside]))
+        assert hits[: len(inside)].all()
+        assert not hits[len(inside):].any()
+        assert t.probe(np.zeros((0, self.KD), np.int32)).shape == (0,)
+
+    def test_host_compaction_fan_in(self, tmp_path):
+        rng = np.random.default_rng(9)
+        t = self._store(tmp_path)
+        all_rows = []
+        for _ in range(TieredSeen.MAX_HOST_RUNS + 1):
+            r, _ = _rand_runs(rng, 60, 0, kd=self.KD)
+            t.spill(r)
+            all_rows.append(r)
+        assert len(t.host_runs) == 1, "fan-in must compact to one run"
+        assert t.compactions >= 1
+        every = np.unique(np.vstack(all_rows), axis=0)
+        assert t.probe(every).all()
+        assert len(t) == len(every)
+
+    def test_disk_flush_and_lsm_compaction(self, tmp_path):
+        rng = np.random.default_rng(13)
+        t = self._store(tmp_path, budget=64)
+        all_rows = []
+        for _ in range(TieredSeen.MAX_DISK_RUNS + 2):
+            r, _ = _rand_runs(rng, 80, 0, kd=self.KD)
+            t.spill(r)  # each spill overflows the 64-key host budget
+            all_rows.append(r)
+        assert t.disk_keys > 0
+        assert len(t.disk_runs) <= TieredSeen.MAX_DISK_RUNS, \
+            "disk fan-in never compacted"
+        for p in t.disk_runs:
+            assert os.path.exists(p) and p.endswith(".npy")
+        leftover = [f for f in os.listdir(t.spill_dir)
+                    if f.endswith(".npy")]
+        assert sorted(leftover) == sorted(
+            os.path.basename(p) for p in t.disk_runs), \
+            "compaction left dead run files behind"
+        every = np.unique(np.vstack(all_rows), axis=0)
+        assert t.probe(every).all()
+        assert len(t) == len(every)
+        assert t.stats()["probe_wall_s"] >= 0
+
+    def test_dump_load_roundtrip(self, tmp_path):
+        rng = np.random.default_rng(17)
+        t = self._store(tmp_path, budget=64)
+        rows = []
+        for _ in range(3):
+            r, _ = _rand_runs(rng, 70, 0, kd=self.KD)
+            t.spill(r)
+            rows.append(r)
+        assert t.disk_keys > 0 and t.host_keys >= 0
+        payload = t.dump()
+        t2 = TieredSeen(self.KD, host_budget_keys=64,
+                        spill_dir=str(tmp_path / "other"))
+        t2.load(payload)
+        every = np.unique(np.vstack(rows), axis=0)
+        assert t2.probe(every).all()
+        assert len(t2) == len(t)
+        t3 = TieredSeen(self.KD + 1)
+        with pytest.raises(ValueError, match="key_words"):
+            t3.load(payload)
+
+    def test_ckpt_path_mode_past_inline_budget(self, tmp_path,
+                                               monkeypatch):
+        # a disk tier past JAXMC_TIER_CKPT_INLINE_KEYS rides the
+        # checkpoint as run-file PATHS (O(host) payload); load
+        # re-opens and validates them, and a vanished spill dir is a
+        # NAMED error, not a silent wrong count
+        monkeypatch.setenv("JAXMC_TIER_CKPT_INLINE_KEYS", "1")
+        rng = np.random.default_rng(29)
+        t = self._store(tmp_path, budget=32)
+        rows = []
+        for _ in range(3):
+            r, _ = _rand_runs(rng, 60, 0, kd=self.KD)
+            t.spill(r)
+            rows.append(r)
+        assert t.disk_keys > 1
+        payload = t.dump()
+        assert "disk_paths" in payload and "disk" not in payload
+        t2 = TieredSeen(self.KD, host_budget_keys=32)
+        t2.load(payload)
+        every = np.unique(np.vstack(rows), axis=0)
+        assert t2.probe(every).all()
+        assert len(t2) == len(t)
+        for p in payload["disk_paths"]:
+            os.unlink(p)
+        t3 = TieredSeen(self.KD, host_budget_keys=32)
+        with pytest.raises(ValueError, match="spill directory"):
+            t3.load(payload)
+
+    def test_compaction_preserves_ckpt_referenced_runs(self, tmp_path,
+                                                       monkeypatch):
+        # a path-mode checkpoint must survive later LSM compactions:
+        # referenced run files are retired, not unlinked, until a
+        # newer dump supersedes them
+        monkeypatch.setenv("JAXMC_TIER_CKPT_INLINE_KEYS", "1")
+        rng = np.random.default_rng(31)
+        t = self._store(tmp_path, budget=32)
+        early = []
+        for _ in range(3):
+            r, _ = _rand_runs(rng, 60, 0, kd=self.KD)
+            t.spill(r)
+            early.append(r)
+        p1 = t.dump()
+        assert "disk_paths" in p1
+        late = []
+        for _ in range(TieredSeen.MAX_DISK_RUNS):
+            r, _ = _rand_runs(rng, 60, 0, kd=self.KD)
+            t.spill(r)
+            late.append(r)
+        assert t.compactions >= 1
+        for p in p1["disk_paths"]:
+            assert os.path.exists(p), \
+                "compaction unlinked a checkpoint's only copy"
+        t_old = TieredSeen(self.KD, host_budget_keys=32)
+        t_old.load(p1)
+        assert t_old.probe(np.unique(np.vstack(early), axis=0)).all()
+        # live store still answers for everything
+        every = np.unique(np.vstack(early + late), axis=0)
+        assert t.probe(every).all() and len(t) == len(every)
+        # a newer dump supersedes the old references: retired files go
+        p2 = t.dump()
+        gone = [p for p in p1["disk_paths"]
+                if p not in p2.get("disk_paths", [])]
+        assert gone and all(not os.path.exists(p) for p in gone)
+
+    def test_spill_shape_mismatch_rejected(self, tmp_path):
+        t = self._store(tmp_path)
+        with pytest.raises(ValueError, match="key_words"):
+            t.spill(np.zeros((4, self.KD + 2), np.int32))
+
+    def test_io_error_degrades_to_host_only(self, tmp_path,
+                                            monkeypatch):
+        # the tier_io_error fault site: a failed disk write must leave
+        # a host-tier-only store with exact membership and the named
+        # event — never a crash
+        monkeypatch.setenv("JAXMC_FAULTS", "tier_io_error:op=write")
+        faults._CACHE = None
+        rng = np.random.default_rng(19)
+        tel = obs.Telemetry()
+        with obs.use_local(tel):
+            t = self._store(tmp_path, budget=32)
+            rows = []
+            for _ in range(3):
+                r, _ = _rand_runs(rng, 50, 0, kd=self.KD)
+                t.spill(r)  # overflows the budget -> flush -> fault
+                rows.append(r)
+        assert t.io_degraded and "tier_io_error" in t.io_degraded
+        assert t.disk_keys == 0 and not t.disk_runs
+        assert "io_degraded" in t.stats()
+        assert "tier.io_degraded" in tel.gauges
+        every = np.unique(np.vstack(rows), axis=0)
+        assert t.probe(every).all(), "degraded store lost keys"
+        assert len(t) == len(every)
+
+    def test_unreadable_disk_run_raises(self, tmp_path):
+        rng = np.random.default_rng(23)
+        t = self._store(tmp_path, budget=32)
+        r, _ = _rand_runs(rng, 60, 0, kd=self.KD)
+        t.spill(r)
+        assert t.disk_runs
+        os.unlink(t.disk_runs[0])
+        with pytest.raises(RuntimeError, match="unreadable"):
+            t.probe(r[:5])
+
+
+# ------------------------------------------------ capped engine parity
+
+def _capped_kw(tmp_path, cap=OOC_CAP, host=OOC_HOST_KEYS):
+    return dict(seen_cap=cap, host_tier_keys=host,
+                spill_dir=str(tmp_path / "spill"))
+
+
+class TestCappedExhaustive:
+    def test_level_mode_spills_both_tiers_exact(self, tmp_path):
+        # the acceptance run: device table capped at ~17% of the state
+        # count, host budget forcing disk — the search must complete
+        # exhaustively (no truncation) with the manifest pins
+        from jaxmc.backend.bfs import TpuExplorer
+        res = TpuExplorer(load("ooc_scaled"),
+                          **_capped_kw(tmp_path)).run()
+        assert res.ok and not res.truncated
+        assert (res.generated, res.distinct) == OOC_WANT
+        assert res.seen_mode == "exact"
+        assert res.tiers and res.tiers["spills"] > 0
+        assert res.tiers["disk_keys"] > 0, "disk tier never exercised"
+        assert res.tiers["probe_wall_s"] >= 0
+
+    def test_resident_mode_spills_both_tiers_exact(self, tmp_path):
+        # the resident loop's spill path: cap overflow rolls the level
+        # back, compacts the sorted prefix out, and redoes the level
+        # against an empty table — exhaustive at the manifest pins
+        from jaxmc.backend.bfs import TpuExplorer
+        res = TpuExplorer(load("ooc_scaled"), resident=True,
+                          chunk=256, **_capped_kw(tmp_path)).run()
+        assert res.ok and not res.truncated
+        assert (res.generated, res.distinct) == OOC_WANT
+        assert res.tiers and res.tiers["spills"] > 0
+        assert res.tiers["disk_keys"] > 0, "disk tier never exercised"
+
+    def test_mesh_per_shard_tiering_exact(self, tmp_path):
+        # per-shard device caps on the mesh-resident loop (D=2):
+        # owner-routed keys partition the space, one combined cold
+        # store answers membership for every shard
+        import jax
+        from jax.sharding import Mesh
+        from jaxmc.backend.mesh import MeshExplorer
+        me = MeshExplorer(load("ooc_scaled"),
+                          mesh=Mesh(np.array(jax.devices()[:2]),
+                                    ("d",)),
+                          **_capped_kw(tmp_path, cap=2 * OOC_CAP))
+        res = me.run()  # resident loop: no PROPERTYs/refiners here
+        assert res.ok and not res.truncated
+        assert (res.generated, res.distinct) == OOC_WANT
+        assert res.tiers and res.tiers["spills"] > 0
+
+    def test_engine_io_degrade_keeps_exact_counts(self, tmp_path,
+                                                  monkeypatch):
+        # end-to-end fault containment: the disk tier dies mid-search,
+        # the run degrades to host-tier-only and still lands the pins
+        monkeypatch.setenv("JAXMC_FAULTS", "tier_io_error:op=write")
+        faults._CACHE = None
+        from jaxmc.backend.bfs import TpuExplorer
+        res = TpuExplorer(load("ooc_scaled"),
+                          **_capped_kw(tmp_path)).run()
+        assert res.ok and not res.truncated
+        assert (res.generated, res.distinct) == OOC_WANT
+        assert res.tiers and res.tiers.get("io_degraded")
+        assert res.tiers["disk_keys"] == 0
+
+
+class TestTruncationAttribution:
+    def test_serial_names_max_states(self):
+        from jaxmc.engine.explore import Explorer
+        res = Explorer(load("constoy"), max_states=5).run()
+        assert res.truncated
+        assert res.trunc_reason and \
+            res.trunc_reason.startswith("max_states")
+
+    def test_device_names_max_states(self, tmp_path):
+        from jaxmc.backend.bfs import TpuExplorer
+        res = TpuExplorer(load("ooc_scaled"), max_states=500,
+                          **_capped_kw(tmp_path)).run()
+        assert res.truncated
+        assert res.trunc_reason and \
+            res.trunc_reason.startswith("max_states")
+
+    def test_complete_run_carries_no_reason(self):
+        from jaxmc.engine.explore import Explorer
+        res = Explorer(load("constoy")).run()
+        assert not res.truncated and res.trunc_reason is None
+
+
+# ------------------------------------------------ fingerprint-only mode
+
+def _fp_params():
+    from jaxmc.corpus import CASES
+    out = []
+    for c in CASES:
+        if c.root != "repo" or c.jax != "yes" or c.expect != "ok" \
+                or c.distinct is None or getattr(c, "lint_only", False):
+            continue
+        marks = []
+        if c.slow or (c.generated or 0) > 20000:
+            marks.append(pytest.mark.slow)  # bench-scale rungs
+        out.append(pytest.param(
+            c, id=os.path.basename(c.cfg or c.spec), marks=marks))
+    return out
+
+
+class TestFingerprintMode:
+    @pytest.mark.parametrize("case", _fp_params())
+    def test_parity_on_repo_rung(self, case):
+        # --seen fingerprint must land the exact manifest pins on
+        # every repo-local rung and report its collision bound
+        for d in case.include_dirs():
+            if not os.path.isdir(d):
+                pytest.skip(f"needs the reference corpus ({d})")
+        from jaxmc.backend.bfs import TpuExplorer
+        from jaxmc.compile.vspec import Bounds
+        cfg = parse_cfg(open(case.cfg_path()).read())
+        if case.no_deadlock:
+            cfg.check_deadlock = False
+        spec = case.spec_path()
+        model = bind_model(
+            Loader([os.path.dirname(spec)]
+                   + case.include_dirs()).load_path(spec), cfg)
+        b = Bounds()
+        for k in ("seq_cap", "grow_cap", "kv_cap"):
+            if getattr(case, k, None):
+                setattr(b, k, getattr(case, k))
+        from jaxmc.compile.vspec import ModeError
+        try:
+            res = TpuExplorer(model, bounds=b,
+                              seen_mode="fingerprint").run()
+        except ModeError as ex:
+            # hybrid-by-construction rungs run in host_seen mode (the
+            # same ladder run_case uses)
+            if "hybrid" not in str(ex):
+                raise
+            from jaxmc import native_store
+            if not native_store.is_available():
+                pytest.skip("hybrid rung needs the native store")
+            res = TpuExplorer(model, bounds=b, host_seen=True,
+                              seen_mode="fingerprint").run()
+        assert res.ok, res.warnings
+        assert (res.generated, res.distinct) == \
+            (case.generated, case.distinct)
+        assert res.seen_mode == "fingerprint"
+        # the bound covers every ADMITTED key (constraint-discarded
+        # states hold keys too), so it sits between distinct^2 and
+        # (generated + distinct)^2 over 2^129
+        assert res.collision_p is not None
+        lo = res.distinct ** 2 * 2.0 ** -129
+        hi = (res.generated + res.distinct) ** 2 * 2.0 ** -129
+        assert lo * 0.999 <= res.collision_p <= hi * 1.001
+
+    def test_exact_refuses_fp_only_modes(self):
+        from jaxmc.backend.bfs import TpuExplorer
+        from jaxmc.compile.vspec import ModeError
+        with pytest.raises(ModeError, match="resident"):
+            TpuExplorer(load("constoy"), resident=True,
+                        seen_mode="exact")
+
+    def test_exact_refused_on_mesh(self):
+        # mesh seen shards are fingerprint-based: --seen exact must
+        # refuse, not silently fingerprint past the contract
+        from jaxmc.backend.mesh import MeshExplorer
+        from jaxmc.compile.vspec import ModeError
+        with pytest.raises(ModeError, match="mesh"):
+            MeshExplorer(load("constoy"), seen_mode="exact")
+
+    def test_unknown_mode_rejected(self):
+        from jaxmc.backend.bfs import TpuExplorer
+        from jaxmc.compile.vspec import ModeError
+        with pytest.raises(ModeError, match="unknown --seen"):
+            TpuExplorer(load("constoy"), seen_mode="sketchy")
+
+
+# ------------------------------------------------ obs diff attribution
+
+class TestObsDiffIoDegrade:
+    def _artifact(self, path, degraded):
+        tel = obs.Telemetry()
+        tel.level(0, frontier=1, generated=100, wall_s=1.0)
+        tel.set_meta(backend="jax", spec="specs/ooc_scaled.tla",
+                     env={"jax_version": "0", "platform": "cpu",
+                          "device_count": 1})
+        if degraded:
+            tel.gauge("tier.io_degraded", "tier_io_error: op=write")
+        tel.write_metrics(str(path), result={
+            "ok": True, "distinct": 50, "generated": 100,
+            "diameter": 3, "truncated": False, "wall_s": 1.0})
+        return str(path)
+
+    def test_io_degrade_appearance_flagged(self, tmp_path):
+        import io as _io
+        from jaxmc.obs import report
+        good = self._artifact(tmp_path / "a.json", degraded=False)
+        bad = self._artifact(tmp_path / "b.json", degraded=True)
+        out = _io.StringIO()
+        rc = report.main(["diff", good, bad, "--fail-on-regress"],
+                         out=out)
+        assert rc == 1
+        assert "REGRESS tier io degradation" in out.getvalue()
+        out = _io.StringIO()
+        rc = report.main(["diff", bad, bad, "--fail-on-regress"],
+                         out=out)
+        assert rc == 0, "a standing degradation must not re-flag"
+
+
+# ------------------------------------------------ chaos: mid-spill
+
+def _cli(args, env_extra, timeout=420):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", **env_extra)
+    return subprocess.run(
+        [sys.executable, "-m", "jaxmc", "check"] + args,
+        capture_output=True, text=True, cwd=REPO, env=env,
+        timeout=timeout)
+
+
+def _counts(stdout):
+    for line in reversed(stdout.splitlines()):
+        if "states generated," in line and "distinct states found" in \
+                line and "states/sec" in line:
+            parts = line.split()
+            return int(parts[0]), int(parts[3])
+    raise AssertionError(f"no summary line in:\n{stdout}")
+
+
+_OOC_ARGS = [os.path.join(SPECS, "ooc_scaled.tla"),
+             "--backend", "jax", "--platform", "cpu"]
+
+
+def _capped_env(tmp_path):
+    return {"JAXMC_SEEN_CAP": str(OOC_CAP),
+            "JAXMC_TIER_HOST_KEYS": str(OOC_HOST_KEYS),
+            "JAXMC_SPILL_DIR": str(tmp_path / "spill"),
+            "JAXMC_PROFILE_STORE": str(tmp_path / "prof")}
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+class TestMidSpillChaos:
+    """SIGKILL and SIGTERM-drain a capped run AFTER it has spilled,
+    then resume: the checkpoint carries the full tier hierarchy, so
+    the resumed totals must be bit-identical to the manifest pins."""
+
+    def test_kill_resume_parity_mid_spill(self, tmp_path):
+        env = _capped_env(tmp_path)
+        ck = str(tmp_path / "ooc.ck")
+        killed = _cli(_OOC_ARGS + ["--checkpoint", ck,
+                                   "--checkpoint-every", "0"],
+                      env_extra=dict(env,
+                                     JAXMC_FAULTS="run_kill:level=10"))
+        assert killed.returncode in (-9, 137), \
+            (killed.returncode, killed.stderr[-500:])
+        assert "tier:" in killed.stdout, \
+            "the run was killed before any spill — not mid-spill"
+        assert os.path.exists(ck), "no checkpoint survived the kill"
+        resumed = _cli(_OOC_ARGS + ["--resume", ck], env_extra=env)
+        assert resumed.returncode == 0, resumed.stderr[-500:]
+        assert _counts(resumed.stdout) == OOC_WANT
+
+    def test_sigterm_drain_resume_parity_mid_spill(self, tmp_path):
+        env = _capped_env(tmp_path)
+        ck = str(tmp_path / "drain.ck")
+        p = subprocess.Popen(
+            [sys.executable, "-m", "jaxmc", "check"] + _OOC_ARGS
+            + ["--checkpoint", ck, "--checkpoint-every", "0"],
+            cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True,
+            env=dict(os.environ, JAX_PLATFORMS="cpu", **env))
+        # the capped search runs ~8s after a ~4s compile; spills start
+        # within the first levels — signal mid-search
+        time.sleep(6.0)
+        p.send_signal(signal.SIGTERM)
+        out, err = p.communicate(timeout=120)
+        if p.returncode == 0:
+            pytest.skip("run finished before the signal landed "
+                        "(box too fast for the fixed delay)")
+        assert p.returncode == 143, (p.returncode, err[-500:])
+        assert "drained" in err
+        assert os.path.exists(ck)
+        resumed = _cli(_OOC_ARGS + ["--resume", ck], env_extra=env)
+        assert resumed.returncode == 0, resumed.stderr[-500:]
+        assert _counts(resumed.stdout) == OOC_WANT
+        # the drained run must have spilled before the signal, or this
+        # proved nothing about mid-spill state
+        if "tier:" not in out:
+            pytest.skip("drain landed before the first spill")
