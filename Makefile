@@ -179,6 +179,11 @@ bench-check:
 	  JAX_PLATFORMS=cpu $(PY) -m jaxmc.kernelbench $$spec \
 	      --out-dir $(BENCH_CHECK_DIR) || exit 1; \
 	done
+	# cross-model batching leg (ISSUE 13): a cold cohort of
+	# layout-compatible jobs must run as ONE vmapped engine at >= 2x
+	# the sequential cold throughput with bit-identical per-member
+	# counts — see batch-check below
+	$(MAKE) batch-check
 	# checking-as-a-service leg (ISSUE 7): the warm second submission
 	# to a live daemon must be a checkpoint-resume with ZERO in-window
 	# recompiles — see serve-check below
@@ -278,6 +283,31 @@ multichip-bench:
 	    --devices $(MULTICHIP_BENCH_DEVICES) \
 	    --out $(MULTICHIP_OUT) --out-dir $(BENCH_CHECK_DIR)
 
+# cross-model vmapped batching gate (ISSUE 13): the batchtoy cohort
+# (one module, four cfgs differing only in liftable constant values)
+# submitted cold must run as ONE vmapped engine — full occupancy, one
+# engine build, per-member counts bit-identical to solo runs — at
+# >= 2x the sequential cold aggregate states/sec (JAXMC_BATCH_GATE_X).
+# The warm deep-rung pair is reported and baseline-gated (cpu-XLA's
+# ~0.5ms dispatches leave little latency to amortize; the accelerator
+# warm measurement is the standing driver-env task).  Prints a
+# parseable `BATCH-CHECK SKIP: <reason>` where the leg cannot run.
+batch-check:
+	JAX_PLATFORMS=cpu $(PY) -m jaxmc.batchbench \
+	    --out-dir $(BENCH_CHECK_DIR)
+	# same-invocation throughput gate, kernelbench-style: artifacts
+	# ordered [sequential, batched], so a batched cohort slower than
+	# the sequential one raises the REGRESS states/sec flag (across-
+	# run wall baselines are too noisy in shared containers; the
+	# same-invocation ratio is load-independent)
+	@if [ -f $(BENCH_CHECK_DIR)/jaxmc_batchbench_cold_seq.json ]; then \
+	  echo "== batchbench cold cohort: sequential -> batched =="; \
+	  $(PY) -m jaxmc.obs diff --fail-on-regress --threshold 25 \
+	      $(BENCH_CHECK_DIR)/jaxmc_batchbench_cold_seq.json \
+	      $(BENCH_CHECK_DIR)/jaxmc_batchbench_cold_batch.json \
+	      || exit 1; \
+	fi
+
 # checking-as-a-service smoke gate (ISSUE 7): fresh spool, in-process
 # daemon, two identical jax-resident jobs — the second MUST reuse the
 # warm session, resume the first job's final checkpoint, report
@@ -298,7 +328,11 @@ bench-check-reset:
 	rm -f $(BENCH_CHECK_DIR)/jaxmc_bench_check_serial.baseline.json \
 	      $(BENCH_CHECK_DIR)/jaxmc_bench_check_par.baseline.json \
 	      $(BENCH_CHECK_DIR)/jaxmc_bench_check_warmleg.baseline.json \
-	      $(BENCH_CHECK_DIR)/jaxmc_bench_check_warm.ck
+	      $(BENCH_CHECK_DIR)/jaxmc_bench_check_warm.ck \
+	      $(BENCH_CHECK_DIR)/jaxmc_batchbench_cold_seq.json \
+	      $(BENCH_CHECK_DIR)/jaxmc_batchbench_cold_batch.json \
+	      $(BENCH_CHECK_DIR)/jaxmc_batchbench_warm_seq.json \
+	      $(BENCH_CHECK_DIR)/jaxmc_batchbench_warm_batch.json
 
 # build the native host fingerprint store (also built on demand at import)
 native:
@@ -307,5 +341,5 @@ native:
 
 .PHONY: all check check-corpus test chaos bench bench-warm bench-tlc \
         pin-si-env bench-check bench-check-reset serve serve-check \
-        multichip-check multichip-bench backend-check native \
-        lint-corpus pylint
+        batch-check multichip-check multichip-bench backend-check \
+        native lint-corpus pylint

@@ -1030,3 +1030,259 @@ def dead_arms(model, arms, report: Optional[BoundsReport] = None
                 raise
             continue
     return out
+
+
+# ---------------------------------------------------------------------------
+# cross-model batch compatibility (ISSUE 13)
+# ---------------------------------------------------------------------------
+#
+# The vmapped multi-model engine (backend/batch.py) shares ONE compiled
+# kernel across layout-compatible models by LIFTING per-model CONSTANT
+# values into traced batch-axis lanes (kernel2.KernelCtx.const_lanes).
+# A constant is liftable only when every occurrence sits in a VALUE
+# position — arithmetic, comparisons, boolean structure, IF/CASE arms,
+# assignment right-hand sides — never in a position compilation needs
+# statically (quantifier/set-constructor domains, `..` range endpoints,
+# function application, container shapes).  The walk below is the
+# conservative parse-time oracle; the kernel trace itself is the
+# soundness net (a lifted constant reaching a static-only position
+# raises CompileError, which the batch planner reads as "not
+# batchable", never as a wrong kernel).
+
+# boolean structure + comparisons + integer arithmetic: operand
+# positions stay value-transparent (kernel2 evaluates them over traced
+# lanes)
+_LIFT_SAFE_OPS = frozenset({
+    "/\\", "\\/", "~", "\\lnot", "\\neg", "=>", "<=>", "\\equiv",
+    "=", "/=", "<", "<=", ">", ">=",
+    "+", "-", "*", "\\div", "%", "-.",
+})
+
+
+def _lift_walk(e, safe: bool, consts: set, pinned: set,
+               defs: Dict[str, Any], seen_ops: set) -> None:
+    """Mark every constant Ident reached in a non-transparent context
+    as pinned.  `safe` is the context flag for THIS node's position."""
+    from ..sem.eval import OpClosure
+    if e is None:
+        return
+    if isinstance(e, A.Ident):
+        if e.name in consts and not safe:
+            pinned.add(e.name)
+        return
+    if isinstance(e, (A.Num, A.Str, A.Bool, A.At)):
+        return
+    if isinstance(e, A.OpApp):
+        nm = _norm(e.name)
+        if e.path:  # instance-path application: opaque, pin everything
+            for _inst, iargs in e.path:
+                for a in iargs:
+                    _lift_walk(a, False, consts, pinned, defs, seen_ops)
+            for a in e.args:
+                _lift_walk(a, False, consts, pinned, defs, seen_ops)
+            return
+        if nm in consts and not e.args:
+            # zero-arg application of the constant itself
+            if not safe:
+                pinned.add(nm)
+            return
+        if nm in _LIFT_SAFE_OPS:
+            for a in e.args:
+                _lift_walk(a, safe, consts, pinned, defs, seen_ops)
+            return
+        d = defs.get(e.name)
+        if isinstance(d, OpClosure):
+            # user operator: walk its body ONCE (occurrences inside are
+            # classified by their own contexts); call-site arguments are
+            # conservatively pinned — the body may route a parameter
+            # into a static-only position
+            if e.name not in seen_ops:
+                seen_ops.add(e.name)
+                _lift_walk(d.body, True, consts, pinned, defs, seen_ops)
+            for a in e.args:
+                _lift_walk(a, False, consts, pinned, defs, seen_ops)
+            return
+        # unknown / static-shaped builtin (.., Cardinality, DOMAIN,
+        # SUBSET, Append, ...): operand positions are pinned
+        for a in e.args:
+            _lift_walk(a, False, consts, pinned, defs, seen_ops)
+        return
+    if isinstance(e, A.If):
+        for c in (e.cond, e.then, e.els):
+            _lift_walk(c, safe, consts, pinned, defs, seen_ops)
+        return
+    if isinstance(e, A.Case):
+        for cond, body in e.arms:
+            _lift_walk(cond, safe, consts, pinned, defs, seen_ops)
+            _lift_walk(body, safe, consts, pinned, defs, seen_ops)
+        _lift_walk(e.other, safe, consts, pinned, defs, seen_ops)
+        return
+    if isinstance(e, A.Quant):
+        for _names, dom in e.binders:
+            _lift_walk(dom, False, consts, pinned, defs, seen_ops)
+        _lift_walk(e.body, safe, consts, pinned, defs, seen_ops)
+        return
+    if isinstance(e, A.SetFilter):
+        _lift_walk(e.set, False, consts, pinned, defs, seen_ops)
+        _lift_walk(e.pred, safe, consts, pinned, defs, seen_ops)
+        return
+    if isinstance(e, A.SetMap):
+        for _names, dom in e.binders:
+            _lift_walk(dom, False, consts, pinned, defs, seen_ops)
+        _lift_walk(e.expr, safe, consts, pinned, defs, seen_ops)
+        return
+    if isinstance(e, A.FnDef):
+        for _names, dom in e.binders:
+            _lift_walk(dom, False, consts, pinned, defs, seen_ops)
+        _lift_walk(e.body, safe, consts, pinned, defs, seen_ops)
+        return
+    if isinstance(e, A.Let):
+        for d in e.defs:
+            body = getattr(d, "body", None) or getattr(d, "expr", None)
+            _lift_walk(body, safe, consts, pinned, defs, seen_ops)
+        _lift_walk(e.body, safe, consts, pinned, defs, seen_ops)
+        return
+    if isinstance(e, A.Except):
+        _lift_walk(e.fn, False, consts, pinned, defs, seen_ops)
+        for path, rhs in e.updates:
+            for kind, part in path:
+                if kind == "idx":
+                    for p in part:
+                        _lift_walk(p, False, consts, pinned, defs,
+                                   seen_ops)
+            _lift_walk(rhs, safe, consts, pinned, defs, seen_ops)
+        return
+    if isinstance(e, (A.TupleExpr,)):
+        for x in e.items:
+            _lift_walk(x, safe, consts, pinned, defs, seen_ops)
+        return
+    if isinstance(e, A.RecordExpr):
+        for _f, v in e.fields:
+            _lift_walk(v, safe, consts, pinned, defs, seen_ops)
+        return
+    if isinstance(e, A.Prime):
+        _lift_walk(e.expr, safe, consts, pinned, defs, seen_ops)
+        return
+    if isinstance(e, (A.BoxAction, A.AngleAction)):
+        _lift_walk(e.expr, safe, consts, pinned, defs, seen_ops)
+        return
+    # everything else (SetEnum, FnApp, Dot, FnSet, RecordSet, Choose,
+    # Unchanged, Enabled, Fair, Lambda, temporal forms): conservative —
+    # every child is a pinned context
+    for f in getattr(e, "__dataclass_fields__", ()):
+        v = getattr(e, f)
+        if isinstance(v, A.Node):
+            _lift_walk(v, False, consts, pinned, defs, seen_ops)
+        elif isinstance(v, tuple):
+            for x in _flat_nodes(v):
+                _lift_walk(x, False, consts, pinned, defs, seen_ops)
+
+
+def _flat_nodes(v):
+    for x in v:
+        if isinstance(x, A.Node):
+            yield x
+        elif isinstance(x, tuple):
+            yield from _flat_nodes(x)
+
+
+def _pin_all(e, consts: set, pinned: set, defs: Dict[str, Any],
+             seen_ops: set) -> None:
+    """Pin EVERY constant reachable from `e`, including through user
+    operator bodies — used for VIEW/SYMMETRY, whose whole expression
+    feeds the dedup-key basis."""
+    from ..sem.eval import OpClosure
+    if e is None or isinstance(e, (A.Num, A.Str, A.Bool, A.At)):
+        return
+    if isinstance(e, A.Ident):
+        if e.name in consts:
+            pinned.add(e.name)
+        return
+    if isinstance(e, A.OpApp):
+        if e.name in consts and not e.args:
+            pinned.add(e.name)
+        d = defs.get(e.name)
+        if isinstance(d, OpClosure) and e.name not in seen_ops:
+            seen_ops.add(e.name)
+            _pin_all(d.body, consts, pinned, defs, seen_ops)
+    for f in getattr(e, "__dataclass_fields__", ()):
+        v = getattr(e, f)
+        if isinstance(v, A.Node):
+            _pin_all(v, consts, pinned, defs, seen_ops)
+        elif isinstance(v, tuple):
+            for x in _flat_nodes(v):
+                _pin_all(x, consts, pinned, defs, seen_ops)
+
+
+def liftable_constants(model) -> Tuple[str, ...]:
+    """Sorted cfg CONSTANT names whose values may become per-model
+    batch lanes: plain ints (not bools — bool lanes would change guard
+    structure) used only in value positions across Init, Next, the
+    checked predicates, and every reachable operator body."""
+    consts = {n for n, v in model.cfg.constants.items()
+              if type(model.defs.get(n)) is int}
+    if not consts:
+        return ()
+    pinned: set = set()
+    seen_ops: set = set()
+    tops = [model.init, model.next]
+    tops += [ex for _n, ex in model.invariants]
+    tops += [ex for _n, ex in model.constraints]
+    tops += [ex for _n, ex in model.action_constraints]
+    tops += [ex for _n, ex in model.properties]
+    try:
+        for t in tops:
+            _lift_walk(t, True, consts, pinned, model.defs, seen_ops)
+        # VIEW and SYMMETRY feed the DEDUP-KEY basis, which the device
+        # engines also trace OUTSIDE the constant-lane install sites
+        # (_keys_of under _host_keys): any constant they reach — value
+        # position or not — must stay baked, so pin wholesale
+        for t in (model.view, model.symmetry):
+            _pin_all(t, consts, pinned, model.defs, set())
+    except RecursionError:
+        return ()
+    return tuple(sorted(consts - pinned))
+
+
+def state_space_estimate(model, report: Optional[BoundsReport] = None
+                         ) -> Optional[int]:
+    """A pre-scheduling COST bound from the converged fixpoint: the
+    product of the proven per-variable interval spans.  None when the
+    fixpoint bails, fails to converge, or ANY variable lacks a bounded
+    int summary — an unsummarizable variable (a set, a sequence, a
+    record) can hide an arbitrarily large factor, and the fast lane
+    must never promote a job on a guess (a multi-minute search jumping
+    the queue is the exact inversion the lane exists to prevent)."""
+    if report is None:
+        rep = getattr(model, "_bounds_report", None)
+        report = rep if isinstance(rep, BoundsReport) \
+            else infer_state_bounds(model)
+    if report is None or not report.converged:
+        return None
+    est = 1
+    sums = report.summaries()
+    for v in model.vars:
+        s = sums.get(v)
+        if s is None or not s.bounded():
+            return None
+        est *= max(int(s.hi) - int(s.lo) + 1, 1)
+        if est >= 2 ** 62:
+            return 2 ** 62
+    return est
+
+
+def merge_lane_bounds(bounds_list) -> Dict[str, Tuple[int, int]]:
+    """Interval-union of per-member proven lane bounds for a batched
+    engine's shared layout: a variable keeps a proof only when EVERY
+    member proves one (absent anywhere -> unproven, sampled+guarded)."""
+    merged: Dict[str, Tuple[int, int]] = {}
+    bl = [b for b in bounds_list]
+    if not bl or any(b is None for b in bl):
+        return {}
+    common = set(bl[0])
+    for b in bl[1:]:
+        common &= set(b)
+    for v in common:
+        merged[v] = (min(b[v][0] for b in bl),
+                     max(b[v][1] for b in bl))
+    return merged

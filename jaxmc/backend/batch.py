@@ -1,0 +1,384 @@
+r"""Cross-model vmapped batching (ISSUE 13): one dispatch serves many
+layout-compatible jobs.
+
+The model-checking analogue of continuous batching in LLM serving
+(Orca, OSDI '22).  The serve fleet's old batching coalesced IDENTICAL
+jobs only; here B *different but layout-compatible* models share one
+compiled device program:
+
+  compat   two models are batch-compatible when they differ only in
+           LIFTABLE constant values (analyze/bounds.liftable_constants:
+           ints used purely in value positions) — everything that shapes
+           the layout, the arm structure, or the dedup key basis is
+           equal.  session.batch_signature proves this at PARSE time,
+           before any engine exists.
+  compile  ONE donor engine builds the layout (lane plan over the union
+           of every member's sampled states; proven bounds interval-
+           merged across members) and the kernels, with the lifted
+           constants as traced inputs (kernel2 const_lanes).  Followers
+           clone the donor (TpuExplorer(donor=...)): zero sampling,
+           zero kernel builds.
+  dispatch every member runs the UNCHANGED host_seen BFS loop — its own
+           init states, native fingerprint store, trace bookkeeping,
+           verdicts — but its per-chunk device call routes through the
+           shared BatchDispatcher, which waits until every ACTIVE
+           member has a pending chunk and then runs ONE
+           jit(vmap(hstep_core)) over [B, CH, PW] frontiers + [B]
+           counts + [B, n_lift] constant vectors.
+  ragged   per-member frontier occupancy is handled by the step's own
+           validity masks (fcount per lane); a member that finishes —
+           exhaustion, violation, truncation, drain — DEREGISTERS and
+           its lane goes idle-masked: membership changes between
+           supersteps without recompiling (the continuous-batching
+           move).
+
+Because each member's host loop IS the solo engine's loop and
+vmap(f)(stack(xs))[i] == f(xs[i]) exactly over integer kernels, per-job
+counts, traces, and verdicts are byte-identical to solo runs — batching
+is a throughput optimization, never a semantics change.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .. import obs
+from ..compile.vspec import Bounds, CompileError, ModeError
+from ..engine.simulate import sample_states
+from .bfs import SENTINEL, TpuExplorer, _pow2_at_least
+
+
+class BatchIncompatible(Exception):
+    """The cohort cannot share one program; the message names why.  The
+    caller (serve daemon, batchbench) falls back to solo runs."""
+
+
+@dataclass
+class _MergedBounds:
+    """Shim BoundsReport for the donor build: the interval-UNION of
+    every member's converged proof, sound for all of them."""
+    merged: Dict[str, Tuple[int, int]]
+    converged: bool = True
+
+    def lane_bounds(self) -> Dict[str, Tuple[int, int]]:
+        return self.merged
+
+
+class BatchDispatcher:
+    """The superstep barrier: collects one pending device chunk per
+    ACTIVE member, runs ONE vmapped dispatch, hands each member its
+    slice.  The thread that completes the barrier executes the dispatch
+    inline (every other member is blocked waiting on its slice)."""
+
+    def __init__(self, donor: TpuExplorer, cvecs: np.ndarray,
+                 tel=None):
+        self.CH = _pow2_at_least(donor.chunk, lo=64)
+        self.B = len(cvecs)
+        self.PW = donor.PW
+        self._core = donor._hstep_core(self.CH)
+        self._vstep = jax.jit(jax.vmap(self._core))
+        self._cvecs = jnp.asarray(np.ascontiguousarray(cvecs, np.int32))
+        self.tel = tel
+        self._cv = threading.Condition()
+        self._active: set = set(range(self.B))
+        self._pending: Dict[int, Tuple[np.ndarray, int]] = {}
+        self._results: Dict[int, Dict[str, Any]] = {}
+        self._gen = 0            # dispatch generation (wakeup marker)
+        self.dispatches = 0
+        self.max_width = 0
+        self.widths: List[int] = []
+
+    def reset(self) -> None:
+        """Re-arm for another cohort run (bench warm re-runs): all
+        lanes active again, superstep state and PER-RUN STATS cleared
+        (the artifact's dispatch count must describe one run, not the
+        lifetime).  The compiled vmapped program is untouched — that is
+        the warm artifact."""
+        with self._cv:
+            self._active = set(range(self.B))
+            self._pending.clear()
+            self._results.clear()
+            self.dispatches = 0
+            self.max_width = 0
+            self.widths = []
+
+    # ---- member surface ------------------------------------------------
+    def hstep_factory(self, slot: int):
+        """The _hstep_override for member `slot`: returns a callable
+        with the solo hstep's signature whose device work goes through
+        the shared vmapped program."""
+        def factory(CH: int):
+            if CH != self.CH:
+                raise ModeError(
+                    f"batch member chunk capacity {CH} != shared "
+                    f"dispatcher capacity {self.CH}")
+
+            def hstep(frontier_p, fcount):
+                return self._step(slot, frontier_p, int(fcount))
+
+            return hstep
+
+        return factory
+
+    def deregister(self, slot: int) -> None:
+        """Membership change between supersteps: the member is done (or
+        failed); remaining members' barrier no longer waits for it."""
+        with self._cv:
+            self._active.discard(slot)
+            self._pending.pop(slot, None)
+            if self._active and \
+                    set(self._pending) >= self._active:
+                self._fire_locked()
+            self._cv.notify_all()
+
+    # ---- the superstep -------------------------------------------------
+    def _step(self, slot: int, frontier_p, fcount: int
+              ) -> Dict[str, Any]:
+        with self._cv:
+            self._pending[slot] = (np.asarray(frontier_p, np.int32),
+                                   fcount)
+            if set(self._pending) >= self._active:
+                self._fire_locked()
+            while slot not in self._results:
+                self._cv.wait(0.5)
+            res = self._results.pop(slot)
+            if isinstance(res, BaseException):
+                # the shared dispatch failed: EVERY waiter gets the
+                # error (not just the thread that fired) — each member
+                # fails its own run and deregisters, so the cohort
+                # never deadlocks on a lane that cannot re-fire
+                raise RuntimeError(
+                    f"vmapped batch dispatch failed: "
+                    f"{type(res).__name__}: {res}") from res
+            return res
+
+    def _fire_locked(self) -> None:
+        """One vmapped dispatch over every pending member lane (caller
+        holds the condition).  A dispatch failure is distributed to
+        every pending slot as its result — see _step."""
+        slots = sorted(self._pending)
+        width = len(slots)
+        fr = np.full((self.B, self.CH, self.PW), SENTINEL, np.int32)
+        fc = np.zeros(self.B, np.int32)
+        for s in slots:
+            bf, c = self._pending[s]
+            fr[s] = bf
+            fc[s] = c
+        self._pending.clear()
+        try:
+            out = self._vstep(jnp.asarray(fr), jnp.asarray(fc),
+                              self._cvecs)
+            out_np = {k: np.asarray(v) for k, v in out.items()}
+        except Exception as ex:  # noqa: BLE001 — XLA runtime/OOM/
+            # compile failures land on every waiting member
+            for s in slots:
+                self._results[s] = ex
+            self._cv.notify_all()
+            return
+        for s in slots:
+            self._results[s] = {k: v[s] for k, v in out_np.items()}
+        self.dispatches += 1
+        self.max_width = max(self.max_width, width)
+        self.widths.append(width)
+        if self.tel is not None:
+            self.tel.gauge("batch.width", width)
+            self.tel.counter("batch.dispatches")
+        self._cv.notify_all()
+
+
+@dataclass
+class BatchMember:
+    """One job in the cohort: its model, engine, telemetry channel and
+    (after run) result or error."""
+    model: Any
+    engine: Optional[TpuExplorer] = None
+    tel: Any = None
+    result: Any = None
+    error: Optional[BaseException] = None
+    tag: Optional[str] = None  # caller's handle (job id)
+    warnings: List[str] = field(default_factory=list)
+
+
+# engine-relevant option surface every member must share (per-model
+# differences ride the lifted constant lanes, nothing else)
+_SHARED_FIELDS = ("include", "no_deadlock", "max_states", "seq_cap",
+                  "grow_cap", "kv_cap", "no_trace", "sample", "chunk")
+
+
+class BatchCheckEngine:
+    """B layout-compatible CheckSession configs -> one donor engine +
+    B-1 follower clones -> one vmapped dispatch sequence -> B solo-
+    identical CheckResults."""
+
+    def __init__(self, cfgs: List[Any], tels: Optional[List[Any]] = None,
+                 tags: Optional[List[str]] = None, log=None, tel=None):
+        if len(cfgs) < 1:
+            raise ValueError("empty batch")
+        self.cfgs = cfgs
+        self.tel = tel if tel is not None else obs.current()
+        self.log = log if log is not None else obs.Logger(self.tel,
+                                                          quiet=True)
+        self.members: List[BatchMember] = []
+        self.dispatcher: Optional[BatchDispatcher] = None
+        self.lift_names: Tuple[str, ...] = ()
+        self._tels = tels or [None] * len(cfgs)
+        self._tags = tags or [None] * len(cfgs)
+        self.build_wall_s = 0.0
+
+    # ---- compat proof + build -----------------------------------------
+    def build(self) -> "BatchCheckEngine":
+        from ..analyze.bounds import (infer_state_bounds,
+                                      liftable_constants,
+                                      merge_lane_bounds)
+        from ..session import load_model
+        t0 = time.time()
+        c0 = self.cfgs[0]
+        for c in self.cfgs[1:]:
+            for f in _SHARED_FIELDS:
+                if getattr(c, f) != getattr(c0, f):
+                    raise BatchIncompatible(
+                        f"member option {f!r} differs "
+                        f"({getattr(c, f)!r} vs {getattr(c0, f)!r})")
+        models = []
+        for c, jt in zip(self.cfgs, self._tels):
+            with (jt or self.tel).span("load", spec=c.spec):
+                models.append(load_model(c.spec, c.cfg, c.no_deadlock,
+                                         c.include))
+        m0 = models[0]
+        lift = liftable_constants(m0)
+        for m in models[1:]:
+            if m.module.name != m0.module.name:
+                raise BatchIncompatible(
+                    f"module {m.module.name!r} != {m0.module.name!r}")
+            if tuple(m.vars) != tuple(m0.vars):
+                raise BatchIncompatible("state variables differ")
+            if liftable_constants(m) != lift:
+                raise BatchIncompatible("liftable-constant sets differ")
+            if set(m.cfg.constants) != set(m0.cfg.constants):
+                raise BatchIncompatible("cfg CONSTANT names differ")
+            for n in m.cfg.constants:
+                if n not in lift and \
+                        m.defs.get(n) != m0.defs.get(n):
+                    raise BatchIncompatible(
+                        f"non-liftable constant {n} differs "
+                        f"({m.defs.get(n)!r} vs {m0.defs.get(n)!r}) — "
+                        f"it shapes the layout, so the models are not "
+                        f"layout-compatible")
+        self.lift_names = lift
+        self.members = [BatchMember(model=m, tel=t, tag=g)
+                        for m, t, g in zip(models, self._tels,
+                                           self._tags)]
+
+        # ONE layout over the union of every member's sampled states,
+        # with the proven bounds interval-merged so no member's values
+        # can trip another's proof
+        bfs_n, walks, depth = tuple(c0.sample)
+        extra: List[Dict[str, Any]] = []
+        reports = []
+        with self.tel.span("batch_sample", members=len(models)):
+            for m in models:
+                reports.append(infer_state_bounds(m))
+                if m is not m0:
+                    extra.extend(sample_states(m, bfs_states=bfs_n,
+                                               n_walks=walks,
+                                               walk_depth=depth))
+        merged = merge_lane_bounds(
+            [r.lane_bounds() if r is not None and r.converged else None
+             for r in reports])
+        m0._bounds_report = _MergedBounds(merged=merged)
+
+        bounds = Bounds(seq_cap=c0.seq_cap, grow_cap=c0.grow_cap,
+                        kv_cap=c0.kv_cap)
+        with self.tel.span("engine_build", batch=len(models)):
+            try:
+                donor = TpuExplorer(
+                    m0, log=self.log, bounds=bounds,
+                    store_trace=not c0.no_trace,
+                    progress_every=c0.progress_every,
+                    host_seen=True, chunk=c0.chunk,
+                    sample_cfg=tuple(c0.sample),
+                    extra_samples=extra,
+                    max_states=c0.max_states,
+                    relayouts_left=0,
+                    lift_consts=lift)
+            except (CompileError, ModeError) as ex:
+                raise BatchIncompatible(
+                    f"lifted-constant compile failed: {ex}")
+        reason = donor.batch_block_reason()
+        if reason is not None:
+            raise BatchIncompatible(f"donor engine not batchable: "
+                                    f"{reason}")
+        self.members[0].engine = donor
+        for mem in self.members[1:]:
+            mem.engine = TpuExplorer(
+                mem.model, donor=donor, log=self.log,
+                max_states=c0.max_states,
+                store_trace=not c0.no_trace,
+                progress_every=c0.progress_every)
+        cvecs = np.stack([mem.engine._cvec for mem in self.members]) \
+            if lift else np.zeros((len(self.members), 0), np.int32)
+        self.dispatcher = BatchDispatcher(donor, cvecs, tel=self.tel)
+        # MEASURED engine-build count for the cohort (the "one compile"
+        # gauge must be derived, not asserted): the donor build above
+        # is the only build path — follower clones and the vmapped jit
+        # reuse it; any future path that rebuilds must increment this
+        self.engine_builds = 1
+        self.build_wall_s = time.time() - t0
+        self.tel.gauge("batch.members", len(self.members))
+        self.tel.gauge("batch.lifted_consts", list(lift))
+        self.tel.gauge("batch.plan", donor.plan.batch_descriptor())
+        return self
+
+    # ---- run -----------------------------------------------------------
+    def run(self) -> List[BatchMember]:
+        """Drive every member's UNCHANGED host_seen loop, one thread per
+        member, device work through the shared dispatcher.  Returns the
+        members with .result (or .error) filled."""
+        assert self.dispatcher is not None, "build() first"
+        disp = self.dispatcher
+        disp.reset()
+        for mem in self.members:
+            mem.result = mem.error = None
+        # serial init prep: tiny, and it primes the shared _host_keys
+        # jit buckets so member threads race on dispatch only
+        import contextlib
+        for mem in self.members:
+            eng = mem.engine
+            with obs.use_local(mem.tel) if mem.tel is not None \
+                    else contextlib.nullcontext():
+                eng._prepare_init(time.time(), [])
+
+        def drive(slot: int, mem: BatchMember) -> None:
+            eng = mem.engine
+            eng._hstep_override = disp.hstep_factory(slot)
+            try:
+                if mem.tel is not None:
+                    with obs.use_local(mem.tel), \
+                            mem.tel.span("search", batch_slot=slot):
+                        mem.result = eng.run()
+                else:
+                    mem.result = eng.run()
+            except BaseException as ex:  # noqa: BLE001 — the member's
+                # failure is ITS verdict; the cohort keeps running
+                mem.error = ex
+            finally:
+                disp.deregister(slot)
+
+        threads = [threading.Thread(
+            target=drive, args=(i, mem),
+            name=f"jaxmc-batch-m{i}", daemon=True)
+            for i, mem in enumerate(self.members)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        self.tel.gauge("batch.occupancy", disp.max_width)
+        self.tel.gauge("batch.dispatch_count", disp.dispatches)
+        return self.members

@@ -23,6 +23,7 @@ store_trace=False for benchmark runs.
 from __future__ import annotations
 
 import os
+import threading
 import time
 from functools import partial
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -92,6 +93,26 @@ def filter_init_states(model, layout, init_rows):
                 return explored, (nm, st)
         explored.append(i)
     return explored, None
+
+
+def _any_fast(x) -> bool:
+    """bool(any(x)) without lifting a HOST array onto the device: the
+    batched host_seen loop receives numpy step outputs (the vmapped
+    dispatcher converts once for all members), and an eager jnp.any on
+    those pays a host->device->host round trip PER CALL, which at
+    thousands of supersteps dominated the batch win."""
+    if isinstance(x, np.ndarray):
+        return bool(np.any(x))
+    return bool(jnp.any(x))
+
+
+def _take_rows_fast(x, idx) -> np.ndarray:
+    """Row-gather returning numpy: fancy-index for host arrays, device
+    jnp.take (avoids transferring the full block) for device arrays."""
+    if isinstance(x, np.ndarray):
+        return x[idx]
+    return np.asarray(jnp.take(x, jnp.asarray(idx, dtype=jnp.int32),
+                               axis=0))
 
 
 def _pow2_at_least(n: int, lo: int = 256) -> int:
@@ -365,7 +386,32 @@ class TpuExplorer:
                  seen_mode: str = "auto",
                  seen_cap: Optional[int] = None,
                  spill_dir: Optional[str] = None,
-                 host_tier_keys: Optional[int] = None):
+                 host_tier_keys: Optional[int] = None,
+                 lift_consts: Optional[Tuple[str, ...]] = None,
+                 donor: Optional["TpuExplorer"] = None):
+        # cross-model batching (ISSUE 13): `lift_consts` compiles the
+        # named CONSTANTs as traced kernel inputs instead of baked
+        # scalars, so one compiled program serves every model that
+        # differs only in those values; `donor` clones a FOLLOWER
+        # engine that reuses the donor's layout + compiled kernels
+        # (zero kernel builds) while keeping its own model, init
+        # states, seen store and checkpoint surface.
+        self._hstep_override: Optional[Callable] = None
+        if donor is not None:
+            self._clone_from_donor(
+                donor, model, log=log, max_states=max_states,
+                store_trace=store_trace, progress_every=progress_every,
+                checkpoint_path=checkpoint_path,
+                checkpoint_every=checkpoint_every,
+                resume_from=resume_from,
+                final_checkpoint=final_checkpoint)
+            return
+        self._lift_names: Tuple[str, ...] = tuple(lift_consts or ())
+        if self._lift_names and not host_seen:
+            raise ModeError(
+                "lifted-constant (batchable) engines run in host_seen "
+                "mode only — the level/resident/mesh steps do not "
+                "thread constant lanes")
         self.model = model
         # the device layer this engine is compiled FOR (ISSUE 11): one
         # descriptor instead of per-engine re-derivation from global
@@ -446,6 +492,11 @@ class TpuExplorer:
             self.layout = build_layout2(model, sampled, self.bounds,
                                         static_bounds=self._static_bounds)
         self.kc = KernelCtx(model, self.layout, self.bounds)
+        # per-model lifted-constant values, in _lift_names order: the
+        # runtime input vector the shared kernels read instead of baked
+        # scalars (empty for ordinary engines — same code path)
+        self._cvec = np.asarray([int(model.defs[n])
+                                 for n in self._lift_names], np.int32)
         # dynamic \E expansion applies to message tables AND to
         # state-dependent intervals (\E i \in 1..Len(q), AlternatingBit's
         # Lose); slots beyond the actual element count are mask-disabled.
@@ -536,6 +587,29 @@ class TpuExplorer:
                             cas = []
                             for ga in gas:
                                 ca = compile_action2(self.kc, ga)
+                                if self._lift_names:
+                                    # lifted build: the forced abstract
+                                    # trace installs const TRACERS so
+                                    # compile success/demotion is
+                                    # decided exactly as the shared
+                                    # run-time trace will decide it
+                                    # (introspection skipped — it would
+                                    # re-trace without the lanes)
+                                    cspec = jax.ShapeDtypeStruct(
+                                        (len(self._lift_names),),
+                                        jnp.int32)
+                                    if ca.n_slots:
+                                        jax.eval_shape(
+                                            partial(self._traced_with,
+                                                    ca.fn),
+                                            cspec, row_spec, slot_spec)
+                                    else:
+                                        jax.eval_shape(
+                                            partial(self._traced_with,
+                                                    ca.fn),
+                                            cspec, row_spec)
+                                    cas.append(ca)
+                                    continue
                                 if tel.enabled:
                                     # the introspection trace IS the
                                     # forced abstract trace (same lazy
@@ -671,7 +745,14 @@ class TpuExplorer:
                 f = compile_predicate2(self.kc, ex)
                 t_tr = time.time()
                 try:
-                    jax.eval_shape(f, row_spec)
+                    if self._lift_names:
+                        jax.eval_shape(
+                            partial(self._traced_with, f),
+                            jax.ShapeDtypeStruct(
+                                (len(self._lift_names),), jnp.int32),
+                            row_spec)
+                    else:
+                        jax.eval_shape(f, row_spec)
                 except CompileError as e:
                     demoted.append((nm, ex, str(e)))
                     continue
@@ -808,6 +889,7 @@ class TpuExplorer:
         tel.gauge("device.donation", bool(self.donate))
         tel.gauge("backend.platform", self.backend_desc.platform)
         tel.gauge("backend.profile_ns", self.backend_desc.profile_ns)
+        self._trace_lock = threading.Lock()
         self._step_cache: Dict[Tuple[int, int], Callable] = {}
         self._hstep_cache: Dict[int, Callable] = {}
         self._hstep_group_jits: Dict[int, List[Callable]] = {}
@@ -934,6 +1016,135 @@ class TpuExplorer:
                     self.log(f"-- tier: capacity profile predicts an "
                              f"out-of-core run (~{int(prof['TIERK'])} "
                              f"cold-tier keys at the last completion)")
+
+    # ---- lifted constants + follower clones (ISSUE 13) ---------------
+
+    def _install_const_lanes(self, cvec) -> None:
+        """Bind the lifted-constant TRACERS into the kernel context for
+        the duration of a trace (kernel2 identifier resolution reads
+        kc.const_lanes).  No-op for ordinary engines."""
+        if self._lift_names:
+            self.kc.const_lanes = {
+                nm: cvec[i] for i, nm in enumerate(self._lift_names)}
+
+    def _traced_with(self, fn, cvec, *args):
+        """Run `fn(*args)` (a trace) with const lanes installed; used
+        by the forced abstract traces at build time."""
+        self._install_const_lanes(cvec)
+        try:
+            return fn(*args)
+        finally:
+            self.kc.const_lanes = {}
+
+    def _cvec_jnp(self):
+        if getattr(self, "_cvec_dev", None) is None:
+            self._cvec_dev = jnp.asarray(self._cvec)
+        return self._cvec_dev
+
+    def batch_block_reason(self) -> Optional[str]:
+        """None when this engine can serve as a cross-model batch
+        donor/member; otherwise the human-readable blocker (the batch
+        planner falls back to solo runs and reports it)."""
+        if not self.host_seen:
+            return "host_seen mode required"
+        if self.hybrid:
+            return ("hybrid execution (interp-demoted units): "
+                    + "; ".join(
+                        [f"arm {a.label or 'Next'}" for a, _ in
+                         self.fb_arms]
+                        + [f"invariant {nm}" for nm, _, _ in
+                           self.fb_invs]
+                        + [f"constraint {nm}" for nm, _, _ in
+                           self.fb_cons]))
+        if self.refiners:
+            return "refinement PROPERTYs (stepwise host edge checks)"
+        if self.live_obligations:
+            return "temporal PROPERTYs (behavior graph)"
+        if self._demotable:
+            # a fired compile-recovery demotion restarts via
+            # _demote_arms, which MUTATES the (donor-shared) compiled
+            # arm set mid-cohort — refuse up front; the jobs run solo
+            # where the demotion restart is sound
+            return ("compile-recovery demotions possible (arms "
+                    + ", ".join(self.arms[i].label or "Next"
+                                for i in self._demotable)
+                    + "): a runtime demotion restart would mutate the "
+                      "shared batch program")
+        if self.seen_cap is not None:
+            return "hierarchical seen-set spill (per-member tiers)"
+        fused_max = int(os.environ.get("JAXMC_FUSED_MAX_INSTANCES",
+                                       "24"))
+        if jax.default_backend() == "cpu" and self.A > fused_max:
+            return (f"arm-split step ({self.A} instances > "
+                    f"JAXMC_FUSED_MAX_INSTANCES={fused_max})")
+        return None
+
+    _DONOR_SHARED = (
+        "backend_desc", "bounds", "layout", "kc", "plan", "compiled",
+        "actions", "arms", "_ca_arm", "fb_arms", "fb_invs", "fb_cons",
+        "inv_fns", "constraint_fns", "canon_fn", "_sym_fallback",
+        "sym_identity", "view_fn", "view_width", "refiners",
+        "unrefined", "live_obligations", "live_unsupported",
+        "collect_edges", "hybrid", "_demotable", "labels_flat",
+        "arm_verdicts", "A", "W", "PW", "K", "fp_mode", "key_width",
+        "donate", "chunk", "sample_cfg", "host_seen", "seen_mode_req",
+        "_lift_names", "_trace_lock",
+        # compiled-program caches are SHARED OBJECTS: a follower's
+        # first dispatch is a cache hit on the donor's jit, with its
+        # own constant vector as a runtime argument
+        "_step_cache", "_hstep_cache", "_hstep_group_jits",
+        "_newcheck_cache", "_res_cache", "_hostkeys_cache",
+        "_pkeys_cache")
+
+    def _clone_from_donor(self, donor: "TpuExplorer", model: Model,
+                          log, max_states, store_trace, progress_every,
+                          checkpoint_path, checkpoint_every,
+                          resume_from, final_checkpoint) -> None:
+        """FOLLOWER construction (ISSUE 13): reuse the donor's layout
+        and compiled kernels wholesale — zero sampling, zero bounds
+        fixpoint, zero kernel builds — binding only this member's
+        model, init states and run-control surface.  The caller
+        (backend/batch.py) has already proven layout compatibility
+        (same module shape; constants outside the lifted set equal) and
+        that the donor is batchable (no hybrid units, no refiners, no
+        temporal obligations)."""
+        reason = donor.batch_block_reason()
+        if reason is not None:
+            raise ModeError(f"donor engine is not batchable: {reason}")
+        for attr in self._DONOR_SHARED:
+            setattr(self, attr, getattr(donor, attr))
+        self.model = model
+        self.log = log if log is not None else obs.Logger(quiet=True)
+        self.max_states = max_states
+        self.store_trace = store_trace
+        self.progress_every = progress_every
+        self.checkpoint_path = checkpoint_path
+        self.checkpoint_every = checkpoint_every
+        self.resume_from = resume_from
+        self.final_checkpoint = final_checkpoint
+        self.resident = False
+        self.pin_interp_arms = False
+        self.extra_samples = []
+        # relayout/demotion restarts rebuild layout+kernels per member,
+        # which would diverge from the shared batch program: a follower
+        # that hits a recovery abort surfaces it (the batch runner
+        # falls back to a solo re-run)
+        self.relayouts_left = 0
+        self.cap_profile = False
+        self._res_caps_hint = None
+        self._res_caps = None
+        self._res_maxlvl = donor._res_maxlvl
+        self._last_frontier_np = None
+        self.seen_cap = None
+        self.spill_dir = None
+        self.host_tier_keys = None
+        self._tiers = None
+        self._cvec = np.asarray([int(model.defs[n])
+                                 for n in self._lift_names], np.int32)
+        self._cvec_dev = None
+        base_ctx = model.ctx()
+        self.init_states = enumerate_init(model.init, base_ctx,
+                                          model.vars)
 
     def _expand_fn(self):
         """The (state x action) expansion closure shared by both step
@@ -1436,6 +1647,57 @@ class TpuExplorer:
         self._step_cache[key] = step
         return step
 
+    def _hstep_core(self, FC: int) -> Callable:
+        """The UNJITTED fused host_seen step:
+        (frontier_p [FC, PW], fcount, cvec [n_lift] i32) -> out dict.
+        One unit, two compilers: the solo engine jits it directly
+        (_get_hstep), the cross-model batcher (backend/batch.py) jits
+        jax.vmap of it so B members' frontiers + per-model constant
+        vectors go through ONE dispatch.  `cvec` is the lifted-constant
+        vector (empty for ordinary engines); the tracer install at the
+        top is what makes the compiled program constant-generic."""
+        A, W = self.A, self.W
+        plan = self.plan
+        inv_fns = self.inv_fns
+        con_fns = self.constraint_fns
+        keys_of = self._keys_of
+        install = self._install_const_lanes
+
+        def hstep_core(frontier_p, fcount, cvec):
+            install(cvec)
+            frontier = plan.unpack_rows(frontier_p)
+            fvalid = jnp.arange(FC) < fcount
+            en, aok, ov, succ = self._expand_fn()(frontier)
+            valid = en & fvalid[None, :]
+            assert_bad = (~aok) & fvalid[None, :]
+            # int overflow CODE (kernel2.OV_*), max-reduced below
+            overflow = jnp.where(fvalid[None, :], ov, 0)
+            dead = fvalid & ~jnp.any(en, axis=0)
+            gen = jnp.sum(valid)
+            C = A * FC
+            cand_u = succ.reshape(C, W)
+            cvalid = valid.reshape(C)
+            cand_u = jnp.where(cvalid[:, None], cand_u, SENTINEL)
+            keys, cand, pack_ovf = keys_of(cand_u, cvalid)
+            inv_ok = jnp.ones(C, bool)
+            for nm, f in inv_fns:
+                inv_ok = inv_ok & jax.vmap(f)(cand_u)
+            explore = jnp.ones(C, bool)
+            for nm, f in con_fns:
+                explore = explore & jax.vmap(f)(cand_u)
+            base_ov = jnp.max(overflow, initial=0)
+            ov_out = jnp.where(base_ov != 0, base_ov,
+                               jnp.where(pack_ovf, OV_PACK, 0))
+            # trace hygiene: clear the shared ctx so no stale tracers
+            # outlive this trace (every read happened above)
+            self.kc.const_lanes = {}
+            return dict(cand=cand, cvalid=cvalid, keys=keys, gen=gen,
+                        dead=dead, assert_bad=assert_bad,
+                        overflow=ov_out,
+                        inv_ok=inv_ok, explore=explore)
+
+        return hstep_core
+
     def _get_hstep(self, FC: int) -> Callable:
         """Expand-only step for host_seen mode: the seen-set lives in the
         native C++ fingerprint store (native/fps_store.cc) — the spill
@@ -1447,7 +1709,6 @@ class TpuExplorer:
         obs.current().counter("compile.cache_misses")
         A, W, PW = self.A, self.W, self.PW
         plan = self.plan
-        inv_fns = self.inv_fns
         con_fns = self.constraint_fns
         keys_of = self._keys_of
 
@@ -1464,40 +1725,15 @@ class TpuExplorer:
         # models split on CPU (JAXMC_FUSED_MAX_INSTANCES, default 24).
         fused_max = int(os.environ.get("JAXMC_FUSED_MAX_INSTANCES",
                                        "24"))
-        split = jax.default_backend() == "cpu" and A > fused_max
+        split = jax.default_backend() == "cpu" and A > fused_max \
+            and not self._lift_names
 
         if not split:
-            expand = self._expand_fn()
+            core_j = jax.jit(self._hstep_core(FC))
+            cvec = self._cvec_jnp()
 
-            @jax.jit
             def hstep(frontier_p, fcount):
-                frontier = plan.unpack_rows(frontier_p)
-                fvalid = jnp.arange(FC) < fcount
-                en, aok, ov, succ = expand(frontier)
-                valid = en & fvalid[None, :]
-                assert_bad = (~aok) & fvalid[None, :]
-                # int overflow CODE (kernel2.OV_*), max-reduced below
-                overflow = jnp.where(fvalid[None, :], ov, 0)
-                dead = fvalid & ~jnp.any(en, axis=0)
-                gen = jnp.sum(valid)
-                C = A * FC
-                cand_u = succ.reshape(C, W)
-                cvalid = valid.reshape(C)
-                cand_u = jnp.where(cvalid[:, None], cand_u, SENTINEL)
-                keys, cand, pack_ovf = keys_of(cand_u, cvalid)
-                inv_ok = jnp.ones(C, bool)
-                for nm, f in inv_fns:
-                    inv_ok = inv_ok & jax.vmap(f)(cand_u)
-                explore = jnp.ones(C, bool)
-                for nm, f in con_fns:
-                    explore = explore & jax.vmap(f)(cand_u)
-                base_ov = jnp.max(overflow, initial=0)
-                ov_out = jnp.where(base_ov != 0, base_ov,
-                                   jnp.where(pack_ovf, OV_PACK, 0))
-                return dict(cand=cand, cvalid=cvalid, keys=keys, gen=gen,
-                            dead=dead, assert_bad=assert_bad,
-                            overflow=ov_out,
-                            inv_ok=inv_ok, explore=explore)
+                return core_j(frontier_p, fcount, cvec)
 
             hstep.is_async = True  # fused jit: dispatch is asynchronous
             self._hstep_cache[FC] = hstep
@@ -1664,9 +1900,11 @@ class TpuExplorer:
             inv_fns = self.inv_fns
             con_fns = [] if skip_cons else self.constraint_fns
             plan = self.plan
+            install = self._install_const_lanes
 
             @jax.jit
-            def chk(rows_p):
+            def chk(rows_p, cvec):
+                install(cvec)
                 rows = plan.unpack_rows(rows_p)
                 ok = jnp.ones(rows.shape[0], bool)
                 for nm, f in inv_fns:
@@ -1674,12 +1912,19 @@ class TpuExplorer:
                 ex_ = jnp.ones(rows.shape[0], bool)
                 for nm, f in con_fns:
                     ex_ = ex_ & jax.vmap(f)(rows)
+                self.kc.const_lanes = {}  # trace hygiene (see core)
                 return ok, ex_
 
             self._newcheck_cache[ckey] = jf = chk
         buf = np.repeat(rows_np[:1], cap, axis=0)
         buf[:n] = rows_np
-        ok, ex_ = jf(jnp.asarray(buf))
+        # the shared trace lock serializes first-call tracing of the
+        # (donor-shared) jit against concurrent member threads: two
+        # traces installing const lanes into the ONE shared KernelCtx
+        # would cross-contaminate (unreachable in the fused batch path,
+        # which never defers predicate checks — belt and braces)
+        with self._trace_lock:
+            ok, ex_ = jf(jnp.asarray(buf), self._cvec_jnp())
         return np.asarray(ok)[:n], np.asarray(ex_)[:n]
 
     # ---- resident mode: the whole BFS inside one jitted while_loop ----
@@ -2817,7 +3062,12 @@ class TpuExplorer:
                  f"{distinct} distinct, {len(frontier_np)} on "
                  f"queue.")
         last_progress = last_ck = time.time()
-        hstep = self._get_hstep(CH)
+        # cross-model batching hook (ISSUE 13): a batch member's device
+        # call routes through the shared vmapped dispatcher instead of
+        # its own jit — same signature, same outputs, one dispatch for
+        # the whole cohort
+        hstep = self._hstep_override(CH) \
+            if self._hstep_override is not None else self._get_hstep(CH)
         while len(frontier_np) > 0:
             # chaos sites: simulated hard crash / terminal device failure
             # entering a level (no-ops unless JAXMC_FAULTS names them)
@@ -2869,7 +3119,7 @@ class TpuExplorer:
                 c = min(CH, ll - b)
                 bf = np.full((CH, self.PW), SENTINEL, np.int32)
                 bf[:c] = fnp[b:b + c]
-                return b, c, bf, hstep(jnp.asarray(bf), c)
+                return b, c, bf, hstep(bf, c)
 
             nxt = None  # one-slot prefetch: the chunk dispatched early
             for base in range(0, L, CH):
@@ -2894,7 +3144,7 @@ class TpuExplorer:
                     return self._mk_result(
                         False, distinct, generated, depth, t0, warnings,
                         Violation("error", "capacity overflow", [], msg))
-                if bool(jnp.any(out["assert_bad"])):
+                if _any_fast(out["assert_bad"]):
                     ab = np.asarray(out["assert_bad"])
                     ai, f = np.unravel_index(np.argmax(ab), ab.shape)
                     trace = self._trace_to(trace_levels, frontier_maps,
@@ -2905,7 +3155,7 @@ class TpuExplorer:
                                   [x for x in trace if x[0] is not None],
                                   f"assertion in "
                                   f"{self.labels_flat[int(ai)]}"))
-                if model.check_deadlock and bool(jnp.any(out["dead"])):
+                if model.check_deadlock and _any_fast(out["dead"]):
                     if self.fb_arms:
                         # a device-dead state may still have fallback-arm
                         # successors: defer the verdict to after the
@@ -2913,7 +3163,7 @@ class TpuExplorer:
                         lvl_dead[base:base + cn] = \
                             np.asarray(out["dead"])[:cn]
                     else:
-                        f = int(jnp.argmax(out["dead"]))
+                        f = int(np.argmax(np.asarray(out["dead"])))
                         trace = self._trace_to(trace_levels,
                                                frontier_maps,
                                                depth, base + f)
@@ -2956,9 +3206,7 @@ class TpuExplorer:
                 new_idx = valid_idx[new_mask]
                 if not len(new_idx):
                     continue
-                rows_np = np.asarray(jnp.take(
-                    out["cand"], jnp.asarray(new_idx, dtype=np.int32),
-                    axis=0))
+                rows_np = _take_rows_fast(out["cand"], new_idx)
                 # predicate checks run on NEW rows only (TLC checks each
                 # state once): the split hstep defers them entirely —
                 # evaluating MCVoting's quantifier-heavy Inv over every

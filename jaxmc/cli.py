@@ -240,6 +240,27 @@ def cmd_info(args) -> int:
     print(f"  variables: {', '.join(mod.variables) or '-'}")
     ops = [u.name for u in mod.ast.units if isinstance(u, A.OpDef)]
     print(f"  operators: {len(ops)}")
+    # batch compatibility surface (ISSUE 13): which constants would
+    # ride the batch axis, the layout-compat class key, and analyze's
+    # state-space estimate — the parse-time facts the serve fleet
+    # schedules on.  Needs a bindable cfg; silent otherwise (info on a
+    # bare module stays cfg-free).
+    cfgp = getattr(args, "cfg", None) or \
+        os.path.splitext(args.spec)[0] + ".cfg"
+    if os.path.exists(cfgp):
+        try:
+            from .session import SessionConfig, batch_profile
+            prof = batch_profile(SessionConfig(
+                spec=args.spec, cfg=cfgp, backend="jax",
+                host_seen=True))
+        except Exception:  # noqa: BLE001 — info must never fail on
+            prof = None    # an analysis defect
+        if prof is not None:
+            est = prof.cost_estimate \
+                if prof.cost_estimate is not None else "?"
+            print(f"  batch:     sig={prof.bsig} "
+                  f"lifted=[{', '.join(prof.lift) or '-'}] "
+                  f"est_states={est}")
     return 0
 
 
@@ -403,6 +424,9 @@ def main(argv=None) -> int:
 
     i = sub.add_parser("info", help="parse a spec and print a summary")
     i.add_argument("spec")
+    i.add_argument("--cfg", default=None,
+                   help="model config for the batch-compat surface "
+                        "(default: <spec>.cfg when present)")
     i.set_defaults(fn=cmd_info)
 
     s = sub.add_parser("sweep",

@@ -175,6 +175,19 @@ class KernelCtx:
         self.op_depth: Dict[str, int] = {}
         self.op_unroll_limit = int(
             os.environ.get("JAXMC_OP_UNROLL_LIMIT", "64"))
+        # LIFTED CONSTANTS (ISSUE 13): name -> traced int32 scalar.
+        # When a name is present here, identifier resolution returns the
+        # traced lane instead of baking the model's concrete value into
+        # the kernel — the same compiled program then serves every
+        # layout-compatible model, with per-model CONSTANT values fed in
+        # as batch-axis inputs (backend/batch.py).  Installed at TRACE
+        # time by the engine (bfs.py installs the tracers at the top of
+        # each jitted step / forced abstract trace), empty otherwise.
+        # A lifted constant used where compilation needs a STATIC value
+        # (a quantifier domain bound, a container cap) raises the usual
+        # CompileError at trace time — the batch planner treats that as
+        # "not batchable", never as a wrong kernel.
+        self.const_lanes: Dict[str, Any] = {}
 
 
 class Frame:
@@ -1396,6 +1409,10 @@ def _sym_eval2_inner(e: A.Node, fr: Frame):
             return v
         if name in fr.state:
             return fr.state[name]
+        if kc.const_lanes and name in kc.const_lanes:
+            # lifted CONSTANT (ISSUE 13): a traced per-model lane, not
+            # the baked concrete value
+            return mk_int(kc.const_lanes[name])
         d = kc.model.defs.get(name)
         if isinstance(d, OpClosure):
             if d.params:
@@ -2126,6 +2143,8 @@ def _sym_opapp2(e: A.OpApp, fr: Frame):
             return sym_eval2(d.body,
                              fr.with_bound(dict(zip(d.params, args))))
     if d is not None and not e.args:
+        if kc.const_lanes and name in kc.const_lanes:
+            return mk_int(kc.const_lanes[name])  # lifted CONSTANT
         if isinstance(d, (SymV, frozenset, Fcn, Elems)):
             return d
         return _static_const(d, fr)

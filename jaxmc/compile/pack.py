@@ -299,6 +299,18 @@ class LanePlan:
                      self.bits.tolist(), self.bias.tolist(),
                      self.sent_code.tolist()))
 
+    def batch_descriptor(self) -> Dict[str, int]:
+        """The compat surface the cross-model batcher reports and
+        verifies (ISSUE 13): the packed word width and lane accounting
+        every member of a vmapped batch shares — per-model CONSTANT
+        values are batch-axis lanes, so they are deliberately NOT in
+        here."""
+        return {"width": self.width, "packed_width": self.packed_width,
+                "identity": int(self.identity),
+                "bits_per_state": self.bits_per_state,
+                "proven_lanes": self.proven_lanes,
+                "guarded_lanes": self.guarded_lanes}
+
     # ---------------- host (numpy) pack/unpack ----------------
 
     def pack_np(self, rows: np.ndarray) -> np.ndarray:

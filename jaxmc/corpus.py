@@ -252,6 +252,28 @@ CASES: List[Case] = [
          lint_waive=("JMC301",),
          res_caps={"SC": 256, "FCap": 64, "AccCap": 128, "VC": 64,
                    "chunk": 64}),
+    # cross-model batching fixture family (ISSUE 13): one module, four
+    # cfgs differing ONLY in liftable constant values — layout-
+    # compatible by construction, so the serve fleet and `make
+    # batch-check` can prove the vmapped multi-model engine in
+    # containers without /root/reference.  batchtoy_bad's Bound sits
+    # below the reachable x maximum: the mixed-batch scenario (one
+    # member violates, the rest run to exhaustion).
+    Case("specs/batchtoy.tla", root="repo",
+         cfg="specs/batchtoy_a.cfg",
+         distinct=28, generated=29, jax="yes", mode="compiled"),
+    Case("specs/batchtoy.tla", root="repo",
+         cfg="specs/batchtoy_b.cfg",
+         distinct=40, generated=41, jax="yes", mode="compiled"),
+    Case("specs/batchtoy.tla", root="repo",
+         cfg="specs/batchtoy_c.cfg",
+         distinct=20, generated=21, jax="yes", mode="compiled"),
+    Case("specs/batchtoy.tla", root="repo",
+         cfg="specs/batchtoy_d.cfg",
+         distinct=32, generated=33, jax="yes", mode="compiled"),
+    Case("specs/batchtoy.tla", root="repo",
+         cfg="specs/batchtoy_bad.cfg",
+         expect="violation:invariant", jax="yes", mode="compiled"),
     # bench-scale kernelbench rungs (ISSUE 6): wide-shallow variants of
     # the VIEW/SYMMETRY fixtures sized so states/sec measures
     # throughput; `make bench-check`'s kernel-vs-interp leg gates the

@@ -289,6 +289,23 @@ def cmd_report(args, out=sys.stdout) -> int:
         hl.append("tier[" + " ".join(
             f"{t}={occ.get(t, 0)}" for t in ("device", "host", "disk"))
             + "]")
+    # cross-model batching highlight row (ISSUE 13): cohort width,
+    # dispatch count and the constants riding the batch axis — a
+    # batched fleet artifact reads batch[occupancy=4 dispatches=40
+    # lifted=Bound,Limit] at a glance
+    bocc = g.get("batch.occupancy", g.get("serve.batch_occupancy"))
+    if isinstance(bocc, int) and bocc:
+        cells = [f"occupancy={bocc}"]
+        bd = g.get("batch.dispatch_count")
+        if isinstance(bd, int):
+            cells.append(f"dispatches={bd}")
+        lifted = g.get("batch.lifted_consts")
+        if isinstance(lifted, list) and lifted:
+            cells.append("lifted=" + ",".join(str(x) for x in lifted))
+        fl = c.get("serve.fastlane_jobs")
+        if fl:
+            cells.append(f"fastlane={fl}")
+        hl.append("batch[" + " ".join(cells) + "]")
     # proven-lane ratio (ISSUE 9): how much of the int-lane surface the
     # static analyzer proved vs what stayed sampled+guarded
     pv, gd = g.get("analyze.proven_lanes"), \

@@ -304,6 +304,31 @@ jaxmc.metrics/2 artifact minus the new optional surface, so readers and
       SC/FCap/AccCap/VC; a capped run that loads one stamps gauge
       `tier.predicted_keys` (the expected out-of-core magnitude)
       before the first spill.
+
+  (PR 13, still jaxmc.metrics/2 — all additive/optional; cross-model
+   vmapped batching, backend/batch.py + serve fleet wiring + ISSUE 13:)
+    - batch scheduling (fleet telemetry): gauge `serve.batch_sigs`
+      (distinct layout-compat classes seen this life), gauge
+      `serve.batch_occupancy` (member width of the last vmapped
+      cohort), gauge `serve.batch_compiles` (engine builds per cohort
+      — 1 by construction), counters `serve.vbatch_jobs` /
+      `serve.fastlane_jobs` (analyze-cost-routed queue jumps) /
+      `serve.batch_incompatible` (parse-time-compatible cohorts the
+      build refused; members requeued solo) / `serve.owner_respawns`
+      + trace event `serve.owner_died {error}` (device-owner process
+      death; jobs requeued, never lost).
+    - batch engine (run-scope telemetry): gauge `batch.width` (member
+      lanes in the last vmapped dispatch), counter `batch.dispatches`,
+      gauges `batch.members` / `batch.occupancy` /
+      `batch.dispatch_count` / `batch.lifted_consts` (the CONSTANT
+      names riding the batch axis) / `batch.plan` (the shared
+      pack-plan descriptor: width/packed_width/bits_per_state/...).
+    - serve job artifacts: the `serve` block gains optional `bsig`
+      (the layout-compat class), `cost_estimate` (analyze's
+      state-space estimate consumed by the fast lane — null when the
+      fixpoint bailed), `batch_occupancy`, `batch_dispatches`,
+      `lifted_consts`, and `device_owner` (job ran in the owner
+      process); job records carry `bsig`/`cost_estimate`/`fast_lane`.
 """
 
 from __future__ import annotations

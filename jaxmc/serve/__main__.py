@@ -24,6 +24,10 @@ from typing import List, Optional
 def cmd_run(args) -> int:
     from .. import drain
     from .daemon import ServeDaemon
+    if getattr(args, "device_owner", False):
+        # flag -> env so the policy has ONE read site (the daemon's),
+        # and subprocess daemon tests can set it the same way
+        os.environ["JAXMC_SERVE_DEVICE_OWNER"] = "1"
     daemon = ServeDaemon(args.spool, host=args.host, port=args.port,
                          workers=args.workers, trace=args.trace,
                          metrics_out=args.metrics_out, quiet=args.quiet)
@@ -170,6 +174,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     r.add_argument("--metrics-out", default=None, metavar="FILE",
                    help="fleet metrics artifact written at drain")
     r.add_argument("--quiet", action="store_true")
+    r.add_argument("--device-owner", action="store_true",
+                   help="route device work (vmapped batches, solo "
+                        "device jobs) through a spawned owner process "
+                        "(ISSUE 13): the daemon never initializes jax, "
+                        "a wedged/crashed dispatch kills at worst the "
+                        "owner (jobs requeue, owner respawns). Equiv: "
+                        "JAXMC_SERVE_DEVICE_OWNER=1")
     r.set_defaults(fn=cmd_run)
 
     s = sub.add_parser("submit", help="submit a job to a live daemon")

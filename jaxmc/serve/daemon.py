@@ -39,7 +39,7 @@ import json
 import os
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from .. import drain, obs
 from ..session import CheckSession
@@ -94,7 +94,11 @@ class ServeDaemon:
         self._sig_locks: Dict[str, threading.Lock] = {}
         self._cv = threading.Condition()
         self._pending: collections.deque = collections.deque()
-        self._running: Dict[str, str] = {}  # jid -> sig
+        # jid -> (sig, claim token): the token identifies WHICH claim
+        # registered the job, so a worker whose fallback REQUEUED a
+        # claimed job (another worker may re-claim it immediately)
+        # never pops the re-claimer's live registration in its finally
+        self._running: Dict[str, Tuple[str, object]] = {}
         self._draining = False
         self._drain_reason: Optional[str] = None
         self._workers: List[threading.Thread] = []
@@ -102,6 +106,41 @@ class ServeDaemon:
         self._http_thread: Optional[threading.Thread] = None
         self._jobs_done = 0
         self._jobs_failed = 0
+        # CROSS-MODEL VMAPPED BATCHING (ISSUE 13): jobs whose parse-time
+        # batch profile (session.batch_profile) puts them in the same
+        # layout-compat class (`bsig`) pop TOGETHER and run as ONE
+        # vmapped device program (backend/batch.py) — per-job results
+        # byte-identical to solo runs, one compile for the cohort.
+        # JAXMC_SERVE_BATCH=0 restores exact-signature-only coalescing.
+        self.batch_enabled = os.environ.get(
+            "JAXMC_SERVE_BATCH", "1").strip().lower() \
+            not in ("0", "off", "no", "false")
+        try:
+            self.batch_max = max(2, int(os.environ.get(
+                "JAXMC_SERVE_BATCH_MAX", "8") or 8))
+        except ValueError:
+            self.batch_max = 8
+        # FAST LANE (ROADMAP 1c): analyze's state-space estimate is a
+        # pre-scheduling cost oracle — small proven-bounded jobs jump
+        # the queue (they finish in milliseconds; parking them behind a
+        # multi-minute search is pure latency for free).
+        try:
+            self.fastlane_bound = int(os.environ.get(
+                "JAXMC_SERVE_FASTLANE_BOUND", "50000") or 50000)
+        except ValueError:
+            self.fastlane_bound = 50000
+        # DEVICE-OWNER process (opt-in): device work leaves the daemon
+        # process entirely — see serve/owner.py
+        self.owner = None
+        if os.environ.get("JAXMC_SERVE_DEVICE_OWNER", "").strip() \
+                .lower() in ("1", "on", "yes", "true"):
+            from .owner import DeviceOwner
+            self.owner = DeviceOwner(log=self.log)
+        self._batch_sigs_seen: set = set()
+        # parse-time batch profiles are mtime-cached per (spec, cfg,
+        # options): the admission path pays the model load + bounds
+        # fixpoint once per content, not once per submission
+        self._bprof_cache: Dict[Any, Any] = {}
 
     # ---- lifecycle ----------------------------------------------------
     def start(self) -> "ServeDaemon":
@@ -223,6 +262,10 @@ class ServeDaemon:
             self._drain_reason = reason
             self._cv.notify_all()
         drain.request(f"serve drain: {reason}")
+        if self.owner is not None:
+            # forward to the device-owner process: its engines park at
+            # their next safe boundary exactly like in-process ones
+            self.owner.drain()
         self.tel.event("serve.drain", reason=reason)
         self.log(f"serve: draining ({reason}) — in-flight jobs will "
                  f"checkpoint and requeue")
@@ -247,6 +290,8 @@ class ServeDaemon:
         if self._http_thread is not None:
             self._http_thread.join(timeout=5.0)
             self._http_thread = None
+        if self.owner is not None:
+            self.owner.stop()
         self.wd.stop()
         self._update_gauges()
         self.q.stamp(host=self.host, port=self.port, pid=os.getpid(),
@@ -294,11 +339,54 @@ class ServeDaemon:
                     "statically broken job rejected by the analyzer: "
                     + "; ".join(d.render() for d in errs[:5]))
         sig = job_signature(cfg)
+        # parse-time batch profile (ISSUE 13): the layout-compat class
+        # key + analyze's cost estimate, both computed BEFORE any
+        # engine exists; a failure here only means the job schedules
+        # solo, exactly as before
+        bsig = cost = None
+        fast = False
+        if self.batch_enabled and cfg.backend != "interp":
+            # mtime-keyed cache: the profile costs a model load + the
+            # bounds fixpoint — pay it once per (spec, cfg, options)
+            # content, not once per submission on the admission path
+            try:
+                key = (cfg.spec, cfg.cfg,
+                       os.path.getmtime(cfg.spec),
+                       os.path.getmtime(cfg.cfg) if cfg.cfg else None,
+                       json.dumps(cfg.batch_signature_fields(),
+                                  sort_keys=True))
+            except OSError:
+                key = None
+            if key is not None and key in self._bprof_cache:
+                prof = self._bprof_cache[key]
+            else:
+                from ..session import batch_profile
+                try:
+                    prof = batch_profile(cfg)
+                except Exception:  # noqa: BLE001 — profiling must
+                    prof = None    # never reject a servable job
+                if key is not None:
+                    if len(self._bprof_cache) >= 256:
+                        self._bprof_cache.clear()
+                    self._bprof_cache[key] = prof
+            if prof is not None:
+                bsig, cost = prof.bsig, prof.cost_estimate
+                fast = cost is not None and cost <= self.fastlane_bound
         job = self.q.new_job(cfg.spec, cfg.cfg, payload.get("options"),
-                             sig)
+                             sig, bsig=bsig, cost_estimate=cost,
+                             fast_lane=fast or None)
         self.tel.counter("serve.jobs_submitted")
         with self._cv:
-            self._pending.append(job["id"])
+            if fast:
+                # proven-small jobs jump the queue (fast lane)
+                self._pending.appendleft(job["id"])
+                self.tel.counter("serve.fastlane_jobs")
+            else:
+                self._pending.append(job["id"])
+            if bsig:
+                self._batch_sigs_seen.add(bsig)
+                self.tel.gauge("serve.batch_sigs",
+                               len(self._batch_sigs_seen))
             self._cv.notify()
         self._update_gauges()
         return job
@@ -314,30 +402,88 @@ class ServeDaemon:
                 jid = self._pending.popleft()
                 job = self.q.load(jid)
                 followers: List[Dict[str, Any]] = []
+                xmembers: List[Dict[str, Any]] = []
                 if job is not None:
                     # BATCH: claim every queued job with this signature
-                    # — one engine run answers all of them
+                    # (one engine run answers all of them) AND — when
+                    # the leader carries a batch profile — every job in
+                    # the same LAYOUT-COMPAT class (`bsig`): those run
+                    # as one vmapped device program (ISSUE 13).
+                    # Claiming happens under the ONE _cv hold that also
+                    # registers every claimed id in _running, so a
+                    # second worker popping the same signature class
+                    # can never pick a claimed follower up again (the
+                    # satellite race), and the LRU eviction's busy-set
+                    # sees every claimed signature.
+                    bsig = job.get("bsig") if self.batch_enabled \
+                        else None
+                    xsigs = {job["sig"]}
                     rest = []
                     for other in self._pending:
                         oj = self.q.load(other)
-                        if oj is not None and \
-                                oj.get("sig") == job["sig"]:
+                        if oj is None:
+                            rest.append(other)
+                        elif oj.get("sig") == job["sig"]:
                             followers.append(oj)
+                        elif bsig and oj.get("bsig") == bsig and \
+                                (oj.get("sig") in xsigs or
+                                 len(xsigs) < self.batch_max) and \
+                                (not job.get("fast_lane") or
+                                 oj.get("fast_lane")):
+                            # a fast-lane leader claims only fast-lane
+                            # members: stapling a proven-small job to a
+                            # multi-minute cohort member would withhold
+                            # its result for the whole cohort wall —
+                            # the inversion the lane exists to prevent
+                            xmembers.append(oj)
+                            xsigs.add(oj["sig"])
                         else:
                             rest.append(other)
                     self._pending = collections.deque(rest)
-                    self._running[jid] = job["sig"]
+                    tok = object()  # this claim's ownership marker
+                    self._running[jid] = (job["sig"], tok)
+                    for j in followers + xmembers:
+                        self._running[j["id"]] = (j["sig"], tok)
             if job is None:
                 continue
+            claimed = followers + xmembers
             try:
-                self._run_batch(job, followers)
+                if xmembers:
+                    self._run_vbatch(job, followers, xmembers)
+                elif self.owner is not None and \
+                        (job.get("options") or {}).get(
+                            "backend", "interp") != "interp":
+                    # owner mode: solo DEVICE jobs leave the daemon
+                    # process too (interp jobs stay on the thread pool)
+                    self._run_owner_solo(job, followers)
+                else:
+                    self._run_batch(job, followers)
             except Exception as ex:  # noqa: BLE001 — a job failure must
-                # never kill the worker; the defect lands on the job
-                self._fail_job(job, followers,
-                               f"{type(ex).__name__}: {ex}")
+                # never kill the worker; the defect lands on the job —
+                # but only on jobs THIS claim still owns (a fallback
+                # may have requeued some, and another worker may
+                # already be running them)
+                with self._cv:
+                    own = self._running.get(job["id"])
+                    leader_owned = own is not None and own[1] is tok
+                    still = [
+                        j for j in claimed
+                        if (self._running.get(j["id"])
+                            or (None, None))[1] is tok]
+                err = f"{type(ex).__name__}: {ex}"
+                if leader_owned:
+                    self._fail_job(job, still, err)
+                elif still:
+                    # the leader itself was requeued (and possibly
+                    # re-claimed elsewhere): fail only the members this
+                    # claim still owns
+                    self._fail_job(still[0], still[1:], err)
             finally:
                 with self._cv:
-                    self._running.pop(job["id"], None)
+                    for j in [job] + claimed:
+                        cur = self._running.get(j["id"])
+                        if cur is not None and cur[1] is tok:
+                            self._running.pop(j["id"])
                 self._update_gauges()
 
     def _fail_job(self, job, followers, error: str) -> None:
@@ -358,6 +504,33 @@ class ServeDaemon:
                 lk = self._sig_locks[sig] = threading.Lock()
             return lk
 
+    def _locked_sig(self, sig: str):
+        """Per-signature run lock, IMMUNE to the LRU-eviction race
+        (ISSUE 13 bugfix): eviction pops a sig's lock from the registry,
+        and a worker that FETCHED the lock object before the eviction
+        but ACQUIRED it after would no longer serialize against a later
+        worker's fresh lock — two jobs could then drive one warm
+        session's single-flight engine concurrently.  Re-fetch after
+        acquiring and retry until the held object IS the registered
+        one."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _cm():
+            while True:
+                lk = self._sig_lock(sig)
+                lk.acquire()
+                with self._cv:
+                    if self._sig_locks.get(sig) is lk:
+                        break
+                lk.release()
+            try:
+                yield
+            finally:
+                lk.release()
+
+        return _cm()
+
     def _touch_warm_locked(self, sig: str) -> None:
         """Move `sig` to the registry's most-recently-used end (dicts
         are insertion-ordered; caller holds _cv)."""
@@ -372,7 +545,7 @@ class ServeDaemon:
         idle one goes instead."""
         if len(self.warm) <= self.warm_max:
             return
-        busy = set(self._running.values())
+        busy = {s for s, _t in self._running.values()}
         for sig in list(self.warm):
             if len(self.warm) <= self.warm_max:
                 break
@@ -441,7 +614,7 @@ class ServeDaemon:
             if warm is not None:
                 self._touch_warm_locked(sig)
         warm_engine = resumed = False
-        with self._sig_lock(sig), obs.use_local(job_tel), \
+        with self._locked_sig(sig), obs.use_local(job_tel), \
                 self.tel.span("job", id=jid, sig=sig, spec=job["spec"],
                               backend=cfg.backend,
                               batched=len(followers)):
@@ -556,6 +729,254 @@ class ServeDaemon:
                      f"warm={warm_engine}, resumed={resumed}, "
                      f"batched={len(followers)})")
 
+    def _run_owner_solo(self, job: Dict[str, Any],
+                        followers: List[Dict[str, Any]]) -> None:
+        """One solo device job (plus exact-sig followers) in the
+        device-owner process.  The in-process warm registry does not
+        apply — the signature-keyed spool checkpoint still makes
+        repeats incremental (the owner resumes it) — and an owner death
+        requeues the jobs exactly like a mid-batch death."""
+        t0 = time.time()
+        jid, sig = job["id"], job["sig"]
+        jobs = [job] + followers
+        for j in jobs:
+            self.q.mark(j["id"], "running", started_at=t0,
+                        batch_leader=jid if j is not job else None)
+        if followers:
+            self.tel.counter("serve.batched_jobs", len(followers))
+        self._update_gauges()
+        md = {"spec": job["spec"], "cfg": job.get("cfg"),
+              "options": job.get("options"), "sig": sig,
+              "jids": [j["id"] for j in jobs],
+              "checkpoint": self.q.ckpt_path(sig),
+              "checkpoint_every": self.checkpoint_every}
+        from .owner import OwnerDied
+        with self.tel.span("job", id=jid, sig=sig, spec=job["spec"],
+                           owner=True, batched=len(followers)):
+            try:
+                resp = self.owner.request({"kind": "solo",
+                                           "member": md})
+            except OwnerDied as ex:
+                if ex.timed_out:
+                    # policy kill: requeueing would livelock (the
+                    # re-run hits the same deadline) — the timeout is
+                    # the job's verdict
+                    self._fail_job(job, followers, str(ex))
+                    return
+                self.tel.counter("serve.owner_respawns")
+                self.tel.event("serve.owner_died", error=str(ex))
+                self.log(f"serve: device-owner died mid-job ({ex}); "
+                         f"requeued {len(jobs)} job"
+                         f"{'s' if len(jobs) != 1 else ''}")
+                with self._cv:
+                    for j in jobs:
+                        self.q.mark(j["id"], "queued",
+                                    requeue_note="requeued after "
+                                    f"device-owner death: {ex}")
+                        self._running.pop(j["id"], None)
+                        self._pending.append(j["id"])
+                    self._cv.notify_all()
+                return
+        if resp.get("error"):
+            self._fail_job(job, followers, resp["error"])
+            return
+        summary = resp["summary"]
+        summary.setdefault("serve", {})["cost_estimate"] = \
+            job.get("cost_estimate")
+        status = "drained" if resp.get("drained") else "done"
+        for j in jobs:
+            self.q.save_result(j["id"], summary)
+            self.q.mark(j["id"], status, finished_at=time.time(),
+                        ok=resp["ok"], distinct=resp["distinct"],
+                        generated=resp["generated"],
+                        warm_engine=False, device_owner=True,
+                        resumed_from_checkpoint=summary["serve"].get(
+                            "resumed_from_checkpoint", False),
+                        batch_leader=jid if j is not job else None)
+        if status == "drained":
+            self.tel.counter("serve.jobs_drained", len(jobs))
+            self.log(f"serve: job {jid} drained in the device owner "
+                     f"(checkpointed; will resume next life)")
+        else:
+            self.tel.counter("serve.jobs_done", len(jobs))
+            self._jobs_done += len(jobs)
+            self.log(f"serve: job {jid} done in the device owner "
+                     f"({time.time() - t0:.2f}s, ok={resp['ok']}, "
+                     f"{resp['distinct']} distinct)")
+
+    # ---- cross-model vmapped batches (ISSUE 13) ------------------------
+    def _run_vbatch(self, job: Dict[str, Any],
+                    followers: List[Dict[str, Any]],
+                    xmembers: List[Dict[str, Any]]) -> None:
+        """Run one layout-compat cohort — the leader (+ its exact-sig
+        followers) and every claimed cross-model member — through ONE
+        vmapped device program.  Per-job artifacts and statuses are
+        written exactly like solo runs; on any cohort-level failure the
+        cross-model members are REQUEUED and the leader falls back to
+        the solo path, so batching can delay a job but never lose or
+        corrupt one."""
+        t0 = time.time()
+        jid = job["id"]
+        # one member per DISTINCT signature; duplicates share a result
+        groups: Dict[str, List[Dict[str, Any]]] = \
+            {job["sig"]: [job] + followers}
+        order = [job["sig"]]
+        for oj in xmembers:
+            if oj["sig"] not in groups:
+                groups[oj["sig"]] = []
+                order.append(oj["sig"])
+            groups[oj["sig"]].append(oj)
+        desc = [{"spec": groups[s][0]["spec"],
+                 "cfg": groups[s][0].get("cfg"),
+                 "options": groups[s][0].get("options"),
+                 "sig": s, "bsig": job.get("bsig"),
+                 "jids": [j["id"] for j in groups[s]]}
+                for s in order]
+        for s in order:
+            for j in groups[s]:
+                self.q.mark(j["id"], "running", started_at=t0,
+                            batch_leader=jid
+                            if j["id"] != jid else None,
+                            bsig=job.get("bsig"))
+        self.tel.counter("serve.vbatch_jobs",
+                         sum(len(groups[s]) for s in order))
+        self._update_gauges()
+
+        def _requeue(members: List[Dict[str, Any]], note: str,
+                     strip_bsig: bool = False) -> None:
+            # strip_bsig: a DETERMINISTIC batch failure (compat refused
+            # at build) must not re-form the same failing cohort — the
+            # retry runs solo; transient failures (owner death) keep
+            # the bsig so the retry can batch again
+            with self._cv:
+                for j in members:
+                    self.q.mark(j["id"], "queued", requeue_note=note,
+                                bsig=None if strip_bsig
+                                else j.get("bsig"))
+                    self._running.pop(j["id"], None)
+                    self._pending.append(j["id"])
+                self._cv.notify_all()
+
+        resp = None
+        with self.tel.span("vbatch", id=jid, bsig=job.get("bsig"),
+                           members=len(order),
+                           jobs=sum(len(groups[s]) for s in order)):
+            if self.owner is not None:
+                from .owner import OwnerDied
+                try:
+                    resp = self.owner.request(
+                        {"kind": "vbatch", "members": desc})
+                except OwnerDied as ex:
+                    if ex.timed_out:
+                        # policy kill, not a death: requeueing would
+                        # re-run the identical cohort into the same
+                        # deadline forever — fail with the named knob
+                        self._fail_job(job, followers + xmembers,
+                                       str(ex))
+                        return
+                    # the owner process died with the cohort in flight:
+                    # nothing was written, so every job simply requeues
+                    # and the next device job respawns the owner
+                    self.tel.counter("serve.owner_respawns")
+                    self.tel.event("serve.owner_died", error=str(ex))
+                    self.log(f"serve: device-owner died mid-batch "
+                             f"({ex}); requeued "
+                             f"{sum(len(groups[s]) for s in order)} "
+                             f"jobs")
+                    _requeue([j for s in order for j in groups[s]],
+                             f"requeued after device-owner death: {ex}")
+                    return
+            else:
+                from .owner import run_vbatch
+                resp = run_vbatch(desc)
+
+        if resp.get("error"):
+            # owner-side cohort-level failure (not a death — the child
+            # answered): deterministic, so requeueing would loop; the
+            # REAL error lands on every job
+            self._fail_job(job, followers + xmembers, resp["error"])
+            return
+        if resp.get("incompatible"):
+            # parse-time bsig said compatible but the build disagreed
+            # (e.g. a lifted constant reached a static-only position):
+            # cross-model members requeue solo, the leader group runs
+            # the ordinary path
+            self.tel.counter("serve.batch_incompatible")
+            self.log(f"serve: batch {job.get('bsig')} fell back to "
+                     f"solo runs ({resp['incompatible']})")
+            _requeue(xmembers, "requeued after batch-compat fallback: "
+                               + str(resp["incompatible"]),
+                     strip_bsig=True)
+            self._run_batch(job, followers)
+            return
+
+        occupancy = int(resp.get("occupancy") or 0)
+        self.tel.gauge("serve.batch_occupancy", occupancy)
+        # MEASURED by the batch engine (1 by construction today; a
+        # future in-cohort rebuild would surface here, not be papered
+        # over by a constant)
+        self.tel.gauge("serve.batch_compiles",
+                       int(resp.get("engine_builds") or 1))
+        done = failed = drained_n = 0
+        for md, mres in zip(desc, resp["members"]):
+            jobs = groups[md["sig"]]
+            if mres.get("retry_solo"):
+                # engine-level abort solo runs recover from (adaptive
+                # relayout): requeue WITH BATCHING STRIPPED so the
+                # retry cannot re-form the same failing cohort
+                self.tel.counter("serve.batch_solo_retries", len(jobs))
+                self.log(f"serve: batch member {md['jids'][0]} "
+                         f"requeued for solo retry "
+                         f"({mres['retry_solo']})")
+                with self._cv:
+                    for j in jobs:
+                        self.q.mark(j["id"], "queued", bsig=None,
+                                    requeue_note="solo retry: "
+                                    + str(mres["retry_solo"]))
+                        self._running.pop(j["id"], None)
+                        self._pending.append(j["id"])
+                    self._cv.notify_all()
+                continue
+            if mres.get("error"):
+                self.tel.counter("serve.jobs_failed", len(jobs))
+                self._jobs_failed += len(jobs)
+                self.tel.event("serve.job_failed", id=md["jids"][0],
+                               error=mres["error"])
+                for j in jobs:
+                    self.q.mark(j["id"], "failed", error=mres["error"],
+                                finished_at=time.time(),
+                                batch_leader=jid
+                                if j["id"] != jid else None)
+                failed += len(jobs)
+                continue
+            summary = mres["summary"]
+            summary.setdefault("serve", {})["cost_estimate"] = \
+                jobs[0].get("cost_estimate")
+            status = "drained" if mres.get("drained") else "done"
+            for j in jobs:
+                self.q.save_result(j["id"], summary)
+                self.q.mark(j["id"], status, finished_at=time.time(),
+                            ok=mres["ok"], distinct=mres["distinct"],
+                            generated=mres["generated"],
+                            warm_engine=False,
+                            resumed_from_checkpoint=False,
+                            batch_occupancy=occupancy,
+                            batch_leader=jid
+                            if j["id"] != jid else None)
+            if status == "drained":
+                drained_n += len(jobs)
+            else:
+                done += len(jobs)
+                self._jobs_done += len(jobs)
+        if drained_n:
+            self.tel.counter("serve.jobs_drained", drained_n)
+        if done:
+            self.tel.counter("serve.jobs_done", done)
+        self.log(f"serve: vbatch {jid} done in "
+                 f"{time.time() - t0:.2f}s (members={len(order)}, "
+                 f"occupancy={occupancy}, done={done}, "
+                 f"failed={failed}, drained={drained_n})")
+
     # ---- introspection ------------------------------------------------
     def _update_gauges(self) -> None:
         with self._cv:
@@ -571,13 +992,17 @@ class ServeDaemon:
         self._update_gauges()
         with self._cv:
             pending = list(self._pending)
-            running = dict(self._running)
+            running = {jid: s for jid, (s, _t)
+                       in self._running.items()}
             warm = {s: w["session"] for s, w in self.warm.items()}
         return {
             "spool": self.q.root,
             "queue_depth": len(pending),
             "pending": pending,
             "running": running,
+            "batch_enabled": self.batch_enabled,
+            "device_owner_pid": self.owner.pid
+            if self.owner is not None else None,
             "warm_sessions": {
                 s: sess.describe() for s, sess in warm.items()},
             "workers": self.n_workers,

@@ -72,12 +72,17 @@ class JobQueue:
         return os.path.join(self.ckpt_dir, f"{sig}.ck")
 
     def new_job(self, spec: str, cfg: Optional[str], options: Dict,
-                sig: str) -> Dict[str, Any]:
+                sig: str, **extra) -> Dict[str, Any]:
+        """`extra` carries scheduler metadata (ISSUE 13): `bsig` (the
+        layout-compat batch class), `cost_estimate` (analyze's
+        state-space estimate) and `fast_lane` — all optional and
+        omitted when absent, so old spools read unchanged."""
         job = {
             "id": self._next_id(), "sig": sig, "status": "queued",
             "submitted_at": time.time(), "spec": spec, "cfg": cfg,
             "options": dict(options or {}),
         }
+        job.update({k: v for k, v in extra.items() if v is not None})
         self.save(job)
         return job
 

@@ -172,6 +172,93 @@ class SessionConfig:
             "seen": self.seen, "seen_cap": self.seen_cap,
         }
 
+    def batch_signature_fields(self) -> Dict[str, Any]:
+        """job_signature_fields WITHOUT the model identity: the option
+        surface every member of a cross-model vmapped batch must share
+        (per-model differences ride the lifted constant lanes)."""
+        f = self.job_signature_fields()
+        f.pop("spec", None)
+        f.pop("cfg", None)
+        return f
+
+
+def _stable(v) -> str:
+    """Deterministic rendering of a parsed cfg constant value (repr of
+    frozensets is insertion-ordered — sort them)."""
+    if isinstance(v, frozenset):
+        return "{" + ",".join(sorted(_stable(x) for x in v)) + "}"
+    return repr(v)
+
+
+@dataclass
+class BatchProfile:
+    """Parse-time batch compatibility verdict for one submission
+    (ISSUE 13): the LAYOUT-COMPAT CLASS key plus the scheduling cost
+    estimate — both derived before any engine exists."""
+    bsig: str                      # equal <=> layout-compatible, i.e.
+    # one vmapped engine can serve both jobs
+    lift: Tuple[str, ...]          # constants that become batch lanes
+    cost_estimate: Optional[int]   # analyze's state-space estimate
+    # (None = analysis bailed: no fast-lane routing)
+
+
+def batch_profile(cfg: SessionConfig) -> Optional["BatchProfile"]:
+    """Prove (at parse time) which layout-compat class this job belongs
+    to.  Two submissions with equal `bsig` differ at most in LIFTABLE
+    constant values — same module shape, same non-lifted constants,
+    same cfg-declared predicates, same result-affecting options — so
+    the serve fleet may run them through one vmapped device program
+    (backend/batch.py).  Returns None for configurations the batcher
+    does not cover (interp backend, resident mode, non-host_seen device
+    modes, tiered seen sets) or when the model fails to load — the job
+    then schedules solo, exactly as before."""
+    import hashlib
+    import json
+    if cfg.backend == "interp" or cfg.resident or not cfg.host_seen \
+            or cfg.seen_cap is not None:
+        return None
+    try:
+        model = load_model(cfg.spec, cfg.cfg, cfg.no_deadlock,
+                           cfg.include)
+    except Exception:  # noqa: BLE001 — an unloadable pair is simply
+        # not batchable; the solo path reports the real error
+        return None
+    from .analyze.bounds import liftable_constants, state_space_estimate
+    lift = liftable_constants(model)
+    mc = model.cfg
+    masked = {n: ("<lifted>" if n in lift else _stable(v))
+              for n, v in sorted(mc.constants.items())}
+    ident = {
+        "module": model.module.name,
+        "vars": list(model.vars),
+        "spec_sha": hashlib.sha256(
+            read_text(cfg.spec).encode()).hexdigest(),
+        "cfg_shape": {
+            "specification": mc.specification, "init": mc.init,
+            "next": mc.next,
+            "invariants": sorted(mc.invariants),
+            "properties": sorted(mc.properties),
+            "constraints": sorted(mc.constraints),
+            "action_constraints": sorted(mc.action_constraints),
+            "symmetry": mc.symmetry, "view": mc.view,
+            "overrides": sorted(mc.overrides.items()),
+            "scoped_overrides": sorted(
+                (f"{k[0]}!{k[1]}", v)
+                for k, v in mc.scoped_overrides.items()),
+            "check_deadlock": mc.check_deadlock,
+            "constants": masked,
+        },
+        "lift": list(lift),
+        "options": cfg.batch_signature_fields(),
+    }
+    blob = json.dumps(ident, sort_keys=True).encode()
+    bsig = "b" + hashlib.sha256(blob).hexdigest()[:15]
+    try:
+        est = state_space_estimate(model)
+    except Exception:  # noqa: BLE001 — estimation must never block
+        est = None
+    return BatchProfile(bsig=bsig, lift=lift, cost_estimate=est)
+
 
 class CheckSession:
     """One check as three resumable stages over one model/engine pair.

@@ -1,0 +1,30 @@
+---------------------------- MODULE batchtoy ----------------------------
+(* The cross-model batching fixture family (ISSUE 13).  One module,
+   several cfgs that differ ONLY in constant values every use of which
+   is a pure VALUE position (guards, arithmetic, invariant/constraint
+   comparisons) — so analyze/bounds.liftable_constants proves all four
+   liftable and every cfg in the family is layout-compatible by
+   construction: the serve fleet checks them through ONE vmapped
+   device program.  batchtoy_bad picks Bound below the reachable x
+   maximum, so a mixed batch exercises one member violating while the
+   others run to exhaustion. *)
+EXTENDS Naturals
+
+CONSTANTS Limit, Step, Bound, WrapCap
+
+VARIABLES x, wraps
+
+Init == x = 0 /\ wraps = 0
+
+Tick == x < Limit /\ x' = x + Step /\ wraps' = wraps
+
+Wrap == x >= Limit /\ x' = 0 /\ wraps' = wraps + 1
+
+Next == Tick \/ Wrap
+
+Spec == Init /\ [][Next]_<<x, wraps>>
+
+InBound == x =< Bound
+
+StateCap == wraps =< WrapCap
+=========================================================================

@@ -1,0 +1,463 @@
+r"""Cross-model vmapped batching (ISSUE 13).
+
+Covers the acceptance surface:
+  - parse-time compatibility: liftable-constant analysis + batch_sig
+    equality across the batchtoy family (and inequality elsewhere);
+  - the vmapped engine: 4 layout-compatible NON-identical jobs through
+    ONE compiled program (occupancy 4, one engine build), per-job
+    counts/diameters/violations/traces byte-identical to solo runs —
+    including the mixed batch where one member violates while the
+    others run to exhaustion;
+  - serve fleet wiring: cold-spool cohort pops by bsig and runs as one
+    vbatch; artifacts carry the batch block + cost estimate; fast-lane
+    jobs jump the queue;
+  - the claimed-follower race and the warm-registry sig-lock eviction
+    race (ISSUE 13 bugfix), pinned with concurrency tests;
+  - chaos: mid-batch drain parks members as drained and the next
+    daemon life re-answers them with identical counts; device-owner
+    death requeues (never loses) the in-flight cohort and respawns.
+"""
+
+import os
+import threading
+import time
+
+import pytest
+
+from jaxmc import drain
+from jaxmc.engine.explore import Explorer, format_trace
+from jaxmc.serve import JobQueue, ServeDaemon
+from jaxmc.serve.protocol import ServeClient, build_config, job_signature
+from jaxmc.session import SessionConfig, batch_profile, load_model
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SPECS = os.path.join(REPO, "specs")
+BT = os.path.join(SPECS, "batchtoy.tla")
+
+
+def btcfg(v):
+    return os.path.join(SPECS, f"batchtoy_{v}.cfg")
+
+
+JAX_OPTS = {"backend": "jax", "platform": "cpu", "host_seen": True}
+
+
+def session_cfg(v, **kw):
+    return SessionConfig(spec=BT, cfg=btcfg(v), backend="jax",
+                         platform="cpu", host_seen=True, **kw)
+
+
+@pytest.fixture(autouse=True)
+def _clean_drain():
+    drain.clear()
+    yield
+    drain.clear()
+
+
+_SOLO_CACHE = {}
+
+
+def _solo(v):
+    """Solo host_seen reference run, cached per variant — every parity
+    assertion reuses one engine build (builds dominate suite wall)."""
+    if v not in _SOLO_CACHE:
+        from jaxmc.backend.bfs import TpuExplorer
+        m = load_model(BT, btcfg(v), False)
+        _SOLO_CACHE[v] = TpuExplorer(m, host_seen=True).run()
+    return _SOLO_CACHE[v]
+
+
+def _result_tuple(r):
+    viol = None
+    if r.violation is not None:
+        viol = (r.violation.kind, r.violation.name,
+                format_trace(r.violation))
+    return (r.ok, r.distinct, r.generated, r.diameter,
+            bool(r.truncated), viol)
+
+
+class TestCompat:
+    def test_batchtoy_constants_all_liftable(self):
+        from jaxmc.analyze.bounds import liftable_constants
+        for v in ("a", "b", "c", "bad"):
+            m = load_model(BT, btcfg(v), False)
+            assert liftable_constants(m) == \
+                ("Bound", "Limit", "Step", "WrapCap")
+
+    def test_view_constants_pinned(self):
+        # constants reachable from a cfg VIEW feed the dedup-key basis
+        # outside the const-lane install sites: never liftable
+        from jaxmc.analyze.bounds import liftable_constants
+        m = load_model(os.path.join(SPECS, "viewtoy.tla"),
+                       os.path.join(SPECS, "viewtoy.cfg"), False)
+        for n in m.cfg.constants:
+            assert n not in liftable_constants(m) or \
+                m.view is None
+
+    def test_batch_profile_equality(self):
+        profs = [batch_profile(session_cfg(v))
+                 for v in ("a", "b", "c", "bad")]
+        assert all(p is not None for p in profs)
+        assert len({p.bsig for p in profs}) == 1
+        assert profs[0].lift == ("Bound", "Limit", "Step", "WrapCap")
+        # the analyze cost estimate rides the profile (fast-lane oracle)
+        assert all(isinstance(p.cost_estimate, int) for p in profs)
+
+    def test_batch_profile_separates_other_models_and_options(self):
+        base = batch_profile(session_cfg("a"))
+        other = batch_profile(SessionConfig(
+            spec=os.path.join(SPECS, "transfer_scaled.tla"),
+            backend="jax", platform="cpu", host_seen=True))
+        assert other is None or other.bsig != base.bsig
+        opt = batch_profile(session_cfg("a", max_states=7))
+        assert opt.bsig != base.bsig
+        # non-batchable configurations profile to None, never crash
+        assert batch_profile(SessionConfig(spec=BT, cfg=btcfg("a"))) \
+            is None  # interp backend
+        assert batch_profile(session_cfg("a")) is not None
+
+
+class TestVmappedEngine:
+    @pytest.fixture(scope="class")
+    def batch_run(self):
+        from jaxmc.backend.batch import BatchCheckEngine
+        cfgs = [session_cfg(v) for v in ("a", "b", "c", "bad")]
+        be = BatchCheckEngine(cfgs).build()
+        members = be.run()
+        return be, members
+
+    def test_one_engine_serves_all(self, batch_run):
+        be, members = batch_run
+        donor = members[0].engine
+        # followers share the donor's compiled kernels + caches — zero
+        # extra engine builds (the "one compile" criterion)
+        for mem in members[1:]:
+            assert mem.engine.compiled is donor.compiled
+            assert mem.engine.layout is donor.layout
+            assert mem.engine._hstep_cache is donor._hstep_cache
+        assert be.dispatcher.max_width == 4
+        assert be.dispatcher.dispatches > 0
+        assert be.lift_names == ("Bound", "Limit", "Step", "WrapCap")
+
+    def test_per_member_solo_parity(self, batch_run):
+        _be, members = batch_run
+        for v, mem in zip(("a", "b", "c", "bad"), members):
+            assert mem.error is None, f"{v}: {mem.error}"
+            assert _result_tuple(mem.result) == \
+                _result_tuple(_solo(v)), v
+
+    def test_mixed_batch_verdicts(self, batch_run):
+        # one member violates; the others run to exhaustion — the
+        # continuous-batching membership change between supersteps
+        _be, members = batch_run
+        ok = {v: m.result for v, m in
+              zip(("a", "b", "c", "bad"), members)}
+        assert ok["bad"].violation is not None
+        assert ok["bad"].violation.kind == "invariant"
+        assert ok["bad"].violation.name == "InBound"
+        for v in ("a", "b", "c"):
+            assert ok[v].ok and ok[v].violation is None
+            assert not ok[v].truncated
+
+    def test_member_counts_differ(self, batch_run):
+        # NON-identical jobs: the whole point vs PR 7's coalescing
+        _be, members = batch_run
+        assert len({m.result.distinct for m in members}) == 4
+
+    def test_interp_parity(self, batch_run):
+        _be, members = batch_run
+        for v, mem in zip(("a", "b", "c"), members):
+            exp = Explorer(load_model(BT, btcfg(v), False)).run()
+            assert (mem.result.distinct, mem.result.generated) == \
+                (exp.distinct, exp.generated)
+
+    def test_incompatible_cohort_refused(self):
+        from jaxmc.backend.batch import (BatchCheckEngine,
+                                         BatchIncompatible)
+        cfgs = [session_cfg("a"),
+                SessionConfig(spec=os.path.join(SPECS,
+                                                "transfer_scaled.tla"),
+                              backend="jax", platform="cpu",
+                              host_seen=True)]
+        with pytest.raises(BatchIncompatible):
+            BatchCheckEngine(cfgs).build()
+
+
+def prime_spool(spool, variants, opts=JAX_OPTS):
+    """Queue one job per variant in a COLD spool (before any daemon
+    life), so the first pop claims the whole cohort."""
+    q = JobQueue(spool)
+    jids = []
+    for v in variants:
+        cfg = build_config(BT, btcfg(v), opts)
+        prof = batch_profile(cfg)
+        job = q.new_job(cfg.spec, cfg.cfg, opts, job_signature(cfg),
+                        bsig=prof.bsig if prof else None,
+                        cost_estimate=prof.cost_estimate
+                        if prof else None)
+        jids.append(job["id"])
+    return jids
+
+
+class TestServeFleet:
+    def test_cold_cohort_one_vbatch(self, tmp_path):
+        spool = str(tmp_path / "spool")
+        jids = prime_spool(spool, ("a", "b", "c", "bad"))
+        d = ServeDaemon(spool, workers=2, quiet=True).start()
+        try:
+            c = ServeClient("127.0.0.1", d.port)
+            recs = {j: c.wait(j, timeout=240) for j in jids}
+            for v, j in zip(("a", "b", "c", "bad"), jids):
+                solo = _solo(v)
+                assert recs[j]["status"] == "done"
+                assert recs[j]["ok"] == solo.ok
+                assert recs[j]["distinct"] == solo.distinct
+                assert recs[j]["generated"] == solo.generated
+                assert recs[j]["batch_occupancy"] == 4
+            st = d.status()
+            assert st["gauges"]["serve.batch_occupancy"] == 4
+            assert st["gauges"]["serve.batch_compiles"] == 1
+            assert st["counters"]["serve.vbatch_jobs"] == 4
+            # artifacts: batch block + cost estimate + trace for the
+            # violating member
+            code, res = c.result(jids[3])
+            assert code == 200
+            sv = res["serve"]
+            assert sv["batch_occupancy"] == 4
+            assert sv["lifted_consts"] == ["Bound", "Limit", "Step",
+                                           "WrapCap"]
+            assert isinstance(sv["cost_estimate"], int)
+            assert res["result"]["violation"]["name"] == "InBound"
+            solo_bad = _solo("bad")
+            assert res["result"]["trace"] == \
+                format_trace(solo_bad.violation)
+        finally:
+            d.shutdown()
+
+    def test_fast_lane_jumps_queue(self, tmp_path, monkeypatch):
+        # batchtoy's proven estimate (~65-95 states) sits under the
+        # bound; transfer_scaled's (~768) sits over it
+        monkeypatch.setenv("JAXMC_SERVE_FASTLANE_BOUND", "100")
+        spool = str(tmp_path / "spool")
+        d = ServeDaemon(spool, workers=1, quiet=True).start()
+        try:
+            c = ServeClient("127.0.0.1", d.port)
+            # occupy the single worker so queue order is observable
+            # (bench1 compiles + runs for a few seconds)
+            code, blocker = c.submit(BT, btcfg("bench1"), JAX_OPTS)
+            deadline = time.time() + 60
+            while time.time() < deadline and \
+                    (d.q.load(blocker["id"]) or {}).get("status") \
+                    != "running":
+                time.sleep(0.01)
+            code, slow = c.submit(
+                os.path.join(SPECS, "transfer_scaled.tla"),
+                options={"backend": "jax", "platform": "cpu",
+                         "host_seen": True, "max_states": 50})
+            code, fast = c.submit(BT, btcfg("a"), JAX_OPTS)
+            assert fast.get("fast_lane") is True
+            with d._cv:
+                pending = list(d._pending)
+            # the proven-small job queued FIRST despite arriving last
+            assert pending.index(fast["id"]) < \
+                pending.index(slow["id"])
+            assert d.tel.counters.get("serve.fastlane_jobs", 0) >= 1
+        finally:
+            d.shutdown()
+
+    def test_owner_solo_device_job(self, tmp_path, monkeypatch):
+        # owner mode routes SOLO device jobs out of the daemon process
+        # too; the result is solo-identical and the record says so
+        monkeypatch.setenv("JAXMC_SERVE_DEVICE_OWNER", "1")
+        spool = str(tmp_path / "spool")
+        d = ServeDaemon(spool, workers=1, quiet=True).start()
+        try:
+            c = ServeClient("127.0.0.1", d.port)
+            code, job = c.submit(BT, btcfg("a"), JAX_OPTS)
+            assert code == 200
+            rec = c.wait(job["id"], timeout=240)
+            assert rec["status"] == "done"
+            assert rec["device_owner"] is True
+            solo = _solo("a")
+            assert rec["distinct"] == solo.distinct
+            code, res = c.result(job["id"])
+            assert res["serve"]["device_owner"] is True
+            assert d.status()["device_owner_pid"] is not None
+        finally:
+            d.shutdown()
+
+    def test_batch_disabled_env(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("JAXMC_SERVE_BATCH", "0")
+        spool = str(tmp_path / "spool")
+        jids = prime_spool(spool, ("a", "b"))
+        d = ServeDaemon(spool, workers=1, quiet=True).start()
+        try:
+            c = ServeClient("127.0.0.1", d.port)
+            for j in jids:
+                assert c.wait(j, timeout=240)["status"] == "done"
+            assert d.tel.counters.get("serve.vbatch_jobs", 0) == 0
+        finally:
+            d.shutdown()
+
+
+class TestRaces:
+    def test_claimed_followers_never_double_run(self, tmp_path):
+        # 6 jobs in one compat class, 3 workers racing to pop: every
+        # job must land exactly one terminal result, each claimed
+        # member registered in _running while in flight
+        spool = str(tmp_path / "spool")
+        jids = prime_spool(spool, ("a", "b", "c", "a", "b", "c"))
+        d = ServeDaemon(spool, workers=3, quiet=True).start()
+        try:
+            c = ServeClient("127.0.0.1", d.port)
+            for j in jids:
+                rec = c.wait(j, timeout=240)
+                assert rec["status"] == "done", rec
+            done = d.tel.counters.get("serve.jobs_done", 0)
+            vb = d.tel.counters.get("serve.vbatch_jobs", 0)
+            assert done == 6
+            assert vb >= 4  # at least one cross-model cohort formed
+            # exactly one result artifact per job, written once
+            for j in jids:
+                assert d.q.load_result(j) is not None
+        finally:
+            d.shutdown()
+
+    def test_sig_lock_eviction_race_fixed(self, tmp_path):
+        # ISSUE 13 bugfix: _locked_sig must hold the REGISTERED lock
+        # even when eviction popped + a fresh lock was registered
+        # between the fetch and the acquire
+        d = ServeDaemon(str(tmp_path / "spool"), workers=1, quiet=True)
+        stale = threading.Lock()
+        real = d._sig_lock
+        first = []
+
+        def fetch(sig):
+            if not first:
+                first.append(1)
+                with d._cv:
+                    # simulate: eviction dropped the entry and another
+                    # submission re-registered a fresh lock after this
+                    # worker fetched `stale`
+                    d._sig_locks[sig] = threading.Lock()
+                return stale
+            return real(sig)
+
+        d._sig_lock = fetch
+        with d._locked_sig("s1"):
+            with d._cv:
+                held = d._sig_locks["s1"]
+            assert held.locked(), \
+                "worker must end up holding the registered lock"
+            assert not stale.locked(), \
+                "the stale pre-fetched lock must have been released"
+        assert not d._sig_locks["s1"].locked()
+
+    def test_eviction_never_pops_held_sig_lock(self, tmp_path):
+        d = ServeDaemon(str(tmp_path / "spool"), workers=1, quiet=True)
+        d.warm_max = 0
+        lk = d._sig_lock("busy")
+        lk.acquire()
+        try:
+            with d._cv:
+                d.warm["busy"] = {"session": None, "completed": True}
+                d._evict_warm_locked()
+                # held lock -> the sig survives eviction untouched
+                assert d._sig_locks.get("busy") is lk
+        finally:
+            lk.release()
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+class TestChaos:
+    # chaos+slow (the pytest.ini pattern): `make chaos` runs these;
+    # tier-1 timing stays inside its budget
+    def test_drain_mid_batch_then_resume_parity(self, tmp_path):
+        # deep cohort, drain mid-flight: members park as drained (no
+        # result yet), requeue next life, and the re-run answers with
+        # solo-identical counts — a batch can be delayed, never lost
+        spool = str(tmp_path / "spool")
+        jids = prime_spool(spool, ("bench1", "bench2", "bench3",
+                                   "bench4"))
+        d = ServeDaemon(spool, workers=2, quiet=True).start()
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            if any(d.q.load(j).get("status") == "running"
+                   for j in jids):
+                break
+            time.sleep(0.02)
+        d.initiate_drain("test drain mid-batch")
+        d.shutdown()
+        statuses = {d.q.load(j).get("status") for j in jids}
+        assert statuses <= {"queued", "drained", "done"}, statuses
+        # next life: recover() requeues drained members, all complete
+        d2 = ServeDaemon(spool, workers=2, quiet=True).start()
+        try:
+            c = ServeClient("127.0.0.1", d2.port)
+            for v, j in zip(("bench1", "bench2", "bench3", "bench4"),
+                            jids):
+                rec = c.wait(j, timeout=300)
+                assert rec["status"] == "done", rec
+                solo = _solo(v)
+                assert rec["distinct"] == solo.distinct
+                assert rec["generated"] == solo.generated
+        finally:
+            d2.shutdown()
+
+    def test_device_owner_death_requeues_and_respawns(self, tmp_path,
+                                                      monkeypatch):
+        monkeypatch.setenv("JAXMC_SERVE_DEVICE_OWNER", "1")
+        spool = str(tmp_path / "spool")
+        jids = prime_spool(spool, ("bench1", "bench2", "bench3",
+                                   "bench4"))
+        d = ServeDaemon(spool, workers=2, quiet=True).start()
+        try:
+            import signal as _sig
+            # kill the owner while the cohort is in flight
+            deadline = time.time() + 180
+            killed = False
+            while time.time() < deadline and not killed:
+                pid = d.owner.pid
+                if pid is not None and any(
+                        d.q.load(j).get("status") == "running"
+                        for j in jids):
+                    try:
+                        os.kill(pid, _sig.SIGKILL)
+                        killed = True
+                    except ProcessLookupError:
+                        pass
+                time.sleep(0.05)
+            c = ServeClient("127.0.0.1", d.port)
+            for v, j in zip(("bench1", "bench2", "bench3", "bench4"),
+                            jids):
+                rec = c.wait(j, timeout=300)
+                assert rec["status"] == "done", rec
+                solo = _solo(v)
+                assert rec["distinct"] == solo.distinct
+            if killed:
+                assert d.tel.counters.get("serve.owner_respawns",
+                                          0) >= 1
+                assert d.owner.spawns >= 2
+        finally:
+            d.shutdown()
+
+
+class TestObs:
+    def test_fleet_artifact_highlight_row(self, tmp_path, capsys):
+        spool = str(tmp_path / "spool")
+        jids = prime_spool(spool, ("a", "b", "c"))
+        out = str(tmp_path / "fleet.json")
+        d = ServeDaemon(spool, workers=1, quiet=True,
+                        metrics_out=out).start()
+        c = ServeClient("127.0.0.1", d.port)
+        for j in jids:
+            c.wait(j, timeout=240)
+        d.shutdown()
+        import argparse
+        import io
+        from jaxmc.obs.report import cmd_report
+        buf = io.StringIO()
+        rc = cmd_report(argparse.Namespace(file=out), out=buf)
+        assert rc == 0
+        assert "batch[occupancy=3" in buf.getvalue()
