@@ -198,6 +198,10 @@ bench-check:
 	# out-of-core leg (ISSUE 12): capped exhaustive run via tier spill
 	# + fingerprint parity — see ooc-check below
 	$(MAKE) ooc-check
+	# independence/reduction leg (ISSUE 15): regroup parity, --por
+	# verdict preservation + >=30% explored-state reduction, and the
+	# predicted capacity rung's zero-growth cold run — see por-check
+	$(MAKE) por-check
 	# static-analysis legs (ISSUE 9): an analyzer regression gates the
 	# same way perf regressions do — the corpus must stay lint-clean
 	# (modulo manifest waivers) and jaxmc's own Python must stay free
@@ -266,6 +270,21 @@ backend-check:
 # SKIP ...` and exits 0.
 ooc-check:
 	JAX_PLATFORMS=cpu $(PY) -m jaxmc.oocbench \
+	    --out-dir $(BENCH_CHECK_DIR)
+
+# independence/reduction gate (ISSUE 15): (1) unreduced portoy_ok
+# counts == manifest pins; (2) --por completes with >= 30% fewer
+# explored distinct states and preserves the deadlock/invariant
+# verdicts of the portoy rungs; (3) the grouped host_seen path with
+# independence regrouping ON vs OFF stays byte-identical (trace
+# compared line-for-line, artifact gated via `python -m jaxmc.obs
+# diff --fail-on-regress` against its saved baseline); (4) a COLD
+# resident run of the fully-proven fixture takes the `predicted`
+# capacity rung and pays zero growth recompiles.  A jax-less
+# container still runs the interpreter legs and prints `POR-CHECK
+# SKIP ...` for the rest.
+por-check:
+	JAX_PLATFORMS=cpu $(PY) -m jaxmc.porbench \
 	    --out-dir $(BENCH_CHECK_DIR)
 
 # the published scaling curve (ISSUE 8/10): per-rung, per-D warm-up +
@@ -342,4 +361,4 @@ native:
 .PHONY: all check check-corpus test chaos bench bench-warm bench-tlc \
         pin-si-env bench-check bench-check-reset serve serve-check \
         batch-check multichip-check multichip-bench backend-check \
-        native lint-corpus pylint
+        por-check native lint-corpus pylint

@@ -16,6 +16,11 @@ Three consumers, one parse-time pass:
       stable codes; `python -m jaxmc.analyze lint`, `check
       --analyze={off,warn,strict}`, the serve daemon's submit-time
       rejection, and `make lint-corpus` all consume it.
+  independence        (analyze/independence.py, ISSUE 15)  per-arm
+      read/write footprints down to container ELEMENTS and a
+      conservative commutativity matrix; feeds the fused-group
+      regrouping planner (default ON, JAXMC_ANALYZE_INDEP=0 opts out)
+      and the opt-in --por persistent-set frontier filter.
 
 `python -m jaxmc.analyze pylint` is the repo's own Python static
 analysis fallback (unused imports/locals) for containers without ruff;
@@ -41,13 +46,18 @@ def predict_enabled() -> bool:
         not in _OFF
 
 
-from .bounds import (BoundsReport, Iv, dead_arms,  # noqa: E402
-                     infer_state_bounds)
+from .bounds import (BoundsReport, EB, Iv, dead_arms,  # noqa: E402
+                     infer_state_bounds, state_space_estimate)
 from .verdicts import predict_arm_demotions  # noqa: E402
 from .lint import Diagnostic, lint_pair  # noqa: E402
+from .independence import (IndependenceReport,  # noqa: E402
+                           independence_report, indep_enabled,
+                           por_refusal)
 
 __all__ = [
-    "BoundsReport", "Iv", "Diagnostic", "bounds_enabled", "dead_arms",
-    "infer_state_bounds", "lint_pair", "predict_arm_demotions",
-    "predict_enabled",
+    "BoundsReport", "EB", "IndependenceReport", "Iv", "Diagnostic",
+    "bounds_enabled", "dead_arms", "indep_enabled",
+    "independence_report", "infer_state_bounds", "lint_pair",
+    "por_refusal", "predict_arm_demotions", "predict_enabled",
+    "state_space_estimate",
 ]

@@ -108,10 +108,17 @@ def iv_mod(a: Iv, b: Iv) -> Iv:
 #
 # AV = ("int", Iv)          integer scalar
 #    | ("bool",)            boolean scalar
-#    | ("enum",)            string / model value scalar
+#    | ("enum", vals|None)  string / model value scalar; vals is the
+#                           frozenset of every value it can hold (None:
+#                           unknown / too many) — cardinalities feed
+#                           state_space_estimate (ISSUE 15)
 #    | ("set", elem|None)   set; elem abstracts every member (None: empty)
 #    | ("seq", elem|None)   sequence/tuple
 #    | ("fun", dom, rng)    function/record; dom/rng abstract keys/values
+#    | ("rec", fields)      record with KNOWN string keys: fields is a
+#                           sorted tuple of (key, AV) — per-key precision
+#                           through Dot/EXCEPT (ISSUE 15); degrades to
+#                           "fun" on any key mismatch
 #    | ("blob", Iv)         opaque value whose int components lie in Iv
 #
 # summary(AV) -> Iv | None: every integer scalar component anywhere in
@@ -120,10 +127,22 @@ def iv_mod(a: Iv, b: Iv) -> Iv:
 AV = Tuple
 INT_TOP = ("int", TOP)
 BOOL = ("bool",)
-ENUM = ("enum",)
+ENUM = ("enum", None)
 BLOB_TOP = ("blob", TOP)
 
 _MAX_DEPTH = 8
+# enum value-set tracking cap: past this many distinct scalar values the
+# set degrades to None (unknown) — joins stay O(small)
+_ENUM_MAX = 64
+# record width cap for per-key tracking
+_REC_MAX = 32
+
+
+def _enum_join(a, b):
+    if a is None or b is None:
+        return None
+    u = a | b
+    return u if len(u) <= _ENUM_MAX else None
 
 
 def summary(av: Optional[AV]) -> Optional[Iv]:
@@ -138,6 +157,11 @@ def summary(av: Optional[AV]) -> Optional[Iv]:
         return summary(av[1]) if av[1] is not None else None
     if k == "fun":
         return _sum_join(summary(av[1]), summary(av[2]))
+    if k == "rec":
+        s = None
+        for _k, v in av[1]:
+            s = _sum_join(s, summary(v))
+        return s
     if k == "blob":
         return av[1]
     return TOP
@@ -151,6 +175,17 @@ def _sum_join(a: Optional[Iv], b: Optional[Iv]) -> Optional[Iv]:
     return a.join(b)
 
 
+def _rec_to_fun(av: AV) -> AV:
+    """Degrade a per-key record to the keyless function abstraction."""
+    rng = None
+    keys = []
+    for k, v in av[1]:
+        keys.append(k)
+        rng = join(rng, v)
+    return ("fun", ("enum", frozenset(keys)),
+            rng if rng is not None else BLOB_TOP)
+
+
 def join(a: Optional[AV], b: Optional[AV], depth: int = 0) -> AV:
     if a is None:
         return b if b is not None else BLOB_TOP
@@ -161,11 +196,23 @@ def join(a: Optional[AV], b: Optional[AV], depth: int = 0) -> AV:
         s = _sum_join(sa, sb)
         return ("blob", s) if s is not None else ENUM
     ka, kb = a[0], b[0]
+    if ka == "rec" and kb == "rec":
+        if tuple(k for k, _ in a[1]) == tuple(k for k, _ in b[1]):
+            return ("rec", tuple(
+                (k, join(v, w, depth + 1))
+                for (k, v), (_k2, w) in zip(a[1], b[1])))
+        return join(_rec_to_fun(a), _rec_to_fun(b), depth)
+    if ka == "rec":
+        return join(_rec_to_fun(a), b, depth)
+    if kb == "rec":
+        return join(a, _rec_to_fun(b), depth)
     if ka == kb:
         if ka == "int":
             return ("int", a[1].join(b[1]))
-        if ka in ("bool", "enum"):
+        if ka == "bool":
             return a
+        if ka == "enum":
+            return ("enum", _enum_join(a[1], b[1]))
         if ka in ("set", "seq"):
             if a[1] is None:
                 return b
@@ -202,8 +249,14 @@ def widen(new: AV, old: AV, depth: int = 0) -> AV:
         whi = ln.hi if (lo_.hi is not None and ln.hi is not None
                         and ln.hi <= lo_.hi) else None
         return (k, Iv(wlo, whi))
-    if k in ("bool", "enum"):
+    if k == "bool":
         return new
+    if k == "enum":
+        # a still-growing value set widens to unknown (termination)
+        if new[1] is not None and old[1] is not None \
+                and new[1] <= old[1]:
+            return new
+        return ENUM
     if k in ("set", "seq"):
         if new[1] is None or old[1] is None:
             return new
@@ -211,6 +264,13 @@ def widen(new: AV, old: AV, depth: int = 0) -> AV:
     if k == "fun":
         return ("fun", widen(new[1], old[1], depth + 1),
                 widen(new[2], old[2], depth + 1))
+    if k == "rec":
+        if tuple(kk for kk, _ in new[1]) == \
+                tuple(kk for kk, _ in old[1]):
+            return ("rec", tuple(
+                (kk, widen(v, w, depth + 1))
+                for (kk, v), (_k2, w) in zip(new[1], old[1])))
+        return widen(_rec_to_fun(new), _rec_to_fun(old), depth)
     return new
 
 
@@ -222,7 +282,7 @@ def lift_concrete(v: Any, depth: int = 0) -> AV:
     if isinstance(v, int):
         return ("int", Iv(v, v))
     if isinstance(v, (str, ModelValue)):
-        return ENUM
+        return ("enum", frozenset((v,)))
     if isinstance(v, InfiniteSet):
         if v.kind == "Nat":
             return ("set", ("int", Iv(0, None)))
@@ -242,8 +302,14 @@ def lift_concrete(v: Any, depth: int = 0) -> AV:
             elem = join(elem, lift_concrete(x, depth + 1), depth)
         return ("set", elem)
     if isinstance(v, Fcn):
+        items = list(v.d.items())
+        if items and len(items) <= _REC_MAX and \
+                all(isinstance(k, str) for k, _ in items):
+            return ("rec", tuple(
+                (k, lift_concrete(val, depth + 1))
+                for k, val in sorted(items)))
         dom = rng = None
-        for k, val in list(v.d.items())[:4096]:
+        for k, val in items[:4096]:
             dom = join(dom, lift_concrete(k, depth + 1), depth)
             rng = join(rng, lift_concrete(val, depth + 1), depth)
         if dom is None:
@@ -318,7 +384,7 @@ class AbsEval:
         if isinstance(e, A.Bool):
             return BOOL
         if isinstance(e, A.Str):
-            return ENUM
+            return ("enum", frozenset((e.val,)))
         if isinstance(e, A.Prime):
             if isinstance(e.expr, A.Ident) and e.expr.name in self.vars:
                 return primes.get(e.expr.name, BLOB_TOP)
@@ -375,6 +441,13 @@ class AbsEval:
                             elem_of(self.eval(e.rng, env, bound, primes,
                                               stack))))
         if isinstance(e, A.RecordExpr):
+            # per-key record abstraction (ISSUE 15): each field keeps
+            # its own AV so Dot/EXCEPT stay field-precise
+            if 0 < len(e.fields) <= _REC_MAX:
+                return ("rec", tuple(sorted(
+                    ((k, self.eval(vex, env, bound, primes, stack))
+                     for k, vex in e.fields),
+                    key=lambda kv: kv[0])))
             rng = None
             for _k, vex in e.fields:
                 rng = join(rng, self.eval(vex, env, bound, primes, stack))
@@ -387,7 +460,18 @@ class AbsEval:
             return ("set", ("fun", ENUM,
                             rng if rng is not None else BLOB_TOP))
         if isinstance(e, A.FnApp):
+            # applied-element fact (ISSUE 15): a guard like
+            # `turns[p] + k =< MaxTurns` refined THIS application's
+            # interval — the fact outranks the keyless rng join
+            if isinstance(e.fn, A.Ident) and e.fn.name in self.vars \
+                    and e.fn.name not in bound and len(e.args) == 1:
+                fav = self._fact_lookup(e.fn.name, e.args[0], env, bound)
+                if fav is not None:
+                    return fav
             f = self.eval(e.fn, env, bound, primes, stack)
+            if f[0] == "rec":
+                return self._rec_app(f, e.args, env, bound, primes,
+                                     stack)
             if f[0] == "fun":
                 return f[2]
             if f[0] == "seq":
@@ -396,7 +480,16 @@ class AbsEval:
                 return f
             return BLOB_TOP
         if isinstance(e, A.Dot):
+            if isinstance(e.expr, A.Ident) and e.expr.name in self.vars \
+                    and e.expr.name not in bound:
+                fav = self._fact_lookup(e.expr.name, A.Str(e.fld),
+                                        env, bound)
+                if fav is not None:
+                    return fav
             f = self.eval(e.expr, env, bound, primes, stack)
+            if f[0] == "rec":
+                d = dict(f[1])
+                return d.get(e.fld, BLOB_TOP)
             if f[0] == "fun":
                 return f[2]
             if f[0] == "blob":
@@ -404,19 +497,20 @@ class AbsEval:
             return BLOB_TOP
         if isinstance(e, A.Except):
             f = self.eval(e.fn, env, bound, primes, stack)
+            fname = e.fn.name if (isinstance(e.fn, A.Ident)
+                                  and e.fn.name in self.vars
+                                  and e.fn.name not in bound) else None
             acc = f
-            for _path, rhs in e.updates:
-                rv = self.eval(rhs, env, dict(bound, **{"@": elem_of(acc)
-                               if acc[0] in ("set", "seq")
-                               else (acc[2] if acc[0] == "fun" else acc)}),
+            for ui, (path, rhs) in enumerate(e.updates):
+                # the applied-element FACT describes the PRE-state
+                # value: only the FIRST update may bind @ through it —
+                # later updates read the already-updated function,
+                # whose joined rng/field covers the new value
+                at = self._path_at(acc, list(path), env, bound,
+                                   fname if ui == 0 else None)
+                rv = self.eval(rhs, env, dict(bound, **{"@": at}),
                                primes, stack)
-                if acc[0] == "fun":
-                    acc = ("fun", acc[1], join(acc[2], rv))
-                elif acc[0] == "seq":
-                    acc = ("seq", join(acc[1], rv))
-                else:
-                    s = _sum_join(summary(acc), summary(rv))
-                    acc = ("blob", s) if s is not None else acc
+                acc = self._path_update(acc, list(path), rv, env, bound)
             return acc
         if isinstance(e, A.At):
             at = bound.get("@")
@@ -527,6 +621,9 @@ class AbsEval:
                 self.eval(args[0], env, bound, primes, stack))))
         if name == "DOMAIN":
             f = self.eval(args[0], env, bound, primes, stack)
+            if f[0] == "rec":
+                return ("set", ("enum",
+                                frozenset(k for k, _ in f[1])))
             if f[0] == "fun":
                 return ("set", f[1])
             if f[0] == "seq":
@@ -585,6 +682,131 @@ class AbsEval:
         s = summary(av)
         return s if s is not None else TOP
 
+    # ---- per-element precision helpers (ISSUE 15) --------------------
+
+    def _rec_app(self, f: AV, args, env, bound, primes, stack) -> AV:
+        """Apply a per-key record: a literal (or enum-valued) key picks
+        its field(s); anything else joins every field."""
+        d = dict(f[1])
+        if len(args) == 1:
+            a0 = args[0]
+            if isinstance(a0, A.Str):
+                return d.get(a0.val, BLOB_TOP)
+            kv = self.eval(a0, env, bound, primes, stack)
+            if kv[0] == "enum" and kv[1] is not None and \
+                    all(isinstance(x, str) and x in d for x in kv[1]):
+                out = None
+                for x in kv[1]:
+                    out = join(out, d[x])
+                if out is not None:
+                    return out
+        out = None
+        for _k, v in f[1]:
+            out = join(out, v)
+        return out if out is not None else BLOB_TOP
+
+    def _fact_id(self, fname: str, idx, bound):
+        """(env key, binding token) for the applied element f[idx].
+        The token is the CURRENT binding object of an identifier index,
+        compared by identity at lookup, so a rebound binder name can
+        never resurrect a stale fact."""
+        if isinstance(idx, A.Ident):
+            return f"{fname}[{idx.name}]", bound.get(idx.name)
+        if isinstance(idx, A.Num):
+            return f"{fname}[{idx.val}]", None
+        if isinstance(idx, A.Str):
+            return f"{fname}[{idx.val!r}]", None
+        return None, None
+
+    def _fact_lookup(self, fname: str, idx, env, bound) -> Optional[AV]:
+        key, tok = self._fact_id(fname, idx, bound)
+        if key is None:
+            return None
+        f = env.get(key)
+        if isinstance(f, tuple) and len(f) == 3 and f[0] == "$fact" \
+                and f[1] is tok:
+            return f[2]
+        return None
+
+    def _fact_store(self, env, fname: str, idx, bound, av: AV):
+        """Returns env (a copy on write) with the applied-element fact
+        recorded; the pre-state value of f[idx] lies in av for the rest
+        of this branch (pre-state vars never change mid-branch)."""
+        key, tok = self._fact_id(fname, idx, bound)
+        if key is None:
+            return env
+        env = dict(env)
+        env[key] = ("$fact", tok, av)
+        return env
+
+    def _step_into(self, cur: AV, kind: str, part, env, bound) -> AV:
+        """Abstract value one EXCEPT-path step below `cur`."""
+        if cur[0] == "rec":
+            d = dict(cur[1])
+            if kind == "dot":
+                return d.get(part, BLOB_TOP)
+            if kind == "idx" and len(part) == 1 and \
+                    isinstance(part[0], A.Str):
+                return d.get(part[0].val, BLOB_TOP)
+            out = None
+            for _k, v in cur[1]:
+                out = join(out, v)
+            return out if out is not None else BLOB_TOP
+        if cur[0] == "fun":
+            return cur[2]
+        if cur[0] == "seq":
+            return cur[1] if cur[1] is not None else BLOB_TOP
+        if cur[0] == "blob":
+            return cur
+        return BLOB_TOP
+
+    def _path_at(self, acc: AV, path, env, bound,
+                 fname: Optional[str]) -> AV:
+        """The value @ is bound to for one EXCEPT update: the element at
+        the full path, consulting applied-element facts at the root."""
+        cur = acc
+        for i, (kind, part) in enumerate(path):
+            if i == 0 and fname is not None:
+                idx = None
+                if kind == "idx" and len(part) == 1:
+                    idx = part[0]
+                elif kind == "dot":
+                    idx = A.Str(part)
+                if idx is not None:
+                    fav = self._fact_lookup(fname, idx, env, bound)
+                    if fav is not None:
+                        cur = fav
+                        continue
+            cur = self._step_into(cur, kind, part, env, bound)
+        return cur if cur is not None else BLOB_TOP
+
+    def _path_update(self, acc: AV, path, rv: AV, env, bound) -> AV:
+        """[acc EXCEPT !<path> = rv]: strong update on known record
+        keys, weak (join) update everywhere else — always covers both
+        the updated and the untouched elements."""
+        if not path:
+            return rv
+        (kind, part), rest = path[0], path[1:]
+        inner = self._step_into(acc, kind, part, env, bound)
+        nv = self._path_update(inner, rest, rv, env, bound)
+        if acc[0] == "rec":
+            key = None
+            if kind == "dot":
+                key = part
+            elif kind == "idx" and len(part) == 1 and \
+                    isinstance(part[0], A.Str):
+                key = part[0].val
+            if key is not None and any(k == key for k, _ in acc[1]):
+                return ("rec", tuple(
+                    (k, nv if k == key else v) for k, v in acc[1]))
+            return ("rec", tuple((k, join(v, nv)) for k, v in acc[1]))
+        if acc[0] == "fun":
+            return ("fun", acc[1], join(acc[2], nv))
+        if acc[0] == "seq":
+            return ("seq", join(acc[1], nv))
+        s = _sum_join(summary(acc), summary(nv))
+        return ("blob", s) if s is not None else acc
+
     # ---- guard refinement --------------------------------------------
     def refine(self, e: A.Node, env: Dict[str, AV],
                bound: Dict[str, Any]) -> Dict[str, AV]:
@@ -601,20 +823,12 @@ class AbsEval:
                                         bound)
             if name == "\\in":
                 x, s = e.args
-                if isinstance(x, A.Ident) and x.name in self.vars \
-                        and x.name in env and env[x.name][0] == "int":
-                    sv = self.eval(s, env, bound, {})
-                    el = elem_of(sv)
-                    if el[0] == "int":
-                        cur = env[x.name][1]
-                        lo = cur.lo if el[1].lo is None else \
-                            (el[1].lo if cur.lo is None
-                             else max(cur.lo, el[1].lo))
-                        hi = cur.hi if el[1].hi is None else \
-                            (el[1].hi if cur.hi is None
-                             else min(cur.hi, el[1].hi))
-                        env = dict(env)
-                        env[x.name] = ("int", Iv(lo, hi))
+                sv = self.eval(s, env, bound, {})
+                el = elem_of(sv)
+                if el[0] == "int" and (el[1].lo is not None
+                                       or el[1].hi is not None):
+                    env = self._clamp_expr(x, env, bound,
+                                           lo=el[1].lo, hi=el[1].hi)
                 return env
         if isinstance(e, A.Ident):
             from ..sem.eval import OpClosure
@@ -624,10 +838,20 @@ class AbsEval:
                 return self.refine(d.body, env, dict(d.bound))
         return env
 
-    def _refine_cmp(self, op, l, r, env, bound) -> Dict[str, AV]:
-        def clamp(var, lo=None, hi=None):
-            nonlocal env
-            if var in self.vars and var in env and env[var][0] == "int":
+    def _clamp_expr(self, ex, env, bound, lo=None, hi=None):
+        """Refine a comparable LVALUE by [lo, hi] (either side None =
+        unconstrained): a state-variable Ident narrows its env interval;
+        a single-index function application `f[i]` or record field
+        access `r.fld` on a state variable records an applied-element
+        FACT (ISSUE 15) — the pre-state value of that element lies in
+        the clamped interval for the rest of this branch.  Unrefinable
+        shapes return env unchanged (always sound)."""
+        if lo is None and hi is None:
+            return env
+        if isinstance(ex, A.Ident):
+            var = ex.name
+            if var in self.vars and var not in bound and var in env \
+                    and env[var][0] == "int":
                 cur = env[var][1]
                 nlo = cur.lo if lo is None else \
                     (lo if cur.lo is None else max(cur.lo, lo))
@@ -635,51 +859,100 @@ class AbsEval:
                     (hi if cur.hi is None else min(cur.hi, hi))
                 env = dict(env)
                 env[var] = ("int", Iv(nlo, nhi))
+            return env
+        fname = idx = None
+        if isinstance(ex, A.FnApp) and isinstance(ex.fn, A.Ident) \
+                and ex.fn.name in self.vars \
+                and ex.fn.name not in bound and len(ex.args) == 1:
+            fname, idx = ex.fn.name, ex.args[0]
+        elif isinstance(ex, A.Dot) and isinstance(ex.expr, A.Ident) \
+                and ex.expr.name in self.vars \
+                and ex.expr.name not in bound:
+            fname, idx = ex.expr.name, A.Str(ex.fld)
+        if fname is None:
+            return env
+        base = self._as_iv(ex, env, bound, {}, ())
+        nlo = base.lo if lo is None else \
+            (lo if base.lo is None else max(base.lo, lo))
+        nhi = base.hi if hi is None else \
+            (hi if base.hi is None else min(base.hi, hi))
+        return self._fact_store(env, fname, idx, bound,
+                                ("int", Iv(nlo, nhi)))
+
+    def _is_lvalue(self, ex, bound) -> bool:
+        """Can _clamp_expr refine this shape?  Cheap pre-test so the
+        comparison refinement only pays an abstract evaluation of the
+        OPPOSING side when there is something to clamp."""
+        if isinstance(ex, A.Ident):
+            return ex.name in self.vars and ex.name not in bound
+        if isinstance(ex, A.FnApp):
+            return (isinstance(ex.fn, A.Ident)
+                    and ex.fn.name in self.vars
+                    and ex.fn.name not in bound and len(ex.args) == 1)
+        if isinstance(ex, A.Dot):
+            return (isinstance(ex.expr, A.Ident)
+                    and ex.expr.name in self.vars
+                    and ex.expr.name not in bound)
+        return False
+
+    def _refine_cmp(self, op, l, r, env, bound) -> Dict[str, AV]:
+        def clamp(ex, lo=None, hi=None):
+            nonlocal env
+            env = self._clamp_expr(ex, env, bound, lo=lo, hi=hi)
 
         def iv(e):
             return self._as_iv(e, env, bound, {}, ())
 
-        # x op e  /  e op x
-        if isinstance(l, A.Ident):
+        # x op e  /  e op x  (x an Ident, f[i] or r.fld lvalue)
+        if self._is_lvalue(l, bound):
             b = iv(r)
             if op == "<" and b.hi is not None:
-                clamp(l.name, hi=b.hi - 1)
+                clamp(l, hi=b.hi - 1)
             elif op == "<=" and b.hi is not None:
-                clamp(l.name, hi=b.hi)
+                clamp(l, hi=b.hi)
             elif op == ">" and b.lo is not None:
-                clamp(l.name, lo=b.lo + 1)
+                clamp(l, lo=b.lo + 1)
             elif op == ">=" and b.lo is not None:
-                clamp(l.name, lo=b.lo)
+                clamp(l, lo=b.lo)
             elif op == "=":
-                clamp(l.name, lo=b.lo, hi=b.hi)
-        if isinstance(r, A.Ident):
+                clamp(l, lo=b.lo, hi=b.hi)
+        if self._is_lvalue(r, bound):
             a = iv(l)
             if op == "<" and a.lo is not None:
-                clamp(r.name, lo=a.lo + 1)
+                clamp(r, lo=a.lo + 1)
             elif op == "<=" and a.lo is not None:
-                clamp(r.name, lo=a.lo)
+                clamp(r, lo=a.lo)
             elif op == ">" and a.hi is not None:
-                clamp(r.name, hi=a.hi - 1)
+                clamp(r, hi=a.hi - 1)
             elif op == ">=" and a.hi is not None:
-                clamp(r.name, hi=a.hi)
+                clamp(r, hi=a.hi)
             elif op == "=":
-                clamp(r.name, lo=a.lo, hi=a.hi)
-        # x + y <= c  (CONSTRAINT shape, constoy): bound each addend by
-        # c - other.lo
+                clamp(r, lo=a.lo, hi=a.hi)
+
+        # x + y <= c  (CONSTRAINT shape, constoy; EXCEPT-guard shape,
+        # symtoy/raft): bound each refinable addend by c - other.lo
+        def sum_shape(sumex, cex, op2):
+            x1, x2 = sumex.args
+            if not (self._is_lvalue(x1, bound)
+                    or self._is_lvalue(x2, bound)):
+                return
+            c = iv(cex)
+            if c.hi is None:
+                return
+            chi = c.hi - (1 if op2 == "<" else 0)
+            for me, other in ((x1, x2), (x2, x1)):
+                if not self._is_lvalue(me, bound):
+                    continue
+                o = iv(other)
+                if o.lo is not None:
+                    clamp(me, hi=chi - o.lo)
+
         if op in ("<", "<=") and isinstance(l, A.OpApp) \
-                and _norm(l.name) == "+" and len(l.args) == 2 \
-                and isinstance(l.args[0], A.Ident) \
-                and isinstance(l.args[1], A.Ident):
-            c = iv(r)
-            if c.hi is not None:
-                chi = c.hi - (1 if op == "<" else 0)
-                xn, yn = l.args[0].name, l.args[1].name
-                xv = env.get(xn, INT_TOP)
-                yv = env.get(yn, INT_TOP)
-                if yv[0] == "int" and yv[1].lo is not None:
-                    clamp(xn, hi=chi - yv[1].lo)
-                if xv[0] == "int" and xv[1].lo is not None:
-                    clamp(yn, hi=chi - xv[1].lo)
+                and _norm(l.name) == "+" and len(l.args) == 2:
+            sum_shape(l, r, op)
+        if op in (">", ">=") and isinstance(r, A.OpApp) \
+                and _norm(r.name) == "+" and len(r.args) == 2:
+            sum_shape(r, l, {">": "<", ">=": "<="}[op])
         return env
 
     # ---- abstract transition walker ----------------------------------
@@ -904,6 +1177,94 @@ class AbsEval:
 
 
 # ---------------------------------------------------------------------------
+# per-element proven bounds (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+
+class EB:
+    """Per-element PROVEN bounds for one variable — the structured shape
+    compile/pack.py descends alongside the vspec tree, so a container's
+    element lanes pack at their own proven widths instead of the
+    whole-variable summary.
+
+      all    (lo, hi) covering EVERY int component anywhere in the
+             value (None: not fully bounded) — the sound fallback for
+             any component without a more precise child bound
+      dom    key-side bounds (fun/kvtable key lanes)
+      rng    value-side bounds (fun/pfcn value lanes)
+      elem   element bounds (seq/growset element lanes)
+      keys   per-key bounds for record fields (str keys)
+    """
+
+    __slots__ = ("all", "dom", "rng", "elem", "keys")
+
+    def __init__(self, all=None, dom=None, rng=None, elem=None,
+                 keys=None):
+        self.all = all
+        self.dom = dom
+        self.rng = rng
+        self.elem = elem
+        self.keys = keys
+
+    def __repr__(self):
+        parts = [f"all={self.all}"]
+        for f in ("dom", "rng", "elem", "keys"):
+            v = getattr(self, f)
+            if v is not None:
+                parts.append(f"{f}={v}")
+        return "EB(" + ", ".join(parts) + ")"
+
+    def empty(self) -> bool:
+        return (self.all is None and self.dom is None
+                and self.rng is None and self.elem is None
+                and not self.keys)
+
+
+def _fin(iv: Optional[Iv]) -> Optional[Tuple[int, int]]:
+    if iv is None or not iv.bounded():
+        return None
+    if abs(iv.lo) >= 2 ** 31 or iv.hi >= 2 ** 31:
+        return None
+    return (int(iv.lo), int(iv.hi))
+
+
+def av_to_eb(av: Optional[AV], depth: int = 0) -> Optional[EB]:
+    """Structured proven bounds from a converged abstract value; None
+    when nothing below this node is provably bounded (pack then falls
+    back to structural/observed widths — never a wrong lane)."""
+    if av is None or depth > _MAX_DEPTH:
+        return None
+    k = av[0]
+    if k == "int":
+        a = _fin(av[1])
+        return EB(all=a) if a is not None else None
+    if k in ("bool", "enum"):
+        return None  # no int lanes below
+    if k in ("set", "seq"):
+        eb = EB(all=_fin(summary(av)),
+                elem=av_to_eb(av[1], depth + 1) if av[1] is not None
+                else None)
+        return None if eb.empty() else eb
+    if k == "fun":
+        eb = EB(all=_fin(summary(av)), dom=av_to_eb(av[1], depth + 1),
+                rng=av_to_eb(av[2], depth + 1))
+        return None if eb.empty() else eb
+    if k == "rec":
+        keys = {kk: av_to_eb(v, depth + 1) for kk, v in av[1]}
+        rng = None
+        for _kk, v in av[1]:
+            rng = join(rng, v)
+        eb = EB(all=_fin(summary(av)), keys=keys,
+                rng=av_to_eb(rng, depth + 1) if rng is not None
+                else None)
+        return None if eb.empty() else eb
+    if k == "blob":
+        a = _fin(av[1])
+        return EB(all=a) if a is not None else None
+    return None
+
+
+# ---------------------------------------------------------------------------
 # fixpoint driver
 # ---------------------------------------------------------------------------
 
@@ -940,6 +1301,22 @@ class BoundsReport:
         for v, s in self.summaries().items():
             if s.bounded() and abs(s.lo) < 2 ** 31 and s.hi < 2 ** 31:
                 out[v] = (s.lo, s.hi)
+        return out
+
+    def element_bounds(self) -> Dict[str, "EB"]:
+        """var -> structured per-element proven bounds (ISSUE 15): the
+        richer shape compile/pack.py consumes — a variable appears as
+        soon as ANY component below it proves, even when the whole-value
+        summary does not (e.g. a bounded function range under an
+        unbounded-count container).  Same truncation rule as
+        lane_bounds: a non-converged fixpoint proves nothing."""
+        if not self.converged:
+            return {}
+        out = {}
+        for v, av in self.env.items():
+            eb = av_to_eb(av)
+            if eb is not None:
+                out[v] = eb
         return out
 
 
@@ -1244,28 +1621,83 @@ def liftable_constants(model) -> Tuple[str, ...]:
     return tuple(sorted(consts - pinned))
 
 
+_NO_REPORT = object()  # "never analyzed" vs a cached ran-and-bailed None
+
+
+def av_cardinality(av: Optional[AV], depth: int = 0) -> Optional[int]:
+    """Upper bound on the number of distinct concrete values the
+    abstract value can denote; None = unbounded/unknown.  Soundly
+    over-counts (a possibly-partial function counts each key as
+    absent-or-any-value), never under-counts."""
+    if av is None or depth > _MAX_DEPTH:
+        return None
+    k = av[0]
+    if k == "bool":
+        return 2
+    if k == "int":
+        iv = av[1]
+        if iv.bounded():
+            return max(int(iv.hi) - int(iv.lo) + 1, 1)
+        return None
+    if k == "enum":
+        return len(av[1]) if av[1] else None
+    if k == "set":
+        if av[1] is None:
+            return 1  # provably always empty
+        c = av_cardinality(av[1], depth + 1)
+        if c is not None and c <= 24:
+            return 2 ** c
+        return None
+    if k == "fun":
+        dc = av_cardinality(av[1], depth + 1)
+        rc = av_cardinality(av[2], depth + 1)
+        if dc is not None and rc is not None and dc <= 16 \
+                and rc < 2 ** 20:
+            # rc+1: each key may also be ABSENT (partial functions /
+            # varying domains share this abstraction)
+            return min((rc + 1) ** dc, 2 ** 62)
+        return None
+    if k == "rec":
+        est = 1
+        for _kk, v in av[1]:
+            c = av_cardinality(v, depth + 1)
+            if c is None:
+                return None
+            est *= c
+            if est >= 2 ** 62:
+                return 2 ** 62
+        return est
+    return None  # seq/blob: an unbounded count axis
+
+
 def state_space_estimate(model, report: Optional[BoundsReport] = None
                          ) -> Optional[int]:
     """A pre-scheduling COST bound from the converged fixpoint: the
-    product of the proven per-variable interval spans.  None when the
-    fixpoint bails, fails to converge, or ANY variable lacks a bounded
-    int summary — an unsummarizable variable (a set, a sequence, a
-    record) can hide an arbitrarily large factor, and the fast lane
-    must never promote a job on a guess (a multi-minute search jumping
-    the queue is the exact inversion the lane exists to prevent)."""
+    product of per-variable value-count bounds (interval spans, enum
+    value-set cardinalities, set powersets, function spaces — ISSUE 15
+    widened this beyond pure-int vars).  None when the fixpoint bails,
+    fails to converge, or ANY variable's count is unbounded — the fast
+    lane and the predicted-capacity rung must never act on a guess (a
+    multi-minute search jumping the queue, or an undersized engine
+    paying growth recompiles, is the exact inversion they exist to
+    prevent)."""
     if report is None:
-        rep = getattr(model, "_bounds_report", None)
+        rep = getattr(model, "_bounds_report", _NO_REPORT)
+        if rep is None:
+            # the analysis already RAN on this model and bailed —
+            # re-running the whole fixpoint would bail again after
+            # paying the full budget a second time
+            return None
         report = rep if isinstance(rep, BoundsReport) \
             else infer_state_bounds(model)
     if report is None or not report.converged:
         return None
     est = 1
-    sums = report.summaries()
     for v in model.vars:
-        s = sums.get(v)
-        if s is None or not s.bounded():
+        c = av_cardinality(report.env.get(v))
+        if c is None:
             return None
-        est *= max(int(s.hi) - int(s.lo) + 1, 1)
+        est *= max(c, 1)
         if est >= 2 ** 62:
             return 2 ** 62
     return est

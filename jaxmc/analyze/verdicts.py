@@ -223,9 +223,40 @@ class _ArmScan:
             return None
         if isinstance(e, A.Except):
             return self.fatal(e.fn, stack, local)
-        # IF/CASE/quantifiers/LET/filters: lazily recovered or scoped —
-        # never predict through them
+        if isinstance(e, A.Quant):
+            # ISSUE 15 taxonomy: a quantifier whose binder has NO
+            # domain, or whose domain is an infinite constant set,
+            # is certain to raise at trace time (kernel2's
+            # _binder_combos / set_elements) — the predictor names it
+            # with the build-time constant.  Bounded-finite domains are
+            # compilable: do not descend (binder scoping unmodelled)
+            from ..compile.kernel2 import (UNBOUNDED_QUANTIFIER_MSG,
+                                           cannot_enumerate_message)
+            for _names, dom in e.binders:
+                if dom is None:
+                    return (UNBOUNDED_QUANTIFIER_MSG, False)
+                iv = self._static_infinite(dom, local)
+                if iv is not None:
+                    return (cannot_enumerate_message(iv), False)
+            return None
+        # IF/CASE/LET/filters: lazily recovered or scoped — never
+        # predict through them
         return None
+
+    def _static_infinite(self, dom: A.Node, local):
+        """The InfiniteSet a domain expression statically denotes, or
+        None.  Only Ident / zero-arg applications resolved through the
+        defs table are claimed — anything else might be finite."""
+        from ..sem.values import InfiniteSet
+        name = None
+        if isinstance(dom, A.Ident):
+            name = dom.name
+        elif isinstance(dom, A.OpApp) and not dom.args and not dom.path:
+            name = dom.name
+        if name is None or name in local or name in self.vars:
+            return None
+        d = self.defs.get(name)
+        return d if isinstance(d, InfiniteSet) else None
 
     # ---- arm-item walk ------------------------------------------------
     def scan_arm(self, arm) -> Optional[str]:
@@ -324,6 +355,25 @@ class _ArmScan:
         if isinstance(e, A.Unchanged):
             return
         if isinstance(e, A.Quant) and e.kind == "E":
+            from ..compile.ground import DYN_NESTED_MSG, DYN_SHAPE_MSG
+            # a binder domain that IS a state variable certainly
+            # raises in ground's static iter_binders, forcing the
+            # dynamic slot path — the certainty the shape verdicts
+            # below need (ISSUE 15: unsized dynamic \E axes).  Ground
+            # failures demote the whole arm regardless of position, so
+            # these verdicts ignore `enabled`.
+            certain_dynamic = any(
+                isinstance(sexpr, A.Ident) and sexpr.name in self.vars
+                for _names, sexpr in e.binders if sexpr is not None)
+            slot_ok = (len(e.binders) == 1
+                       and len(e.binders[0][0]) == 1
+                       and isinstance(e.binders[0][0][0], str))
+            if certain_dynamic and not slot_ok:
+                state["verdict"] = DYN_SHAPE_MSG
+                return
+            if certain_dynamic and state.get("dyn_slot"):
+                state["verdict"] = DYN_NESTED_MSG
+                return
             for _names, sexpr in e.binders:
                 if sexpr is None:
                     state["stop"] = True
@@ -336,6 +386,8 @@ class _ArmScan:
                     # dynamic \E: slot guards make `enabled` symbolic
                     # before any item runs
                     state["enabled"] = False
+            if certain_dynamic:
+                state["dyn_slot"] = True
             self._walk_items(e.body, local, state, stack)
             return
         if isinstance(e, A.Let):

@@ -485,7 +485,13 @@ class TpuExplorer:
                 except AttributeError:
                     pass
             if rep is not None:
-                self._static_bounds = rep.lane_bounds()
+                # per-element structured bounds when the report carries
+                # them (ISSUE 15: container element lanes pack at their
+                # own proven widths); merged/shim reports (batch donor
+                # builds) still provide whole-variable intervals
+                ebf = getattr(rep, "element_bounds", None)
+                self._static_bounds = ebf() if callable(ebf) \
+                    else rep.lane_bounds()
                 tel.gauge("analyze.bounds_converged",
                           bool(rep.converged))
         with tel.span("layout_build", samples=len(sampled)):
@@ -892,7 +898,8 @@ class TpuExplorer:
         self._trace_lock = threading.Lock()
         self._step_cache: Dict[Tuple[int, int], Callable] = {}
         self._hstep_cache: Dict[int, Callable] = {}
-        self._hstep_group_jits: Dict[int, List[Callable]] = {}
+        self._hstep_group_jits: Dict[
+            int, Tuple[List[Callable], List[np.ndarray]]] = {}
         self._newcheck_cache: Dict[int, Callable] = {}
         self._res_cache: Dict[Tuple[int, ...], Callable] = {}
         self._hostkeys_cache: Dict[int, Callable] = {}
@@ -1000,6 +1007,16 @@ class TpuExplorer:
                 model.module.name, self._layout_sig(), tel=tel,
                 variant=self.backend_desc.profile_variant(),
                 optional=("TIERK",))
+            if not prof and not self._res_caps_hint:
+                # PREDICTED capacity rung (ISSUE 15, below `learned`):
+                # a converged bounds fixpoint proves a state-count
+                # ceiling, so a COLD first-contact run can size every
+                # bucket up front instead of paying growth-retry
+                # recompile doublings — window_recompiles reads 0 on
+                # fully-proven specs with no saved profile
+                pred = self._predicted_caps()
+                if pred:
+                    self._res_caps_hint = pred
             if prof:
                 hint = dict(self._res_caps_hint or {})
                 for kk, vv in prof.items():
@@ -1016,6 +1033,47 @@ class TpuExplorer:
                     self.log(f"-- tier: capacity profile predicts an "
                              f"out-of-core run (~{int(prof['TIERK'])} "
                              f"cold-tier keys at the last completion)")
+
+    # ---- predicted capacities (ISSUE 15 tentpole c) -------------------
+
+    def state_estimate(self) -> Optional[int]:
+        """analyze's proven state-count ceiling for this model, or None
+        (fixpoint bailed / some variable unbounded)."""
+        from ..analyze.bounds import BoundsReport, state_space_estimate
+        rep = getattr(self.model, "_bounds_report", None)
+        if not isinstance(rep, BoundsReport) or not rep.converged:
+            return None
+        try:
+            return state_space_estimate(self.model, rep)
+        except Exception:
+            if os.environ.get("JAXMC_DEBUG"):
+                raise
+            return None
+
+    def _predicted_caps(self) -> Optional[Dict[str, int]]:
+        """Bounds-sized initial buckets for a cold resident run: the
+        capacity-profile ladder's `predicted` rung (below `learned`,
+        above the platform defaults).  Only fires when the proven state
+        count is small enough that over-allocation is cheap
+        (JAXMC_PREDICT_MAX, default 1<<18 states) — a wrong refusal
+        costs growth recompiles exactly as before, never memory."""
+        est = self.state_estimate()
+        cap_max = int(os.environ.get("JAXMC_PREDICT_MAX",
+                                     str(1 << 18)))
+        if not est or est > cap_max:
+            return None
+        tel = obs.current()
+        caps = {"SC": _pow2_at_least(4 * est, lo=256),
+                "FCap": _pow2_at_least(est, lo=64),
+                "AccCap": _pow2_at_least(2 * est, lo=128),
+                "VC": _pow2_at_least(4 * est, lo=64)}
+        tel.gauge("profile.status", "predicted")
+        tel.gauge("profile.predicted_states", int(est))
+        tel.gauge("profile.predicted_caps", dict(caps))
+        self.log(f"-- capacity profile: predicted rung — analyze "
+                 f"proves <= {est} states; buckets sized up front "
+                 f"(no growth-retry recompiles expected)")
+        return caps
 
     # ---- lifted constants + follower clones (ISSUE 13) ---------------
 
@@ -1789,23 +1847,27 @@ class TpuExplorer:
                     out["explore"] = jnp.asarray(z)
                 return out
             frontier = unpack_j(frontier_p)
-            ens, aoks, ovs, succs = [], [], [], []
-            for jf in self._hstep_groups(fused_max):
-                en, aok, ov, succ = jf(frontier)  # [a_g, FC(, W)]
-                ens.append(np.asarray(en))
-                aoks.append(np.asarray(aok))
-                ovs.append(np.asarray(ov))
-                succs.append(np.asarray(succ).reshape(-1, W))
-            en = np.concatenate(ens)          # [A, FC]
-            aok = np.concatenate(aoks)
-            ov = np.concatenate(ovs)
+            # grouped dispatches SCATTER into original instance order
+            # (independence regrouping may have permuted the arms; the
+            # candidate stream must stay byte-identical)
+            jits, inst_blocks = self._hstep_groups(fused_max)
+            en = np.empty((A, FC), bool)
+            aok = np.empty((A, FC), bool)
+            ov = np.empty((A, FC), np.int32)
+            succ_all = np.empty((A, FC, W), np.int32)
+            for jf, ii in zip(jits, inst_blocks):
+                en_g, aok_g, ov_g, succ_g = jf(frontier)  # [a_g, FC(,W)]
+                en[ii] = np.asarray(en_g)
+                aok[ii] = np.asarray(aok_g)
+                ov[ii] = np.asarray(ov_g)
+                succ_all[ii] = np.asarray(succ_g)
             valid = en & fvalid[None, :]
             assert_bad = (~aok) & fvalid[None, :]
             overflow = int(np.where(fvalid[None, :], ov, 0).max(
                 initial=0))
             dead = fvalid & ~en.any(axis=0)
             gen = int(valid.sum())
-            cand_u = np.concatenate(succs).reshape(A * FC, W)
+            cand_u = succ_all.reshape(A * FC, W)
             cvalid = valid.reshape(A * FC)
             cand, keys, pack_ovf, explore = combine(
                 jnp.asarray(cand_u), jnp.asarray(cvalid))
@@ -1822,33 +1884,69 @@ class TpuExplorer:
         self._hstep_cache[FC] = hstep
         return hstep
 
-    def _hstep_groups(self, fused_max: int) -> List[Callable]:
+    def _arm_group_plan(self, fused_max: int) -> List[List[int]]:
+        """Compiled-action index groups for the fused arm-group paths
+        (bfs host_seen split + mesh grouped expand).  Default plan is
+        the legacy contiguous first-fit; with the independence matrix
+        (ISSUE 15, JAXMC_ANALYZE_INDEP=0 opts out) commuting arms
+        cluster into the same dispatch and the plan with FEWER groups
+        wins.  Callers restore provenance order at the merge, so any
+        plan here is count/trace byte-identical."""
+        from ..analyze.independence import (indep_enabled,
+                                            independence_report,
+                                            plan_arm_groups)
+        weights = [max(1, ca.n_slots) for ca in self.compiled]
+        commutes = None
+        if indep_enabled() and self.arms:
+            try:
+                irep = independence_report(self.model, self.arms)
+                commutes = irep.commutes
+                obs.current().gauge("analyze.independence_pairs",
+                                    irep.commuting_pairs())
+                obs.current().gauge("analyze.independence_safe",
+                                    len(irep.por_safe))
+            except Exception:
+                if os.environ.get("JAXMC_DEBUG"):
+                    raise
+                commutes = None
+        groups = plan_arm_groups(weights, list(self._ca_arm), commutes,
+                                 fused_max)
+        flat = [i for g in groups for i in g]
+        obs.current().gauge("expand.regrouped",
+                            int(flat != list(range(len(weights)))))
+        return groups
+
+    def _group_inst_blocks(self, groups: List[List[int]]
+                           ) -> List[np.ndarray]:
+        """Per-group FLAT INSTANCE indices (into the [A, ...] expansion
+        axis) — the scatter targets that restore original provenance
+        order after grouped dispatches."""
+        w = [max(1, ca.n_slots) for ca in self.compiled]
+        off = np.concatenate([[0], np.cumsum(w)]).astype(np.int64)
+        return [np.concatenate([np.arange(off[i], off[i] + w[i])
+                                for i in g]).astype(np.int64)
+                for g in groups]
+
+    def _hstep_groups(self, fused_max: int):
         """The arm-group fused expansion jits for the many-instance
-        host_seen path: contiguous groups of compiled actions, each
-        holding at most `fused_max` kernel INSTANCES (a single action
-        whose slot fan-out alone exceeds the cap gets its own group —
-        the cap bounds the fused-compile blowup, and one slotted kernel
-        is a single program regardless of its slot count).  One jit per
-        group; instance order matches self.compiled flattening, so the
-        candidate stream is identical to the per-action and fully-fused
-        paths."""
+        host_seen path: groups of compiled actions, each holding at
+        most `fused_max` kernel INSTANCES (a single action whose slot
+        fan-out alone exceeds the cap gets its own group — the cap
+        bounds the fused-compile blowup, and one slotted kernel is a
+        single program regardless of its slot count).  One jit per
+        group.  Returns (jits, inst_blocks): inst_blocks[g] holds the
+        original flat instance indices of group g's output rows, and
+        the caller SCATTERS them back, so the candidate stream is
+        identical to the per-action and fully-fused paths even when
+        independence-driven regrouping reordered the arms."""
         cached = self._hstep_group_jits.get(fused_max)
         if cached is not None:
             obs.current().counter("compile.cache_hits")
             return cached
         obs.current().counter("compile.cache_misses")
-        groups: List[List[Any]] = []
-        cur: List[Any] = []
-        cur_w = 0
-        for ca in self.compiled:
-            w = max(1, ca.n_slots)
-            if cur and cur_w + w > fused_max:
-                groups.append(cur)
-                cur, cur_w = [], 0
-            cur.append(ca)
-            cur_w += w
-        if cur:
-            groups.append(cur)
+        plan = self._arm_group_plan(fused_max)
+        groups = [[self.compiled[i] for i in g] for g in plan]
+        inst_blocks = self._group_inst_blocks(plan)
 
         def _mk(subset):
             def gexpand(frontier):
@@ -1877,8 +1975,9 @@ class TpuExplorer:
 
         jits = [_mk(g) for g in groups]
         obs.current().gauge("expand.fused_groups", len(jits))
-        self._hstep_group_jits[fused_max] = jits
-        return jits
+        out = (jits, inst_blocks)
+        self._hstep_group_jits[fused_max] = out
+        return out
 
     def _check_new_rows(self, rows_np, skip_cons=False):
         """Compiled invariant (+ constraint unless skip_cons — the edge
@@ -3634,6 +3733,9 @@ class TpuExplorer:
         self._demotable = []
         self._step_cache.clear()
         self._hstep_cache.clear()
+        # grouped-dispatch plans index the OLD compiled list: stale
+        # (jits, inst_blocks) would scatter past the shrunken A
+        self._hstep_group_jits.clear()
         self._res_cache.clear()
         obs.current().counter("expand.recovery_demotions", len(idxset))
         return labels

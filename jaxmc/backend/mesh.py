@@ -1271,23 +1271,13 @@ class MeshExplorer(TpuExplorer):
         keys_of = self._keys_of
         shard_map = self._shard_map()
         fused_max = self._mesh_fused_max
-        groups: List[List] = []
-        cur: List = []
-        cur_w = 0
-        for ca in self.compiled:
-            w = max(1, ca.n_slots)
-            if cur and cur_w + w > fused_max:
-                groups.append(cur)
-                cur, cur_w = [], 0
-            cur.append(ca)
-            cur_w += w
-        if cur:
-            groups.append(cur)
-        offsets = []
-        off = 0
-        for g in groups:
-            offsets.append(off)
-            off += sum(max(1, ca.n_slots) for ca in g)
+        # independence-driven group plan (ISSUE 15) shared with the
+        # bfs host_seen path; inst_blocks carry each group's original
+        # flat instance indices so the caller can restore provenance
+        # order after the group dispatches
+        gplan = self._arm_group_plan(fused_max)
+        groups = [[self.compiled[i] for i in g] for g in gplan]
+        inst_blocks = self._group_inst_blocks(gplan)
 
         def _mk(subset):
             ag = sum(max(1, ca.n_slots) for ca in subset)
@@ -1343,7 +1333,7 @@ class MeshExplorer(TpuExplorer):
 
         jits = [_mk(g) for g in groups]
         obs.current().gauge("mesh.grouped_expand", len(jits))
-        out = (jits, np.asarray(offsets, np.int64))
+        out = (jits, inst_blocks)
         self._mesh_step_cache[ckey] = out
         return out
 
@@ -1369,7 +1359,23 @@ class MeshExplorer(TpuExplorer):
         check_deadlock = self.model.check_deadlock
         tail = self._mk_level_tail(SC, FC, TRL, N, route, merge_fn,
                                    with_trace)
-        jits, a_off = self._mesh_expand_group_jits(FC)
+        jits, inst_blocks = self._mesh_expand_group_jits(FC)
+        # provenance restore (ISSUE 15): regrouped dispatches emit
+        # candidates in group order; one gather puts them back into
+        # original instance order so counts/traces stay byte-identical
+        inst_order = np.concatenate(inst_blocks) if inst_blocks \
+            else np.zeros(0, np.int64)
+        identity_order = bool(
+            (inst_order == np.arange(self.A)).all())
+        pos = np.empty(self.A, np.int64)
+        pos[inst_order] = np.arange(self.A)
+        cand_perm = (pos[:, None] * FC
+                     + np.arange(FC)[None, :]).reshape(-1)
+        max_ag = max((len(b) for b in inst_blocks), default=1)
+        inst_pad = np.zeros((max(len(inst_blocks), 1), max_ag),
+                            np.int64)
+        for _gi, _b in enumerate(inst_blocks):
+            inst_pad[_gi, :len(_b)] = _b
 
         def tail_dev(seen_keys, seen_count, frontier_p, fcount, *rest):
             if with_trace:
@@ -1424,6 +1430,11 @@ class MeshExplorer(TpuExplorer):
             ckeys = jnp.concatenate([o[0] for o in outs], axis=1)
             cand = jnp.concatenate([o[1] for o in outs], axis=1)
             cvalid = jnp.concatenate([o[2] for o in outs], axis=1)
+            if not identity_order:
+                permj = jnp.asarray(cand_perm, jnp.int32)
+                ckeys = jnp.take(ckeys, permj, axis=1)
+                cand = jnp.take(cand, permj, axis=1)
+                cvalid = jnp.take(cvalid, permj, axis=1)
             # host-combined per-device fault scalars (tiny [D] reads):
             # exactly what the fused step's block_fn computes inline
             en_any = np.logical_or.reduce(
@@ -1448,10 +1459,18 @@ class MeshExplorer(TpuExplorer):
             aa = np.stack([np.asarray(o[6]) != 0 for o in outs])
             af = np.stack([np.asarray(o[7]) for o in outs])
             assert_local = aa.any(axis=0)
-            gidx = np.argmax(aa, axis=0)      # first group with assert
-            aflat = af[gidx, np.arange(D)]
-            asrt_a = (a_off[gidx] + aflat // FC).astype(np.int32)
-            asrt_f = (aflat % FC).astype(np.int32)
+            # pick the asserting row FIRST IN ORIGINAL instance order
+            # (the fused step's argmax semantics) across the groups:
+            # per-group first-assert rows map through inst_pad back to
+            # original flat indices, then min-reduce
+            g_arange = np.arange(aa.shape[0])[:, None]
+            orig_flat = inst_pad[g_arange, af // FC] * FC + af % FC
+            orig_flat = np.where(aa, orig_flat, np.int64(2 ** 62))
+            sel = orig_flat.min(axis=0)                      # [D]
+            asrt_a = np.where(assert_local, sel // FC,
+                              0).astype(np.int32)
+            asrt_f = np.where(assert_local, sel % FC,
+                              0).astype(np.int32)
             targs = (seen, seen_count, frontier, fcount) + tr + (
                 ckeys, cand, cvalid,
                 jnp.asarray(gen_local), jnp.asarray(ov_local),

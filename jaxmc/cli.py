@@ -248,19 +248,72 @@ def cmd_info(args) -> int:
     cfgp = getattr(args, "cfg", None) or \
         os.path.splitext(args.spec)[0] + ".cfg"
     if os.path.exists(cfgp):
+        # ONE model load + ONE bounds fixpoint serve both the batch
+        # line and the analysis surface below
+        model = rep = None
+        try:
+            from .session import load_model
+            from .analyze.bounds import infer_state_bounds
+            model = load_model(args.spec, cfgp, False)
+            rep = infer_state_bounds(model)
+            model._bounds_report = rep
+        except Exception:  # noqa: BLE001 — info must never fail on
+            model = None   # an analysis defect
         try:
             from .session import SessionConfig, batch_profile
             prof = batch_profile(SessionConfig(
                 spec=args.spec, cfg=cfgp, backend="jax",
-                host_seen=True))
-        except Exception:  # noqa: BLE001 — info must never fail on
-            prof = None    # an analysis defect
+                host_seen=True), model=model)
+        except Exception:  # noqa: BLE001
+            prof = None
         if prof is not None:
             est = prof.cost_estimate \
                 if prof.cost_estimate is not None else "?"
             print(f"  batch:     sig={prof.bsig} "
                   f"lifted=[{', '.join(prof.lift) or '-'}] "
                   f"est_states={est}")
+        if model is None:
+            print("  analyze:   unavailable (model does not bind)")
+            return 0
+        # analysis surface (ISSUE 15): why a spec did or did not get
+        # the fast path — proven per-element lane bounds, the
+        # predicted state count the capacity ladder/fast lane reads,
+        # and the arm-independence matrix regrouping/--por consume
+        try:
+            from .analyze.bounds import state_space_estimate
+            from .analyze.independence import (independence_report,
+                                               por_refusal)
+            if rep is None:
+                print("  bounds:    analysis bailed (no proofs)")
+            else:
+                ebs = rep.element_bounds()
+                lanes = rep.lane_bounds()
+                parts = []
+                for v in model.vars:
+                    if v in lanes:
+                        parts.append(f"{v}∈[{lanes[v][0]},"
+                                     f"{lanes[v][1]}]")
+                    elif v in ebs:
+                        parts.append(f"{v}:{ebs[v]!r}")
+                est = state_space_estimate(model, rep)
+                print(f"  bounds:    "
+                      f"{'converged' if rep.converged else 'TRUNCATED'}"
+                      f" proven=[{', '.join(parts) or '-'}] "
+                      f"predicted_states="
+                      f"{est if est is not None else '?'}")
+            irep = independence_report(model)
+            refusal = por_refusal(model)
+            print(f"  independence: {len(irep.labels)} arms, "
+                  f"{irep.commuting_pairs()} commuting pairs, "
+                  f"{len(irep.por_safe)} por-safe"
+                  + (f" (--por disabled: {refusal})" if refusal
+                     else ""))
+            for row in irep.matrix_rows():
+                print(f"    {row}")
+        except Exception as ex:  # noqa: BLE001 — info must never fail
+            if os.environ.get("JAXMC_DEBUG"):
+                raise
+            print(f"  analyze:   unavailable ({type(ex).__name__})")
     return 0
 
 
@@ -320,6 +373,19 @@ def main(argv=None) -> int:
                         "demotion prediction are independent of this "
                         "flag (JAXMC_ANALYZE_BOUNDS / "
                         "JAXMC_ANALYZE_PREDICT, both default on)")
+    c.add_argument("--por", action="store_true",
+                   help="partial-order reduction (ISSUE 15, opt-in): "
+                        "expand ONE provably-commuting invisible arm "
+                        "per state (persistent-set filter; BFS cycle "
+                        "proviso) instead of every enabled arm. "
+                        "Preserves invariant/deadlock verdicts and "
+                        "reports traces that replay under unreduced "
+                        "semantics — raw state counts SHRINK by "
+                        "design. Runs on the exact interpreter engine; "
+                        "disabled with a named reason on CONSTRAINT/"
+                        "SYMMETRY/VIEW/temporal models. Reduction "
+                        "facts: jaxmc info --cfg prints the arm "
+                        "independence matrix")
     c.add_argument("--no-device-fallback", action="store_true",
                    help="jax backend: exit on a terminal device failure "
                         "instead of falling back to the parallel CPU "

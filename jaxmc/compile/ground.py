@@ -24,6 +24,16 @@ from ..sem.modules import Model
 from .vspec import CompileError  # noqa: F401  (re-export)
 
 
+# shared demotion-reason wording for the dynamic-\E slot axis (ISSUE
+# 15): analyze/verdicts.py predicts these ground-time demotions and
+# must report the exact build-time string — both sides read the one
+# constant (the PR 9 SUBSET_SYMBOLIC_MSG pattern)
+DYN_NESTED_MSG = ("nested dynamic \\E binders not supported "
+                  "(one slot axis per action)")
+DYN_SHAPE_MSG = ("dynamic \\E with multiple binders/patterns not "
+                 "supported (one slot axis per action)")
+
+
 # ---------------- static action grounding ----------------
 
 @dataclass
@@ -214,9 +224,7 @@ def _ground_expr(model: Model, root: A.Node, root_bound: Dict[str, Any],
                         # two dynamic binders would share the one traced
                         # slot index and only explore diagonal pairs —
                         # reject rather than silently drop transitions
-                        raise CompileError(
-                            "nested dynamic \\E binders not supported "
-                            "(one slot axis per action)")
+                        raise CompileError(DYN_NESTED_MSG) from ex
                     # one vectorized instance: the kernel binds the slot
                     # element by a traced slot index and the engine vmaps
                     # over slots (keeps trace size O(1) in table capacity)
@@ -224,6 +232,11 @@ def _ground_expr(model: Model, root: A.Node, root_bound: Dict[str, Any],
                     sexpr = e.binders[0][1]
                     nb = {**bound, var: ("$slotv", sexpr)}
                     return walk2(e.body, nb, label)
+                if dyn_slots > 0:
+                    # dynamic domain but an UNSIZED slot axis: the
+                    # binder shape disqualifies slot expansion — a
+                    # constant reason the predictor mirrors verbatim
+                    raise CompileError(DYN_SHAPE_MSG) from ex
                 raise CompileError(f"\\E over non-static domain: {ex}") \
                     from ex
             out2 = []

@@ -878,7 +878,7 @@ def set_elements(s, fr: Frame):
             yield True, m
         return
     if kind == "inf":
-        raise CompileError(f"cannot enumerate {sv!r}")
+        raise CompileError(cannot_enumerate_message(sv))
     sp = sv.spec
     if sp.kind in ("set", "iset"):
         for i, m in enumerate(sp.dom):
@@ -1635,7 +1635,7 @@ def _binder_combos(binders, fr: Frame):
     groups = []
     for names, sexpr in binders:
         if sexpr is None:
-            raise CompileError("unbounded quantifier")
+            raise CompileError(UNBOUNDED_QUANTIFIER_MSG)
         sval = sym_eval2(sexpr, fr)
         elems = list(_elements(sval, fr))
         for pat in names:
@@ -2165,6 +2165,16 @@ class UnrollLimitError(CompileError):
 # must carry the exact string the build-time path reports — both sides
 # read the one constant, so the wording cannot diverge
 SUBSET_SYMBOLIC_MSG = "SUBSET of symbolic set"
+
+# ISSUE 15 taxonomy additions: a quantifier with no domain at all, and
+# a quantifier/enumeration over an infinite constant set (Nat, Int,
+# STRING, Seq(S)) — both certain demotions the predictor can name
+# before any build
+UNBOUNDED_QUANTIFIER_MSG = "unbounded quantifier"
+
+
+def cannot_enumerate_message(sv) -> str:
+    return f"cannot enumerate {sv!r}"
 
 
 def unroll_limit_message(name: str, limit: int) -> str:

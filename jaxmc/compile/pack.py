@@ -83,21 +83,55 @@ class _LaneClass:
                           self.proven or other.proven)
 
 
+def _sb_all(static) -> Optional[Tuple[int, int]]:
+    """The covering interval of a static bound: a plain (lo, hi) tuple
+    is itself; an analyze.bounds.EB contributes its `all` field."""
+    if static is None or isinstance(static, tuple):
+        return static
+    return static.all
+
+
+def _sb_child(static, role: str, key=None):
+    """Descend a static bound alongside the vspec tree (ISSUE 15).
+
+    A plain (lo, hi) tuple covers every int component, so it passes
+    through unchanged (the pre-ISSUE-15 whole-variable behavior).  An
+    EB picks the per-key bound when `key` matches a tracked record
+    field, else the role child (rng/elem interchange: a tuple value
+    abstracted as a sequence still covers function-encoded layouts and
+    vice versa), else falls back to the covering `all` interval —
+    every fallback is a superset, never a narrower guess."""
+    if static is None or isinstance(static, tuple):
+        return static
+    if key is not None and static.keys and key in static.keys:
+        c = static.keys[key]
+        return c if c is not None else static.all
+    alts = {"rng": ("rng", "elem"), "elem": ("elem", "rng"),
+            "dom": ("dom",)}[role]
+    for r in alts:
+        c = getattr(static, r)
+        if c is not None:
+            return c
+    return static.all
+
+
 def _walk(spec: VS, uni_n: int, zero_pad: bool, sent_ok: bool,
-          out: List[_LaneClass],
-          static: Optional[Tuple[int, int]] = None) -> None:
+          out: List[_LaneClass], static=None) -> None:
     """Emit one _LaneClass per lane, in exactly vspec.encode's order.
 
-    `static` is the variable's analyzer-proven summary interval (ISSUE
-    9): it covers EVERY integer scalar component anywhere in the value,
-    so it applies to each raw-int lane the walk reaches — those lanes
-    become proven-width instead of observed-range."""
+    `static` is the variable's analyzer-proven bound (ISSUE 9/15):
+    either a plain (lo, hi) summary interval covering EVERY integer
+    scalar component anywhere in the value, or a structured
+    analyze.bounds.EB whose dom/rng/elem/per-key children bound each
+    container side separately — element lanes then pack at their own
+    proven widths (the EXCEPT-guard container win)."""
     k = spec.kind
     if k == "justempty":
         return
     if k == "int":
-        if static is not None:
-            out.append(_LaneClass(static[0], static[1], True, sent_ok,
+        b = _sb_all(static)
+        if b is not None:
+            out.append(_LaneClass(b[0], b[1], True, sent_ok,
                                   zero_pad, proven=True))
         else:
             out.append(_LaneClass(None, None, True, sent_ok, zero_pad))
@@ -107,13 +141,15 @@ def _walk(spec: VS, uni_n: int, zero_pad: bool, sent_ok: bool,
         out.append(_LaneClass(0, max(uni_n - 1, 0), False, sent_ok,
                               zero_pad))
     elif k == "fcn":
-        for e in spec.elems:
-            _walk(e, uni_n, zero_pad, sent_ok, out, static)
+        for kk, e in zip(spec.dom, spec.elems):
+            _walk(e, uni_n, zero_pad, sent_ok, out,
+                  _sb_child(static, "rng", key=kk))
     elif k == "seq":
         out.append(_LaneClass(0, spec.cap, False, sent_ok, zero_pad))
         for _ in range(spec.cap):
             # tail slots beyond the length are zero-padded
-            _walk(spec.elem, uni_n, True, sent_ok, out, static)
+            _walk(spec.elem, uni_n, True, sent_ok, out,
+                  _sb_child(static, "elem"))
     elif k == "set":
         for _ in spec.dom:
             out.append(_LaneClass(0, 1, False, sent_ok, zero_pad))
@@ -121,32 +157,38 @@ def _walk(spec: VS, uni_n: int, zero_pad: bool, sent_ok: bool,
         out.append(_LaneClass(0, spec.cap, False, sent_ok, zero_pad))
         for _ in range(spec.cap):
             # slots beyond the cardinality are SENTINEL-padded
-            _walk(spec.elem, uni_n, zero_pad, True, out, static)
+            _walk(spec.elem, uni_n, zero_pad, True, out,
+                  _sb_child(static, "elem"))
     elif k == "pfcn":
-        for _kk, e in zip(spec.dom, spec.elems):
+        for kk, e in zip(spec.dom, spec.elems):
             out.append(_LaneClass(0, 1, False, sent_ok, zero_pad))
             # absent keys zero their value lanes
-            _walk(e, uni_n, True, sent_ok, out, static)
+            _walk(e, uni_n, True, sent_ok, out,
+                  _sb_child(static, "rng", key=kk))
     elif k == "union":
         out.append(_LaneClass(0, max(len(spec.variants) - 1, 0), False,
                               sent_ok, zero_pad))
         pay = spec.width - 1
         # payload lanes are OVERLAID across variants: merge the classes
-        # positionally; lanes past a variant's width are zero-padded
+        # positionally; lanes past a variant's width are zero-padded —
+        # only the covering interval is sound across the overlay
+        cover = _sb_all(static)
         lanes = [_LaneClass(0, 0, False, sent_ok, True)
                  for _ in range(pay)]
         for _names, fields in spec.variants:
             sub: List[_LaneClass] = []
             for f in fields:
-                _walk(f, uni_n, True, sent_ok, sub, static)
+                _walk(f, uni_n, True, sent_ok, sub, cover)
             for i, lc in enumerate(sub):
                 lanes[i] = lanes[i].merge(lc)
         out.extend(lanes)
     elif k == "kvtable":
         out.append(_LaneClass(0, spec.cap, False, sent_ok, zero_pad))
         for _ in range(spec.cap):
-            _walk(spec.elem, uni_n, zero_pad, True, out, static)
-            _walk(spec.val, uni_n, zero_pad, True, out, static)
+            _walk(spec.elem, uni_n, zero_pad, True, out,
+                  _sb_child(static, "dom"))
+            _walk(spec.val, uni_n, zero_pad, True, out,
+                  _sb_child(static, "rng"))
     else:
         raise AssertionError(k)
 

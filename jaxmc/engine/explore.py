@@ -166,9 +166,18 @@ class Explorer:
                  checkpoint_path: Optional[str] = None,
                  checkpoint_every: float = 600.0,
                  resume_from: Optional[str] = None,
-                 final_checkpoint: bool = False):
+                 final_checkpoint: bool = False,
+                 por: bool = False):
         from .. import obs
         self.model = model
+        # partial-order reduction (ISSUE 15, opt-in --por): expand ONE
+        # globally-commuting invisible arm per state when every one of
+        # its successors is new (persistent-set filter + BFS cycle
+        # proviso) — preserves invariant/deadlock verdicts, NOT raw
+        # state counts.  Disabled with a named reason on models whose
+        # constructs interact with the reduction (CONSTRAINT, SYMMETRY,
+        # VIEW, temporal/refinement PROPERTYs).
+        self.por = por
         # default sink: silent on stdout but still mirrored into the
         # telemetry trace (obs.Logger is THE log funnel — cli.py passes
         # a printing one; library callers get the quiet one)
@@ -281,6 +290,9 @@ class Explorer:
 
         view_expr = getattr(model, "view", None)
 
+        def _lstr(label) -> str:
+            return label if isinstance(label, str) else label_str(label)
+
         def add_state(st, parent, label, depth):
             """Returns (sid | None, new). sid None = discarded by
             CONSTRAINT; new is True the first time any state (kept or
@@ -288,7 +300,14 @@ class Explorer:
             add_state + merge replay): any change to this dedup/discard
             flow must land there too or the engines' bit-identical
             parity breaks (tests/test_parallel.py pins it)."""
-            key = state_fingerprint(model, canon, view_expr, vars, st)
+            # the POR proviso check may have fingerprinted this very
+            # successor object already — reuse its key (por_keys is
+            # empty on unreduced runs; defined below, bound at call
+            # time)
+            key = por_keys.pop(id(st), None) if por_keys else None
+            if key is None:
+                key = state_fingerprint(model, canon, view_expr, vars,
+                                        st)
             # single-hash insert: tentatively claim the next sid; a dup
             # returns the existing mapping without a second key hash (the
             # fingerprint tuple is hashed once per generated state instead
@@ -316,6 +335,103 @@ class Explorer:
         live_obligations, collect_edges, warnings = \
             liveness_setup(model, refiners, view_expr)
         edges: List[Tuple[int, int]] = []
+
+        # ---- partial-order reduction setup (ISSUE 15) ----
+        por_active = False
+        por_stats = {"ample": 0, "full": 0}
+        por_arms = por_safe = por_ctxs = por_walkers = None
+        if self.por:
+            from ..analyze.independence import (independence_report,
+                                                por_refusal)
+            from ..compile.ground import split_arms
+            por_reason = por_refusal(model)
+            if por_reason is None and canon is not None:
+                por_reason = "symmetry canonicalizer active"
+            if por_reason is None:
+                por_arms = split_arms(model)
+                irep = independence_report(model, por_arms)
+                tel.gauge("analyze.independence_pairs",
+                          irep.commuting_pairs())
+                tel.gauge("analyze.independence_safe",
+                          len(irep.por_safe))
+                if not irep.por_safe:
+                    por_reason = ("no arm commutes with every other "
+                                  "arm invisibly")
+            if por_reason is not None:
+                warnings.append(f"--por requested but reduction "
+                                f"disabled: {por_reason} (running "
+                                f"unreduced)")
+                tel.gauge("por.disabled_reason", por_reason)
+            else:
+                por_active = True
+                por_safe = sorted(irep.por_safe)
+                por_ctxs = [base_ctx.with_bound(a.bound) if a.bound
+                            else base_ctx for a in por_arms]
+                por_walkers = [Walker("next", vars) for _ in por_arms]
+                self.log(f"-- por: {len(por_safe)}/{len(por_arms)} "
+                         f"arms eligible as singleton ample sets")
+
+        def _arm_succs(i, st):
+            arm = por_arms[i]
+            fallback = arm.label or "Next"
+            out = []
+            for succ, label in enumerate_next(arm.expr, por_ctxs[i],
+                                              vars, st,
+                                              walker=por_walkers[i]):
+                out.append((succ, _lstr(label) if label is not None
+                            else fallback))
+            return out
+
+        # keys computed by the ample proviso check, reused by add_state
+        # (the single-hash-per-state discipline the serial hot loop is
+        # built around); repopulated per _por_expand call — entries
+        # only ever describe the CURRENTLY-returned successor objects,
+        # so a recycled id() can never resurrect a stale key
+        por_keys: Dict[int, Any] = {}
+
+        def _por_expand(st):
+            """The persistent-set filter: the FIRST eligible arm whose
+            successor set is nonempty and entirely NEW (keys outside
+            `seen` — the BFS cycle proviso) becomes the singleton ample
+            set; otherwise every arm expands, in original arm order
+            (byte-identical to the unreduced walk's stream).
+
+            Verdict preservation for SKIPPED arms (why an Assert or a
+            guard violation in arm B cannot be lost): every ample arm
+            commutes with EVERY arm, so no ample-only chain writes
+            B's read set — B's enabledness and full evaluation
+            (including any Assert outcome) are INVARIANT along the
+            chain — and the all-successors-new proviso forces each
+            chain to end in a full expansion (the seen set is finite
+            and grows), which evaluates B with bit-identical inputs.
+            Only TLC PRINT side effects of skipped interleavings are
+            lost (documented in the README)."""
+            por_keys.clear()
+            cached = {}
+            for i in por_safe:
+                ss = _arm_succs(i, st)
+                keys = [state_fingerprint(model, canon, view_expr,
+                                          vars, s) for s, _l in ss]
+                cached[i] = (ss, keys)
+                if ss and all(k not in seen for k in keys):
+                    por_stats["ample"] += 1
+                    for (s, _l), k in zip(ss, keys):
+                        por_keys[id(s)] = k
+                    return ss
+            out = []
+            for i in range(len(por_arms)):
+                hit = cached.get(i)
+                if hit is None:
+                    out.extend(_arm_succs(i, st))
+                    continue
+                ss, keys = hit
+                # the proviso trials already hashed these successors:
+                # keep their keys for add_state too
+                for (s, _l), k in zip(ss, keys):
+                    por_keys[id(s)] = k
+                out.extend(ss)
+            por_stats["full"] += 1
+            return out
 
         # per-level BFS telemetry: record level d when its last state has
         # been expanded (the queue is depth-ordered, so the first pop of
@@ -345,6 +461,18 @@ class Explorer:
                 tel.gauge("memo.hits", mst.hits)
                 tel.gauge("memo.misses", mst.misses)
             tel.gauge("fingerprint.occupancy", len(seen))
+            if self.por:
+                tel.gauge("por.enabled", por_active)
+                if por_active:
+                    total = por_stats["ample"] + por_stats["full"]
+                    tel.counter("por.ample_states", por_stats["ample"])
+                    tel.counter("por.full_states", por_stats["full"])
+                    tel.gauge("por.ample_ratio",
+                              round(por_stats["ample"] / total, 4)
+                              if total else 0.0)
+                    # the REDUCED run's distinct count — obs diff reads
+                    # it against an unreduced baseline's result.distinct
+                    tel.gauge("por.reduced_states", len(states))
             if truncated and trunc_reason is None:
                 # name the exhausted resource (ISSUE 12 satellite) —
                 # the serial engine truncates on max_states or a drain
@@ -474,15 +602,17 @@ class Explorer:
             gen_at_pop = generated
             prints_at_pop = len(self.prints)
             try:
-                for succ, label in enumerate_next(model.next, base_ctx, vars,
-                                                  st, walker=next_walker):
+                pairs = _por_expand(st) if por_active else \
+                    enumerate_next(model.next, base_ctx, vars, st,
+                                   walker=next_walker)
+                for succ, label in pairs:
                     succ_count += 1
                     generated += 1
                     lv["generated"] += 1
                     if model.action_constraints and not \
                             self._satisfies_action_constraints(st, succ):
                         continue
-                    nid, new = add_state(succ, sid, label_str(label),
+                    nid, new = add_state(succ, sid, _lstr(label),
                                          depth + 1)
                     if nid is None:
                         continue  # discarded by CONSTRAINT (not checked)
@@ -492,7 +622,7 @@ class Explorer:
                         if not rc.check_edge(st, succ):
                             trace = self._trace_to(sid, parents, states,
                                                    labels)
-                            trace.append((succ, label_str(label)))
+                            trace.append((succ, _lstr(label)))
                             msg = (f"step is not a [{rc.name}-Next]_v "
                                    f"step of the refined specification")
                             if rc.last_error:

@@ -329,6 +329,32 @@ jaxmc.metrics/2 artifact minus the new optional surface, so readers and
       fixpoint bailed), `batch_occupancy`, `batch_dispatches`,
       `lifted_consts`, and `device_owner` (job ran in the owner
       process); job records carry `bsig`/`cost_estimate`/`fast_lane`.
+
+  (PR 15, still jaxmc.metrics/2 — all additive/optional;
+   independence-driven hot path, ISSUE 15:)
+    - independence analysis: gauge `analyze.independence_pairs`
+      (commuting arm pairs proven by the element-atom footprints),
+      gauge `analyze.independence_safe` (arms eligible as singleton
+      ample sets), gauge `expand.regrouped` (1 when the fused-group
+      plan departed from the legacy contiguous one — counts/traces
+      stay byte-identical; `expand.fused_groups` /
+      `mesh.grouped_expand` may SHRINK under the new plan).
+    - partial-order reduction (opt-in --por): gauge `por.enabled`
+      (false + gauge `por.disabled_reason` when the model's
+      constructs refuse the reduction), counters `por.ample_states` /
+      `por.full_states` (states expanded through a singleton ample
+      set vs fully), gauge `por.ample_ratio` (ample / total expanded),
+      gauge `por.reduced_states` (the REDUCED run's distinct count —
+      compare against an unreduced baseline's result.distinct; raw
+      counts shrink BY DESIGN under --por), gauge `por.engine`
+      ("interp" when a device-backend --por request demoted to the
+      exact interpreter).
+    - bounds-sized engines: `profile.status` gains the value
+      "predicted" (capacity ladder rung below `learned`: no saved
+      profile, but a converged bounds fixpoint proved a state-count
+      ceiling), gauges `profile.predicted_states` (the proven
+      ceiling) and `profile.predicted_caps` (the buckets sized from
+      it — a cold run then pays zero growth-retry recompiles).
 """
 
 from __future__ import annotations

@@ -1,0 +1,232 @@
+r"""`make por-check` (ISSUE 15): the independence/reduction gate.
+
+Four legs over the repo-local commuting fixture (specs/portoy.tla),
+one parseable `POR-CHECK …` line each:
+
+  1. UNREDUCED   the exact serial run of portoy_ok; counts must equal
+                 the corpus manifest pins.
+  2. POR         the same rung under --por: the run must still
+                 complete OK, report por.* gauges, and explore >= 30%
+                 fewer distinct states than leg 1; the deadlock and
+                 invariant rungs must keep their violation VERDICTS
+                 under --por (trace-replay validity is pinned by
+                 tests/test_independence.py).
+  3. REGROUP     the jax host_seen grouped path at
+                 JAXMC_FUSED_MAX_INSTANCES=2, independence regrouping
+                 ON vs OFF: counts and the rendered counterexample
+                 byte-identical; the regrouped artifact gates against
+                 its saved baseline via `python -m jaxmc.obs diff
+                 --fail-on-regress` (meshbench._gate, like every
+                 bench-check leg).
+  4. PREDICTED   a COLD resident run (fresh profile store) of a fully
+                 proven spec must take the `predicted` capacity rung
+                 and pay exactly ONE compile — zero growth-retry
+                 recompiles (`window_recompiles == 0` in the serve
+                 sense: no fresh compile after the first dispatch).
+
+A container without the jax backend prints `POR-CHECK SKIP …` for the
+jax legs (3, 4) and still runs the interpreter legs (1, 2) — the POR
+filter itself is device-independent.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Dict, List, Optional
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_SPEC = "specs/portoy.tla"
+_CFG_OK = "specs/portoy_ok.cfg"
+_CFG_DEAD = "specs/portoy.cfg"
+_CFG_BAD = "specs/portoy_bad.cfg"
+#: acceptance floor: --por must cut explored distinct states by this
+_MIN_REDUCTION = 0.30
+
+
+def _check(cfg: str, metrics: Optional[str], extra: List[str],
+           env_extra: Dict[str, str], timeout_s: float) -> Dict:
+    cmd = [sys.executable, "-m", "jaxmc", "check",
+           os.path.join(_REPO, _SPEC),
+           "--cfg", os.path.join(_REPO, cfg), "--quiet"] + extra
+    if metrics:
+        cmd += ["--metrics-out", metrics]
+    env = dict(os.environ, PYTHONPATH=_REPO, JAX_PLATFORMS="cpu",
+               **env_extra)
+    t0 = time.time()
+    try:
+        p = subprocess.run(cmd, capture_output=True, text=True,
+                           cwd=_REPO, env=env, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return {"error": f"leg timed out after {timeout_s:.0f}s"}
+    out = {"rc": p.returncode, "stdout": p.stdout, "stderr": p.stderr,
+           "wall_s": round(time.time() - t0, 3)}
+    if metrics:
+        try:
+            with open(metrics, encoding="utf-8") as fh:
+                out["summary"] = json.load(fh)
+        except (OSError, ValueError) as ex:
+            out["error"] = f"no metrics artifact ({ex})"
+    return out
+
+
+def _trace_lines(stdout: str) -> List[str]:
+    lines = stdout.splitlines()
+    for i, ln in enumerate(lines):
+        if "is violated" in ln or "Error:" in ln:
+            return lines[i:]
+    return []
+
+
+def _have_jax() -> bool:
+    import importlib.util
+    return importlib.util.find_spec("jax") is not None
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m jaxmc.porbench",
+        description="independence/reduction gate (POR verdicts + "
+                    "regroup parity + predicted capacity rung)")
+    ap.add_argument("--out-dir", default="/tmp")
+    ap.add_argument("--leg-timeout", type=float, default=float(
+        os.environ.get("JAXMC_POR_CHECK_TIMEOUT", "600")))
+    args = ap.parse_args(argv)
+
+    from .corpus import case_for_cfg
+    case = case_for_cfg(os.path.basename(_CFG_OK))
+    want = (case.generated, case.distinct) if case else (366, 150)
+    failures = 0
+
+    # leg 1: unreduced exact baseline (serial: POR's comparison basis)
+    m_base = os.path.join(args.out_dir, "jaxmc_por_unreduced.json")
+    r = _check(_CFG_OK, m_base, ["--no-deadlock", "--workers", "1"],
+               {}, args.leg_timeout)
+    res = (r.get("summary") or {}).get("result") or {}
+    if r.get("rc") != 0 or not res.get("ok") or \
+            (res.get("generated"), res.get("distinct")) != want:
+        print(f"POR-CHECK FAIL unreduced: rc={r.get('rc')} counts="
+              f"{(res.get('generated'), res.get('distinct'))} != "
+              f"manifest pins {want} "
+              f"{(r.get('stderr') or '')[-200:]}", file=sys.stderr)
+        return 1
+    print(f"POR-CHECK ok unreduced: {want[0]} gen / {want[1]} "
+          f"distinct ({r['wall_s']}s)")
+
+    # leg 2: --por reduction + verdict preservation
+    m_por = os.path.join(args.out_dir, "jaxmc_por_reduced.json")
+    r2 = _check(_CFG_OK, m_por, ["--no-deadlock", "--por"], {},
+                args.leg_timeout)
+    res2 = (r2.get("summary") or {}).get("result") or {}
+    gauges2 = (r2.get("summary") or {}).get("gauges") or {}
+    red = 1.0 - (res2.get("distinct") or want[1]) / want[1]
+    if r2.get("rc") != 0 or not res2.get("ok"):
+        print(f"POR-CHECK FAIL por: rc={r2.get('rc')} "
+              f"{(r2.get('stderr') or '')[-200:]}", file=sys.stderr)
+        failures += 1
+    elif red < _MIN_REDUCTION or not gauges2.get("por.enabled"):
+        print(f"POR-CHECK FAIL por: explored-state reduction "
+              f"{red:.0%} < {_MIN_REDUCTION:.0%} "
+              f"(distinct {res2.get('distinct')} vs {want[1]}; "
+              f"por.enabled={gauges2.get('por.enabled')})",
+              file=sys.stderr)
+        failures += 1
+    else:
+        print(f"POR-CHECK ok por: {res2.get('distinct')} distinct "
+              f"(-{red:.0%}), ample_ratio="
+              f"{gauges2.get('por.ample_ratio')} ({r2['wall_s']}s)")
+    for cfg, wkind, wrc in ((_CFG_DEAD, "Deadlock", 1),
+                            (_CFG_BAD, "Invariant NoFire", 1)):
+        rv = _check(cfg, None, ["--por"], {}, args.leg_timeout)
+        head = _trace_lines(rv.get("stdout", ""))[:1]
+        if rv.get("rc") != wrc or not head or wkind not in head[0]:
+            print(f"POR-CHECK FAIL por verdict: {cfg} rc="
+                  f"{rv.get('rc')} head={head}", file=sys.stderr)
+            failures += 1
+        else:
+            print(f"POR-CHECK ok por verdict: {cfg} -> {head[0]!r}")
+
+    if not _have_jax():
+        print("POR-CHECK SKIP regroup+predicted: jax backend "
+              "unavailable in this container")
+        print(f"por-check: {'FAIL' if failures else 'ok'} "
+              f"({failures} failing legs)")
+        return 1 if failures else 0
+
+    # leg 3: regroup parity on the grouped host_seen path (cap 2 forces
+    # ceil(A/2) groups on the 4-arm fixture)
+    genv = {"JAXMC_FUSED_MAX_INSTANCES": "2"}
+    m_grp = os.path.join(args.out_dir, "jaxmc_por_regroup.json")
+    ron = _check(_CFG_BAD, m_grp,
+                 ["--backend", "jax", "--platform", "cpu",
+                  "--host-seen"],
+                 dict(genv, JAXMC_ANALYZE_INDEP="1"), args.leg_timeout)
+    roff = _check(_CFG_BAD, None,
+                  ["--backend", "jax", "--platform", "cpu",
+                   "--host-seen"],
+                  dict(genv, JAXMC_ANALYZE_INDEP="0"), args.leg_timeout)
+    t_on, t_off = _trace_lines(ron.get("stdout", "")), \
+        _trace_lines(roff.get("stdout", ""))
+    if ron.get("rc") != 1 or roff.get("rc") != 1 or not t_on or \
+            t_on != t_off:
+        print(f"POR-CHECK FAIL regroup: grouped runs differ with "
+              f"regrouping on/off (rc {ron.get('rc')}/"
+              f"{roff.get('rc')}, {len(t_on)} vs {len(t_off)} trace "
+              f"lines) {(ron.get('stderr') or '')[-200:]}",
+              file=sys.stderr)
+        failures += 1
+    else:
+        print(f"POR-CHECK ok regroup: counterexample byte-identical "
+              f"with regrouping on/off ({len(t_on)} lines)")
+        from .meshbench import _gate as gate
+        if gate(m_grp, log=print,
+                ignore_phases=("device_init", "engine_build",
+                               "layout_sample", "compile_arm")):
+            failures += 1
+
+    # leg 4: predicted capacity rung — cold resident run, fresh store
+    with tempfile.TemporaryDirectory(prefix="jaxmc_pred_") as store:
+        m_pred = os.path.join(args.out_dir, "jaxmc_por_predicted.json")
+        rp = _check(_CFG_OK, m_pred,
+                    ["--no-deadlock", "--backend", "jax",
+                     "--platform", "cpu", "--resident", "--no-trace"],
+                    {"JAXMC_PROFILE_STORE": store}, args.leg_timeout)
+        resp = (rp.get("summary") or {}).get("result") or {}
+        gp = (rp.get("summary") or {}).get("gauges") or {}
+        levels = (rp.get("summary") or {}).get("levels") or []
+        fresh = sum(1 for lv in levels if lv.get("fresh_compile"))
+        window = sum(1 for lv in levels[1:] if lv.get("fresh_compile"))
+        if rp.get("rc") != 0 or not resp.get("ok") or \
+                (resp.get("generated"), resp.get("distinct")) != want:
+            print(f"POR-CHECK FAIL predicted: rc={rp.get('rc')} "
+                  f"counts={(resp.get('generated'), resp.get('distinct'))}"
+                  f" != {want} {(rp.get('stderr') or '')[-200:]}",
+                  file=sys.stderr)
+            failures += 1
+        elif gp.get("profile.predicted_states") is None or window:
+            print(f"POR-CHECK FAIL predicted: cold run must take the "
+                  f"predicted rung with zero growth recompiles "
+                  f"(predicted_states="
+                  f"{gp.get('profile.predicted_states')}, "
+                  f"fresh_compiles={fresh}, in-window={window})",
+                  file=sys.stderr)
+            failures += 1
+        else:
+            print(f"POR-CHECK ok predicted: cold resident run, "
+                  f"predicted<={gp['profile.predicted_states']} "
+                  f"states, {fresh} compile, 0 growth recompiles "
+                  f"({rp['wall_s']}s)")
+
+    print(f"por-check: {'FAIL' if failures else 'ok'} "
+          f"({failures} failing legs)")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -48,6 +48,7 @@ OPTION_FIELDS = (
     "backend", "platform", "max_states", "workers", "no_deadlock",
     "seq_cap", "grow_cap", "kv_cap", "no_trace", "host_seen", "sample",
     "chunk", "resident", "include", "progress_every", "res_caps",
+    "por",
 )
 
 JOB_STATUSES = ("queued", "running", "done", "failed", "drained")

@@ -136,6 +136,12 @@ class SessionConfig:
     # stage — "off" (skip), "warn" (print diagnostics, continue),
     # "strict" (error diagnostics abort with exit 2 before compile)
     analyze: str = "off"
+    # partial-order reduction (ISSUE 15, opt-in): expand one
+    # globally-commuting invisible arm per state instead of every
+    # enabled arm — preserves invariant/deadlock verdicts, NOT raw
+    # counts.  Runs on the exact serial interpreter engine; a device
+    # backend with --por demotes to it with a named warning.
+    por: bool = False
     # serve-only knobs (no CLI flags):
     final_checkpoint: bool = False  # checkpoint COMPLETED runs too —
     # the daemon's warm-resume source
@@ -170,6 +176,7 @@ class SessionConfig:
             "host_seen": self.host_seen, "sample": list(self.sample),
             "chunk": self.chunk, "resident": self.resident,
             "seen": self.seen, "seen_cap": self.seen_cap,
+            "por": self.por,
         }
 
     def batch_signature_fields(self) -> Dict[str, Any]:
@@ -202,7 +209,8 @@ class BatchProfile:
     # (None = analysis bailed: no fast-lane routing)
 
 
-def batch_profile(cfg: SessionConfig) -> Optional["BatchProfile"]:
+def batch_profile(cfg: SessionConfig,
+                  model=None) -> Optional["BatchProfile"]:
     """Prove (at parse time) which layout-compat class this job belongs
     to.  Two submissions with equal `bsig` differ at most in LIFTABLE
     constant values — same module shape, same non-lifted constants,
@@ -215,14 +223,15 @@ def batch_profile(cfg: SessionConfig) -> Optional["BatchProfile"]:
     import hashlib
     import json
     if cfg.backend == "interp" or cfg.resident or not cfg.host_seen \
-            or cfg.seen_cap is not None:
+            or cfg.seen_cap is not None or cfg.por:
         return None
-    try:
-        model = load_model(cfg.spec, cfg.cfg, cfg.no_deadlock,
-                           cfg.include)
-    except Exception:  # noqa: BLE001 — an unloadable pair is simply
-        # not batchable; the solo path reports the real error
-        return None
+    if model is None:
+        try:
+            model = load_model(cfg.spec, cfg.cfg, cfg.no_deadlock,
+                               cfg.include)
+        except Exception:  # noqa: BLE001 — an unloadable pair is simply
+            # not batchable; the solo path reports the real error
+            return None
     from .analyze.bounds import liftable_constants, state_space_estimate
     lift = liftable_constants(model)
     mc = model.cfg
@@ -495,7 +504,18 @@ class CheckSession:
             self.analyze()  # no-op when cfg.analyze == "off"
         assert self.kind == "model", "assumes sessions have no engine"
         cfg = self.cfg
-        if cfg.backend == "interp":
+        if cfg.por and cfg.backend != "interp":
+            # POR's persistent-set filter is a per-state host decision;
+            # the device kernels expand whole frontiers per dispatch.
+            # A --por run therefore executes on the exact serial
+            # interpreter — named, never silent (the device engines
+            # would otherwise quietly ignore the reduction)
+            print("warning: --por runs on the exact interpreter engine "
+                  "(device kernels are not POR-aware); "
+                  f"--backend {cfg.backend} request demoted",
+                  file=sys.stderr)
+            self.tel.gauge("por.engine", "interp")
+        if cfg.backend == "interp" or cfg.por:
             from .engine.parallel import ParallelExplorer, default_workers
             # None or 0 = auto (JAXMC_WORKERS, else min(cpu_count, 8))
             self.workers = default_workers() if not cfg.workers \
@@ -506,7 +526,17 @@ class CheckSession:
                       checkpoint_every=cfg.checkpoint_every,
                       resume_from=cfg.resume,
                       final_checkpoint=cfg.final_checkpoint)
-            if self.workers > 1:
+            if cfg.por:
+                # the ample-set choice depends on the live seen-set, a
+                # per-state sequential decision — the fork-pool's
+                # chunked expansion cannot replay it; serial engine,
+                # named reason
+                if self.workers > 1:
+                    self.tel.gauge("parallel.fallback_reason", "por")
+                self.workers = 1
+                from .engine.explore import Explorer
+                self.engine = Explorer(self.model, por=True, **kw)
+            elif self.workers > 1:
                 # worker-parallel frontier expansion (crash-safe:
                 # checkpoints natively, survives worker deaths); falls
                 # back to the serial engine (identical results) only for
@@ -562,7 +592,7 @@ class CheckSession:
         if final_checkpoint is not _SENTINEL:
             ex.final_checkpoint = final_checkpoint
         self.explore_count += 1
-        if self.cfg.backend == "interp":
+        if self.cfg.backend == "interp" or self.cfg.por:
             with self.tel.span("search", workers=self.workers):
                 self.result = ex.run()
         else:

@@ -1,0 +1,32 @@
+---------------------------- MODULE dyntoy ----------------------------
+(* Derived interp-arms fixture (ISSUE 15): both arms quantify over the
+   state variable msgs with slot-axis shapes the grounder cannot size —
+   Pair's multi-binder dynamic \E and Relay's nested dynamic \E — so
+   every arm demotes to the interpreter at BUILD time, and the
+   analyze/verdicts.py taxonomy predicts both with the exact ground.py
+   reason strings (DYN_SHAPE_MSG / DYN_NESTED_MSG).  The corpus
+   manifest pins this case mode="interp-arms" with pin_derived=True:
+   the predictor, not a measured pin, skips the futile builds, and a
+   predictor regression fails the sweep loudly. *)
+EXTENDS Naturals, FiniteSets
+CONSTANTS N
+VARIABLES msgs, acks
+
+Init == msgs = 1..N /\ acks = {}
+
+Pair == \E m \in msgs, k \in msgs :
+          /\ m < k
+          /\ acks' = acks \cup {m}
+          /\ UNCHANGED msgs
+
+Relay == \E m \in msgs : \E k \in msgs :
+           /\ m < k
+           /\ acks' = acks \cup {k}
+           /\ UNCHANGED msgs
+
+Next == Pair \/ Relay
+
+Spec == Init /\ [][Next]_<<msgs, acks>>
+
+AcksInMsgs == acks \subseteq msgs
+=======================================================================
