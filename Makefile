@@ -188,6 +188,10 @@ bench-check:
 	# to a live daemon must be a checkpoint-resume with ZERO in-window
 	# recompiles — see serve-check below
 	$(MAKE) serve-check
+	# observability leg (ISSUE 16): live daemon scraped mid-run
+	# (/metrics parses, per-job progress gauge moves), multi-process
+	# timeline with zero orphan spans — see trace-check below
+	$(MAKE) trace-check
 	# multi-chip parity leg (ISSUE 8): D=2 and D=4 virtual-device mesh
 	# runs must match the manifest pins bit-for-bit — see
 	# multichip-check below
@@ -336,6 +340,16 @@ batch-check:
 serve-check:
 	JAX_PLATFORMS=cpu $(PY) -m jaxmc.serve smoke
 
+# fleet-observability gate (ISSUE 16): in-process daemon + slow interp
+# job with a fork pool + a device-owner jax job; GET /metrics must
+# parse as Prometheus text with a MOVING per-job search.progress_est
+# mid-run, GET /jobs/<id>/events must answer mid-run, warm counters
+# must move on resubmission, and `obs timeline` over the daemon +
+# per-job traces must stitch >= 3 distinct OS processes with ZERO
+# orphan spans.  Exit 0 only when every assertion holds.
+trace-check:
+	JAX_PLATFORMS=cpu $(PY) -m jaxmc.tracecheck
+
 # run the checking daemon on a durable spool (jobs/results/checkpoints
 # survive restarts; SIGTERM drains gracefully — see README "Checking
 # as a service")
@@ -360,5 +374,5 @@ native:
 
 .PHONY: all check check-corpus test chaos bench bench-warm bench-tlc \
         pin-si-env bench-check bench-check-reset serve serve-check \
-        batch-check multichip-check multichip-bench backend-check \
-        por-check native lint-corpus pylint
+        trace-check batch-check multichip-check multichip-bench \
+        backend-check por-check native lint-corpus pylint

@@ -647,7 +647,9 @@ def _run_child(env_extra: dict, timeout_s: float, tag: str):
 def _run_child_once(env_extra: dict, timeout_s: float, tag: str):
     """(json_line | None, fate) for one child attempt; fate is "ok",
     "timeout", "rc=N", "signal=-N" or "no-json"."""
-    env = dict(os.environ, **env_extra)
+    # children join the bench's trace: their artifacts merge back into
+    # one `obs timeline` view, parented on this process's span
+    env = obs.child_env(dict(os.environ, **env_extra))
     with _PROCS_LOCK:
         # check-and-spawn under the lock: a worker racing main()'s kill
         # loop must not start a fresh multi-minute XLA compile that the

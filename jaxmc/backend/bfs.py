@@ -2826,7 +2826,8 @@ class TpuExplorer:
         # least one record; same format as the interval lines below
         self.log(f"Progress({depth}): {generated} states generated, "
                  f"{distinct} distinct states found, "
-                 f"{fcount} states left on queue.")
+                 f"{fcount} states left on queue."
+                 f"{obs.eta_suffix(distinct)}")
         last_progress = last_ck = time.time()
         while True:
             # chaos sites: crash / device failure between dispatches
@@ -2995,7 +2996,8 @@ class TpuExplorer:
                     last_progress = now
                     self.log(f"Progress({depth}): {generated} states "
                              f"generated, {distinct} distinct states "
-                             f"found, {fcount} states left on queue.")
+                             f"found, {fcount} states left on queue."
+                             f"{obs.eta_suffix(distinct)}")
                 if self.checkpoint_path and \
                         now - last_ck >= self.checkpoint_every:
                     last_ck = now
@@ -3159,7 +3161,7 @@ class TpuExplorer:
         # interval-line format (see the loop's progress_every site)
         self.log(f"Progress({depth}): {generated} generated, "
                  f"{distinct} distinct, {len(frontier_np)} on "
-                 f"queue.")
+                 f"queue.{obs.eta_suffix(distinct)}")
         last_progress = last_ck = time.time()
         # cross-model batching hook (ISSUE 13): a batch member's device
         # call routes through the shared vmapped dispatcher instead of
@@ -3458,7 +3460,7 @@ class TpuExplorer:
                 last_progress = now
                 self.log(f"Progress({depth}): {generated} generated, "
                          f"{distinct} distinct, {len(frontier_np)} on "
-                         f"queue.")
+                         f"queue.{obs.eta_suffix(distinct)}")
 
         if graph is not None:
             viol = self._check_live(graph, warnings)
@@ -3868,7 +3870,8 @@ class TpuExplorer:
 
         self.log(f"Progress({depth}): {generated} states generated, "
                  f"{distinct} distinct states found, "
-                 f"{fcount} states left on queue.")
+                 f"{fcount} states left on queue."
+                 f"{obs.eta_suffix(distinct)}")
         last_progress = last_ck = time.time()
         while fcount > 0:
             # chaos sites (see _run_host_seen): crash / device failure
@@ -4087,7 +4090,8 @@ class TpuExplorer:
                 last_progress = now
                 self.log(f"Progress({depth}): {generated} states generated, "
                          f"{distinct} distinct states found, "
-                         f"{fcount} states left on queue.")
+                         f"{fcount} states left on queue."
+                         f"{obs.eta_suffix(distinct)}")
 
         if graph is not None:
             viol = self._check_live(graph, warnings)

@@ -132,7 +132,8 @@ def probe_platforms(platforms: List[str],
     sweep measurably blew the 10s budget on a loaded box).  Each probe
     is its own subprocess so a wedged plugin init costs the deadline,
     never a hung run."""
-    env = dict(os.environ)
+    from ..obs import context as trace_context
+    env = trace_context.child_env()  # probes join the caller's trace
     # children must see the REAL plugin surface: a parent pinned to
     # cpu via JAX_PLATFORMS would make every accelerator probe lie
     env.pop("JAX_PLATFORMS", None)

@@ -305,10 +305,12 @@ def _health_probe(path: str, timeout_s: float) -> Tuple[bool, str]:
         "jax.jit(lambda x: x * 2 + 1)(jnp.arange(3)).block_until_ready()"
         "\n")
     try:
+        from ..obs import context as trace_context
         p = subprocess.run([sys.executable, "-c", code],
                            capture_output=True, text=True,
                            timeout=timeout_s,
-                           env=dict(os.environ, JAX_PLATFORMS="cpu"))
+                           env=dict(trace_context.child_env(),
+                                    JAX_PLATFORMS="cpu"))
     except subprocess.TimeoutExpired:
         return False, f"wedged past {timeout_s:.0f}s"
     except OSError as ex:

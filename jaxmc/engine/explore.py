@@ -572,7 +572,8 @@ class Explorer:
         d0 = depth_of[queue[0]] if queue else 0
         self.log(f"Progress({d0}): {generated} states generated, "
                  f"{len(states)} distinct states found, "
-                 f"{len(queue)} states left on queue.")
+                 f"{len(queue)} states left on queue."
+                 f"{obs.eta_suffix(len(states), tel)}")
 
         # ---- BFS ----
         # one reusable walker for the whole search: the action AST is
@@ -659,7 +660,8 @@ class Explorer:
                 last_progress = now
                 self.log(f"Progress({depth}): {generated} states generated, "
                          f"{len(states)} distinct states found, "
-                         f"{len(queue)} states left on queue.")
+                         f"{len(queue)} states left on queue."
+                         f"{obs.eta_suffix(len(states), tel)}")
             if self.checkpoint_path and \
                     now - last_checkpoint >= ck_state["every"]:
                 last_checkpoint = now

@@ -312,23 +312,31 @@ def _worker_main(task_q, result_q) -> None:
     escape from a chunk is reported as a "fail" message, never fatal —
     the parent decides retry vs degrade.  The worker_kill/chunk_error
     fault sites live here and ONLY here: the parent-inline path must
-    never kill or fail the run's only process."""
+    never kill or fail the run's only process.
+
+    Every message carries the worker's span lineage (ISSUE 16): the
+    fork child re-derives its trace context lazily — same trace_id as
+    the parent, its own span parented on the parent's process span — so
+    the parent can place worker pids (including post-respawn ones) in
+    the fleet timeline without the workers writing any artifact."""
     from .. import faults
+    from ..obs import context as trace_context
+    lin = trace_context.get().lineage()
     while True:
         task = task_q.get()
         if task is None:
             return
         idx, depth, chunk = task
-        result_q.put(("start", idx, os.getpid()))
+        result_q.put(("start", idx, os.getpid(), lin))
         try:
             faults.kill_self("worker_kill", level=depth)
             faults.inject("chunk_error", level=depth)
             out = _expand_chunk(chunk)
         except BaseException as ex:  # noqa: BLE001 — report, keep serving
             result_q.put(("fail", idx, os.getpid(),
-                          f"{type(ex).__name__}: {ex}"))
+                          f"{type(ex).__name__}: {ex}", lin))
             continue
-        result_q.put(("done", idx, os.getpid(), out))
+        result_q.put(("done", idx, os.getpid(), out, lin))
 
 
 class _WorkerPool:
@@ -479,6 +487,7 @@ class ParallelExplorer(Explorer):
         self._pool_size = self.workers
         self._respawns = 0
         self._degraded: Optional[str] = None
+        self._worker_lineage: Dict[int, Dict] = {}  # pid -> trace span
 
     # -- engine selection ------------------------------------------------
     def _fallback_reason(self, refiners) -> Optional[str]:
@@ -563,10 +572,22 @@ class ParallelExplorer(Explorer):
         for i, p in enumerate(payloads):
             self._pool.submit((i, depth, p))
 
+        def note_lineage(pid, lin):
+            # first sight of a worker pid: one trace event placing its
+            # span in the fleet timeline (same trace_id over fork, span
+            # parented on this process's span) — respawned workers get
+            # a fresh pid+span under the ORIGINAL trace_id
+            if lin and pid not in self._worker_lineage:
+                self._worker_lineage[pid] = lin
+                tel.event("parallel.worker_span", pid=pid,
+                          span=lin.get("span"), parent=lin.get("parent"),
+                          level=depth)
+
         def absorb(msg):
             kind = msg[0]
             if kind == "start":
                 in_flight[msg[2]] = msg[1]
+                note_lineage(msg[2], msg[3] if len(msg) > 3 else None)
             elif kind == "done":
                 done[msg[1]] = msg[3]
                 in_flight.pop(msg[2], None)
@@ -814,7 +835,8 @@ class ParallelExplorer(Explorer):
         d0 = depth_of[frontier[0]] if frontier else 0
         self.log(f"Progress({d0}): {generated} states generated, "
                  f"{len(states)} distinct states found, "
-                 f"{len(frontier) + len(carry)} states left on queue.")
+                 f"{len(frontier) + len(carry)} states left on queue."
+                 f"{obs.eta_suffix(len(states))}")
 
         # ---- the level-synchronous pool loop ----
         self._mp = multiprocessing.get_context("fork")
@@ -990,7 +1012,8 @@ class ParallelExplorer(Explorer):
                                 f"generated, {len(states)} distinct "
                                 f"states found, "
                                 f"{remaining + len(next_frontier)} "
-                                f"states left on queue.")
+                                f"states left on queue."
+                                f"{obs.eta_suffix(len(states))}")
                     lv["merge_wall"] += time.perf_counter() - m0
                 flush_level(len(next_frontier))
                 frontier = next_frontier

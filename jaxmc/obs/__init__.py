@@ -1,6 +1,7 @@
 r"""jaxmc.obs — run telemetry (phase spans, counters, per-level BFS
 metrics) with JSONL trace streaming, a JSON summary artifact, a
-watchdog heartbeat/stall monitor, and a cross-run report CLI.
+watchdog heartbeat/stall monitor, distributed trace context, a
+search-progress/ETA estimator, and a cross-run report CLI.
 
     from jaxmc import obs
 
@@ -15,21 +16,29 @@ watchdog heartbeat/stall monitor, and a cross-run report CLI.
 Engines report through `obs.current()` — a no-op NullTelemetry unless a
 real recorder is installed — so instrumentation costs nothing when no
 artifact was requested. See obs/telemetry.py for the model,
-obs/schema.py for the artifact schema (jaxmc.metrics/2),
-obs/watchdog.py for live stall diagnosis, and obs/report.py for
-`python -m jaxmc.obs report|diff` over artifacts.
+obs/schema.py for the artifact schema (jaxmc.metrics/3),
+obs/context.py for the JAXMC_TRACE_CTX propagation contract,
+obs/progress.py for the ETA estimator, obs/watchdog.py for live stall
+diagnosis, and obs/report.py for
+`python -m jaxmc.obs report|diff|timeline` over artifacts.
 """
 
+from . import context
 from .telemetry import (Logger, NullTelemetry, Telemetry, current,
                         device_mem_high_water, environment_meta,
-                        rss_bytes, use, use_local, write_json_atomic)
+                        prom_name, rss_bytes, use, use_local,
+                        write_json_atomic)
+from .context import TraceContext, child_env
+from .progress import ProgressEstimator, attach_estimator, eta_suffix
 from .schema import (CHECK_KEYS, HEARTBEAT_KEYS, REQUIRED_KEYS,
                      RESULT_KEYS, SCHEMA, SCHEMAS, STALL_KEYS,
                      validate_summary, validate_trace_event)
 from .watchdog import Watchdog
 
-__all__ = ["Logger", "NullTelemetry", "Telemetry", "Watchdog", "current",
-           "device_mem_high_water", "environment_meta", "rss_bytes",
+__all__ = ["Logger", "NullTelemetry", "Telemetry", "Watchdog",
+           "TraceContext", "ProgressEstimator", "attach_estimator",
+           "child_env", "context", "current", "device_mem_high_water",
+           "environment_meta", "eta_suffix", "prom_name", "rss_bytes",
            "use", "use_local", "write_json_atomic", "SCHEMA", "SCHEMAS",
            "REQUIRED_KEYS", "CHECK_KEYS", "RESULT_KEYS",
            "HEARTBEAT_KEYS", "STALL_KEYS", "validate_summary",

@@ -1,4 +1,5 @@
-r"""Cross-run metrics reporting: `python -m jaxmc.obs {report,diff}`.
+r"""Cross-run metrics reporting: `python -m jaxmc.obs
+{report,diff,timeline}`.
 
 PR 1 made one run legible (`--metrics-out` / `--trace`); this closes the
 loop ACROSS runs. Two subcommands, both pure stdlib (no jax import — the
@@ -15,6 +16,12 @@ against import rot):
                          demotions (tpu -> cpu -> interp). With
                          --fail-on-regress the exit status is 1 when
                          any flag fired, so the bench driver can gate.
+  timeline FILE [...]    merge multi-process trace JSONLs (daemon +
+                         device owner + per-job recorders) into one
+                         causally-ordered per-process-lane view;
+                         orphan spans and silent gaps are flagged and
+                         counted on a machine-parseable summary line
+                         (obs/timeline.py; --fail-on-orphans gates).
 
 Both input shapes normalize into one record (`load_record`):
   - a metrics artifact (schema jaxmc.metrics/1 or /2, obs/schema.py);
@@ -347,6 +354,28 @@ def cmd_report(args, out=sys.stdout) -> int:
                     if isinstance(pr, dict) else "?"
                 cells.append(f"{plat}=dead({str(why)[:40]})")
         hl.append("backend.oracle_probe[" + " ".join(cells) + "]")
+    # fleet-serve highlight row (PR 16): how the daemon ran this job —
+    # serve[warm=yes resumed=yes recompiles=0 batched_with=2] at a
+    # glance, same keys cmd_smoke asserts on
+    sv = s.get("serve")
+    if isinstance(sv, dict) and sv:
+        cells = []
+        if "warm_engine" in sv:
+            cells.append(f"warm={'yes' if sv['warm_engine'] else 'no'}")
+        if "resumed_from_checkpoint" in sv:
+            cells.append("resumed=" + (
+                "yes" if sv["resumed_from_checkpoint"] else "no"))
+        if "window_recompiles" in sv:
+            cells.append(f"recompiles={sv['window_recompiles']}")
+        bw = sv.get("batched_with")
+        if isinstance(bw, list) and bw:
+            cells.append(f"batched_with={len(bw)}")
+        if sv.get("cost_estimate") is not None:
+            cells.append(f"est={sv['cost_estimate']}")
+        if sv.get("job_wall_s") is not None:
+            cells.append(f"wall={_fmt_s(sv['job_wall_s'])}")
+        if cells:
+            hl.append("serve[" + " ".join(cells) + "]")
     if hl:
         print("highlights: " + "  ".join(hl), file=out)
     return 0 if rows else 1
@@ -569,10 +598,29 @@ def main(argv: Optional[List[str]] = None, out=sys.stdout) -> int:
                         "the per-phase wall gate (cold-start compile "
                         "walls flap with box load; states/sec and "
                         "demotion gates always apply)")
+    t = sub.add_parser(
+        "timeline",
+        help="merge multi-process trace JSONLs into one causally "
+             "ordered per-process-lane view (orphan spans + silent "
+             "gaps flagged)")
+    t.add_argument("files", nargs="+")
+    t.add_argument("--limit", type=int, default=200,
+                   help="max merged events to print (0 = all; the "
+                        "summary line always counts all)")
+    t.add_argument("--gap-threshold", type=float, default=30.0,
+                   metavar="SECONDS",
+                   help="flag a lane silent for longer than this "
+                        "(default 30s)")
+    t.add_argument("--fail-on-orphans", action="store_true",
+                   help="exit 1 when any lane's parent span resolves "
+                        "to no known process (trace-check gate)")
     args = ap.parse_args(argv)
     try:
         if args.cmd == "report":
             return cmd_report(args, out)
+        if args.cmd == "timeline":
+            from .timeline import cmd_timeline
+            return cmd_timeline(args, out)
         if len(args.files) < 2:
             print("error: diff needs at least two artifacts",
                   file=sys.stderr)

@@ -6,8 +6,15 @@ ValueError with the missing/ill-typed field names — it is deliberately
 structural (required keys + types + level-index monotonicity), not
 exhaustive: engines are free to add fields.
 
-Trace JSONL event grammar (one JSON object per line, `ev` discriminates):
+Trace JSONL event grammar (one JSON object per line, `ev` discriminates;
+since jaxmc.metrics/3 every event also carries `tid` — the fleet-wide
+trace id, obs/context.py):
 
+  proc_meta  {t, mono, pid, argv, psid, parent_span, env}
+                                           -- per-file header (first
+                                              line): process identity +
+                                              span lineage + monotonic
+                                              clock anchor
   run_start  {t, meta}
   span_open  {name, t, parent, attrs}      -- partial-span forensics
   span       {name, t0, wall_s, attrs[, error]}
@@ -355,17 +362,64 @@ jaxmc.metrics/2 artifact minus the new optional surface, so readers and
       ceiling), gauges `profile.predicted_states` (the proven
       ceiling) and `profile.predicted_caps` (the buckets sized from
       it — a cold run then pays zero growth-retry recompiles).
+
+  jaxmc.metrics/3  (PR 16) adds, all optional — the fleet-wide
+   distributed-tracing + live-exposition surface; every /2 artifact
+   remains valid (readers accept both):
+    - trace-context propagation (obs/context.py): every trace event
+      carries `tid` (16-hex fleet-wide trace id); every trace FILE
+      opens with a `proc_meta` header {t, mono, pid, argv, psid,
+      parent_span, env} — `psid` is this process's span id,
+      `parent_span` the span of whoever spawned it (inherited over
+      the JAXMC_TRACE_CTX env var as "<trace_id>:<parent_span_id>";
+      absent -> this process is a trace root and `parent_span` is
+      null).  Fork-pool workers (engine/parallel.py) write no files;
+      the parent emits one `parallel.worker_span {pid, span, parent,
+      level}` event per worker instead.  `python -m jaxmc.obs
+      timeline` reconstructs the process tree from exactly these two
+      shapes and flags orphan spans (a `parent_span` resolving to no
+      known `psid`/worker span — a broken propagation hop).
+    - search-progress estimation (obs/progress.py): trace event
+      `progress_estimate {estimate, source}` when analyze's bounds
+      fixpoint proved a state-space ceiling; gauge
+      `search.progress_est` (fraction of the estimate explored, live
+      during the run); heartbeat events gain `progress_fraction` /
+      `progress_eta_s` / `progress_verdict` ("est" | "unbounded" —
+      unbounded when no estimate exists or the observed distinct
+      count exceeded it); `--progress-every` stdout lines (and their
+      `log` mirrors) gain the same "~N% of est. M states, ETA Ks"
+      suffix, including the immediate first line.
+    - live exposition (serve/daemon.py): `GET /metrics` renders the
+      daemon's counters/gauges plus per-job series in Prometheus
+      text format 0.0.4.  Name grammar: `jaxmc_` + the internal
+      dotted name with every character outside [a-zA-Z0-9_] mapped
+      to `_` (obs.prom_name — e.g. `serve.queue_depth` ->
+      `jaxmc_serve_queue_depth`, `search.progress_est` ->
+      `jaxmc_search_progress_est`); per-job samples carry a
+      `{job="<id>"}` label; derived per-job series:
+      `jaxmc_job_running`, `jaxmc_job_levels`,
+      `jaxmc_job_states_per_sec`, `jaxmc_job_progress_distinct`,
+      `jaxmc_job_progress_eta_s`.  `GET /jobs/<id>/events` serves
+      the job's bounded in-memory event ring (JAXMC_TRACE_RING,
+      default 256 events) readable MID-RUN; `GET /status` gains a
+      `progress` block {job id -> progress snapshot}.  Scrapes never
+      block job threads (bounded ring + lock-copy snapshots).
+    - per-job watchdogs (serve fleet): each in-daemon job and each
+      owner-side solo job runs its own obs.Watchdog over the job's
+      Telemetry, so one slow tenant cannot mask another job's stall;
+      job heartbeat/stall events land in the per-job trace
+      (`<spool>/results/<id>.trace.jsonl`) and ring.
 """
 
 from __future__ import annotations
 
 from typing import Any, Dict
 
-SCHEMA = "jaxmc.metrics/2"
+SCHEMA = "jaxmc.metrics/3"
 
 # every schema revision an artifact may carry and a reader must accept
 # (additive history: a v1 artifact simply lacks the v2 optional surface)
-SCHEMAS = ("jaxmc.metrics/1", "jaxmc.metrics/2")
+SCHEMAS = ("jaxmc.metrics/1", "jaxmc.metrics/2", "jaxmc.metrics/3")
 
 # top-level summary keys every artifact must carry
 REQUIRED_KEYS = ("schema", "started_at", "wall_s", "phases", "counters",

@@ -21,6 +21,7 @@ user asked for an artifact.
 
 from __future__ import annotations
 
+import collections
 import json
 import os
 import sys
@@ -28,7 +29,13 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from . import context as trace_context
 from .schema import SCHEMA  # one source of truth for the artifact schema
+
+# every live recorder keeps the last N trace events in memory (the
+# serve daemon's GET /jobs/<id>/events reads them mid-run); bounded so
+# a long search cannot grow the daemon without limit
+_RING_MAX = int(os.environ.get("JAXMC_TRACE_RING", "256") or "256")
 
 
 def write_json_atomic(path: str, obj) -> None:
@@ -97,6 +104,12 @@ class NullTelemetry:
     enabled = False
     progress_seq = 0  # never advances: a watchdog on a null recorder
     # would see an eternal stall, so Watchdog refuses to start on one
+    progress_est = None  # a ProgressEstimator when one is attached
+    # (obs/progress.py); engines read it via getattr, so the null
+    # recorder's class attribute keeps the hot path allocation-free
+
+    def recent_events(self) -> List[Dict[str, Any]]:
+        return []
 
     def span(self, name: str, **attrs):
         return _NULL_SPAN
@@ -177,23 +190,50 @@ class Telemetry(NullTelemetry):
         self.counters: Dict[str, int] = {}
         self.gauges: Dict[str, Any] = {}
         self.levels: List[Dict[str, Any]] = []
+        self.progress_est = None  # attached by obs.progress when the
+        # model binds and analyze offers a state-space estimate
+        self._ring: collections.deque = collections.deque(maxlen=_RING_MAX)
+        # the trace context is derived once per process; every event
+        # this recorder emits is stamped with its trace_id so fleet
+        # artifacts merge into one causally-ordered timeline
+        self.ctx = trace_context.get()
         self._trace_fh = None
         if trace_path:
             self._trace_fh = open(trace_path, "w", encoding="utf-8")
+        # the per-file meta header (ISSUE 16): pid/argv/env fingerprint
+        # plus a monotonic-clock anchor, so `obs timeline` can place
+        # this file's process in the trace tree and skew-align its
+        # wall-clock timestamps against the other processes'
+        self._emit({"ev": "proc_meta", "t": self.t_start,
+                    "mono": time.monotonic(), "pid": os.getpid(),
+                    "argv": list(sys.argv), "psid": self.ctx.span_id,
+                    "parent_span": self.ctx.parent_span_id,
+                    "env": environment_meta()})
         self._emit({"ev": "run_start", "t": self.t_start,
                     "meta": _jsonable(self.meta)})
 
     # ---- trace stream ----
     def _emit(self, obj: Dict[str, Any]) -> None:
-        fh = self._trace_fh
-        if fh is None:
-            return
+        obj.setdefault("tid", self.ctx.trace_id)
         with self._lock:
+            # the in-memory ring is fed even with no trace file: the
+            # serve daemon reads it live for /jobs/<id>/events
+            self._ring.append(obj)
+            fh = self._trace_fh
+            if fh is None:
+                return
             try:
                 fh.write(json.dumps(obj) + "\n")
                 fh.flush()
             except ValueError:  # closed file: late event after close()
                 pass
+
+    def recent_events(self) -> List[Dict[str, Any]]:
+        """A snapshot of the last ~_RING_MAX trace events (newest last).
+        Short critical section only — safe to call from a scrape thread
+        while engine threads emit."""
+        with self._lock:
+            return list(self._ring)
 
     # ---- spans ----
     def _stack(self) -> List[str]:
@@ -265,6 +305,18 @@ class Telemetry(NullTelemetry):
             self.progress_seq += 1
             self.levels.append(rec)
         self._emit(dict(rec, ev="level", t=self._clock()))
+        pe = self.progress_est
+        if pe is not None:  # feed the ETA estimator per level, so the
+            # `search.progress_est` gauge moves with the frontier even
+            # between --progress-every lines
+            if rec.get("distinct") is not None:
+                fr = pe.observe(distinct=rec["distinct"])
+            elif rec.get("new") is not None:
+                fr = pe.observe(new=rec["new"])
+            else:
+                fr = None
+            if fr is not None:
+                self.gauge("search.progress_est", fr)
 
     def reset_levels(self, reason: str = "") -> None:
         """A search RESTART (hybrid demotion, adaptive relayout) replays
@@ -305,6 +357,15 @@ class Telemetry(NullTelemetry):
                                 if isinstance(r.get("wall_s"),
                                               (int, float))],
             }
+
+    def metrics_snapshot(self) -> Dict[str, Any]:
+        """Consistent copies of the scalar surfaces for a live scrape
+        (the serve daemon's /metrics) — short critical section, never
+        blocks the emitting threads for long."""
+        with self._lock:
+            return {"counters": dict(self.counters),
+                    "gauges": dict(self.gauges),
+                    "levels": list(self.levels)}
 
     # ---- rollup ----
     def phase_list(self) -> List[Dict[str, Any]]:
@@ -441,6 +502,16 @@ class Logger:
             self.sink(msg)
         tel = self.tel if self.tel is not None else current()
         tel.log_line(msg)
+
+
+def prom_name(name: str) -> str:
+    """Map an internal dotted metric name onto the Prometheus exposition
+    grammar (documented in obs/schema.py): `jaxmc_` prefix, every char
+    outside [a-zA-Z0-9_] replaced by `_`.  `serve.warm_hits` ->
+    `jaxmc_serve_warm_hits`."""
+    return "jaxmc_" + "".join(
+        c if (c.isascii() and (c.isalnum() or c == "_")) else "_"
+        for c in name)
 
 
 def rss_bytes() -> Optional[int]:

@@ -140,13 +140,20 @@ class Watchdog:
             self._stalled = False
         stalled_for = now - self._last_change_t
         tel.counter("watchdog.heartbeats")
-        tel.event("heartbeat",
-                  wall_s=round(max(now - tel.t_start, 0.0), 3),
-                  rss_bytes=rss_bytes(),
-                  open_spans=snap["open_spans"],
-                  last_level=snap["last_level"],
-                  progress_seq=snap["progress_seq"],
-                  stalled_for_s=round(stalled_for, 3))
+        beat = dict(
+            wall_s=round(max(now - tel.t_start, 0.0), 3),
+            rss_bytes=rss_bytes(),
+            open_spans=snap["open_spans"],
+            last_level=snap["last_level"],
+            progress_seq=snap["progress_seq"],
+            stalled_for_s=round(stalled_for, 3))
+        pe = getattr(tel, "progress_est", None)
+        if pe is not None:  # ISSUE 16: the beat carries the live ETA
+            ps = pe.snapshot()
+            beat.update(progress_fraction=ps["fraction"],
+                        progress_eta_s=ps["eta_s"],
+                        progress_verdict=ps["verdict"])
+        tel.event("heartbeat", **beat)
         threshold = self.stall_threshold_s(snap["level_walls"])
         if stalled_for >= threshold and not self._stalled:
             self._stalled = True

@@ -28,8 +28,15 @@ Telemetry: the daemon owns one fleet Telemetry (per-job `job` spans,
 queue-depth/warm-hit/batched-jobs gauges, watchdog heartbeats); each
 job ALSO records into a private per-thread recorder (obs.use_local) so
 its own spans/levels/counters land in `<spool>/results/<id>.json` as a
-normal jaxmc.metrics/2 artifact — `python -m jaxmc.obs report/diff`
-works on serve results unchanged.
+normal jaxmc.metrics/3 artifact — `python -m jaxmc.obs report/diff`
+works on serve results unchanged.  Each job's recorder additionally
+writes a per-job trace (`<spool>/results/<id>.trace.jsonl`, trace
+context inherited from the daemon so `obs timeline` stitches daemon +
+owner + job into one tree), keeps a bounded in-memory event ring
+served live at `GET /jobs/<id>/events`, and runs under its OWN
+watchdog (a slow tenant cannot mask another job's stall).  `GET
+/metrics` renders the whole fleet as Prometheus text without ever
+touching a job thread.
 """
 
 from __future__ import annotations
@@ -141,6 +148,16 @@ class ServeDaemon:
         # options): the admission path pays the model load + bounds
         # fixpoint once per content, not once per submission
         self._bprof_cache: Dict[Any, Any] = {}
+        # LIVE EXPOSITION (ISSUE 16): jid -> the job's Telemetry while
+        # it runs IN THIS PROCESS (GET /metrics per-job series, GET
+        # /jobs/<id>/events, /status progress); finished jobs keep
+        # their last ring-buffer snapshot in a small bounded LRU.
+        # Owner-process jobs have no in-daemon recorder — their events
+        # endpoint reads the tail of the job's trace file instead.
+        self._job_tels: Dict[str, Any] = {}
+        self._done_events: "collections.OrderedDict[str, list]" = \
+            collections.OrderedDict()
+        self._done_events_max = 16
 
     # ---- lifecycle ----------------------------------------------------
     def start(self) -> "ServeDaemon":
@@ -208,12 +225,32 @@ class ServeDaemon:
             def do_GET(self):
                 if self.path == "/status":
                     return self._json(200, daemon.status())
+                if self.path == "/metrics":
+                    # Prometheus text exposition; the snapshot copies
+                    # are short-critical-section, so a scraper can poll
+                    # aggressively without blocking job threads
+                    body = daemon.metrics_text().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 if self.path == "/jobs":
                     return self._json(200,
                                       {"jobs": daemon.q.list_jobs()})
                 if self.path.startswith("/jobs/"):
                     parts = self.path.split("/")
                     jid = parts[2] if len(parts) > 2 else ""
+                    if len(parts) == 4 and parts[3] == "events":
+                        evs = daemon.job_events(jid)
+                        if evs is None:
+                            return self._json(
+                                404, {"error": f"no events for {jid}"})
+                        return self._json(200, {"job": jid,
+                                                "events": evs})
                     if len(parts) == 4 and parts[3] == "result":
                         res = daemon.q.load_result(jid)
                         if res is None:
@@ -579,10 +616,33 @@ class ServeDaemon:
                                   sess.layout_sig, tel=job_tel,
                                   variant=variant)
 
+    def _job_trace_path(self, jid: str) -> str:
+        """The job's JSONL trace artifact (next to its result JSON) —
+        one lane of the fleet's `obs timeline` view."""
+        return os.path.join(self.q.results_dir, f"{jid}.trace.jsonl")
+
+    def _register_job_tel(self, jids: List[str], job_tel) -> None:
+        with self._cv:
+            for j in jids:
+                self._job_tels[j] = job_tel
+
+    def _unregister_job_tel(self, jids: List[str], job_tel) -> None:
+        """Drop the live registration; the leader keeps its final ring
+        snapshot in the bounded done-LRU so /jobs/<id>/events stays
+        answerable briefly after completion."""
+        with self._cv:
+            for j in jids:
+                if self._job_tels.get(j) is job_tel:
+                    del self._job_tels[j]
+            if jids:
+                self._done_events[jids[0]] = job_tel.recent_events()
+                self._done_events.move_to_end(jids[0])
+                while len(self._done_events) > self._done_events_max:
+                    self._done_events.popitem(last=False)
+
     def _run_batch(self, job: Dict[str, Any],
                    followers: List[Dict[str, Any]]) -> None:
         jid, sig = job["id"], job["sig"]
-        t0 = time.time()
         cfg = build_config(job["spec"], job.get("cfg"),
                            job.get("options"))
         if cfg.backend == "interp" and not cfg.workers:
@@ -598,10 +658,29 @@ class ServeDaemon:
         cfg.checkpoint = ck
         cfg.checkpoint_every = self.checkpoint_every
         cfg.final_checkpoint = True
-        job_tel = obs.Telemetry(meta={
-            "command": "serve.job", "job": jid, "sig": sig,
-            "backend": cfg.backend, "spec": job["spec"],
-            "cfg": job.get("cfg"), "env": obs.environment_meta()})
+        job_tel = obs.Telemetry(
+            trace_path=self._job_trace_path(jid),
+            meta={"command": "serve.job", "job": jid, "sig": sig,
+                  "backend": cfg.backend, "spec": job["spec"],
+                  "cfg": job.get("cfg"), "env": obs.environment_meta()})
+        # per-JOB watchdog (ISSUE 16): the stall threshold derives from
+        # THIS job's level rhythm — concurrent tenants no longer share
+        # one threshold built from their mixed median level wall
+        jwd = obs.Watchdog(job_tel)
+        jids = [j["id"] for j in [job] + followers]
+        self._register_job_tel(jids, job_tel)
+        jwd.start()
+        try:
+            self._run_batch_inner(job, followers, cfg, ck, job_tel)
+        finally:
+            jwd.stop()
+            self._unregister_job_tel(jids, job_tel)
+
+    def _run_batch_inner(self, job: Dict[str, Any],
+                         followers: List[Dict[str, Any]],
+                         cfg, ck: str, job_tel) -> None:
+        jid, sig = job["id"], job["sig"]
+        t0 = time.time()
         for j in [job] + followers:
             self.q.mark(j["id"], "running", started_at=t0,
                         batch_leader=jid if j is not job else None)
@@ -749,7 +828,8 @@ class ServeDaemon:
               "options": job.get("options"), "sig": sig,
               "jids": [j["id"] for j in jobs],
               "checkpoint": self.q.ckpt_path(sig),
-              "checkpoint_every": self.checkpoint_every}
+              "checkpoint_every": self.checkpoint_every,
+              "trace": self._job_trace_path(jid)}
         from .owner import OwnerDied
         with self.tel.span("job", id=jid, sig=sig, spec=job["spec"],
                            owner=True, batched=len(followers)):
@@ -830,7 +910,8 @@ class ServeDaemon:
                  "cfg": groups[s][0].get("cfg"),
                  "options": groups[s][0].get("options"),
                  "sig": s, "bsig": job.get("bsig"),
-                 "jids": [j["id"] for j in groups[s]]}
+                 "jids": [j["id"] for j in groups[s]],
+                 "trace": self._job_trace_path(groups[s][0]["id"])}
                 for s in order]
         for s in order:
             for j in groups[s]:
@@ -988,6 +1069,91 @@ class ServeDaemon:
         self.tel.gauge("serve.workers", self.n_workers)
         self.tel.gauge("serve.draining", self._draining)
 
+    def job_events(self, jid: str) -> Optional[list]:
+        """Recent trace events for one job, readable MID-RUN: the live
+        ring buffer for in-daemon jobs, the trace-file tail for
+        owner-process jobs, the retained ring for recently finished
+        ones.  None when nothing is known about the job."""
+        with self._cv:
+            jt = self._job_tels.get(jid)
+            done = self._done_events.get(jid)
+        if jt is not None:
+            return jt.recent_events()
+        if done is not None:
+            return list(done)
+        try:  # owner-process jobs: their Telemetry streams to the
+            # spool trace file, flushed per event — tail it
+            with open(self._job_trace_path(jid),
+                      encoding="utf-8") as fh:
+                lines = fh.readlines()[-256:]
+            out = []
+            for ln in lines:
+                try:
+                    out.append(json.loads(ln))
+                except ValueError:
+                    pass  # torn final line of a live writer
+            return out
+        except OSError:
+            return None
+
+    def metrics_text(self) -> str:
+        """The GET /metrics body: Prometheus text exposition 0.0.4 over
+        the fleet counters/gauges plus per-running-job series labeled
+        {job="<id>"} (name grammar in obs/schema.py).  Built from
+        short-critical-section snapshots — never blocks job threads."""
+        self._update_gauges()
+        fleet = self.tel.metrics_snapshot()
+        with self._cv:
+            jobs = dict(self._job_tels)
+        # family name -> (type, [(label_str, value)])
+        fams: Dict[str, Tuple[str, list]] = {}
+
+        def add(name, value, typ="gauge", jid=None):
+            if isinstance(value, bool):
+                value = int(value)
+            if not isinstance(value, (int, float)):
+                return
+            fam = fams.setdefault(obs.prom_name(name), (typ, []))
+            lbl = "" if jid is None else \
+                '{job="%s"}' % str(jid).replace('"', "'")
+            fam[1].append((lbl, value))
+
+        for name, v in fleet["counters"].items():
+            add(name, v, "counter")
+        for name, v in fleet["gauges"].items():
+            add(name, v, "gauge")
+        now = time.time()
+        seen_tels = set()
+        for jid, jt in sorted(jobs.items()):
+            if id(jt) in seen_tels:
+                continue  # followers share the leader's recorder
+            seen_tels.add(id(jt))
+            add("job.running", 1, jid=jid)
+            snap = jt.metrics_snapshot()
+            for gname, gval in snap["gauges"].items():
+                add(gname, gval, jid=jid)
+            if snap["levels"]:
+                add("job.levels", len(snap["levels"]), jid=jid)
+            gen = sum(lv.get("generated") or 0
+                      for lv in snap["levels"])
+            wall = max(now - jt.t_start, 1e-9)
+            if gen:
+                add("job.states_per_sec", round(gen / wall, 3),
+                    jid=jid)
+            pe = jt.progress_est
+            if pe is not None:
+                ps = pe.snapshot()
+                add("job.progress_distinct", ps["distinct"], jid=jid)
+                if ps["eta_s"] is not None:
+                    add("job.progress_eta_s", ps["eta_s"], jid=jid)
+        lines = []
+        for name in sorted(fams):
+            typ, samples = fams[name]
+            lines.append(f"# TYPE {name} {typ}")
+            for lbl, value in samples:
+                lines.append(f"{name}{lbl} {value}")
+        return "\n".join(lines) + "\n"
+
     def status(self) -> Dict[str, Any]:
         self._update_gauges()
         with self._cv:
@@ -995,7 +1161,16 @@ class ServeDaemon:
             running = {jid: s for jid, (s, _t)
                        in self._running.items()}
             warm = {s: w["session"] for s, w in self.warm.items()}
+            job_tels = dict(self._job_tels)
+        # live per-job search progress (ISSUE 16): fraction/ETA from
+        # the job's estimator, `unbounded` when analyze offered none
+        progress = {}
+        for jid, jt in job_tels.items():
+            pe = jt.progress_est
+            if pe is not None:
+                progress[jid] = pe.snapshot()
         return {
+            "progress": progress,
             "spool": self.q.root,
             "queue_depth": len(pending),
             "pending": pending,

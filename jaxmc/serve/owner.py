@@ -80,7 +80,7 @@ def run_vbatch(members_desc: List[Dict[str, Any]]) -> Dict[str, Any]:
     for md in members_desc:
         cfg = build_config(md["spec"], md.get("cfg"), md.get("options"))
         cfgs.append(cfg)
-        tels.append(obs.Telemetry(meta={
+        tels.append(obs.Telemetry(trace_path=md.get("trace"), meta={
             "command": "serve.job", "job": md["jids"][0],
             "sig": md.get("sig"), "bsig": md.get("bsig"),
             "backend": cfg.backend, "spec": md["spec"],
@@ -151,12 +151,15 @@ def run_solo(md: Dict[str, Any]) -> Dict[str, Any]:
         cfg.final_checkpoint = True
         if os.path.exists(md["checkpoint"]):
             cfg.resume = md["checkpoint"]
-    jt = obs.Telemetry(meta={
+    jt = obs.Telemetry(trace_path=md.get("trace"), meta={
         "command": "serve.job", "job": md["jids"][0],
         "sig": md.get("sig"), "backend": cfg.backend,
         "spec": md["spec"], "cfg": md.get("cfg"),
         "env": obs.environment_meta()})
     resumed = bool(cfg.resume)
+    # per-JOB watchdog (ISSUE 16): the stall threshold derives from
+    # this job's own level rhythm, never a neighbour's
+    wd = obs.Watchdog(jt).start()
     try:
         with obs.use_local(jt):
             sess = CheckSession(cfg, tel=jt,
@@ -172,6 +175,8 @@ def run_solo(md: Dict[str, Any]) -> Dict[str, Any]:
         # verdict; the owner loop must survive to serve the next one
         jt.close()
         return {"error": f"{type(ex).__name__}: {ex}"}
+    finally:
+        wd.stop()
     return _member_summary(res, jt, cfg.backend, md["spec"], {
         "sig": md.get("sig"), "warm_engine": False,
         "resumed_from_checkpoint": resumed,
@@ -255,7 +260,12 @@ class DeviceOwner:
         self._proc = self._mp.Process(target=_owner_main, args=(child,),
                                       name="jaxmc-device-owner",
                                       daemon=True)
-        self._proc.start()
+        # the spawn context snapshots os.environ at start(): export the
+        # trace header for that window so the owner (and every job it
+        # runs) joins the daemon's trace — a respawned owner re-reads
+        # the SAME header, keeping the original trace_id
+        with obs.context.exported():
+            self._proc.start()
         child.close()
         self._conn = parent
         self.spawns += 1

@@ -8,12 +8,24 @@ Endpoints (all JSON bodies/responses; the daemon binds 127.0.0.1):
   GET  /jobs          -> {jobs: [job records]}
   GET  /jobs/<id>     -> job record (+ "result" summary once done)
   GET  /jobs/<id>/result
-                      -> the job's full jaxmc.metrics/2 artifact
+                      -> the job's full jaxmc.metrics/3 artifact
                          (result block carries ok/counts/violation and
                          the rendered counterexample trace), 404 before
                          completion
+  GET  /jobs/<id>/events
+                      -> {id, events: [...]} — the job's bounded
+                         in-memory trace-event ring (JAXMC_TRACE_RING,
+                         default 256), readable MID-RUN; falls back to
+                         the persisted per-job trace tail after the
+                         daemon forgets the ring; 404 when neither
+                         exists
+  GET  /metrics       -> Prometheus text format 0.0.4 (fleet counters
+                         and gauges as jaxmc_serve_*, per-job series
+                         labeled {job="<id>"} incl. the live
+                         jaxmc_search_progress_est fraction); never
+                         blocks job threads
   GET  /status        -> {queue_depth, running, warm_sessions, workers,
-                          draining, counters, gauges}
+                          draining, counters, gauges, progress}
   POST /drain         -> initiate the graceful drain (same path as
                          SIGTERM); 200 {draining: true}
 

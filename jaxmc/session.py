@@ -314,6 +314,10 @@ class CheckSession:
         with self.tel.span("load", spec=cfg.spec):
             self.model = load_model(cfg.spec, cfg.cfg, cfg.no_deadlock,
                                     cfg.include)
+        # ISSUE 16: hang a search-progress estimator off the recorder —
+        # the analyze bound (when one exists) turns every progress line,
+        # heartbeat and /status poll into a fraction-explored + ETA
+        obs.attach_estimator(self.tel, self.model)
         self.kind = "model"
         self.stage = "parse"
         return self.kind

@@ -1,0 +1,284 @@
+r"""`python -m jaxmc.tracecheck` — the `make trace-check` gate.
+
+End-to-end proof of the PR-16 observability contract, in one process,
+against a fresh spool:
+
+  1. boot an in-process serve daemon (2 worker threads, fleet trace,
+     device-owner routing ON) and submit a deliberately SLOW interp job
+     (a CONSTRAINT-bounded grid whose actions carry an expensive
+     bounded-quantifier guard, so analyze proves a state-space estimate
+     AND the search lasts long enough to scrape mid-run) with
+     --workers 2, so the fork pool spawns real worker processes;
+  2. while that job runs, poll GET /metrics and assert (a) every
+     sample line parses as Prometheus text 0.0.4, (b) the per-job
+     jaxmc_search_progress_est{job="<id>"} gauge is present and MOVES
+     between scrapes, (c) GET /jobs/<id>/events answers mid-run from
+     the bounded ring;
+  3. resubmit the identical job — the warm counters must move
+     (serve.warm_hits via the signature-keyed warm registry);
+  4. run one jax resident job, which device-owner routing sends to the
+     spawned owner process — a third OS process in the trace;
+  5. merge the daemon trace + every per-job trace with `python -m
+     jaxmc.obs timeline --fail-on-orphans` and assert the summary line
+     counts >= 3 distinct processes and ZERO orphan spans (every
+     process joined the fleet trace through JAXMC_TRACE_CTX);
+  6. gate the warm artifact against the cold one with `obs diff
+     --fail-on-regress`.
+
+Exit 0 only when every assertion holds; each failure prints one
+`trace-check: FAIL: ...` line.  `make bench-check` runs this after the
+serve smoke.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import io
+import json
+import os
+import re
+import sys
+import tempfile
+import time
+import urllib.request
+from typing import List, Optional
+
+# the slow scrape target: ~230 distinct states over 21 levels, frontier
+# wide enough (> workers*4) that the interp fork pool actually forks,
+# CONSTRAINT-bounded tightly enough that the analyze interval fixpoint
+# converges BEFORE widening (~30 iterations) and proves an estimate;
+# the \A guard costs ~Q interpreter steps per successor, which is what
+# makes the search last seconds instead of milliseconds
+_SLOW_SPEC = """\
+-------------------------- MODULE traceload --------------------------
+EXTENDS Naturals
+
+VARIABLES a, b
+
+Slow == \\A i \\in 1 .. {q} : i + a >= 0
+
+Init == a = 0 /\\ b = 0
+
+Next == \\/ a' = a + 1 /\\ b' = b /\\ Slow
+        \\/ b' = b + 1 /\\ a' = a /\\ Slow
+
+Bound == a + b <= {bound}
+
+TypeInv == a >= 0 /\\ b >= 0
+
+Spec == Init /\\ [][Next]_<<a, b>>
+======================================================================
+"""
+
+_SLOW_CFG = """\
+SPECIFICATION Spec
+CONSTRAINT Bound
+INVARIANT TypeInv
+CHECK_DEADLOCK FALSE
+"""
+
+# one Prometheus 0.0.4 sample line: name{labels}? value
+_SAMPLE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})?"
+    r" -?\d+(\.\d+)?([eE][-+]?\d+)?$")
+
+
+def _scrape(host: str, port: int, timeout: float = 10.0) -> str:
+    url = f"http://{host}:{port}/metrics"
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        ctype = resp.headers.get("Content-Type", "")
+        assert "text/plain" in ctype, f"/metrics Content-Type {ctype!r}"
+        return resp.read().decode()
+
+
+def _prom_errors(text: str) -> List[str]:
+    return [ln for ln in text.splitlines()
+            if ln and not ln.startswith("#") and not _SAMPLE.match(ln)]
+
+
+def _value(text: str, name: str, jid: Optional[str] = None
+           ) -> Optional[float]:
+    want = name + ('{job="%s"} ' % jid if jid else " ")
+    for ln in text.splitlines():
+        if ln.startswith(want):
+            return float(ln.rsplit(" ", 1)[1])
+    return None
+
+
+def _summary_counts(timeline_text: str) -> dict:
+    """The trailing machine-parseable line of `obs timeline`."""
+    for ln in reversed(timeline_text.splitlines()):
+        if ln.startswith("summary: "):
+            return {k: int(v) for k, v in
+                    (kv.split("=") for kv in ln[len("summary: "):]
+                     .split())}
+    raise AssertionError(f"no summary line in timeline output:\n"
+                         f"{timeline_text[-500:]}")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m jaxmc.tracecheck",
+        description="the make trace-check observability gate")
+    ap.add_argument("--spool", default=None,
+                    help="default: a fresh temp dir")
+    ap.add_argument("--timeout", type=float, default=300.0)
+    ap.add_argument("--slow-q", type=int, default=1500,
+                    help="quantifier width of the slow job's guard "
+                         "(scales its wall time; ~1500 -> ~5-10s)")
+    ap.add_argument("--bound", type=int, default=20,
+                    help="grid CONSTRAINT bound (must stay small "
+                         "enough that the bounds fixpoint converges)")
+    args = ap.parse_args(argv)
+
+    from .obs.report import main as obs_main
+    from .serve.daemon import ServeDaemon
+    from .serve.protocol import ServeClient
+
+    spool = args.spool or tempfile.mkdtemp(prefix="jaxmc_trace_check_")
+    # hermetic durable state + the observability knobs under test:
+    # device work in a spawned owner process (a third OS process for
+    # the timeline), fast heartbeats so the slow job's ring carries
+    # progress-stamped beats within the scrape window
+    os.environ["JAXMC_SERVE_DEVICE_OWNER"] = "1"
+    os.environ.setdefault("JAXMC_PROFILE_STORE",
+                          os.path.join(spool, "profiles"))
+    os.environ.setdefault("JAXMC_HEARTBEAT_EVERY", "2")
+
+    spec_dir = os.path.join(spool, "specs")
+    os.makedirs(spec_dir, exist_ok=True)
+    slow_spec = os.path.join(spec_dir, "traceload.tla")
+    with open(slow_spec, "w", encoding="utf-8") as fh:
+        fh.write(_SLOW_SPEC.format(q=args.slow_q, bound=args.bound))
+    with open(os.path.join(spec_dir, "traceload.cfg"), "w",
+              encoding="utf-8") as fh:
+        fh.write(_SLOW_CFG)
+    jax_spec = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "specs", "constoy.tla")
+
+    daemon_trace = os.path.join(spool, "daemon.trace.jsonl")
+    daemon = ServeDaemon(spool, workers=2, trace=daemon_trace,
+                         quiet=False).start()
+    failures: List[str] = []
+    try:
+        client = ServeClient("127.0.0.1", daemon.port)
+        slow_opts = {"backend": "interp", "workers": 2,
+                     "progress_every": 2}
+
+        # ---- 1+2: the slow job, scraped live --------------------------
+        code, job = client.submit(slow_spec, None, slow_opts)
+        assert code == 200, f"slow submit failed ({code}): {job}"
+        jid = job["id"]
+        est_samples: List[float] = []
+        prom_errors: List[str] = []
+        events_midrun = False
+        deadline = time.time() + args.timeout
+        while True:
+            _, rec = client.job(jid)
+            st = rec.get("status")
+            text = _scrape("127.0.0.1", daemon.port)
+            prom_errors.extend(_prom_errors(text))
+            v = _value(text, "jaxmc_search_progress_est", jid)
+            if v is not None and st == "running":
+                est_samples.append(v)
+            if not events_midrun and st == "running":
+                ecode, ebody = client._request(
+                    "GET", f"/jobs/{jid}/events")
+                events_midrun = ecode == 200 and \
+                    bool(ebody.get("events"))
+            if st in ("done", "failed", "drained"):
+                break
+            if time.time() > deadline:
+                raise AssertionError(
+                    f"slow job still {st!r} after {args.timeout}s")
+            time.sleep(0.4)
+        assert st == "done", \
+            f"slow job ended {st!r}: {rec.get('error')}"
+        if prom_errors:
+            failures.append(
+                f"/metrics lines failed Prometheus parse: "
+                f"{prom_errors[:3]}")
+        if len(set(est_samples)) < 2 or \
+                (est_samples and est_samples[-1] <= est_samples[0]):
+            failures.append(
+                f"per-job search.progress_est did not move mid-run "
+                f"(samples: {est_samples[:8]}); slow the job down "
+                f"with --slow-q")
+        if not events_midrun:
+            failures.append(
+                "GET /jobs/<id>/events never answered mid-run")
+
+        # ---- 3: warm resubmission — the warm counters must move -------
+        code, wjob = client.submit(slow_spec, None, slow_opts)
+        assert code == 200, f"warm submit failed ({code}): {wjob}"
+        wrec = client.wait(wjob["id"], timeout=args.timeout)
+        assert wrec["status"] == "done", \
+            f"warm job ended {wrec['status']!r}: {wrec.get('error')}"
+        text = _scrape("127.0.0.1", daemon.port)
+        warm_hits = _value(text, "jaxmc_serve_warm_hits")
+        submitted = _value(text, "jaxmc_serve_jobs_submitted")
+        if not warm_hits:
+            failures.append(
+                f"serve.warm_hits did not move on the identical "
+                f"resubmission (jaxmc_serve_warm_hits={warm_hits})")
+        if not submitted or submitted < 2:
+            failures.append(
+                f"jaxmc_serve_jobs_submitted={submitted}, expected "
+                f">= 2")
+        if _value(text, "jaxmc_serve_queue_depth") is None:
+            failures.append("jaxmc_serve_queue_depth missing from "
+                            "/metrics")
+
+        # ---- 4: one jax job through the device-owner process ----------
+        code, ojob = client.submit(jax_spec, None, {
+            "backend": "jax", "platform": "cpu", "resident": True,
+            "no_trace": True})
+        assert code == 200, f"owner submit failed ({code}): {ojob}"
+        orec = client.wait(ojob["id"], timeout=args.timeout)
+        assert orec["status"] == "done", \
+            f"owner job ended {orec['status']!r}: {orec.get('error')}"
+
+        # ---- 5: one timeline over every process's trace ---------------
+        traces = [daemon_trace] + sorted(glob.glob(
+            os.path.join(spool, "results", "*.trace.jsonl")))
+        buf = io.StringIO()
+        rc = obs_main(["timeline", "--fail-on-orphans"] + traces,
+                      out=buf)
+        tl = buf.getvalue()
+        sys.stdout.write(tl)
+        counts = _summary_counts(tl)
+        if rc != 0 or counts.get("orphans", -1) != 0:
+            failures.append(
+                f"obs timeline found {counts.get('orphans')} orphan "
+                f"spans (rc={rc}) — a trace-context hop broke")
+        if counts.get("processes", 0) < 3:
+            failures.append(
+                f"timeline stitched only {counts.get('processes')} "
+                f"distinct processes, expected >= 3 (daemon + fork "
+                f"workers + device owner)")
+
+        # ---- 6: cold -> warm regression gate --------------------------
+        cold_path = daemon.q.result_path(jid)
+        warm_path = daemon.q.result_path(wjob["id"])
+        rc = obs_main(["diff", "--fail-on-regress", cold_path,
+                       warm_path])
+        if rc != 0:
+            failures.append("obs diff flagged a cold->warm regression")
+
+        for f in failures:
+            print(f"trace-check: FAIL: {f}", file=sys.stderr)
+        if not failures:
+            print(f"trace-check: PASS — {counts['processes']} "
+                  f"processes, {counts['events']} events, 0 orphan "
+                  f"spans; progress_est moved "
+                  f"{est_samples[0]:.3f} -> {est_samples[-1]:.3f} "
+                  f"mid-run (spool: {spool})")
+        return 1 if failures else 0
+    finally:
+        daemon.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -304,3 +304,115 @@ class TestKillResumeParity:
         art = json.load(open(m))
         assert art["gauges"].get("parallel.fallback_reason") is None
         assert art["gauges"].get("parallel.workers") == 3
+
+
+# ------------------------------------------ trace-context chaos (ISSUE 16)
+
+@needs_fork
+class TestTraceContextChaos:
+    """PR-16: the fleet trace survives the chaos matrix.  A worker that
+    is SIGKILLed and respawned rejoins the run's ORIGINAL trace_id
+    (fresh pid+span, same tid), and a SIGTERM-drained run plus its
+    resume both stitch under the JAXMC_TRACE_CTX they inherited — in
+    every case `obs timeline` reconstructs the fleet with zero orphan
+    spans."""
+
+    def test_worker_kill_respawn_keeps_trace_id(self, monkeypatch,
+                                                tmp_path):
+        import io
+        from jaxmc.obs import context
+        from jaxmc.obs.report import main as obs_main
+        monkeypatch.setenv("JAXMC_FAULTS", "worker_kill:level=2")
+        faults._CACHE = None
+        context.reset()
+        trace = str(tmp_path / "kill.trace.jsonl")
+        tel = obs.Telemetry(trace_path=trace)
+        with obs.use(tel):
+            rp = ParallelExplorer(load(os.path.join(SPECS,
+                                                    "viewtoy.tla")),
+                                  workers=4).run()
+        assert rp.ok
+        assert tel.counters.get("parallel.worker_deaths") == 1
+        assert tel.counters.get("parallel.respawns") == 1
+        events = [json.loads(ln) for ln in open(trace)]
+        run_tid = context.get().trace_id
+        # one trace_id across the whole run — including every event
+        # recorded AFTER the kill/respawn cycle
+        assert {e.get("tid") for e in events} == {run_tid}
+        spans = [e for e in events
+                 if e.get("ev") == "parallel.worker_span"]
+        # every worker (original or respawned) holds a DISTINCT
+        # pid+span, all parented on the run's own span
+        assert len(spans) >= 2, spans
+        assert len({s["pid"] for s in spans}) == len(spans)
+        assert len({s["span"] for s in spans}) == len(spans)
+        assert all(s["parent"] == events[0]["psid"] for s in spans)
+        buf = io.StringIO()
+        rc = obs_main(["timeline", "--fail-on-orphans", trace],
+                      out=buf)
+        out = buf.getvalue()
+        assert rc == 0, out
+        assert "orphans=0" in out
+
+    @pytest.mark.slow
+    def test_sigterm_drain_and_resume_share_trace(self, tmp_path):
+        # a SIGTERM-drained run checkpoints AND leaves a trace stitched
+        # under the JAXMC_TRACE_CTX it inherited; the resume, handed
+        # the same context, joins the SAME fleet trace — the conductor
+        # lane plus both run lanes merge with zero orphans
+        import io
+        import signal
+        import time
+        from jaxmc.obs.report import main as obs_main
+        from jaxmc.tracecheck import _SLOW_CFG, _SLOW_SPEC
+
+        spec = str(tmp_path / "traceload.tla")
+        with open(spec, "w") as fh:
+            fh.write(_SLOW_SPEC.format(q=800, bound=15))
+        with open(str(tmp_path / "traceload.cfg"), "w") as fh:
+            fh.write(_SLOW_CFG)
+        parent_tid, parent_span = "ab" * 8, "cd" * 8
+        env = {"JAXMC_TRACE_CTX": f"{parent_tid}:{parent_span}"}
+        ck = str(tmp_path / "drain.ck")
+        t1 = str(tmp_path / "one.trace.jsonl")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "jaxmc", "check", spec,
+             "--workers", "1", "--trace", t1, "--checkpoint", ck,
+             "--checkpoint-every", "0"],
+            env=dict(os.environ, JAX_PLATFORMS="cpu", **env),
+            cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True)
+        deadline = time.time() + 120
+        while not (os.path.exists(t1) and os.path.getsize(t1) > 0):
+            assert proc.poll() is None, proc.communicate()[1]
+            assert time.time() < deadline, "child never wrote a trace"
+            time.sleep(0.1)
+        proc.send_signal(signal.SIGTERM)
+        _, err = proc.communicate(timeout=120)
+        assert proc.returncode == 143, (proc.returncode, err)
+        assert os.path.exists(ck), "the drain left no checkpoint"
+        t2 = str(tmp_path / "two.trace.jsonl")
+        resumed = _cli([spec, "--workers", "1", "--resume", ck,
+                        "--trace", t2], env_extra=env)
+        assert resumed.returncode == 0, resumed.stderr
+        ev1 = [json.loads(ln) for ln in open(t1)]
+        ev2 = [json.loads(ln) for ln in open(t2)]
+        assert {e.get("tid") for e in ev1 + ev2} == {parent_tid}
+        assert ev1[0]["parent_span"] == parent_span
+        assert ev2[0]["parent_span"] == parent_span
+        # a one-line conductor lane makes the inherited parent span
+        # resolvable, exactly as a bench/serve parent's trace would
+        parent_trace = str(tmp_path / "parent.trace.jsonl")
+        with open(parent_trace, "w") as fh:
+            fh.write(json.dumps({
+                "ev": "proc_meta", "t": ev1[0]["t"] - 1.0, "mono": 0.0,
+                "pid": 1, "argv": ["conductor"], "psid": parent_span,
+                "parent_span": None, "env": {},
+                "tid": parent_tid}) + "\n")
+        buf = io.StringIO()
+        rc = obs_main(["timeline", "--fail-on-orphans", parent_trace,
+                       t1, t2], out=buf)
+        out = buf.getvalue()
+        assert rc == 0, out
+        assert "orphans=0" in out
+        assert "processes=3" in out

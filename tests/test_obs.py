@@ -129,7 +129,10 @@ class TestMetricsArtifact:
         for backend, (_, tr) in both.items():
             with open(tr) as fh:
                 events = [json.loads(ln) for ln in fh if ln.strip()]
-            assert events[0]["ev"] == "run_start"
+            # PR 16: every trace file opens with the process-identity
+            # header, then the run record
+            assert events[0]["ev"] == "proc_meta"
+            assert events[1]["ev"] == "run_start"
             assert events[-1]["ev"] == "run_end"
             kinds = {e["ev"] for e in events}
             assert {"span_open", "span", "level", "log"} <= kinds, backend
@@ -226,3 +229,162 @@ class TestTelemetryApi:
         with obs.use(tel):
             assert obs.current() is tel
         assert obs.current() is base
+
+
+class TestTraceContext:
+    """obs/context.py (PR 16): the JAXMC_TRACE_CTX propagation
+    contract every process boundary relies on."""
+
+    @pytest.fixture(autouse=True)
+    def _fresh_ctx(self, monkeypatch):
+        from jaxmc.obs import context
+        monkeypatch.delenv(context.ENV_VAR, raising=False)
+        context.reset()
+        yield
+        context.reset()
+
+    def test_root_when_no_env(self):
+        from jaxmc.obs import context
+        ctx = context.get()
+        assert ctx.parent_span_id is None
+        assert len(ctx.trace_id) == 16 and len(ctx.span_id) == 16
+        assert context.get() is ctx  # cached within the process
+
+    def test_inherits_env_header(self, monkeypatch):
+        from jaxmc.obs import context
+        monkeypatch.setenv(context.ENV_VAR, "aaaabbbbccccdddd:1111222233334444")
+        context.reset()
+        ctx = context.get()
+        assert ctx.trace_id == "aaaabbbbccccdddd"
+        assert ctx.parent_span_id == "1111222233334444"
+        assert ctx.span_id not in ("1111222233334444",
+                                   "aaaabbbbccccdddd")
+
+    def test_malformed_header_falls_back_to_root(self, monkeypatch):
+        from jaxmc.obs import context
+        for bad in ("", "nocolon", ":", "a:", ":b", "a:b:c"):
+            monkeypatch.setenv(context.ENV_VAR, bad)
+            context.reset()
+            assert context.get().parent_span_id is None, bad
+
+    def test_child_env_carries_header(self):
+        from jaxmc.obs import context
+        ctx = context.get()
+        env = context.child_env({"OTHER": "1"})
+        assert env["OTHER"] == "1"
+        assert env[context.ENV_VAR] == \
+            f"{ctx.trace_id}:{ctx.span_id}"
+
+    def test_fork_rederive_keeps_trace_id(self):
+        # simulate the fork child's pid mismatch without forking
+        from jaxmc.obs import context
+        parent = context.get()
+        context._ctx = context.TraceContext(
+            parent.trace_id, parent.parent_span_id, parent.span_id,
+            parent.pid - 1)  # "stale" pid -> get() re-derives
+        child = context.get()
+        assert child.trace_id == parent.trace_id
+        assert child.parent_span_id == parent.span_id
+        assert child.span_id != parent.span_id
+        assert child.pid == os.getpid()
+
+    def test_exported_restores_environ(self):
+        from jaxmc.obs import context
+        assert context.ENV_VAR not in os.environ
+        with context.exported():
+            assert os.environ[context.ENV_VAR] == \
+                context.get().header()
+        assert context.ENV_VAR not in os.environ
+
+    def test_proc_meta_header_and_tid_stamping(self, tmp_path):
+        from jaxmc.obs import context
+        tr = tmp_path / "t.jsonl"
+        tel = obs.Telemetry(trace_path=str(tr))
+        tel.event("log", msg="hello")
+        tel.close()
+        with open(tr) as fh:
+            events = [json.loads(ln) for ln in fh if ln.strip()]
+        ctx = context.get()
+        meta = events[0]
+        assert meta["ev"] == "proc_meta"
+        assert meta["pid"] == os.getpid()
+        assert meta["psid"] == ctx.span_id
+        assert meta["parent_span"] == ctx.parent_span_id
+        assert isinstance(meta["mono"], float)
+        assert all(e["tid"] == ctx.trace_id for e in events)
+
+
+class TestProgressEstimator:
+    def test_fraction_and_eta_math(self):
+        clock = iter(float(i) for i in range(100))
+        pe = obs.ProgressEstimator(100, clock=lambda: next(clock))
+        assert pe.observe(distinct=10) == 0.10
+        assert pe.observe(distinct=40) == 0.40
+        s = pe.snapshot()
+        assert s["verdict"] == "est" and s["estimate"] == 100
+        assert s["rate_states_s"] == 30.0  # (40-10)/(1s)
+        assert s["eta_s"] == 2.0           # 60 remaining / 30 per s
+        assert "% of est. 100 states" in pe.suffix()
+
+    def test_unbounded_when_no_estimate_or_exceeded(self):
+        pe = obs.ProgressEstimator(None)
+        assert pe.observe(distinct=5) is None
+        assert pe.snapshot()["verdict"] == "unbounded"
+        assert pe.suffix() == " (est. unbounded)"
+        pe2 = obs.ProgressEstimator(10)
+        assert pe2.observe(distinct=11) is None  # bound exceeded
+        assert pe2.snapshot()["verdict"] == "unbounded"
+
+    def test_distinct_is_max_accumulated(self):
+        pe = obs.ProgressEstimator(100)
+        pe.observe(distinct=50)
+        pe.observe(distinct=30)   # stale lower reading never regresses
+        assert pe.snapshot()["distinct"] == 50
+        pe.observe(new=5)
+        assert pe.snapshot()["distinct"] == 55
+
+    def test_eta_suffix_empty_without_estimator(self):
+        # default runs keep byte-identical progress lines
+        assert obs.eta_suffix(10, tel=obs.NullTelemetry()) == ""
+
+    def test_eta_suffix_feeds_gauge(self):
+        tel = obs.Telemetry()
+        tel.progress_est = obs.ProgressEstimator(200)
+        out = obs.eta_suffix(100, tel=tel)
+        assert "50% of est. 200 states" in out
+        assert tel.gauges["search.progress_est"] == 0.5
+
+    def test_watchdog_heartbeat_carries_progress(self):
+        import time
+        tel = obs.Telemetry()
+        tel.progress_est = obs.ProgressEstimator(100)
+        tel.progress_est.observe(distinct=25)
+        wd = obs.Watchdog(tel, interval=3600, min_stall_s=7200)
+        wd._tick(time.time())
+        beats = [e for e in tel.recent_events()
+                 if e["ev"] == "heartbeat"]
+        assert beats, "no heartbeat in ring"
+        assert beats[-1]["progress_fraction"] == 0.25
+        assert beats[-1]["progress_verdict"] == "est"
+
+
+class TestPromName:
+    def test_grammar(self):
+        assert obs.prom_name("serve.queue_depth") == \
+            "jaxmc_serve_queue_depth"
+        assert obs.prom_name("search.progress_est") == \
+            "jaxmc_search_progress_est"
+        assert obs.prom_name("a-b c/d") == "jaxmc_a_b_c_d"
+
+
+class TestTelemetryRing:
+    def test_ring_bounded_and_mid_run_readable(self):
+        tel = obs.Telemetry()
+        for i in range(5000):
+            tel.event("log", msg=f"m{i}")
+        evs = tel.recent_events()
+        assert len(evs) <= 256 + 8  # ring max + startup events
+        assert evs[-1]["msg"] == "m4999"
+
+    def test_null_telemetry_ring_empty(self):
+        assert obs.NullTelemetry().recent_events() == []

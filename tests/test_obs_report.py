@@ -361,3 +361,90 @@ class TestEntrypointSmoke:
             capture_output=True, text=True, cwd=REPO, timeout=120)
         assert r.returncode == 1, r.stdout + r.stderr
         assert "states/sec" in r.stdout
+
+
+# ------------------------------------------------------- obs timeline
+
+def _trace_file(path, psid, parent, pid, events=(), t0=1000.0,
+                command="check"):
+    """A synthetic PR-16 trace file: proc_meta header + events."""
+    lines = [{"ev": "proc_meta", "t": t0, "mono": 1.0, "pid": pid,
+              "argv": ["jaxmc"], "psid": psid, "parent_span": parent,
+              "env": {}, "tid": "t" * 16},
+             {"ev": "run_start", "t": t0,
+              "meta": {"command": command}, "tid": "t" * 16}]
+    lines += list(events)
+    with open(path, "w") as fh:
+        for ln in lines:
+            fh.write(json.dumps(ln) + "\n")
+    return str(path)
+
+
+class TestTimeline:
+    def run_timeline(self, files, extra=()):
+        buf = io.StringIO()
+        rc = report.main(["timeline"] + list(extra) + list(files),
+                         out=buf)
+        return rc, buf.getvalue()
+
+    def test_stitches_parent_child_and_workers(self, tmp_path):
+        parent = _trace_file(
+            tmp_path / "daemon.jsonl", "p" * 16, None, 100,
+            events=[{"ev": "parallel.worker_span", "t": 1001.0,
+                     "pid": 201, "span": "w" * 16,
+                     "parent": "p" * 16, "level": 1, "tid": "t" * 16}])
+        child = _trace_file(tmp_path / "job.jsonl", "c" * 16,
+                            "p" * 16, 150, t0=1000.5, command="serve")
+        rc, out = self.run_timeline([parent, child])
+        assert rc == 0
+        assert "summary: files=2 processes=3 lanes=3 events=5 " \
+               "orphans=0 gaps=0" in out
+        assert "parent=P0" in out       # child + worker parented
+        assert "ORPHAN" not in out
+
+    def test_orphan_flagged_and_gates(self, tmp_path):
+        lost = _trace_file(tmp_path / "lost.jsonl", "c" * 16,
+                           "f" * 16, 150)  # parent span in no file
+        rc, out = self.run_timeline([lost])
+        assert rc == 0                  # informational without the flag
+        assert "orphans=1" in out and "ORPHAN" in out
+        rc2, out2 = self.run_timeline([lost],
+                                      extra=["--fail-on-orphans"])
+        assert rc2 == 1
+
+    def test_gap_detection(self, tmp_path):
+        f = _trace_file(
+            tmp_path / "slow.jsonl", "p" * 16, None, 100,
+            events=[{"ev": "log", "t": 1100.0, "msg": "late",
+                     "tid": "t" * 16}])
+        rc, out = self.run_timeline([f], extra=["--gap-threshold", "30"])
+        assert rc == 0
+        assert "gaps=1" in out and "silent for" in out
+
+    def test_tolerates_pre_pr16_artifacts_and_torn_lines(self, tmp_path):
+        p = tmp_path / "old.jsonl"
+        with open(p, "w") as fh:
+            fh.write(json.dumps({"ev": "run_start", "t": 1.0,
+                                 "meta": {}}) + "\n")
+            fh.write('{"ev": "log", "t": 2.0, "msg": "x"}\n')
+            fh.write('{"ev": "level", "t": 2.5, "lev')  # torn tail
+        rc, out = self.run_timeline([str(p)])
+        assert rc == 0
+        assert "events=2" in out and "orphans=0" in out
+
+    def test_real_run_timeline_subprocess(self, tmp_path):
+        """Entrypoint guard: a real interp run's trace renders through
+        `python -m jaxmc.obs timeline` with zero orphans."""
+        from jaxmc.cli import main as cli_main
+        tr = tmp_path / "run.trace.jsonl"
+        rc = cli_main(["check", os.path.join(SPECS, "symtoy.tla"),
+                       "--cfg", os.path.join(SPECS, "symtoy.cfg"),
+                       "--no-deadlock", "--quiet", "--trace", str(tr)])
+        assert rc == 0
+        r = subprocess.run(
+            [sys.executable, "-m", "jaxmc.obs", "timeline",
+             "--fail-on-orphans", str(tr)],
+            capture_output=True, text=True, cwd=REPO, timeout=120)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "orphans=0" in r.stdout
+        assert "run_start check" in r.stdout
