@@ -206,6 +206,12 @@ bench-check:
 	# verdict preservation + >=30% explored-state reduction, and the
 	# predicted capacity rung's zero-growth cold run — see por-check
 	$(MAKE) por-check
+	# profiler/ledger leg (ISSUE 17): warm `--profile` runs must
+	# attribute >= 90% of the search wall to named dispatch sites,
+	# profile-on/off counts must be bit-identical, and the temp-ledger
+	# regression gate must pass (and trip on a synthesized slowdown)
+	# — see prof-check below
+	$(MAKE) prof-check
 	# static-analysis legs (ISSUE 9): an analyzer regression gates the
 	# same way perf regressions do — the corpus must stay lint-clean
 	# (modulo manifest waivers) and jaxmc's own Python must stay free
@@ -231,19 +237,21 @@ bench-check:
 # `obs diff` gates the newer per-rung states/sec/chip against the
 # older (wired into `make bench-check` through this target).
 MULTICHIP_DEVICES ?= 2,4
-MULTICHIP_PREV ?= MULTICHIP_r07.json
-MULTICHIP_CUR  ?= MULTICHIP_r08.json
+# every committed schema>=1 scaling artifact, ordered by recorded
+# timestamp inside `obs diff` (ISSUE 17: diff expands globs itself,
+# so new MULTICHIP_r* drops join the gate without a Makefile edit;
+# r01-r05 predate the /1 schema and stay out of the pattern)
+MULTICHIP_GLOB ?= MULTICHIP_r0[6-9].json
 multichip-check:
 	$(PY) -m jaxmc.meshbench check --devices $(MULTICHIP_DEVICES) \
 	    --out-dir $(BENCH_CHECK_DIR)
 	$(PY) -m jaxmc.meshbench check --devices 2 \
 	    --rung specs/viewtoy_scaled.tla --merge fullsort \
 	    --out-dir $(BENCH_CHECK_DIR)
-	@if [ -f $(MULTICHIP_PREV) ] && [ -f $(MULTICHIP_CUR) ]; then \
-	  echo "== multichip scaling curve: $(MULTICHIP_CUR) vs" \
-	       "$(MULTICHIP_PREV) =="; \
+	@if ls $(MULTICHIP_GLOB) >/dev/null 2>&1; then \
+	  echo "== multichip scaling curve: $(MULTICHIP_GLOB) =="; \
 	  $(PY) -m jaxmc.obs diff --fail-on-regress --threshold 25 \
-	      $(MULTICHIP_PREV) $(MULTICHIP_CUR) || exit 1; \
+	      '$(MULTICHIP_GLOB)' || exit 1; \
 	fi
 
 # backend-portability gate (ISSUE 11): two legs, both parseable —
@@ -326,10 +334,21 @@ batch-check:
 	@if [ -f $(BENCH_CHECK_DIR)/jaxmc_batchbench_cold_seq.json ]; then \
 	  echo "== batchbench cold cohort: sequential -> batched =="; \
 	  $(PY) -m jaxmc.obs diff --fail-on-regress --threshold 25 \
-	      $(BENCH_CHECK_DIR)/jaxmc_batchbench_cold_seq.json \
-	      $(BENCH_CHECK_DIR)/jaxmc_batchbench_cold_batch.json \
+	      '$(BENCH_CHECK_DIR)/jaxmc_batchbench_cold_*.json' \
 	      || exit 1; \
 	fi
+
+# profiler/ledger gate (ISSUE 17): warm checkpoint-then-resume legs on
+# transfer_scaled + symtoy_scaled under `--profile` — per-site walls
+# must attribute >= 90% of the search wall (JAXMC_PROF_CHECK_MIN_SHARE
+# overrides), profile-on vs profile-off counts must be bit-identical,
+# the HBM model must have registered the resident buffers, and the
+# legs' TEMP run ledger must pass `python -m jaxmc.obs history
+# --fail-on-regress` (with a synthesized 2x slowdown proven to trip
+# it).  Prints parseable `PROF-CHECK …` lines; SKIPs without jax.
+prof-check:
+	JAX_PLATFORMS=cpu $(PY) -m jaxmc.profcheck \
+	    --out-dir $(BENCH_CHECK_DIR)
 
 # checking-as-a-service smoke gate (ISSUE 7): fresh spool, in-process
 # daemon, two identical jax-resident jobs — the second MUST reuse the
@@ -375,4 +394,4 @@ native:
 .PHONY: all check check-corpus test chaos bench bench-warm bench-tlc \
         pin-si-env bench-check bench-check-reset serve serve-check \
         trace-check batch-check multichip-check multichip-bench \
-        backend-check por-check native lint-corpus pylint
+        backend-check por-check prof-check native lint-corpus pylint

@@ -83,7 +83,8 @@ class BatchDispatcher:
         self.B = len(cvecs)
         self.PW = donor.PW
         self._core = donor._hstep_core(self.CH)
-        self._vstep = jax.jit(jax.vmap(self._core))
+        self._vstep = obs.prof_wrap("batch.vstep",
+                                    jax.jit(jax.vmap(self._core)))
         self._cvecs = jnp.asarray(np.ascontiguousarray(cvecs, np.int32))
         self.tel = tel
         self._cv = threading.Condition()

@@ -1451,7 +1451,8 @@ class TpuExplorer:
         cap = _pow2_at_least(n, lo=8)
         jf = self._hostkeys_cache.get(cap)
         if jf is None:
-            jf = jax.jit(lambda rows, valid: self._keys_of(rows, valid))
+            jf = obs.prof_wrap("bfs.host_keys", jax.jit(
+                lambda rows, valid: self._keys_of(rows, valid)))
             self._hostkeys_cache[cap] = jf
         buf = np.repeat(np.asarray(rows_np[:1], np.int32), cap, axis=0)
         buf[:n] = rows_np
@@ -1500,7 +1501,8 @@ class TpuExplorer:
                 rows = plan.unpack_rows(packed)
                 return keys_of(rows, valid)[0]
 
-            self._pkeys_cache[cap] = jf = pk
+            self._pkeys_cache[cap] = jf = obs.prof_wrap(
+                "bfs.packed_keys", pk)
         buf = np.repeat(np.asarray(packed_np[:1], np.int32), cap, axis=0)
         buf[:n] = packed_np
         k = jf(jnp.asarray(buf), jnp.asarray(np.arange(cap) < n))
@@ -1702,6 +1704,7 @@ class TpuExplorer:
                 out["explore_all"] = exp_all
             return out
 
+        step = obs.prof_wrap("bfs.level_step", step)
         self._step_cache[key] = step
         return step
 
@@ -1787,7 +1790,8 @@ class TpuExplorer:
             and not self._lift_names
 
         if not split:
-            core_j = jax.jit(self._hstep_core(FC))
+            core_j = obs.prof_wrap("bfs.hstep",
+                                   jax.jit(self._hstep_core(FC)))
             cvec = self._cvec_jnp()
 
             def hstep(frontier_p, fcount):
@@ -1830,7 +1834,9 @@ class TpuExplorer:
                 explore = explore & jax.vmap(f)(cand_u)
             return cand, keys, pack_ovf, explore
 
-        unpack_j = jax.jit(plan.unpack_rows)
+        combine = obs.prof_wrap("bfs.hstep_combine", combine)
+        unpack_j = obs.prof_wrap("bfs.unpack",
+                                 jax.jit(plan.unpack_rows))
 
         def hstep(frontier_p, fcount):
             fvalid = np.arange(FC) < int(fcount)
@@ -1971,7 +1977,7 @@ class TpuExplorer:
                 return (jnp.stack(ens), jnp.stack(aoks),
                         jnp.stack(ovs), jnp.stack(succs))
 
-            return jax.jit(gexpand)
+            return obs.prof_wrap("bfs.hstep_group", jax.jit(gexpand))
 
         jits = [_mk(g) for g in groups]
         obs.current().gauge("expand.fused_groups", len(jits))
@@ -2014,7 +2020,8 @@ class TpuExplorer:
                 self.kc.const_lanes = {}  # trace hygiene (see core)
                 return ok, ex_
 
-            self._newcheck_cache[ckey] = jf = chk
+            self._newcheck_cache[ckey] = jf = obs.prof_wrap(
+                "bfs.newcheck", chk)
         buf = np.repeat(rows_np[:1], cap, axis=0)
         buf[:n] = rows_np
         # the shared trace lock serializes first-call tracing of the
@@ -2303,7 +2310,8 @@ class TpuExplorer:
         # packed frontier (arg 2) — the two big device buffers — update
         # in place across dispatches instead of copying per batch
         donate = (0, 2) if self.donate else ()
-        jitted = jax.jit(run, static_argnames=(), donate_argnums=donate)
+        jitted = obs.prof_wrap("bfs.resident_run", jax.jit(
+            run, static_argnames=(), donate_argnums=donate))
         self._res_cache[key] = jitted
         return jitted
 
@@ -2724,6 +2732,15 @@ class TpuExplorer:
         # slice of the accumulator taken for the next frontier
         caps["VC"] = min(caps["VC"], self.A * CH)
         caps["AccCap"] = max(caps["AccCap"], 2 * caps["VC"], caps["FCap"])
+        # HBM model (ISSUE 17): the finalized caps ARE the device
+        # buffers the resident loop carries — register them so the
+        # profiler's hbm_peak_bytes watermark tracks cap growth
+        obs.note_buffer("resident.seen", caps["SC"] * self.K * 4)
+        obs.note_buffer("resident.frontier", caps["FCap"] * self.PW * 4)
+        obs.note_buffer("resident.accumulator",
+                        caps["AccCap"] * (self.K + self.PW) * 4)
+        obs.note_buffer("resident.candidates",
+                        caps["VC"] * (self.K + self.PW) * 4)
         # levels per dispatch: the host only sees status (and can only
         # checkpoint / log progress) between dispatches, so maxlvl adapts
         # to measured dispatch wall time — targeting the tighter of
@@ -3923,6 +3940,10 @@ class TpuExplorer:
                     seen = jnp.concatenate([seen, pad])
                     SC = SC2
             step = self._get_step(SC, FC)
+            # HBM model (ISSUE 17): the level loop's two device-resident
+            # buffers at their current (possibly re-grown) capacities
+            obs.note_buffer("level.seen", SC * K * 4)
+            obs.note_buffer("level.frontier", FC * self.PW * 4)
             out = step(seen, seen_count, frontier, fcount)
 
             ovc = int(out["overflow"])

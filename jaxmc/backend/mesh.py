@@ -470,6 +470,10 @@ class MeshExplorer(TpuExplorer):
         B = self._a2a_bucket(C, FC)
         SB = self._a2a_spill_bucket(B)
         R = D * (B + SB)
+        # HBM model (ISSUE 17): the two a2a payload staging buffers
+        # ([D*B, Pw] + [D*SB, Pw] words, both directions), per device
+        obs.note_buffer("mesh.a2a_buckets",
+                        2 * D * (B + SB) * (K + PW + 1) * 4 * D)
 
         def route_a2a(ckeys, cand, cvalid, me):
             invalid_key = jnp.asarray(invalid_key_np)
@@ -936,10 +940,10 @@ class MeshExplorer(TpuExplorer):
         shard_map = self._shard_map()
         n_out = 21 if out_cap is not None else \
             (21 if need_edges else 18)
-        step = jax.jit(shard_map(
+        step = obs.prof_wrap("mesh.level_step", jax.jit(shard_map(
             device_step, mesh=self.mesh,
             in_specs=(P("d"), P("d"), P("d"), P("d")),
-            out_specs=tuple([P("d")] * n_out)))
+            out_specs=tuple([P("d")] * n_out))))
         self._mesh_step_cache[key] = step
         return step
 
@@ -1245,12 +1249,12 @@ class MeshExplorer(TpuExplorer):
         # for lax.while_loop (the superstep level loop); every output
         # is P("d")-sharded anyway, so nothing relied on inferred
         # replication
-        step = jax.jit(shard_map(
+        step = obs.prof_wrap("mesh.superstep", jax.jit(shard_map(
             device_step, mesh=self.mesh,
             in_specs=in_specs,
             out_specs=tuple([P("d")] * n_out),
             check_rep=False),
-            donate_argnums=donate)
+            donate_argnums=donate))
         self._mesh_step_cache[key] = step
         return step
 
@@ -1327,9 +1331,9 @@ class MeshExplorer(TpuExplorer):
                         .astype(jnp.int32).reshape(1),
                         pack_ovf.reshape(1))
 
-            return jax.jit(shard_map(
+            return obs.prof_wrap("mesh.group_expand", jax.jit(shard_map(
                 gdev, mesh=self.mesh, in_specs=(P("d"), P("d")),
-                out_specs=tuple([P("d")] * 9)))
+                out_specs=tuple([P("d")] * 9))))
 
         jits = [_mk(g) for g in groups]
         obs.current().gauge("mesh.grouped_expand", len(jits))
@@ -1413,11 +1417,11 @@ class MeshExplorer(TpuExplorer):
         shard_map = self._shard_map()
         n_shard = (16 if with_trace else 14)
         n_out = 9 if with_trace else 7
-        jtail = jax.jit(shard_map(
+        jtail = obs.prof_wrap("mesh.grouped_tail", jax.jit(shard_map(
             tail_dev, mesh=self.mesh,
             in_specs=tuple([P("d")] * n_shard) + (P(), P(), P()),
             out_specs=tuple([P("d")] * n_out),
-            check_rep=False))
+            check_rep=False)))
 
         def step(seen, seen_count, frontier, fcount, *args):
             if with_trace:
@@ -1849,6 +1853,13 @@ class MeshExplorer(TpuExplorer):
             step_key = self._mesh_resident_key(SC, FC, TRL, VC)
             fresh_compile = step_key not in self._mesh_step_cache
             step = self._get_mesh_resident_step(SC, FC, TRL, VC)
+            # HBM model (ISSUE 17): the sharded tables at their current
+            # (possibly re-grown) capacities, summed over the D devices
+            obs.note_buffer("mesh.seen_shards", D * SC * K * 4)
+            obs.note_buffer("mesh.frontier", D * FC * PW * 4)
+            if self.store_trace:
+                obs.note_buffer("mesh.trace_ring",
+                                D * TRL * FC * (PW + 1) * 4)
             args = (seen, seen_count, frontier, fcount)
             if self.store_trace:
                 args = args + (tr_rows, tr_src)
@@ -2293,15 +2304,16 @@ class MeshExplorer(TpuExplorer):
 
             return merge_step
 
-        jexp = jax.jit(shard_map(
+        jexp = obs.prof_wrap("mesh.probe_expand", jax.jit(shard_map(
             expand_step, mesh=self.mesh,
-            in_specs=(P("d"), P("d")), out_specs=(P("d"),) * 3))
-        jrt = jax.jit(shard_map(
+            in_specs=(P("d"), P("d")), out_specs=(P("d"),) * 3)))
+        jrt = obs.prof_wrap("mesh.probe_route", jax.jit(shard_map(
             route_step, mesh=self.mesh,
-            in_specs=(P("d"),) * 3, out_specs=(P("d"),) * 3))
-        jmg = {s: jax.jit(shard_map(
-            mk_merge(s), mesh=self.mesh,
-            in_specs=(P("d"),) * 5, out_specs=(P("d"),) * 5))
+            in_specs=(P("d"),) * 3, out_specs=(P("d"),) * 3)))
+        jmg = {s: obs.prof_wrap(f"mesh.probe_merge_{s}", jax.jit(
+            shard_map(
+                mk_merge(s), mesh=self.mesh,
+                in_specs=(P("d"),) * 5, out_specs=(P("d"),) * 5)))
             for s in ("rank", "fullsort")}
 
         seen = jnp.asarray(seen_np)

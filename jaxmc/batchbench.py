@@ -73,7 +73,7 @@ def _artifact(path: str, leg: str, wall_s: float, distinct: int,
               "batch.lifted_consts": lifted}
     if dispatches is not None:
         gauges["batch.dispatch_count"] = dispatches
-    obs.write_json_atomic(path, {
+    art = {
         "schema": "jaxmc.metrics/2",
         "started_at": time.time(),
         "wall_s": round(wall_s, 6),
@@ -88,7 +88,10 @@ def _artifact(path: str, leg: str, wall_s: float, distinct: int,
         "result": {"ok": True, "distinct": distinct,
                    "generated": generated, "diameter": 0,
                    "truncated": False, "wall_s": round(wall_s, 6)},
-    })
+    }
+    obs.write_json_atomic(path, art)
+    # ISSUE 17: each gate leg lands a trajectory point in the run ledger
+    obs.append_summary(art, source=path)
 
 
 def _counts(r):

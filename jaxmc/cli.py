@@ -33,7 +33,7 @@ def cmd_check(args) -> int:
     # telemetry is a PARALLEL channel: stdout stays byte-identical; a
     # NullTelemetry (every method a no-op) serves runs that asked for no
     # artifact, so the engines' instrumentation costs nothing
-    want_tel = bool(args.metrics_out or args.trace)
+    want_tel = bool(args.metrics_out or args.trace or args.profile)
     tel = obs.Telemetry(
         trace_path=args.trace,
         meta={"command": "check", "backend": args.backend,
@@ -41,6 +41,12 @@ def cmd_check(args) -> int:
               "argv": list(sys.argv[1:]),
               "env": obs.environment_meta()}) if want_tel \
         else obs.NullTelemetry()
+    if args.profile:
+        # per-dispatch device profiling (ISSUE 17, obs/prof.py): wall
+        # mode adds block-until-ready walls + byte accounting to the
+        # always-on dispatch counters; a sync cannot change values, so
+        # counts/traces stay bit-identical to a profile-off run
+        tel.prof.mode = args.profile
     log = obs.Logger(tel, quiet=args.quiet)
     # the watchdog names a wedged phase (device init, a pathological BFS
     # level) on stderr and in the trace WHILE it hangs — start() is a
@@ -52,12 +58,47 @@ def cmd_check(args) -> int:
     # the watchdog instead of leaking both; the process exits 143 with
     # the reason named (jaxmc/drain.py)
     drain.install()
+    xla_tracing = args.profile == "xla" and _start_xla_trace(args, tel)
     try:
         with obs.use(tel):
             return _run_check(args, tel, log, t0)
     finally:
+        if xla_tracing:
+            _stop_xla_trace()
         wd.stop()
         tel.close()
+
+
+def _start_xla_trace(args, tel) -> bool:
+    """--profile=xla: wrap the whole run in a jax.profiler trace
+    capture to a named artifact dir (JAXMC_XLA_TRACE_DIR, else next to
+    --metrics-out, else a fresh tempdir).  Best-effort: a backend
+    without profiler support degrades to wall-mode profiling with a
+    warning, never a failed run."""
+    tdir = os.environ.get("JAXMC_XLA_TRACE_DIR") or \
+        (args.metrics_out + ".xla" if args.metrics_out else None)
+    if tdir is None:
+        import tempfile
+        tdir = tempfile.mkdtemp(prefix="jaxmc-xla-")
+    try:
+        import jax
+        jax.profiler.start_trace(tdir)
+    except Exception as e:  # noqa: BLE001 — profiling is best-effort
+        print(f"warning: --profile=xla trace capture unavailable "
+              f"({e}); continuing with wall-mode profiling",
+              file=sys.stderr)
+        return False
+    tel.prof.xla_trace_dir = tdir
+    print(f"-- profile: xla trace capture -> {tdir}", file=sys.stderr)
+    return True
+
+
+def _stop_xla_trace() -> None:
+    try:
+        import jax
+        jax.profiler.stop_trace()
+    except Exception:  # noqa: BLE001 — never mask the run's own exit
+        pass
 
 
 def _metrics_error(args, tel, error: str) -> None:
@@ -459,9 +500,9 @@ def main(argv=None) -> int:
                         "phase wall times, per-level BFS counts, "
                         "expansion-mode/memo/fingerprint/compile-cost "
                         "counters, the env fingerprint and the result "
-                        "block (schema jaxmc.metrics/2; see "
+                        "block (schema jaxmc.metrics/4; see "
                         "jaxmc/obs/schema.py; render/compare with "
-                        "python -m jaxmc.obs report|diff)")
+                        "python -m jaxmc.obs report|diff|top)")
     c.add_argument("--trace", default=None, metavar="FILE",
                    help="stream telemetry events as JSONL while the run "
                         "is live (span_open/span/level/log plus "
@@ -471,6 +512,18 @@ def main(argv=None) -> int:
                         "stall event while it hangs (knobs: "
                         "JAXMC_HEARTBEAT_EVERY/JAXMC_STALL_FACTOR/"
                         "JAXMC_STALL_MIN_S)")
+    c.add_argument("--profile", nargs="?", const="wall", default=None,
+                   choices=("wall", "xla"),
+                   help="per-dispatch device profiling (obs/prof.py): "
+                        "block-until-ready wall, bytes and recompiles "
+                        "per named dispatch site plus the HBM buffer "
+                        "model, stamped into --metrics-out as the "
+                        "prof{} block (render with python -m "
+                        "jaxmc.obs top). --profile=xla additionally "
+                        "captures a jax.profiler trace to "
+                        "JAXMC_XLA_TRACE_DIR (default: "
+                        "METRICS_OUT.xla/). Profiling never changes "
+                        "counts or traces")
     c.set_defaults(fn=cmd_check)
 
     m = sub.add_parser("simulate",

@@ -60,7 +60,7 @@ def _artifact(path: str, backend: str, spec: str, platform: str,
     from . import obs
     env = obs.environment_meta()
     env["platform"] = platform
-    obs.write_json_atomic(path, {
+    art = {
         "schema": "jaxmc.metrics/2",
         "started_at": time.time(),
         "wall_s": round(wall_s, 6),
@@ -78,7 +78,10 @@ def _artifact(path: str, backend: str, spec: str, platform: str,
                    "diameter": int(result.diameter),
                    "truncated": bool(result.truncated),
                    "wall_s": round(wall_s, 6)},
-    })
+    }
+    obs.write_json_atomic(path, art)
+    # ISSUE 17: each gate leg lands a trajectory point in the run ledger
+    obs.append_summary(art, source=path)
 
 
 def run_leg(spec: str, cfg: Optional[str], out_dir: str,

@@ -307,6 +307,11 @@ def cmd_bench(args) -> int:
         "ok": failures == 0,
     }
     obs.write_json_atomic(args.out, art)
+    try:  # ISSUE 17: land the per-chip curve in the run ledger too
+        from .obs import ledger as _ledger
+        _ledger.import_artifacts([args.out])
+    except Exception:  # noqa: BLE001 — the ledger never breaks a gate
+        pass
     print(f"meshbench: wrote {args.out} "
           f"({'FAIL' if failures else 'ok'}, {len(rungs_out)} rungs)")
     return 1 if failures else 0
@@ -451,6 +456,9 @@ def cmd_child(args) -> int:
                                  "exchange_bytes_per_level")
                                 if k in out}
         obs.write_json_atomic(args.metrics_out, summary)
+        # ISSUE 17: every bench child lands its trajectory point in the
+        # persistent run ledger (never raises, JAXMC_LEDGER=off disables)
+        obs.append_summary(summary, source=args.metrics_out)
     print(_RESULT_TAG + json.dumps(out), flush=True)
     return 0
 

@@ -16,11 +16,13 @@ search-progress/ETA estimator, and a cross-run report CLI.
 Engines report through `obs.current()` — a no-op NullTelemetry unless a
 real recorder is installed — so instrumentation costs nothing when no
 artifact was requested. See obs/telemetry.py for the model,
-obs/schema.py for the artifact schema (jaxmc.metrics/3),
+obs/schema.py for the artifact schema (jaxmc.metrics/4),
 obs/context.py for the JAXMC_TRACE_CTX propagation contract,
 obs/progress.py for the ETA estimator, obs/watchdog.py for live stall
-diagnosis, and obs/report.py for
-`python -m jaxmc.obs report|diff|timeline` over artifacts.
+diagnosis, obs/prof.py for the per-dispatch device profiler + HBM
+model, obs/ledger.py for the persistent run ledger, and obs/report.py
+for `python -m jaxmc.obs report|diff|timeline|top|history` over
+artifacts.
 """
 
 from . import context
@@ -29,16 +31,20 @@ from .telemetry import (Logger, NullTelemetry, Telemetry, current,
                         prom_name, rss_bytes, use, use_local,
                         write_json_atomic)
 from .context import TraceContext, child_env
+from .ledger import append_summary, ledger_path
+from .prof import Profiler, note_buffer, prof_attribution, prof_wrap
 from .progress import ProgressEstimator, attach_estimator, eta_suffix
 from .schema import (CHECK_KEYS, HEARTBEAT_KEYS, REQUIRED_KEYS,
                      RESULT_KEYS, SCHEMA, SCHEMAS, STALL_KEYS,
                      validate_summary, validate_trace_event)
 from .watchdog import Watchdog
 
-__all__ = ["Logger", "NullTelemetry", "Telemetry", "Watchdog",
-           "TraceContext", "ProgressEstimator", "attach_estimator",
-           "child_env", "context", "current", "device_mem_high_water",
-           "environment_meta", "eta_suffix", "prom_name", "rss_bytes",
+__all__ = ["Logger", "NullTelemetry", "Profiler", "Telemetry",
+           "Watchdog", "TraceContext", "ProgressEstimator",
+           "append_summary", "attach_estimator", "child_env", "context",
+           "current", "device_mem_high_water", "environment_meta",
+           "eta_suffix", "ledger_path", "note_buffer",
+           "prof_attribution", "prof_wrap", "prom_name", "rss_bytes",
            "use", "use_local", "write_json_atomic", "SCHEMA", "SCHEMAS",
            "REQUIRED_KEYS", "CHECK_KEYS", "RESULT_KEYS",
            "HEARTBEAT_KEYS", "STALL_KEYS", "validate_summary",

@@ -1,0 +1,414 @@
+r"""Device profiler (ISSUE 17): per-dispatch attribution + HBM accounting.
+
+PR 11 left one perf target unmet — merge wall <30% of step wall — partly
+because nothing below the PHASE level said where device time went:
+`phase_walls` names "the fused step is slow", not which dispatch site,
+buffer traffic, or recompile paid for it.  This module is the missing
+layer:
+
+  sites     every jitted entry point in the engines registers a NAMED
+            dispatch site via `wrap("bfs.level_step", jitted)`; the
+            wrapper resolves the active recorder's Profiler at CALL
+            time (so the serve daemon's per-thread recorders work
+            unchanged) and records per-site stats.
+  cheap     the always-on mode: dispatch counts + recompile attribution
+            only (a `_cache_size()` delta around the call) — no sync,
+            no byte walks, so profile-off runs stay byte-identical and
+            effectively free.
+  wall      `--profile`: additionally blocks until the output pytree is
+            ready and charges the wall to the site, sums argument /
+            result bytes per dispatch, and asks the AOT lowering's
+            cost_analysis once per site for flops / bytes-accessed.
+            Synchronization cannot change counts or traces — profile-on
+            vs profile-off stays bit-identical (pinned by tests and
+            `make prof-check`).
+  xla       wall + the CLI wraps the run in a jax.profiler.trace
+            capture to a named artifact dir.
+  hbm       a device-memory MODEL from the capacity profile / LanePlan:
+            engines register named buffers (seen shards, frontier,
+            trace ring, a2a buckets, tier tables) as byte sizes the
+            moment their capacities are known; the running sum's
+            high-water is `prof.hbm_peak_bytes`, cross-checked against
+            `jax.local_devices()[0].memory_stats()` where the backend
+            exposes it.
+
+The rollup lands in the metrics artifact as the `prof{}` block (schema
+jaxmc.metrics/4, obs/schema.py) and renders via `python -m jaxmc.obs
+top` — the table that answers where the 44–77% goes.  This module is
+import-clean of jax (the report CLI must run in interp-only
+environments); jax is imported lazily inside the wall-mode paths only.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+# resolved lazily to avoid a telemetry<->prof import cycle (telemetry
+# imports Profiler at module load; we only need current() at call time)
+_current = None
+
+
+def _cur():
+    global _current
+    if _current is None:
+        from .telemetry import current as _current
+    return _current()
+
+
+def _nbytes(x) -> int:
+    """Best-effort byte count of a pytree-ish value without importing
+    jax: arrays expose .nbytes; containers recurse; scalars are 0."""
+    nb = getattr(x, "nbytes", None)
+    if isinstance(nb, int):
+        return nb
+    if isinstance(x, dict):
+        return sum(_nbytes(v) for v in x.values())
+    if isinstance(x, (list, tuple)):
+        return sum(_nbytes(v) for v in x)
+    return 0
+
+
+class SiteStats:
+    """Per-site accumulators.  Mutated under the owning Profiler's
+    lock; read via Profiler.snapshot()."""
+
+    __slots__ = ("name", "dispatches", "wall_s", "analysis_wall_s",
+                 "arg_bytes", "res_bytes", "recompiles", "cost",
+                 "_analyzed")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.dispatches = 0
+        self.wall_s = 0.0
+        self.analysis_wall_s = 0.0
+        self.arg_bytes = 0
+        self.res_bytes = 0
+        self.recompiles = 0
+        self.cost: Optional[Dict[str, Any]] = None
+        self._analyzed = False
+
+    def as_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {"dispatches": self.dispatches,
+                             "recompiles": self.recompiles}
+        if self.wall_s:
+            d["wall_s"] = round(self.wall_s, 6)
+        if self.analysis_wall_s:
+            d["analysis_wall_s"] = round(self.analysis_wall_s, 6)
+        if self.arg_bytes or self.res_bytes:
+            d["arg_bytes"] = self.arg_bytes
+            d["res_bytes"] = self.res_bytes
+        if self.cost:
+            d["cost"] = dict(self.cost)
+        return d
+
+
+class Profiler:
+    """One per live Telemetry (NullTelemetry carries `prof = None`, so
+    the un-instrumented hot path costs one getattr + a None test)."""
+
+    CHEAP, WALL, XLA = "cheap", "wall", "xla"
+
+    def __init__(self, mode: str = "cheap",
+                 clock=time.perf_counter):
+        self.mode = mode
+        self._clock = clock
+        self._lock = threading.Lock()
+        self.sites: Dict[str, SiteStats] = {}
+        self._buffers: Dict[str, int] = {}
+        self.hbm_peak_bytes = 0
+        self.xla_trace_dir: Optional[str] = None
+
+    # ---- dispatch sites ------------------------------------------------
+    def _site(self, name: str) -> SiteStats:
+        st = self.sites.get(name)
+        if st is None:
+            with self._lock:
+                st = self.sites.setdefault(name, SiteStats(name))
+        return st
+
+    @staticmethod
+    def _cache_size(fn) -> Optional[int]:
+        cs = getattr(fn, "_cache_size", None)
+        if not callable(cs):
+            return None
+        try:
+            return int(cs())
+        except Exception:  # noqa: BLE001 — profiling never breaks a run
+            return None
+
+    def record(self, name: str, fn, args, kwargs):
+        """One profiled dispatch.  Cheap mode: count + recompile delta
+        only.  Wall mode: + block-until-ready wall and arg/result
+        bytes, + a one-time AOT cost_analysis per site."""
+        st = self._site(name)
+        cs0 = self._cache_size(fn)
+        if self.mode == self.CHEAP:
+            out = fn(*args, **kwargs)
+            cs1 = self._cache_size(fn)
+            with self._lock:
+                st.dispatches += 1
+                if cs0 is not None and cs1 is not None and cs1 > cs0:
+                    st.recompiles += cs1 - cs0
+            return out
+        t0 = self._clock()
+        out = fn(*args, **kwargs)
+        out = self._block(out)
+        dt = self._clock() - t0
+        cs1 = self._cache_size(fn)
+        ab = _nbytes(args) + _nbytes(kwargs)
+        rb = _nbytes(out)
+        with self._lock:
+            st.dispatches += 1
+            st.wall_s += dt
+            st.arg_bytes += ab
+            st.res_bytes += rb
+            if cs0 is not None and cs1 is not None and cs1 > cs0:
+                st.recompiles += cs1 - cs0
+            analyze = not st._analyzed
+            if analyze:
+                st._analyzed = True
+        if analyze:
+            # the one-shot lowering retrace is PROFILER-caused wall
+            # inside the search phase; charge it to the site (its own
+            # column, not wall_s) so the attribution metric stays honest
+            ta = self._clock()
+            self._analyze(st, fn, args, kwargs)
+            with self._lock:
+                st.analysis_wall_s += self._clock() - ta
+        return out
+
+    @staticmethod
+    def _block(out):
+        """Synchronize on the output pytree so the recorded wall covers
+        the device work, not just the async dispatch.  A sync cannot
+        change values — counts/traces stay bit-identical."""
+        try:
+            import jax
+            return jax.block_until_ready(out)
+        except Exception:  # noqa: BLE001 — non-jax outputs pass through
+            return out
+
+    def _analyze(self, st: SiteStats, fn, args, kwargs) -> None:
+        """One-shot AOT cost analysis for the site (wall mode only;
+        JAXMC_PROF_COST=0 disables — the lowering retrace costs a few
+        hundred ms on big programs)."""
+        if os.environ.get("JAXMC_PROF_COST", "").strip() == "0":
+            return
+        try:
+            lowered = fn.lower(*args, **kwargs)
+            ca = lowered.cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else {}
+            cost = {}
+            for key, out_key in (("flops", "flops"),
+                                 ("bytes accessed", "bytes_accessed")):
+                v = ca.get(key) if isinstance(ca, dict) else None
+                if isinstance(v, (int, float)):
+                    cost[out_key] = int(v)
+            if cost:
+                with self._lock:
+                    st.cost = cost
+        except Exception:  # noqa: BLE001 — cost analysis is best-effort
+            pass
+
+    def dominant_site(self) -> Optional[Tuple[str, float]]:
+        """(site name, share) of the site holding the largest wall
+        share (wall mode) or dispatch share (cheap mode); None when no
+        dispatches were recorded yet.  The watchdog's stall suffix."""
+        with self._lock:
+            if not self.sites:
+                return None
+            walls = {n: s.wall_s for n, s in self.sites.items()}
+            total = sum(walls.values())
+            if total > 0:
+                name = max(walls, key=walls.get)
+                return name, walls[name] / total
+            disp = {n: s.dispatches for n, s in self.sites.items()}
+            total = sum(disp.values())
+            if total > 0:
+                name = max(disp, key=disp.get)
+                return name, disp[name] / total
+            return None
+
+    # ---- HBM accounting ------------------------------------------------
+    def note_buffer(self, name: str, nbytes) -> None:
+        """Register (or resize) one named device buffer in the memory
+        model; the running total's high-water is hbm_peak_bytes."""
+        try:
+            nb = int(nbytes)
+        except (TypeError, ValueError):
+            return
+        with self._lock:
+            self._buffers[name] = nb
+            cur = sum(self._buffers.values())
+            if cur > self.hbm_peak_bytes:
+                self.hbm_peak_bytes = cur
+
+    def drop_buffer(self, name: str) -> None:
+        with self._lock:
+            self._buffers.pop(name, None)
+
+    def hbm_current_bytes(self) -> int:
+        with self._lock:
+            return sum(self._buffers.values())
+
+    def hbm_buffers(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._buffers)
+
+    # ---- rollup --------------------------------------------------------
+    def snapshot(self, force: bool = False) -> Optional[Dict[str, Any]]:
+        """The `prof{}` artifact block (schema notes in obs/schema.py).
+        None when nothing was recorded and the mode is cheap (so
+        un-instrumented artifacts carry no empty noise block) unless
+        `force`."""
+        with self._lock:
+            sites = {n: s.as_dict() for n, s in self.sites.items()}
+            buffers = dict(self._buffers)
+            peak = self.hbm_peak_bytes
+        if not force and not sites and not buffers \
+                and self.mode == self.CHEAP:
+            return None
+        out: Dict[str, Any] = {"mode": self.mode, "sites": sites}
+        hbm: Dict[str, Any] = {"buffers": buffers, "peak_bytes": peak}
+        measured = _measured_peak()
+        if measured is not None:
+            hbm["measured_peak_bytes"] = measured
+        out["hbm"] = hbm
+        if self.xla_trace_dir:
+            out["xla_trace_dir"] = self.xla_trace_dir
+        return out
+
+
+def _measured_peak() -> Optional[int]:
+    from .telemetry import device_mem_high_water
+    return device_mem_high_water()
+
+
+def wrap(name: str, fn):
+    """Register `fn` (typically a jitted callable) as the named
+    dispatch site.  The active recorder's Profiler is resolved at CALL
+    time; with no live recorder (NullTelemetry.prof is None) the
+    wrapper is one getattr + a None test."""
+    def profiled(*args, **kwargs):
+        prof = getattr(_cur(), "prof", None)
+        if prof is None:
+            return fn(*args, **kwargs)
+        return prof.record(name, fn, args, kwargs)
+
+    profiled.__wrapped__ = fn
+    profiled.__name__ = getattr(fn, "__name__", name)
+    profiled.profiler_site = name
+    return profiled
+
+
+def note_buffer(name: str, nbytes) -> None:
+    """Module-level HBM-model convenience for engine code: a no-op
+    unless a live recorder (with a Profiler) is installed."""
+    prof = getattr(_cur(), "prof", None)
+    if prof is not None:
+        prof.note_buffer(name, nbytes)
+
+
+# ------------------------------------------------------- rollup helpers
+
+def attribution(summary: Dict[str, Any]) -> Dict[str, Any]:
+    """How much of the measured search wall the named sites explain —
+    the `make prof-check` acceptance metric.  Pure dict math (no jax):
+    works on any jaxmc.metrics/4 artifact."""
+    prof = summary.get("prof") or {}
+    sites = prof.get("sites") or {}
+    attributed = sum((s.get("wall_s") or 0.0)
+                     + (s.get("analysis_wall_s") or 0.0)
+                     for s in sites.values())
+    search = None
+    for ph in summary.get("phases", []) or []:
+        if ph.get("name") == "search":
+            search = ph.get("wall_s")
+            break
+    share = (attributed / search) if search else None
+    return {"attributed_wall_s": round(attributed, 6),
+            "search_wall_s": search,
+            "share": None if share is None else round(share, 4)}
+
+
+# package-namespace aliases (obs.prof_wrap / obs.prof_attribution):
+# "wrap" and "attribution" are too generic at the obs level
+prof_wrap = wrap
+prof_attribution = attribution
+
+
+def _fmt_bytes(n) -> str:
+    if n is None:
+        return "-"
+    n = float(n)
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if abs(n) < 1024.0 or unit == "TB":
+            return f"{n:,.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024.0
+    return f"{n:,.1f}TB"
+
+
+def cmd_top(args, out=None) -> int:
+    """`python -m jaxmc.obs top FILE` — the per-site table: wall,
+    share of the search wall, dispatches, bytes per dispatch,
+    recompiles; plus the HBM model.  Exit 2 when the artifact carries
+    no prof block (pre-/4 artifact, or an un-instrumented run)."""
+    import json
+    import sys
+    out = out if out is not None else sys.stdout
+    with open(args.file, encoding="utf-8") as fh:
+        summary = json.load(fh)
+    prof = summary.get("prof")
+    if not isinstance(prof, dict) or not (prof.get("sites")
+                                          or prof.get("hbm")):
+        print(f"error: {args.file}: no prof block (run with --profile, "
+              f"or any telemetry-enabled run on jaxmc.metrics/4+)",
+              file=sys.stderr)
+        return 2
+    sites: Dict[str, Dict[str, Any]] = prof.get("sites") or {}
+    att = attribution(summary)
+    search = att["search_wall_s"]
+    print(f"== prof top: {args.file} (mode={prof.get('mode')})",
+          file=out)
+    rows: List[Tuple[str, Dict[str, Any]]] = sorted(
+        sites.items(),
+        key=lambda kv: (-(kv[1].get("wall_s") or 0.0),
+                        -kv[1].get("dispatches", 0)))
+    if rows:
+        w = max(len(n) for n, _ in rows)
+        print(f"  {'site':<{w}}  {'wall':>9}  {'share':>6}  "
+              f"{'disp':>6}  {'arg/disp':>10}  {'res/disp':>10}  "
+              f"{'recomp':>6}", file=out)
+        for name, s in rows:
+            wall = s.get("wall_s")
+            share = (wall / search * 100.0) if wall and search else None
+            d = max(s.get("dispatches", 0), 1)
+            print(
+                f"  {name:<{w}}  "
+                f"{'-' if wall is None else f'{wall:9.3f}s'[:10]:>9}  "
+                f"{'-' if share is None else f'{share:5.1f}%':>6}  "
+                f"{s.get('dispatches', 0):>6}  "
+                f"{_fmt_bytes(s.get('arg_bytes', 0) / d if s.get('arg_bytes') else None):>10}  "
+                f"{_fmt_bytes(s.get('res_bytes', 0) / d if s.get('res_bytes') else None):>10}  "
+                f"{s.get('recompiles', 0):>6}", file=out)
+    else:
+        print("  (no dispatch sites recorded)", file=out)
+    if att["share"] is not None:
+        print(f"attributed {att['share'] * 100.0:.1f}% of the search "
+              f"wall ({att['attributed_wall_s']:.3f}s of "
+              f"{search:.3f}s)", file=out)
+    hbm = prof.get("hbm") or {}
+    bufs = hbm.get("buffers") or {}
+    if bufs or hbm.get("peak_bytes"):
+        meas = hbm.get("measured_peak_bytes")
+        print(f"hbm model: peak {_fmt_bytes(hbm.get('peak_bytes'))}"
+              + (f" (measured {_fmt_bytes(meas)})"
+                 if meas is not None else ""), file=out)
+        for bname in sorted(bufs, key=lambda b: -bufs[b]):
+            print(f"  {bname:<28} {_fmt_bytes(bufs[bname]):>12}",
+                  file=out)
+    return 0

@@ -1,5 +1,5 @@
 r"""Cross-run metrics reporting: `python -m jaxmc.obs
-{report,diff,timeline}`.
+{report,diff,timeline,top,history}`.
 
 PR 1 made one run legible (`--metrics-out` / `--trace`); this closes the
 loop ACROSS runs. Two subcommands, both pure stdlib (no jax import — the
@@ -22,6 +22,15 @@ against import rot):
                          orphan spans and silent gaps are flagged and
                          counted on a machine-parseable summary line
                          (obs/timeline.py; --fail-on-orphans gates).
+  top FILE               per-dispatch-site device profile of one
+                         --profile artifact: wall, share of the
+                         search wall, dispatches, bytes, recompiles,
+                         plus the HBM buffer model (obs/prof.py).
+  history [...]          per-rung states/sec trajectory across ALL
+                         ledger-recorded runs, latest-vs-best-of-
+                         window regression flags with env attribution
+                         (obs/ledger.py; --fail-on-regress gates,
+                         --import backfills committed artifacts).
 
 Both input shapes normalize into one record (`load_record`):
   - a metrics artifact (schema jaxmc.metrics/1 or /2, obs/schema.py);
@@ -529,8 +538,69 @@ def _diff_multichip(recs: List[Dict[str, Any]], threshold: float,
     return 1 if (flags and fail_on_regress) else 0
 
 
+def _record_ts(rec: Dict[str, Any]) -> float:
+    """The record's recorded timestamp for trajectory ordering:
+    metrics artifacts carry started_at, multichip artifacts
+    generated_at (ISO string); bench rollups carry neither, so the
+    file mtime stands in."""
+    s = rec.get("summary") or {}
+    ts = s.get("started_at")
+    if isinstance(ts, (int, float)):
+        return float(ts)
+    gen = s.get("generated_at")
+    if isinstance(gen, str):
+        import datetime
+        try:
+            return datetime.datetime.fromisoformat(
+                gen.replace("Z", "+00:00")).timestamp()
+        except ValueError:
+            pass
+    try:
+        return os.path.getmtime(rec["path"])
+    except OSError:
+        return 0.0
+
+
+def expand_artifact_args(paths: List[str]) -> List[str]:
+    """`obs diff` input expansion (ISSUE 17 satellite): each argument
+    may be a file, a glob, or a directory (-> its *.json files).  When
+    ANY argument expanded, the caller re-orders the whole set by
+    recorded timestamp — a shell-quoted "BENCH_r*.json" must diff in
+    run order, not lexical luck."""
+    out: List[str] = []
+    expanded = False
+    for p in paths:
+        if os.path.isdir(p):
+            import glob as _glob
+            out.extend(sorted(_glob.glob(os.path.join(p, "*.json"))))
+            expanded = True
+        elif any(ch in p for ch in "*?["):
+            import glob as _glob
+            hits = sorted(_glob.glob(p))
+            if not hits:
+                raise ValueError(f"{p}: glob matched no files")
+            out.extend(hits)
+            expanded = True
+        else:
+            out.append(p)
+    if not expanded:
+        return paths  # explicit files pass through — `diff A A` is legal
+    # dedup while preserving order (a dir + an explicit member)
+    seen = set()
+    return [p for p in out if not (p in seen or seen.add(p))]
+
+
 def cmd_diff(args, out=sys.stdout) -> int:
-    recs = [load_record(p) for p in args.files]
+    files = expand_artifact_args(args.files)
+    recs = [load_record(p) for p in files]
+    if files != args.files:
+        # expansion happened: order the trajectory by recorded
+        # timestamp instead of trusting the shell's lexical order
+        recs.sort(key=_record_ts)
+    if len(recs) < 2:
+        print("error: diff needs at least two artifacts",
+              file=sys.stderr)
+        return 2
     if all(r["kind"] == "multichip" for r in recs):
         return _diff_multichip(recs, args.threshold,
                                args.fail_on_regress, out)
@@ -583,7 +653,9 @@ def main(argv: Optional[List[str]] = None, out=sys.stdout) -> int:
     r.add_argument("file")
     d = sub.add_parser("diff",
                        help="trajectory table + regression flags over "
-                            "2+ metrics/bench artifacts (in run order)")
+                            "2+ metrics/bench artifacts (files, "
+                            "quoted globs, or directories — expanded "
+                            "and ordered by recorded timestamp)")
     d.add_argument("files", nargs="+")
     d.add_argument("--threshold", type=float, default=10.0,
                    metavar="PCT",
@@ -614,6 +686,42 @@ def main(argv: Optional[List[str]] = None, out=sys.stdout) -> int:
     t.add_argument("--fail-on-orphans", action="store_true",
                    help="exit 1 when any lane's parent span resolves "
                         "to no known process (trace-check gate)")
+    tp = sub.add_parser(
+        "top",
+        help="per-dispatch-site profile table (wall, share, "
+             "dispatches, bytes, recompiles) + the HBM model from one "
+             "--profile metrics artifact (jaxmc.metrics/4 prof{})")
+    tp.add_argument("file")
+    h = sub.add_parser(
+        "history",
+        help="per-rung states/sec trajectory across ALL ledger-"
+             "recorded runs; flags the latest run per rung against "
+             "the rolling best-of-window")
+    h.add_argument("--ledger", default=None, metavar="FILE",
+                   help="ledger JSONL (default: JAXMC_LEDGER or "
+                        "~/.cache/jaxmc/ledger.jsonl)")
+    h.add_argument("--rung", default=None,
+                   help="restrict to one rung (e.g. transfer_scaled, "
+                        "or a multichip point like philtoy@D8)")
+    h.add_argument("--import", dest="import_files", nargs="+",
+                   default=None, metavar="ARTIFACT",
+                   help="backfill committed artifacts (BENCH_r*.json, "
+                        "MULTICHIP_r*.json, --metrics-out JSONs; "
+                        "globs ok) into the ledger first — "
+                        "content-addressed, so re-importing is "
+                        "idempotent")
+    h.add_argument("--threshold", type=float, default=25.0,
+                   metavar="PCT",
+                   help="relative drop vs best-of-window that counts "
+                        "as a regression (default 25%%; ledger points "
+                        "span machines and months, so the bar is "
+                        "looser than diff's pairwise 10%%)")
+    h.add_argument("--window", type=int, default=5,
+                   help="how many preceding runs form the rolling "
+                        "best-of reference (default 5)")
+    h.add_argument("--fail-on-regress", action="store_true",
+                   help="exit 1 when the latest run of any rendered "
+                        "rung regressed (prof-check gate)")
     args = ap.parse_args(argv)
     try:
         if args.cmd == "report":
@@ -621,10 +729,12 @@ def main(argv: Optional[List[str]] = None, out=sys.stdout) -> int:
         if args.cmd == "timeline":
             from .timeline import cmd_timeline
             return cmd_timeline(args, out)
-        if len(args.files) < 2:
-            print("error: diff needs at least two artifacts",
-                  file=sys.stderr)
-            return 2
+        if args.cmd == "top":
+            from .prof import cmd_top
+            return cmd_top(args, out)
+        if args.cmd == "history":
+            from .ledger import cmd_history
+            return cmd_history(args, out)
         return cmd_diff(args, out)
     except (OSError, ValueError, KeyError) as e:
         print(f"error: {type(e).__name__}: {e}", file=sys.stderr)

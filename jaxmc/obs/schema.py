@@ -409,17 +409,78 @@ jaxmc.metrics/2 artifact minus the new optional surface, so readers and
       Telemetry, so one slow tenant cannot mask another job's stall;
       job heartbeat/stall events land in the per-job trace
       (`<spool>/results/<id>.trace.jsonl`) and ring.
+
+  jaxmc.metrics/4  (PR 17) adds, all optional — the device profiler +
+   HBM accounting + run-ledger surface; every /3 artifact remains
+   valid (readers accept both):
+    - the `prof{}` block (obs/prof.py): stamped by any run whose
+      profiler recorded something (always under --profile; under the
+      always-on cheap mode only when a dispatch site fired).  Grammar:
+        prof: {
+          mode: "cheap" | "wall" | "xla",
+          sites: { <site>: {              # e.g. "bfs.resident_run",
+            dispatches: int,              #   "mesh.superstep",
+            recompiles: int,              #   "batch.vstep", ...
+            wall_s?: float,               # block-until-ready wall
+            analysis_wall_s?: float,      # one-shot lowering retrace
+            arg_bytes?: int,              # cumulative argument bytes
+            res_bytes?: int,              # cumulative result bytes
+            cost?: {flops?: int,          # one-shot AOT
+                    bytes_accessed?: int} # lowering cost_analysis
+          }, ... },
+          hbm: {
+            buffers: { <name>: bytes },   # the device-memory MODEL:
+                                          # resident.seen/.frontier/
+                                          # .accumulator/.candidates,
+                                          # mesh.seen_shards/.frontier/
+                                          # .trace_ring/.a2a_buckets,
+                                          # level.seen/.frontier, ...
+            peak_bytes: int,              # model high-water
+            measured_peak_bytes?: int     # cross-check: sum of
+                                          # device memory_stats()
+                                          # peak_bytes_in_use, when
+                                          # the backend exposes it
+          },
+          xla_trace_dir?: str             # --profile=xla capture dir
+        }
+      Cheap mode records counts/recompiles only; wall/xla add the
+      sync + byte surfaces.  Profiling NEVER changes results: counts
+      and traces stay bit-identical profile-on vs profile-off
+      (pinned by tests and `make prof-check`).
+    - watchdog heartbeat events gain optional `device_mem_bytes` (the
+      HBM model's current total) next to `rss_bytes`; stall events
+      gain an optional dominant-site suffix in `msg` ("; 92% in
+      mesh.superstep") naming where the wall concentrated at stall
+      time.
+    - live exposition (serve/daemon.py): per-job `/metrics` series
+      gain `jaxmc_prof_site_dispatches` / `jaxmc_prof_site_wall_s`
+      (labels `{job,site}`) and `jaxmc_hbm_peak_bytes` {job}.
+      Completed jobs' `{job=...}` series persist for
+      JAXMC_METRICS_JOB_TTL seconds (default 600) after completion —
+      `jaxmc_job_running 0` plus the final gauges — then drop, so
+      fleet lifetime no longer grows scrape cardinality without
+      bound.
+    - the run ledger (obs/ledger.py) is a SIBLING artifact, not part
+      of the metrics schema: an append-only JSONL (default
+      ~/.cache/jaxmc/ledger.jsonl; JAXMC_LEDGER=path overrides,
+      =off disables) of one-line trajectory points
+        {v:1, id, ts, rung, run, kind, states_per_sec, platform,
+         env, source, sig?}
+      content-addressed by `id` = sha1(rung, ts, rate, sig, env,
+      source)[:16] — flock-appended, torn-line tolerant, idempotent
+      to re-import.  `python -m jaxmc.obs history` renders/gates it.
 """
 
 from __future__ import annotations
 
 from typing import Any, Dict
 
-SCHEMA = "jaxmc.metrics/3"
+SCHEMA = "jaxmc.metrics/4"
 
 # every schema revision an artifact may carry and a reader must accept
 # (additive history: a v1 artifact simply lacks the v2 optional surface)
-SCHEMAS = ("jaxmc.metrics/1", "jaxmc.metrics/2", "jaxmc.metrics/3")
+SCHEMAS = ("jaxmc.metrics/1", "jaxmc.metrics/2", "jaxmc.metrics/3",
+           "jaxmc.metrics/4")
 
 # top-level summary keys every artifact must carry
 REQUIRED_KEYS = ("schema", "started_at", "wall_s", "phases", "counters",

@@ -30,6 +30,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 from . import context as trace_context
+from .prof import Profiler  # per-dispatch attribution + HBM model
 from .schema import SCHEMA  # one source of truth for the artifact schema
 
 # every live recorder keeps the last N trace events in memory (the
@@ -107,6 +108,9 @@ class NullTelemetry:
     progress_est = None  # a ProgressEstimator when one is attached
     # (obs/progress.py); engines read it via getattr, so the null
     # recorder's class attribute keeps the hot path allocation-free
+    prof = None  # a Profiler on live recorders (obs/prof.py); the
+    # class-level None keeps prof.wrap's per-dispatch check to one
+    # getattr + a None test when telemetry is off
 
     def recent_events(self) -> List[Dict[str, Any]]:
         return []
@@ -192,6 +196,9 @@ class Telemetry(NullTelemetry):
         self.levels: List[Dict[str, Any]] = []
         self.progress_est = None  # attached by obs.progress when the
         # model binds and analyze offers a state-space estimate
+        # always-on cheap profiler (dispatch counts + recompiles only);
+        # the CLI flips mode to wall/xla under --profile
+        self.prof = Profiler()
         self._ring: collections.deque = collections.deque(maxlen=_RING_MAX)
         # the trace context is derived once per process; every event
         # this recorder emits is stamped with its trace_id so fleet
@@ -406,13 +413,27 @@ class Telemetry(NullTelemetry):
             "levels": levels,
         }
         out.update(meta)
+        prof = self.prof
+        if prof is not None:
+            pb = prof.snapshot()
+            if pb is not None:
+                out["prof"] = pb  # additive /4 block (obs/schema.py)
         if result is not None:
             out["result"] = _jsonable(result)
         return out
 
     def write_metrics(self, path: str,
                       result: Optional[Dict[str, Any]] = None) -> None:
-        write_json_atomic(path, self.summary(result))
+        s = self.summary(result)
+        write_json_atomic(path, s)
+        # every artifact-writing run is a trajectory point: record it in
+        # the persistent ledger (no-op when JAXMC_LEDGER=off, never
+        # raises — the ledger must not break a run)
+        try:
+            from .ledger import append_summary
+            append_summary(s, source=path)
+        except Exception:  # noqa: BLE001
+            pass
 
     def close(self) -> None:
         self._emit({"ev": "run_end", "t": self._clock()})

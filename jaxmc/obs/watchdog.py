@@ -147,6 +147,14 @@ class Watchdog:
             last_level=snap["last_level"],
             progress_seq=snap["progress_seq"],
             stalled_for_s=round(stalled_for, 3))
+        prof = getattr(tel, "prof", None)
+        if prof is not None:
+            # ISSUE 17: device memory (the HBM model's current total)
+            # rides next to RSS — a beat that shows host memory flat
+            # while device buffers grew names the right suspect
+            dm = prof.hbm_current_bytes()
+            if dm:
+                beat["device_mem_bytes"] = dm
         pe = getattr(tel, "progress_est", None)
         if pe is not None:  # ISSUE 16: the beat carries the live ETA
             ps = pe.snapshot()
@@ -169,12 +177,19 @@ class Watchdog:
                       else round(med, 6))
             where = " > ".join(snap["open_spans"]) or "no open span"
             lvl = snap["last_level"]
+            # ISSUE 17: name the dominant profiler site, turning "no
+            # progress" into "no progress, 92% in mesh.superstep"
+            dom = ""
+            if prof is not None:
+                ds = prof.dominant_site()
+                if ds is not None:
+                    dom = f"; {ds[1]:.0%} in {ds[0]}"
             try:
                 self.on_stall(
                     f"no span/level progress for {stalled_for:.0f}s "
                     f"(threshold {threshold:.0f}s); open: {where}; "
                     f"last completed level: "
-                    f"{'none' if lvl is None else lvl}")
+                    f"{'none' if lvl is None else lvl}{dom}")
             except Exception:  # noqa: BLE001
                 pass
         elif self._stalled:

@@ -158,6 +158,22 @@ class ServeDaemon:
         self._done_events: "collections.OrderedDict[str, list]" = \
             collections.OrderedDict()
         self._done_events_max = 16
+        # /metrics series TTL hygiene (ISSUE 17): completed jobs keep
+        # their {job="<id>"} series (jaxmc_job_running 0 + the final
+        # gauges) for JAXMC_METRICS_JOB_TTL seconds after completion,
+        # then drop at scrape time — a long-lived fleet no longer grows
+        # scrape cardinality with every job it ever ran.  Tests drive
+        # expiry by monkeypatching _metrics_clock.
+        try:
+            self._job_ttl = float(os.environ.get(
+                "JAXMC_METRICS_JOB_TTL", "600") or 600)
+        except ValueError:
+            self._job_ttl = 600.0
+        self._metrics_clock = time.time
+        # jid -> (completion time, the job's final Telemetry)
+        self._done_series: \
+            "collections.OrderedDict[str, Tuple[float, Any]]" = \
+            collections.OrderedDict()
 
     # ---- lifecycle ----------------------------------------------------
     def start(self) -> "ServeDaemon":
@@ -631,9 +647,15 @@ class ServeDaemon:
         snapshot in the bounded done-LRU so /jobs/<id>/events stays
         answerable briefly after completion."""
         with self._cv:
+            now = self._metrics_clock()
             for j in jids:
                 if self._job_tels.get(j) is job_tel:
                     del self._job_tels[j]
+                # TTL-retained /metrics series (ISSUE 17): scrapes keep
+                # rendering the finished job's final series (running 0)
+                # until the TTL prunes it at scrape time
+                self._done_series[j] = (now, job_tel)
+                self._done_series.move_to_end(j)
             if jids:
                 self._done_events[jids[0]] = job_tel.recent_events()
                 self._done_events.move_to_end(jids[0])
@@ -785,6 +807,13 @@ class ServeDaemon:
             "job_wall_s": round(wall, 6),
         }
         job_tel.close()
+        # run ledger (ISSUE 17): one trajectory point per batch (the
+        # leader's summary IS every member's summary); never raises
+        try:
+            from ..obs.ledger import append_summary
+            append_summary(summary, source=job["spec"])
+        except Exception:  # noqa: BLE001
+            pass
 
         status = "drained" if drained else "done"
         for j in [job] + followers:
@@ -1108,15 +1137,37 @@ class ServeDaemon:
         # family name -> (type, [(label_str, value)])
         fams: Dict[str, Tuple[str, list]] = {}
 
-        def add(name, value, typ="gauge", jid=None):
+        def add(name, value, typ="gauge", jid=None, site=None):
             if isinstance(value, bool):
                 value = int(value)
             if not isinstance(value, (int, float)):
                 return
             fam = fams.setdefault(obs.prom_name(name), (typ, []))
-            lbl = "" if jid is None else \
-                '{job="%s"}' % str(jid).replace('"', "'")
+            if jid is None:
+                lbl = ""
+            else:
+                pairs = ['job="%s"' % str(jid).replace('"', "'")]
+                if site is not None:
+                    pairs.append('site="%s"'
+                                 % str(site).replace('"', "'"))
+                lbl = "{%s}" % ",".join(pairs)
             fam[1].append((lbl, value))
+
+        def add_prof(jid, jt):
+            # ISSUE 17: per-dispatch-site gauges plus the HBM model's
+            # peak, straight off the job recorder's always-on profiler
+            prof = getattr(jt, "prof", None)
+            if prof is None:
+                return
+            for sname, st in sorted(prof.sites.items()):
+                add("prof.site_dispatches", st.dispatches,
+                    jid=jid, site=sname)
+                if st.wall_s:
+                    add("prof.site_wall_s", round(st.wall_s, 6),
+                        jid=jid, site=sname)
+            peak = prof.hbm_peak_bytes
+            if peak:
+                add("hbm.peak_bytes", peak, jid=jid)
 
         for name, v in fleet["counters"].items():
             add(name, v, "counter")
@@ -1146,6 +1197,26 @@ class ServeDaemon:
                 add("job.progress_distinct", ps["distinct"], jid=jid)
                 if ps["eta_s"] is not None:
                     add("job.progress_eta_s", ps["eta_s"], jid=jid)
+            add_prof(jid, jt)
+        # completed jobs linger for JAXMC_METRICS_JOB_TTL seconds so a
+        # scraper on a coarse interval still sees the final series of a
+        # short job (ISSUE 17 satellite: bounded by TTL, not forever)
+        mnow = self._metrics_clock()
+        with self._cv:
+            for jid in [j for j, (t, _jt) in self._done_series.items()
+                        if mnow - t > self._job_ttl]:
+                del self._done_series[jid]
+            done = [(jid, jt) for jid, (t, jt)
+                    in self._done_series.items()
+                    if jid not in jobs]
+        for jid, jt in done:
+            add("job.running", 0, jid=jid)
+            snap = jt.metrics_snapshot()
+            for gname, gval in snap["gauges"].items():
+                add(gname, gval, jid=jid)
+            if snap["levels"]:
+                add("job.levels", len(snap["levels"]), jid=jid)
+            add_prof(jid, jt)
         lines = []
         for name in sorted(fams):
             typ, samples = fams[name]

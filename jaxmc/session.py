@@ -142,6 +142,11 @@ class SessionConfig:
     # counts.  Runs on the exact serial interpreter engine; a device
     # backend with --por demotes to it with a named warning.
     por: bool = False
+    # device profiling mode (ISSUE 17, obs/prof.py): None (cheap
+    # counters only), "wall" or "xla".  Plumbing, not an answer-changer
+    # — deliberately NOT part of job_signature_fields (profiling never
+    # changes counts or traces)
+    profile: Optional[str] = None
     # serve-only knobs (no CLI flags):
     final_checkpoint: bool = False  # checkpoint COMPLETED runs too —
     # the daemon's warm-resume source

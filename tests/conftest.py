@@ -21,6 +21,12 @@ except ImportError:
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# ISSUE 17: every metrics write appends a trajectory point to the run
+# ledger (~/.cache/jaxmc/ledger.jsonl) unless redirected — the suite
+# must never pollute the developer's real history.  Tests that need a
+# live ledger monkeypatch JAXMC_LEDGER to a tmp path themselves.
+os.environ.setdefault("JAXMC_LEDGER", "off")
+
 REFERENCE = os.environ.get("JAXMC_REFERENCE", "/root/reference")
 
 # The reference spec corpus is mounted in the DRIVER environment only —

@@ -542,7 +542,7 @@ class TestMeshbenchChild:
         assert r["exchange"] == "a2a"
         assert r["merge"] == "rank"
         art = json.load(open(out))
-        assert art["schema"] == "jaxmc.metrics/3"
+        assert art["schema"] == "jaxmc.metrics/4"
         assert art["multichip"]["devices"] == 2
         assert art["multichip"]["merge"] == "rank"
         assert art["multichip"]["supersteps"] == r["supersteps"]

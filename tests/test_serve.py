@@ -388,3 +388,35 @@ class TestSigtermDrain:
         finally:
             if p2.poll() is None:
                 p2.kill()
+
+
+class TestMetricsRetention:
+    """ISSUE 17 satellites: per-job /metrics series outlive the job for
+    JAXMC_METRICS_JOB_TTL seconds (a coarse scraper still sees a short
+    job's final series), and jax jobs expose jaxmc_prof_site_* /
+    jaxmc_hbm_peak_bytes gauges from the always-on profiler."""
+
+    def test_done_job_series_ttl_and_prof_gauges(self, daemon,
+                                                 monkeypatch,
+                                                 tmp_path):
+        monkeypatch.setenv("JAXMC_PROFILE_STORE",
+                           str(tmp_path / "profiles"))
+        c = client(daemon)
+        _, job = c.submit(spec("constoy"), options=JAX_OPTS)
+        done = c.wait(job["id"], timeout=180)
+        assert done["status"] == "done", done
+        jid = job["id"]
+        # completed job: the final series linger inside the TTL window
+        body = daemon.metrics_text()
+        assert f'jaxmc_job_running{{job="{jid}"}} 0' in body
+        assert f'jaxmc_prof_site_dispatches{{job="{jid}",' \
+               f'site="bfs.resident_run"}}' in body
+        assert f'jaxmc_hbm_peak_bytes{{job="{jid}"}}' in body
+        # advance the metrics clock past the TTL: the series are pruned
+        t0 = time.time()
+        daemon._metrics_clock = \
+            lambda: t0 + daemon._job_ttl + 1.0
+        body2 = daemon.metrics_text()
+        assert jid not in body2
+        # fleet-level series survive the prune
+        assert "jaxmc_serve_jobs_done" in body2
