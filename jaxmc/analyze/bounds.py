@@ -1718,3 +1718,61 @@ def merge_lane_bounds(bounds_list) -> Dict[str, Tuple[int, int]]:
         merged[v] = (min(b[v][0] for b in bl),
                      max(b[v][1] for b in bl))
     return merged
+
+
+def _union_iv(a: Optional[Tuple[int, int]], b: Optional[Tuple[int, int]]
+              ) -> Optional[Tuple[int, int]]:
+    if a is None or b is None:
+        return None
+    return (min(a[0], b[0]), max(a[1], b[1]))
+
+
+def merge_eb(a: Optional[EB], b: Optional[EB]) -> Optional[EB]:
+    """Structural interval-union of two per-element bound trees: every
+    node keeps a proof only when BOTH sides prove one (a None child on
+    either side drops to None, and the consumer — pack._sb_child —
+    falls back to the merged covering `all`, a superset for both
+    members).  Record keys survive only where both sides track a
+    non-None per-key bound."""
+    if a is None or b is None:
+        return None
+    keys = None
+    if a.keys and b.keys:
+        keys = {}
+        for k in set(a.keys) & set(b.keys):
+            m = merge_eb(a.keys.get(k), b.keys.get(k))
+            if m is not None:
+                keys[k] = m
+        keys = keys or None
+    out = EB(all=_union_iv(a.all, b.all),
+             dom=merge_eb(a.dom, b.dom),
+             rng=merge_eb(a.rng, b.rng),
+             elem=merge_eb(a.elem, b.elem),
+             keys=keys)
+    return None if out.empty() else out
+
+
+def merge_element_bounds(eb_list) -> Dict[str, "EB"]:
+    """Per-element analog of merge_lane_bounds (ISSUE 18): the
+    STRUCTURAL union of every member's element_bounds() trees, so a
+    batch donor's container element lanes still pack at proven
+    per-element widths instead of dropping to whole-variable summary
+    intervals.  A variable keeps its tree only when every member proves
+    one; the result is sound for all members by construction (each node
+    is an interval union, each missing node a superset fallback)."""
+    el = [e for e in eb_list]
+    if not el or any(e is None for e in el):
+        return {}
+    common = set(el[0])
+    for e in el[1:]:
+        common &= set(e)
+    merged: Dict[str, EB] = {}
+    for v in common:
+        m = el[0][v]
+        for e in el[1:]:
+            m = merge_eb(m, e[v])
+            if m is None:
+                break
+        if m is not None:
+            merged[v] = m
+    return merged

@@ -60,12 +60,113 @@ class _NoKey:
 
 _NOKEY = _NoKey()
 
+# a binder domain larger than this never becomes a _KeySet: the
+# interference rules take set intersections and (for key arithmetic)
+# cross products over the domain values
+_KEYSET_MAX = 64
+
+
+class _KeySet:
+    """A binder key known only by its DOMAIN: the set of values the
+    binder may take (ISSUE 18 dynamic element keys).  Interferes with
+    a concrete key iff the key is a possible value, and with another
+    _KeySet iff the domains overlap — two arms writing msgs[self] for
+    bindings with disjoint domains commute element-wise instead of
+    bailing to the whole-variable footprint."""
+    __slots__ = ("vals",)
+
+    def __init__(self, vals):
+        self.vals = frozenset(vals)
+
+    def __eq__(self, other):
+        return isinstance(other, _KeySet) and self.vals == other.vals
+
+    def __hash__(self):
+        return hash((_KeySet, self.vals))
+
+    def __repr__(self):
+        return "{%s}" % "|".join(sorted(str(v) for v in self.vals))
+
+
+class _TupleKey:
+    """A statically-resolved tuple index (msgs[<<p, q>>]) — a dedicated
+    wrapper so tuple keys cannot collide with the internal raw-tuple
+    markers ($slotv etc.) that _static_key must keep rejecting."""
+    __slots__ = ("items",)
+
+    def __init__(self, items):
+        self.items = tuple(items)
+
+    def __eq__(self, other):
+        return isinstance(other, _TupleKey) and self.items == other.items
+
+    def __hash__(self):
+        return hash((_TupleKey, self.items))
+
+    def __repr__(self):
+        return "<<%s>>" % ",".join(str(v) for v in self.items)
+
+
+def _is_static_scalar(v) -> bool:
+    from ..sem.values import ModelValue
+    return isinstance(v, (int, str, ModelValue)) and \
+        not isinstance(v, bool)
+
+
+def _keys_may_equal(k1, k2) -> bool:
+    """Could two STATIC keys denote the same container element?
+    Concrete keys compare by equality; a _KeySet stands for any of its
+    domain values; a tuple key never equals a scalar (TLA+ tuples and
+    scalars are distinct values)."""
+    if isinstance(k1, _TupleKey) and isinstance(k2, _TupleKey):
+        if len(k1.items) != len(k2.items):
+            return False
+        return all(_keys_may_equal(a, b)
+                   for a, b in zip(k1.items, k2.items))
+    if isinstance(k1, _TupleKey) or isinstance(k2, _TupleKey):
+        other = k2 if isinstance(k1, _TupleKey) else k1
+        if isinstance(other, _KeySet):
+            # scalar domain members never equal a tuple value; any
+            # non-scalar member is conservatively a possible match
+            return any(not _is_static_scalar(v) for v in other.vals)
+        return False
+    if isinstance(k1, _KeySet) and isinstance(k2, _KeySet):
+        return bool(k1.vals & k2.vals)
+    if isinstance(k1, _KeySet):
+        return k2 in k1.vals
+    if isinstance(k2, _KeySet):
+        return k1 in k2.vals
+    return k1 == k2
+
+
+def _key_arith(op: str, a, b):
+    """Static integer arithmetic over keys (msgs[self+1]): concrete op
+    concrete folds; a _KeySet maps over its domain (bounded cross
+    product)."""
+    def ints(k):
+        if isinstance(k, int) and not isinstance(k, bool):
+            return [k]
+        if isinstance(k, _KeySet) and all(
+                isinstance(v, int) and not isinstance(v, bool)
+                for v in k.vals):
+            return list(k.vals)
+        return None
+    av, bv = ints(a), ints(b)
+    if av is None or bv is None or len(av) * len(bv) > _KEYSET_MAX:
+        return _NOKEY
+    f = (lambda x, y: x + y) if op == "+" else (lambda x, y: x - y)
+    out = {f(x, y) for x in av for y in bv}
+    if len(out) == 1:
+        return next(iter(out))
+    return _KeySet(out)
+
 
 # Footprint ATOMS are (var, key) pairs: key None = the whole variable,
-# a concrete key = ONE container element (pc[p1]).  Two atoms interfere
-# when they name the same variable and either is whole-var or the keys
-# are equal — the granularity that lets raft/Paxos-style per-process
-# arms over one shared container commute.
+# a concrete key = ONE container element (pc[p1]), a _KeySet = one
+# element from a known domain, a _TupleKey = one tuple-indexed element.
+# Two atoms interfere when they name the same variable and either is
+# whole-var or the keys MAY be equal — the granularity that lets
+# raft/Paxos-style per-process arms over one shared container commute.
 Atom = Tuple[str, object]
 
 
@@ -74,7 +175,7 @@ def _interfere(a: FrozenSet[Atom], b: FrozenSet[Atom]) -> bool:
         for v2, k2 in b:
             if v1 != v2:
                 continue
-            if k1 is None or k2 is None or k1 == k2:
+            if k1 is None or k2 is None or _keys_may_equal(k1, k2):
                 return True
     return False
 
@@ -92,9 +193,27 @@ class ArmFootprint:
     reads: FrozenSet[Atom]
     writes: FrozenSet[Atom]
     exact: bool  # False: the walk bailed and the footprint is ALL vars
+    bail_reason: Optional[str] = None  # named, when exact is False
 
     def write_vars(self) -> FrozenSet[str]:
         return frozenset(v for v, _k in self.writes)
+
+    def key_class(self) -> str:
+        """Dynamic-key classification (ISSUE 18): did the writes
+        resolve to element atoms — the granularity regrouping and POR
+        consume — and when not, why."""
+        if not self.exact:
+            return ("full-footprint bail "
+                    f"({self.bail_reason or 'unanalyzable'})")
+        whole = sorted({v for v, k in self.writes if k is None})
+        if whole:
+            return f"whole-var writes: {','.join(whole)}"
+        return "element-commuting"
+
+
+def _bail(acc, why: str) -> None:
+    acc["bail"] = True
+    acc.setdefault("why", why)
 
 
 class _FootprintWalk:
@@ -105,7 +224,7 @@ class _FootprintWalk:
         self.vars = set(model.vars)
         self.defs = model.defs
         self._def_memo: Dict[str, Tuple[Set[Atom], Set[Atom], Set[str],
-                                        bool]] = {}
+                                        bool, Optional[str]]] = {}
         self._nodes = 0
 
     # ---- one arm ------------------------------------------------------
@@ -116,10 +235,11 @@ class _FootprintWalk:
             self._walk(arm.expr, frozenset(), acc, (),
                        dict(arm.bound or {}))
         except RecursionError:
-            acc["bail"] = True
+            _bail(acc, "python recursion limit")
         if acc["bail"]:
             allv = frozenset((v, None) for v in self.vars)
-            return ArmFootprint(label, allv, allv, exact=False)
+            return ArmFootprint(label, allv, allv, exact=False,
+                                bail_reason=acc.get("why"))
         # a variable the walk never classified is an unknown write
         classified = {v for v, _k in acc["w"]} | acc["u"]
         for v in self.vars - classified:
@@ -132,11 +252,27 @@ class _FootprintWalk:
 
     # ---- static-key resolution ---------------------------------------
     def _static_key(self, e, shadow, bound):
-        """The concrete key of an index expression, or _NOKEY."""
+        """The static key of an index expression, or _NOKEY.  A key is
+        concrete (binder/CONSTANT scalar), a _KeySet (binder over a
+        statically-enumerable domain), a _TupleKey (tuple index of
+        static components), or static +/- arithmetic over those."""
         if isinstance(e, A.Num):
             return e.val
         if isinstance(e, A.Str):
             return e.val
+        if isinstance(e, A.TupleExpr):
+            items = []
+            for it in e.items:
+                k = self._static_key(it, shadow, bound)
+                if k is _NOKEY:
+                    return _NOKEY
+                items.append(k)
+            return _TupleKey(items)
+        if isinstance(e, A.OpApp) and not e.path and \
+                e.name in ("+", "-") and len(e.args) == 2:
+            return _key_arith(e.name,
+                              self._static_key(e.args[0], shadow, bound),
+                              self._static_key(e.args[1], shadow, bound))
         if isinstance(e, A.Ident) and e.name not in shadow:
             v = _NOKEY
             if e.name in bound:
@@ -159,13 +295,68 @@ class _FootprintWalk:
             return v
         return _NOKEY
 
+    def _index_key(self, args, shadow, bound):
+        """The static key of an index-argument list: one argument is
+        the key itself, several are the implicit tuple f[a, b]."""
+        if len(args) == 1:
+            return self._static_key(args[0], shadow, bound)
+        items = []
+        for a in args:
+            k = self._static_key(a, shadow, bound)
+            if k is _NOKEY:
+                return _NOKEY
+            items.append(k)
+        return _TupleKey(items)
+
+    def _static_domain(self, dom, shadow, bound):
+        """The statically-enumerable value set of a binder domain, or
+        None.  Members must be concrete scalar keys: the _KeySet
+        interference rules reason over possible key VALUES, so one
+        unresolvable member poisons the whole domain."""
+        if dom is None:
+            return None
+        if isinstance(dom, A.SetEnum):
+            vals = []
+            for it in dom.items:
+                k = self._static_key(it, shadow, bound)
+                if not _is_static_scalar(k):
+                    return None
+                vals.append(k)
+            return frozenset(vals) \
+                if 0 < len(vals) <= _KEYSET_MAX else None
+        if isinstance(dom, A.Ident) and dom.name not in shadow \
+                and dom.name not in self.vars:
+            d = self.defs.get(dom.name)
+            if isinstance(d, (set, frozenset)) and \
+                    0 < len(d) <= _KEYSET_MAX and \
+                    all(_is_static_scalar(v) for v in d):
+                return frozenset(d)
+            return None
+        if isinstance(dom, A.SetFilter):
+            # a filter only narrows its base set: the base's value set
+            # over-approximates the binder's possible keys, which is
+            # sound (a larger _KeySet only interferes MORE) — this is
+            # the dynamic raft shape `\E i \in {j \in Server : cond}`
+            base = getattr(dom, "set", None)
+            return None if base is None else \
+                self._static_domain(base, shadow, bound)
+        if isinstance(dom, A.OpApp) and not dom.path and \
+                dom.name == ".." and len(dom.args) == 2:
+            lo = self._static_key(dom.args[0], shadow, bound)
+            hi = self._static_key(dom.args[1], shadow, bound)
+            if _is_static_scalar(lo) and _is_static_scalar(hi) and \
+                    isinstance(lo, int) and isinstance(hi, int) and \
+                    0 < hi - lo + 1 <= _KEYSET_MAX:
+                return frozenset(range(lo, hi + 1))
+        return None
+
     # ---- recursive walk ----------------------------------------------
     def _walk(self, e, shadow: FrozenSet[str], acc, stack,
               bound) -> None:
         self._nodes += 1
         if e is None or acc["bail"] or self._nodes > 200000:
             if self._nodes > 200000:
-                acc["bail"] = True
+                _bail(acc, "node budget exceeded")
             return
         if isinstance(e, (A.Num, A.Str, A.Bool, A.At)):
             return
@@ -179,10 +370,10 @@ class _FootprintWalk:
             return
         if isinstance(e, A.FnApp):
             # element read: pc[p] with a statically-bound p reads ONE
-            # atom, not the whole container
+            # atom, not the whole container (f[a, b] = f[<<a, b>>])
             if isinstance(e.fn, A.Ident) and e.fn.name in self.vars \
-                    and e.fn.name not in shadow and len(e.args) == 1:
-                k = self._static_key(e.args[0], shadow, bound)
+                    and e.fn.name not in shadow and len(e.args) >= 1:
+                k = self._index_key(e.args, shadow, bound)
                 if k is not _NOKEY:
                     acc["r"].add((e.fn.name, k))
                     return
@@ -199,17 +390,18 @@ class _FootprintWalk:
                    "bail": False}
             self._walk(e.expr, shadow, sub, stack, bound)
             if sub["bail"]:
-                acc["bail"] = True
+                _bail(acc, sub.get("why", "unanalyzable primed "
+                                          "expression"))
                 return
             acc["w"] |= {(v, None) for v, _k in sub["r"] | sub["w"]}
             return
         if isinstance(e, A.Unchanged):
             if not self._unchanged(e.expr, shadow, acc, stack):
-                acc["bail"] = True
+                _bail(acc, "unresolvable UNCHANGED target")
             return
         if isinstance(e, A.OpApp):
-            if e.path:
-                acc["bail"] = True  # instance-qualified: unmodelled
+            if e.path:  # instance-qualified: unmodelled
+                _bail(acc, "instance-qualified operator")
                 return
             # the per-element assignment shape: v' = [v EXCEPT ![k]=e]
             if e.name == "=" and len(e.args) == 2 and \
@@ -225,7 +417,7 @@ class _FootprintWalk:
                     len(d.params) == len(e.args) and \
                     not isinstance(d.body, A.FnConstrDef):
                 if e.name in stack or len(stack) > 32:
-                    acc["bail"] = True
+                    _bail(acc, f"recursive operator {e.name}")
                     return
                 bound2 = {}
                 static_args = True
@@ -250,13 +442,31 @@ class _FootprintWalk:
         if isinstance(e, (A.Quant, A.SetMap, A.FnDef)):
             binders = e.binders
         if binders is not None:
+            # a binder over a statically-enumerable domain binds its
+            # name to a _KeySet of the possible values instead of
+            # shadowing it (ISSUE 18): element keys indexed by the
+            # binder stay resolvable, so a DYNAMIC \E (one arm) still
+            # gets an element-level footprint.  Names colliding with a
+            # state variable or an operator keep the shadow path (the
+            # Ident walk would misread them otherwise).
             names: List[str] = []
+            ks_bound: Dict[str, object] = {}
             for bnames, dom in binders:
                 names.extend(bnames)
                 self._walk(dom, shadow, acc, stack, bound)
-            shadow2 = shadow | frozenset(names)
+                dvals = self._static_domain(dom, shadow, bound)
+                if dvals is not None:
+                    ks = _KeySet(dvals)
+                    for n in bnames:
+                        if isinstance(n, str) and n not in self.vars \
+                                and self.defs.get(n) is None:
+                            ks_bound[n] = ks
+            shadow2 = (shadow - frozenset(ks_bound)) | frozenset(
+                n for n in names
+                if isinstance(n, str) and n not in ks_bound)
+            bound2 = bound if not ks_bound else {**bound, **ks_bound}
             self._walk(e.expr if isinstance(e, A.SetMap) else e.body,
-                       shadow2, acc, stack, bound)
+                       shadow2, acc, stack, bound2)
             return
         if isinstance(e, (A.SetFilter, A.Choose)):
             v = e.var
@@ -320,9 +530,9 @@ class _FootprintWalk:
             keys = []
             for path, upd in rhs.updates:
                 if len(path) != 1 or path[0][0] != "idx" \
-                        or len(path[0][1]) != 1:
+                        or len(path[0][1]) < 1:
                     return False  # nested/dot path: generic fallback
-                k = self._static_key(path[0][1][0], shadow, bound)
+                k = self._index_key(path[0][1], shadow, bound)
                 if k is _NOKEY:
                     return False
                 keys.append(k)
@@ -363,7 +573,7 @@ class _FootprintWalk:
         fp = self._def_memo.get(name)
         if fp is None:
             if name in stack or len(stack) > 32:
-                acc["bail"] = True
+                _bail(acc, f"recursive operator {name}")
                 return
             sub = {"r": set(), "w": set(), "u": set(), "bail": False}
             body = d.body
@@ -372,11 +582,12 @@ class _FootprintWalk:
             self._walk(body, frozenset(
                 p for p in d.params if isinstance(p, str)),
                 sub, stack + (name,), {})
-            fp = (sub["r"], sub["w"], sub["u"], sub["bail"])
+            fp = (sub["r"], sub["w"], sub["u"], sub["bail"],
+                  sub.get("why"))
             self._def_memo[name] = fp
-        r, w, u, bail = fp
+        r, w, u, bail, why = fp
         if bail:
-            acc["bail"] = True
+            _bail(acc, why or f"unanalyzable operator {name}")
             return
         acc["r"] |= r
         acc["w"] |= w
@@ -427,6 +638,13 @@ class IndependenceReport:
                 + (" por-safe" if i in self.por_safe else ""))
         return out
 
+    def keyclass_rows(self) -> List[str]:
+        """Dynamic-key classification per arm (ISSUE 18), rendered for
+        `jaxmc info --cfg` next to the matrix: element-commuting /
+        whole-var writes / full-footprint bail with the reason named."""
+        return [f"{lb:24s} {self.footprints[i].key_class()}"
+                for i, lb in enumerate(self.labels)]
+
 
 def independence_report(model, arms=None) -> IndependenceReport:
     """Compute (and cache on the model) the arm-independence report.
@@ -446,7 +664,8 @@ def independence_report(model, arms=None) -> IndependenceReport:
         if os.environ.get("JAXMC_DEBUG"):
             raise
         full = frozenset((v, None) for v in model.vars)
-        fps = [ArmFootprint(a.label or "Next", full, full, exact=False)
+        fps = [ArmFootprint(a.label or "Next", full, full, exact=False,
+                            bail_reason="analysis error")
                for a in arms]
     n = len(fps)
     mat = [[False] * n for _ in range(n)]

@@ -65,10 +65,23 @@ class _MergedBounds:
     """Shim BoundsReport for the donor build: the interval-UNION of
     every member's converged proof, sound for all of them."""
     merged: Dict[str, Tuple[int, int]]
+    merged_eb: Dict[str, Any] = field(default_factory=dict)
     converged: bool = True
 
     def lane_bounds(self) -> Dict[str, Tuple[int, int]]:
         return self.merged
+
+    def element_bounds(self) -> Dict[str, Any]:
+        # structural merge (ISSUE 18): per-element trees where every
+        # member proved one, backed by the lane interval for variables
+        # whose structured merge collapsed — the donor plan never packs
+        # wider than the worst solo member would
+        from ..analyze.bounds import EB
+        out: Dict[str, Any] = dict(self.merged_eb)
+        for v, iv in self.merged.items():
+            if v not in out:
+                out[v] = EB(all=iv)
+        return out
 
 
 class BatchDispatcher:
@@ -237,6 +250,7 @@ class BatchCheckEngine:
     def build(self) -> "BatchCheckEngine":
         from ..analyze.bounds import (infer_state_bounds,
                                       liftable_constants,
+                                      merge_element_bounds,
                                       merge_lane_bounds)
         from ..session import load_model
         t0 = time.time()
@@ -293,7 +307,11 @@ class BatchCheckEngine:
         merged = merge_lane_bounds(
             [r.lane_bounds() if r is not None and r.converged else None
              for r in reports])
-        m0._bounds_report = _MergedBounds(merged=merged)
+        merged_eb = merge_element_bounds(
+            [r.element_bounds() if r is not None and r.converged
+             else None for r in reports])
+        m0._bounds_report = _MergedBounds(merged=merged,
+                                          merged_eb=merged_eb)
 
         bounds = Bounds(seq_cap=c0.seq_cap, grow_cap=c0.grow_cap,
                         kv_cap=c0.kv_cap)

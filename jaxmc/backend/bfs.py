@@ -52,6 +52,7 @@ FP_THRESHOLD = 48  # lanes; beyond this, dedup on 128-bit fingerprints
 # "bounds inference not yet attempted" marker for the per-model cache
 # (the cached report itself may legitimately be None = analysis bailed)
 _SENTINEL_NO_REPORT = object()
+_POR_UNSET = object()
 
 # resident-mode status codes (one summary scalar per dispatched batch)
 ST_CONTINUE = 0     # level budget exhausted, search not finished
@@ -195,6 +196,92 @@ def _lsd_sort(key_cols, extra_cols):
     return cols[:nk], cols[nk:]
 
 
+def _seen_probe(seen, seen_count, keys, SC):
+    """Membership of each key row in the seen table's sorted valid
+    prefix — the newness verdict the rank-merge computes, exposed
+    standalone so the device POR filter (ISSUE 18) can reuse it with
+    zero extra dispatches.  keys [N, K] need NOT be sorted
+    (_lower_bound binary-searches per query); invalid rows (validity
+    lane != 0, SENTINEL words) sort past the prefix and report False.
+
+    Returns (found [N] bool, lb [N] int32 lower-bound rank)."""
+    words = keys[:, 1:]
+    seen_words = seen[:, 1:]
+    lb = _lower_bound(seen_words, seen_count, words, SC)
+    at_lb = jnp.take(seen_words, jnp.clip(lb, 0, SC - 1), axis=0)
+    found = (lb < seen_count) & jnp.all(at_lb == words, axis=1)
+    return found, lb
+
+
+def _por_mask(found, cvalid, inst_arm, arm_safe, A, FC):
+    """Device persistent-set filter (ISSUE 18): per frontier slot f,
+    pick the FIRST por-safe arm whose successor set is nonempty and
+    entirely NEW — the interp's singleton-ample rule
+    (engine/explore._por_expand, first arm in sorted(por_safe) order)
+    — and mask every other arm's candidates for that slot; slots with
+    no such arm keep full expansion.
+
+    found/cvalid are [C = A*FC] over the dense candidate grid with
+    c = a * FC + f; inst_arm [A] maps instance rows to split-arm
+    indices (slotted kernels contribute n_slots rows per arm);
+    arm_safe [n_arms] marks the arms the independence report proved
+    globally-commuting + property-invisible.
+
+    Soundness of probing the PRE-LEVEL seen snapshot: after level L's
+    merge the table holds the closure through depth L+1, so a
+    successor that probes NEW has strictly greater depth than its
+    source — ample chains strictly deepen and every cycle retains a
+    fully-expanded state (the BFS cycle proviso C3).  Within-level
+    sibling duplicates pass the probe but are deduped by the merge,
+    which only makes the filter more conservative, never unsound.
+    Deadlock/assert verdicts are evaluated by callers on the PRE-mask
+    enabledness, and the ample arm commutes with every arm, so
+    invariant/deadlock verdicts match the unreduced run.
+
+    Returns (keep [C] = cvalid minus masked candidates,
+             n_ample  frontier slots reduced to a singleton arm,
+             n_expanded  frontier slots with any enabled candidate)."""
+    n_arms = arm_safe.shape[0]
+    cv = cvalid.reshape(A, FC)
+    bad = (found & cvalid).reshape(A, FC)
+    one_hot = (jnp.arange(n_arms, dtype=jnp.int32)[:, None]
+               == inst_arm[None, :]).astype(jnp.int32)   # [n_arms, A]
+    en_cnt = one_hot @ cv.astype(jnp.int32)              # [n_arms, FC]
+    bad_cnt = one_hot @ bad.astype(jnp.int32)
+    elig = arm_safe[:, None] & (en_cnt > 0) & (bad_cnt == 0)
+    has = jnp.any(elig, axis=0)                          # [FC]
+    # argmax over bool returns the FIRST True: the lowest-indexed
+    # eligible arm, matching the interp's sorted(por_safe) order
+    chosen = jnp.argmax(elig, axis=0).astype(jnp.int32)
+    keep_inst = (~has)[None, :] | \
+        (inst_arm[:, None] == chosen[None, :])           # [A, FC]
+    keep = keep_inst.reshape(A * FC) & cvalid
+    slot_en = jnp.any(cv, axis=0)
+    n_ample = jnp.sum(has & slot_en, dtype=jnp.int32)
+    n_expanded = jnp.sum(slot_en, dtype=jnp.int32)
+    return keep, n_ample, n_expanded
+
+
+def _por_mask_np(found, cvalid, inst_arm, arm_safe, A, FC):
+    """NumPy twin of _por_mask for the host_seen engine's host-side
+    filter (same ample rule against the native fingerprint store)."""
+    n_arms = arm_safe.shape[0]
+    cv = cvalid.reshape(A, FC)
+    bad = (found & cvalid).reshape(A, FC)
+    one_hot = (np.arange(n_arms)[:, None] == inst_arm[None, :])
+    en_cnt = one_hot.astype(np.int64) @ cv.astype(np.int64)
+    bad_cnt = one_hot.astype(np.int64) @ bad.astype(np.int64)
+    elig = arm_safe[:, None] & (en_cnt > 0) & (bad_cnt == 0)
+    has = np.any(elig, axis=0)
+    chosen = np.argmax(elig, axis=0)
+    keep_inst = (~has)[None, :] | (inst_arm[:, None] == chosen[None, :])
+    keep = keep_inst.reshape(A * FC) & cvalid
+    slot_en = np.any(cv, axis=0)
+    n_ample = int(np.sum(has & slot_en))
+    n_expanded = int(np.sum(slot_en))
+    return keep, n_ample, n_expanded
+
+
 def _rank_merge(seen, seen_count, keys, N, SC, K, multikey=False):
     """The O(new) seen-merge core SHARED by the single-chip resident
     level and the mesh rank-merge strategy (ISSUE 10; the
@@ -244,10 +331,7 @@ def _rank_merge(seen, seen_count, keys, N, SC, K, multikey=False):
         jnp.any(skeys[1:] != skeys[:-1], axis=1)])
 
     words = skeys[:, 1:]
-    seen_words = seen[:, 1:]
-    lb = _lower_bound(seen_words, seen_count, words, SC)
-    at_lb = jnp.take(seen_words, jnp.clip(lb, 0, SC - 1), axis=0)
-    found = (lb < seen_count) & jnp.all(at_lb == words, axis=1)
+    found, lb = _seen_probe(seen, seen_count, skeys, SC)
     new = svalid & ~found & neq_prev
     new_count = jnp.sum(new, dtype=jnp.int32)
 
@@ -388,6 +472,7 @@ class TpuExplorer:
                  spill_dir: Optional[str] = None,
                  host_tier_keys: Optional[int] = None,
                  lift_consts: Optional[Tuple[str, ...]] = None,
+                 por: bool = False,
                  donor: Optional["TpuExplorer"] = None):
         # cross-model batching (ISSUE 13): `lift_consts` compiles the
         # named CONSTANTs as traced kernel inputs instead of baked
@@ -397,6 +482,15 @@ class TpuExplorer:
         # (zero kernel builds) while keeping its own model, init
         # states, seen store and checkpoint surface.
         self._hstep_override: Optional[Callable] = None
+        # device POR (ISSUE 18): the persistent-set filter runs INSIDE
+        # the fused step (level/resident/host_seen), reusing the seen
+        # probe the merge performs anyway — the plan (instance->arm map
+        # + por-safe mask) is resolved lazily by _por_plan(), which
+        # names the refusal when the reduction cannot run
+        self.por = bool(por)
+        self.por_reason: Optional[str] = None
+        self._por_memo: Any = _POR_UNSET
+        self._por_stats = {"ample": 0, "expanded": 0, "masked": 0}
         if donor is not None:
             self._clone_from_donor(
                 donor, model, log=log, max_states=max_states,
@@ -1075,6 +1169,105 @@ class TpuExplorer:
                  f"(no growth-retry recompiles expected)")
         return caps
 
+    # ---- device persistent-set reduction (ISSUE 18) -------------------
+
+    def _por_plan(self) -> Optional[Dict[str, np.ndarray]]:
+        """The device POR plan, or None with the named refusal in
+        self.por_reason (the engine then runs UNREDUCED and discloses
+        why — same surface as the interp backend's por_refusal path).
+
+        plan = dict(inst_arm [A] int32 — split-arm index per flat
+        kernel instance (slotted kernels contribute n_slots entries),
+        arm_safe [n_arms] bool — arms the independence report proved
+        commuting-with-all + property-invisible).  Memoized: the
+        independence analysis walks the AST once per engine."""
+        if self._por_memo is not _POR_UNSET:
+            return self._por_memo
+        from ..analyze.independence import (indep_enabled,
+                                            independence_report,
+                                            por_refusal)
+        plan = None
+        reason = None
+        if not self.por:
+            reason = "POR not requested"
+        elif not indep_enabled():
+            reason = ("independence analysis disabled "
+                      "(JAXMC_ANALYZE_INDEP=0)")
+        elif self.hybrid:
+            reason = ("hybrid execution: interp-demoted units expand "
+                      "on the host where the device mask cannot reach "
+                      "them")
+        else:
+            reason = por_refusal(self.model)
+            if reason is None and (self.canon_fn is not None
+                                   or self.sym_identity):
+                reason = "symmetry canonicalizer active"
+            if reason is None:
+                try:
+                    irep = independence_report(self.model, self.arms)
+                except Exception:
+                    if os.environ.get("JAXMC_DEBUG"):
+                        raise
+                    irep = None
+                if irep is None:
+                    reason = "independence analysis failed"
+                elif not irep.por_safe:
+                    reason = ("no arm commutes with every other arm "
+                              "invisibly")
+                else:
+                    safe = np.zeros(len(self.arms), dtype=bool)
+                    safe[list(irep.por_safe)] = True
+                    inst = np.asarray(
+                        [self._ca_arm[ci]
+                         for ci, ca in enumerate(self.compiled)
+                         for _ in range(max(1, ca.n_slots))],
+                        np.int32)
+                    assert inst.shape[0] == self.A
+                    plan = dict(inst_arm=inst, arm_safe=safe)
+        self._por_memo = plan
+        self.por_reason = reason
+        tel = obs.current()
+        if self.por:
+            if plan is None:
+                self.log(f"-- por requested but reduction disabled: "
+                         f"{reason} (running unreduced)")
+                tel.gauge("por.disabled_reason", reason)
+                tel.gauge("por.enabled", False)
+            else:
+                n_safe = int(plan["arm_safe"].sum())
+                self.log(f"-- por: {n_safe}/{len(self.arms)} arms "
+                         f"eligible as singleton ample sets (device "
+                         f"persistent-set filter in the fused step)")
+                tel.gauge("por.enabled", True)
+                tel.gauge("por.engine", "device")
+        return plan
+
+    def _por_warnings(self) -> List[str]:
+        """The interp backend's refusal warning, word-for-word, when
+        --por was requested but the reduction cannot run."""
+        if not self.por:
+            return []
+        if self._por_plan() is None:
+            return [f"--por requested but reduction disabled: "
+                    f"{self.por_reason} (running unreduced)"]
+        return []
+
+    def _por_finish(self, ample: int, expanded: int, masked: int,
+                    distinct: int) -> None:
+        """Emit the end-of-run POR counters (same names as the interp
+        engine, plus the device-only masked-candidate gauge)."""
+        if not self.por or self._por_memo in (None, _POR_UNSET):
+            return
+        tel = obs.current()
+        full = max(0, int(expanded) - int(ample))
+        tel.counter("por.ample_states", int(ample))
+        tel.counter("por.full_states", full)
+        tel.gauge("por.ample_ratio",
+                  round(int(ample) / int(expanded), 4)
+                  if expanded else 0.0)
+        tel.gauge("por.device_masked_arms", int(masked))
+        tel.gauge("por.reduced_states", int(distinct))
+
     # ---- lifted constants + follower clones (ISSUE 13) ---------------
 
     def _install_const_lanes(self, cvec) -> None:
@@ -1537,7 +1730,11 @@ class TpuExplorer:
         # recomputes keys; the flag joins the compile key — the one
         # recompile it costs happens at the first spill
         tiered = self._tiers is not None
-        key = (SC, FC, rank, tiered)
+        # device POR (ISSUE 18): the persistent-set filter joins the
+        # compile key — the mask arrays are baked constants
+        por_plan = self._por_plan() if self.por else None
+        por = por_plan is not None
+        key = (SC, FC, rank, tiered, por)
         if key in self._step_cache:
             obs.current().counter("compile.cache_hits")
             return self._step_cache[key]
@@ -1551,6 +1748,12 @@ class TpuExplorer:
         # stream candidates for stepwise refinement and/or the liveness
         # behavior graph on the host (verdict parity with the interp)
         need_edges = bool(self.refiners) or self.collect_edges
+        if por:
+            # temporal/refinement PROPERTYs are por_refusal territory,
+            # so the edge stream and the mask can never co-occur
+            assert not need_edges
+            por_inst = jnp.asarray(por_plan["inst_arm"])
+            por_safe_v = jnp.asarray(por_plan["arm_safe"])
         # FUSED + DONATED level step (ISSUE 6): the whole level —
         # expansion, fingerprint/pack, dedup sort, CONSTRAINT and
         # invariant evaluation — is ONE jitted dispatch, and the seen
@@ -1579,6 +1782,26 @@ class TpuExplorer:
             prov = jnp.arange(C, dtype=jnp.int32)
             cand_u = jnp.where(cvalid[:, None], cand_u, SENTINEL)
             ckeys, cand, pack_ovf = keys_of(cand_u, cvalid)
+
+            por_ample = por_expanded = por_masked = jnp.int32(0)
+            if por:
+                # persistent-set filter INSIDE the fused step (ISSUE
+                # 18): probe the PRE-level seen snapshot (closure
+                # through this depth — see _por_mask for the cycle-
+                # proviso argument), then mask every non-ample arm's
+                # candidates.  Deadlock/assert verdicts above read the
+                # PRE-mask enabledness; gen counts the reduced stream.
+                found, _ = _seen_probe(seen_keys, seen_count, ckeys, SC)
+                keep, por_ample, por_expanded = _por_mask(
+                    found, cvalid, por_inst, por_safe_v, A, FC)
+                por_masked = jnp.sum(cvalid & ~keep, dtype=jnp.int32)
+                inv_key = jnp.concatenate([
+                    jnp.ones((C, 1), jnp.int32),
+                    jnp.full((C, K - 1), SENTINEL, jnp.int32)], axis=1)
+                ckeys = jnp.where(keep[:, None], ckeys, inv_key)
+                cand_u = jnp.where(keep[:, None], cand_u, SENTINEL)
+                cvalid = keep
+                gen = jnp.sum(keep)
 
             if rank:
                 # O(new): sort only the C candidate keys, dedup against
@@ -1693,6 +1916,10 @@ class TpuExplorer:
                        front_count=explore_count,
                        inv_bad_any=inv_bad_any, inv_bad_idx=inv_bad_idx,
                        inv_bad_which=inv_bad_which)
+            if por:
+                out["por_ample"] = por_ample
+                out["por_expanded"] = por_expanded
+                out["por_masked"] = por_masked
             if front_keys is not None:
                 out["front_keys"] = front_keys
             if need_edges:
@@ -2064,6 +2291,18 @@ class TpuExplorer:
         expand = self._expand_fn()
         check_deadlock = self.model.check_deadlock
         assert FCap % CH == 0
+        # device POR (ISSUE 18): the persistent-set filter probes the
+        # PRE-LEVEL seen snapshot (chunk bodies close over level()'s
+        # `seen` — the merge runs after all chunks), so the resident,
+        # level and mesh engines make identical ample decisions and
+        # produce identical reduced counts.  The three counters always
+        # ride the carry/summary (zero when POR is off) so the host
+        # unpack is unconditional.
+        por_plan = self._por_plan() if self.por else None
+        por = por_plan is not None
+        if por:
+            por_inst = jnp.asarray(por_plan["inst_arm"])
+            por_safe_v = jnp.asarray(por_plan["arm_safe"])
 
         def level(seen, seen_count, frontier, fcount):
             # frontier is PACKED [FCap, PW]; each chunk unpacks to lanes
@@ -2073,7 +2312,7 @@ class TpuExplorer:
 
             def chunk_body(carry):
                 (ci, acc_keys, acc_rows, acc_n, gen, stat,
-                 bad_row, ovcode) = carry
+                 bad_row, ovcode, pora, porx, porm) = carry
                 base = ci * CH
                 chunk_p = lax.dynamic_slice(frontier, (base, 0),
                                             (CH, PW))
@@ -2125,6 +2364,35 @@ class TpuExplorer:
                     jnp.where(pack_ovf, OV_PACK, 0).astype(jnp.int32),
                     ovcode)
 
+                if por:
+                    # persistent-set filter (ISSUE 18): probe the
+                    # compacted candidate keys against the pre-level
+                    # seen prefix, scatter the verdicts back onto the
+                    # dense [A, CH] grid, mask every non-ample arm's
+                    # candidates.  Deadlock/assert above read PRE-mask
+                    # enabledness; gen drops to the reduced stream.
+                    found_c, _ = _seen_probe(seen, seen_count, keys_c,
+                                             SC)
+                    found_g = jnp.zeros(C, dtype=bool).at[cidx].set(
+                        found_c & vmask, mode="drop",
+                        unique_indices=True)
+                    keep_g, n_amp, n_exp = _por_mask(
+                        found_g, cvalid, por_inst, por_safe_v, A, CH)
+                    keep_c = jnp.take(keep_g, jnp.clip(cidx, 0, C - 1)) \
+                        & vmask
+                    n_masked = jnp.sum(vmask & ~keep_c,
+                                       dtype=jnp.int32)
+                    inv_key = jnp.concatenate([
+                        jnp.ones((VC, 1), jnp.int32),
+                        jnp.full((VC, K - 1), SENTINEL, jnp.int32)],
+                        axis=1)
+                    keys_c = jnp.where(keep_c[:, None], keys_c, inv_key)
+                    rows_c = jnp.where(keep_c[:, None], rows_c, SENTINEL)
+                    gen = gen - n_masked
+                    pora = pora + n_amp
+                    porx = porx + n_exp
+                    porm = porm + n_masked
+
                 # append the block at acc_n (clamped; overflow redoes the
                 # level so clobbered rows never count)
                 off = jnp.clip(acc_n, 0, AccCap - VC)
@@ -2156,26 +2424,27 @@ class TpuExplorer:
                     jnp.where((stat == ST_CONTINUE) & dead_any,
                               ST_DEADLOCK, stat))
                 return (ci + 1, acc_keys, acc_rows, acc_n, gen, stat,
-                        bad_row, ovcode)
+                        bad_row, ovcode, pora, porx, porm)
 
             def chunk_cond(carry):
                 # stop at the FIRST non-continue status: carrying on after
                 # an assert/deadlock would skip the accumulator-overflow
                 # checks (they only arm while stat == CONTINUE) and let
                 # clamped writes clobber earlier candidate blocks
-                ci, _, _, _, _, stat, _, _ = carry
+                ci, _, _, _, _, stat, _, _, _, _, _ = carry
                 return (ci < nchunks) & (stat == ST_CONTINUE)
 
             acc_keys0 = jnp.full((AccCap, K), SENTINEL, jnp.int32)
             acc_rows0 = jnp.full((AccCap, PW), SENTINEL, jnp.int32)
             bad_row0 = jnp.full((PW,), SENTINEL, jnp.int32)
             (_, acc_keys, acc_rows, acc_n, gen, stat, bad_row,
-             ovcode) = \
+             ovcode, pora, porx, porm) = \
                 lax.while_loop(chunk_cond, chunk_body,
                                (jnp.int32(0), acc_keys0, acc_rows0,
                                 jnp.int32(0), jnp.int32(0),
                                 jnp.int32(ST_CONTINUE), bad_row0,
-                                jnp.int32(0)))
+                                jnp.int32(0), jnp.int32(0),
+                                jnp.int32(0), jnp.int32(0)))
 
             # conservative seen-capacity check BEFORE the merge: every
             # accumulated candidate could be new
@@ -2243,21 +2512,23 @@ class TpuExplorer:
                              ST_INV, stat)
 
             return (seen2, seen_count2, front_rows, explore_count, gen,
-                    explore_count, stat, inv_bad_which, bad_row, ovcode)
+                    explore_count, stat, inv_bad_which, bad_row, ovcode,
+                    pora, porx, porm)
 
         def run(seen, seen_count, frontier, fcount, distinct,
                 gen_lo, gen_hi, depth, max_states, maxlvl):
             def cond(carry):
-                (_, _, _, _, _, _, _, _, lvls, stat, _, _, _) = carry
+                (_, _, _, _, _, _, _, _, lvls, stat, _, _, _,
+                 _, _, _) = carry
                 return (stat == ST_CONTINUE) & (lvls < maxlvl)
 
             def body(carry):
                 (seen, seen_count, frontier, fcount, distinct,
                  gen_lo, gen_hi, depth, lvls, stat, which, brow,
-                 ovcode) = carry
+                 ovcode, pora, porx, porm) = carry
                 (seen2, seen_count2, front2, fcount2, gen_l, kept,
-                 lstat, lwhich, lbrow, lovcode) = level(seen, seen_count,
-                                                        frontier, fcount)
+                 lstat, lwhich, lbrow, lovcode, lpora, lporx,
+                 lporm) = level(seen, seen_count, frontier, fcount)
                 ovf = (lstat == ST_OVF_SEEN) | (lstat == ST_OVF_FRONT) | \
                     (lstat == ST_OVF_ACC) | (lstat == ST_OVF_VC) | \
                     (lstat == ST_OVF_LANES)
@@ -2288,22 +2559,32 @@ class TpuExplorer:
                               jnp.where((max_states > 0) &
                                         (distinct2 >= max_states),
                                         ST_TRUNC, ST_CONTINUE)))
+                # POR counters roll back with the level: a redone level
+                # must not count its ample decisions twice
+                pora2 = jnp.where(ovf, pora, pora + lpora)
+                porx2 = jnp.where(ovf, porx, porx + lporx)
+                porm2 = jnp.where(ovf, porm, porm + lporm)
                 return (seen2, seen_count2, front2, fcount2, distinct2,
                         gen_lo2, gen_hi2, depth2, lvls + 1, stat2,
                         jnp.where(lstat == ST_INV, lwhich, which), lbrow,
                         jnp.where(lstat == ST_OVF_LANES, lovcode,
-                                  ovcode))
+                                  ovcode), pora2, porx2, porm2)
 
             carry0 = (seen, seen_count, frontier, fcount, distinct,
                       gen_lo, gen_hi, depth, jnp.int32(0),
                       jnp.int32(ST_CONTINUE), jnp.int32(-1),
                       jnp.full((PW,), SENTINEL, jnp.int32),
+                      jnp.int32(0), jnp.int32(0), jnp.int32(0),
                       jnp.int32(0))
             (seen, seen_count, frontier, fcount, distinct, gen_lo,
-             gen_hi, depth, _, stat, which, brow, ovcode) = \
+             gen_hi, depth, _, stat, which, brow, ovcode, pora, porx,
+             porm) = \
                 lax.while_loop(cond, body, carry0)
+            # indices 0-8 are the PR-6 summary; 9-11 are the per-
+            # dispatch POR counters (ISSUE 18; zero when POR is off)
             summary = jnp.stack([stat, seen_count, fcount, distinct,
-                                 gen_lo, gen_hi, depth, which, ovcode])
+                                 gen_lo, gen_hi, depth, which, ovcode,
+                                 pora, porx, porm])
             return seen, frontier, summary, brow
 
         # DONATED dispatch (ISSUE 6): the seen table (arg 0) and the
@@ -2676,6 +2957,7 @@ class TpuExplorer:
                     "collision probability < n^2 * 2^-129".format(W)]
         warnings.extend(self._temporal_warnings())
         warnings.extend(self._symmetry_warnings())
+        warnings.extend(self._por_warnings())
 
         init_rows, explored_init, n_init, err = \
             self._prepare_init(t0, warnings)
@@ -2898,6 +3180,12 @@ class TpuExplorer:
             depth = int(summary[6])
             which = int(summary[7])
             ovcode = int(summary[8])
+            # per-dispatch POR deltas: run() zero-seeds them per
+            # dispatch and rolls back overflowed levels, so summing
+            # across dispatches (including redos) never double-counts
+            self._por_stats["ample"] += int(summary[9])
+            self._por_stats["expanded"] += int(summary[10])
+            self._por_stats["masked"] += int(summary[11])
             # cold-tier filter (ISSUE 12): after a spill the device
             # table restarted empty, so a committed level's frontier
             # may hold rows whose keys live in the host/disk runs —
@@ -3125,6 +3413,13 @@ class TpuExplorer:
                     "store (host_seen); dedup on 128-bit fingerprints"]
         warnings.extend(self._temporal_warnings())
         warnings.extend(self._symmetry_warnings())
+        warnings.extend(self._por_warnings())
+        # device POR (ISSUE 18): the ample check probes the native store
+        # BEFORE insert via contains(); the store grows chunk-by-chunk, so
+        # this engine's probe is (soundly) MORE conservative than the
+        # pre-level snapshot the level/resident engines use — a state
+        # found by an earlier chunk of the same level counts as seen here
+        por_plan = self._por_plan() if self.por else None
         if self.seen_cap is not None:
             # the native store is already host-resident (its growth IS
             # the host tier): name the dropped option instead of
@@ -3290,9 +3585,24 @@ class TpuExplorer:
                             warnings,
                             Violation("deadlock", "deadlock", trace))
 
-                generated += int(out["gen"])
                 cvalid = np.asarray(out["cvalid"])
                 keys = np.asarray(out["keys"])
+                if por_plan is not None:
+                    vidx = np.nonzero(cvalid)[0]
+                    found = np.zeros(len(cvalid), dtype=bool)
+                    if len(vidx):
+                        found[vidx] = store.contains(keys[vidx][:, 1:])
+                    keep, n_amp, n_exp = _por_mask_np(
+                        found, cvalid, por_plan["inst_arm"],
+                        por_plan["arm_safe"], self.A, CH)
+                    self._por_stats["ample"] += int(n_amp)
+                    self._por_stats["expanded"] += int(n_exp)
+                    self._por_stats["masked"] += \
+                        int(np.sum(cvalid & ~keep))
+                    cvalid = keep
+                    generated += int(np.sum(keep))
+                else:
+                    generated += int(out["gen"])
                 deferred = out.get("deferred_preds", False)
                 explore = np.asarray(out["explore"]) \
                     if "explore" in out else None
@@ -3750,6 +4060,10 @@ class TpuExplorer:
             [arm.label or "Next" for arm, _ in self.fb_arms]
         self.hybrid = True
         self._demotable = []
+        # the engine is hybrid now: a cached POR plan would mask arms
+        # the interpreter expands out of the device's sight — recompute
+        # (the hybrid refusal fires on the restarted run)
+        self._por_memo = _POR_UNSET
         self._step_cache.clear()
         self._hstep_cache.clear()
         # grouped-dispatch plans index the OLD compiled list: stale
@@ -3818,6 +4132,7 @@ class TpuExplorer:
         warnings = []
         warnings.extend(self._temporal_warnings())
         warnings.extend(self._symmetry_warnings())
+        warnings.extend(self._por_warnings())
         if self.fp_mode:
             warnings.append(
                 "wide state (W={}): dedup on 128-bit fingerprints; "
@@ -3994,6 +4309,10 @@ class TpuExplorer:
 
             front_count = int(out["front_count"])
             generated += int(out["gen"])
+            if "por_ample" in out:
+                self._por_stats["ample"] += int(out["por_ample"])
+                self._por_stats["expanded"] += int(out["por_expanded"])
+                self._por_stats["masked"] += int(out["por_masked"])
             # cold-tier membership filter (ISSUE 12): rows the device
             # rank-merge called new may duplicate keys spilled to the
             # host/disk tiers — drop them (order-preserving) before
@@ -4161,6 +4480,11 @@ class TpuExplorer:
         if self._tiers is not None and self._tiers.active:
             tiers_stats = self._tiers.stats()
             self._tiers.publish_gauges(occ or 0)
+        # device POR end-of-run counters (ISSUE 18): every engine funnels
+        # its result through here, so the gauge surface is uniform
+        self._por_finish(self._por_stats["ample"],
+                         self._por_stats["expanded"],
+                         self._por_stats["masked"], distinct)
         seen_mode = "fingerprint" if self.fp_mode else "exact"
         collision_p = None
         if self.fp_mode:

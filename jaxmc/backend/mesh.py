@@ -91,7 +91,7 @@ from ..engine.explore import CheckResult, Violation
 from ..compile.vspec import ModeError
 from ..compile.kernel2 import OV_DEMOTED, OV_PACK
 from .bfs import (SENTINEL, TpuExplorer, _LiveGraph, _pow2_at_least,
-                  _rank_merge)
+                  _por_mask, _rank_merge, _seen_probe)
 
 _BIG = np.int32(2 ** 31 - 1)
 
@@ -136,7 +136,10 @@ _S_SPILL = 14     # psum rows drained through the spill pass
 _S_MAXDEST = 15   # pmax per-destination bucket occupancy (a2a)
 _S_VOVF = 16      # rank merge's valid-candidate block outgrew VC (redo)
 _S_MAXV = 17      # pmax per-shard valid-candidate need (grows VC)
-_NS = 18
+_S_PORA = 18      # psum POR singleton-ample states this level (ISSUE 18)
+_S_PORX = 19      # psum POR expanded (any-arm-enabled) states this level
+_S_PORM = 20      # psum POR-masked candidate rows this level
+_NS = 21
 
 # per-device violation-localization vector (fetched only on violation)
 _A_INVW = 0
@@ -959,10 +962,43 @@ class MeshExplorer(TpuExplorer):
         expansion hands it the candidate block plus the per-device
         fault scalars."""
 
+        por_plan = self._por_plan() if self.por else None
+        if por_plan is not None:
+            por_inst = jnp.asarray(por_plan["inst_arm"])
+            por_safe_v = jnp.asarray(por_plan["arm_safe"])
+        A, D, K, C = self.A, self.D, self.K, self.A * FC
+
         def tail(seen_keys, seen_count, frontier_p, fcount,
                  tr_rows, tr_src, lvl, dist, max_states, me,
                  ckeys, cand, cvalid, gen_local, overflow,
                  dead_local, dead_slot, assert_bad, asrt_a, asrt_f):
+            # ---- device POR (ISSUE 18): the ample mask runs BEFORE the
+            # exchange, against the PRE-LEVEL seen snapshot — the same
+            # rule as the single-chip level/resident engines, so reduced
+            # counts are bit-identical across engine shapes.  Every key
+            # lives in exactly ONE owner shard: gather all devices'
+            # candidate keys, probe the LOCAL shard, psum the verdicts —
+            # global membership with no host round-trip, and masked rows
+            # never enter the a2a/gather exchange (they also shrink the
+            # ICI traffic the reduction is meant to save).
+            pora = porx = porm = jnp.int32(0)
+            if por_plan is not None:
+                allk = lax.all_gather(ckeys, "d")         # [D, C, K]
+                fl, _ = _seen_probe(seen_keys, seen_count,
+                                    allk.reshape(D * C, K), SC)
+                fg = lax.psum(fl.astype(jnp.int32), "d").reshape(D, C)
+                found = lax.dynamic_slice_in_dim(fg, me, 1, 0)[0] > 0
+                keep, pora, porx = _por_mask(
+                    found, cvalid, por_inst, por_safe_v, A, FC)
+                porm = jnp.sum(cvalid & ~keep, dtype=jnp.int32)
+                inv_key = jnp.concatenate([
+                    jnp.ones((C, 1), jnp.int32),
+                    jnp.full((C, K - 1), SENTINEL, jnp.int32)], axis=1)
+                ckeys = jnp.where(keep[:, None], ckeys, inv_key)
+                cand = jnp.where(keep[:, None], cand, SENTINEL)
+                cvalid = keep
+                gen_local = gen_local - porm
+
             (gkeys, gcand, gsrc, spill_local, a2a_ovf, maxdest,
              _evalid) = route(ckeys, cand, cvalid, me)
 
@@ -1046,6 +1082,9 @@ class MeshExplorer(TpuExplorer):
             scal = scal.at[_S_VOVF].set(v_ovf.astype(jnp.int32))
             scal = scal.at[_S_MAXV].set(
                 lax.pmax(mg["v_need"], "d"))
+            scal = scal.at[_S_PORA].set(lax.psum(pora, "d"))
+            scal = scal.at[_S_PORX].set(lax.psum(porx, "d"))
+            scal = scal.at[_S_PORM].set(lax.psum(porm, "d"))
 
             # per-device localization vector (fetched only on
             # violation — always the LAST executed level's, because
@@ -1707,6 +1746,7 @@ class MeshExplorer(TpuExplorer):
                     "collision probability < n^2 * 2^-129"]
         warnings.extend(self._temporal_warnings())
         warnings.extend(self._symmetry_warnings())
+        warnings.extend(self._por_warnings())
 
         init_rows, explored_init, n_init, err = \
             self._prepare_init(t0, warnings)
@@ -2063,6 +2103,9 @@ class MeshExplorer(TpuExplorer):
 
                 generated += int(scal[_S_GEN])
                 distinct += int(scal[_S_NEW])
+                self._por_stats["ample"] += int(scal[_S_PORA])
+                self._por_stats["expanded"] += int(scal[_S_PORX])
+                self._por_stats["masked"] += int(scal[_S_PORM])
                 sum_seen = int(scal[_S_SUMS])
                 max_seen = int(scal[_S_MAXS])
                 self._fp_occupancy = sum_seen
@@ -2454,6 +2497,19 @@ class MeshExplorer(TpuExplorer):
         warnings = ["mesh backend: dedup on 128-bit fingerprints; "
                     "collision probability < n^2 * 2^-129"]
         warnings.extend(self._temporal_warnings())
+        if self.por and self._por_plan() is not None:
+            # reachable only via the JAXMC_MESH_RESIDENT=0 escape hatch
+            # (refinement/temporal PROPERTYs already refuse in
+            # _por_plan): the ample mask lives in the resident
+            # superstep's level tail — name the refusal, run unreduced
+            self._por_memo = None
+            self.por_reason = ("mesh host loop active "
+                               "(JAXMC_MESH_RESIDENT=0): the device "
+                               "mask lives in the resident superstep")
+            obs.current().gauge("por.disabled_reason", self.por_reason)
+            obs.current().gauge("por.enabled", False)
+            warnings.append(f"--por requested but reduction disabled: "
+                            f"{self.por_reason} (running unreduced)")
         if need_props and not self.store_trace:
             raise ModeError(
                 "mesh refinement/temporal checking needs the per-level "
@@ -2781,6 +2837,9 @@ class MeshExplorer(TpuExplorer):
             violation=None, truncated=False, drained=False,
             trunc_reason=None):
         tel = obs.current()
+        self._por_finish(self._por_stats["ample"],
+                         self._por_stats["expanded"],
+                         self._por_stats["masked"], distinct)
         tel.high_water("device.mem_high_water_bytes",
                        obs.device_mem_high_water())
         occ = getattr(self, "_fp_occupancy", None)

@@ -351,6 +351,12 @@ def cmd_info(args) -> int:
                      else ""))
             for row in irep.matrix_rows():
                 print(f"    {row}")
+            # dynamic-key classification (ISSUE 18): WHY each arm is
+            # (or is not) element-commuting — the key expressions the
+            # element-atom footprints resolved to
+            print("  key classes:")
+            for row in irep.keyclass_rows():
+                print(f"    {row}")
         except Exception as ex:  # noqa: BLE001 — info must never fail
             if os.environ.get("JAXMC_DEBUG"):
                 raise

@@ -358,6 +358,14 @@ CASES: List[Case] = [
     Case("specs/portoy.tla", root="repo", cfg="specs/portoy_bad.cfg",
          expect="violation:invariant", jax="yes", mode="compiled",
          lint_waive=("JMC301",)),
+    # raft-shaped dynamic-key fixture (ISSUE 18): per-process message
+    # table msgs[self] (element-commuting Send arms), a DYNAMIC \E arm
+    # whose binder key resolves to a domain key set, and a CONSTANT-
+    # keyed element read.  Unreduced counts pinned here; the por-check
+    # device legs gate >=30% reduction with por.engine=device
+    Case("specs/msgstoy.tla", root="repo", cfg="specs/msgstoy.cfg",
+         no_deadlock=True, distinct=324, generated=1108,
+         jax="yes", mode="compiled"),
     # DERIVED interp-arms fixture (ISSUE 15): both arms are unsized
     # dynamic \E shapes (multi-binder / nested) that the verdict
     # taxonomy predicts with ground.py's exact reason strings — the

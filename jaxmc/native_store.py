@@ -52,6 +52,13 @@ def _load():
                 np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),
             ]
             lib.jaxmc_fps_insert.restype = ctypes.c_uint64
+            lib.jaxmc_fps_contains.argtypes = [
+                ctypes.c_void_p,
+                np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS"),
+                ctypes.c_uint64,
+                np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),
+            ]
             lib.jaxmc_fps_export.argtypes = [
                 ctypes.c_void_p,
                 np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS"),
@@ -132,6 +139,19 @@ class FingerprintStore:
                 "native fingerprint store could not allocate a run "
                 "(set JAXMC_FPS_SPILL_DIR to a disk path for seen-sets "
                 "beyond RAM)")
+        return out.astype(bool)
+
+    def contains(self, fps: np.ndarray) -> np.ndarray:
+        """Membership probe: bool mask, True for EVERY row whose
+        fingerprint is already in the store. Nothing is inserted —
+        the device-POR ample check reads this before insert()."""
+        fps = np.ascontiguousarray(fps, dtype=np.int32)
+        u = fps.view(np.uint32).astype(np.uint64)
+        hi = np.ascontiguousarray((u[:, 0] << np.uint64(32)) | u[:, 1])
+        lo = np.ascontiguousarray((u[:, 2] << np.uint64(32)) | u[:, 3])
+        out = np.zeros(len(fps), dtype=np.uint8)
+        self._lib.jaxmc_fps_contains(self._h, hi, lo,
+                                     np.uint64(len(fps)), out)
         return out.astype(bool)
 
     def dump(self) -> np.ndarray:

@@ -354,8 +354,9 @@ jaxmc.metrics/2 artifact minus the new optional surface, so readers and
       gauge `por.reduced_states` (the REDUCED run's distinct count —
       compare against an unreduced baseline's result.distinct; raw
       counts shrink BY DESIGN under --por), gauge `por.engine`
-      ("interp" when a device-backend --por request demoted to the
-      exact interpreter).
+      ("interp" on the exact interpreter; "device" since PR 18, when
+      the ample mask runs inside the fused device step — the PR 15
+      demotion of device --por requests to the interpreter is gone).
     - bounds-sized engines: `profile.status` gains the value
       "predicted" (capacity ladder rung below `learned`: no saved
       profile, but a converged bounds fixpoint proved a state-count
@@ -469,6 +470,32 @@ jaxmc.metrics/2 artifact minus the new optional surface, so readers and
       content-addressed by `id` = sha1(rung, ts, rate, sig, env,
       source)[:16] — flock-appended, torn-line tolerant, idempotent
       to re-import.  `python -m jaxmc.obs history` renders/gates it.
+
+  (PR 18, still jaxmc.metrics/4 — all additive/optional; device-side
+   POR + dynamic element keys + structural batch-bound merge:)
+    - device POR (--por on the jax/mesh backends): gauge `por.engine`
+      gains the value "device" (ample mask applied INSIDE the fused
+      step — level, resident, host_seen, and mesh supersteps; zero
+      extra dispatches), gauge `por.device_masked_arms` (candidate
+      rows the device mask dropped before dedup/exchange — the raw
+      arm-level reduction the por.ample_states/full_states counters
+      summarise per state), and the existing `por.ample_ratio` /
+      `por.reduced_states` gauges are now also emitted by the device
+      engines with IDENTICAL semantics (counts are bit-identical
+      across engine shapes, including mesh data-parallel runs, where
+      the ample probe is psum-distributed over the pre-level seen
+      snapshot).  `por.disabled_reason` gains the mesh host-loop
+      refusal (JAXMC_MESH_RESIDENT=0 escape hatch).
+    - independence analysis: the arm-footprint report adds per-arm
+      dynamic-key classes (element-commuting / whole-var writes /
+      full-footprint bail) surfaced by `jaxmc info --cfg`; no new
+      metrics keys.
+    - batch engine: `batch.plan` (the shared pack-plan descriptor)
+      now reflects the STRUCTURAL per-element bound merge — the donor
+      packs container elements at the interval-union of every
+      member's proven element bounds instead of falling back to
+      whole-variable summaries; `bits_per_state` never exceeds the
+      worst solo member's.
 """
 
 from __future__ import annotations

@@ -1,7 +1,8 @@
-r"""`make por-check` (ISSUE 15): the independence/reduction gate.
+r"""`make por-check` (ISSUE 15, device legs ISSUE 18): the
+independence/reduction gate.
 
-Four legs over the repo-local commuting fixture (specs/portoy.tla),
-one parseable `POR-CHECK …` line each:
+Five legs over the repo-local commuting fixtures (specs/portoy.tla,
+specs/msgstoy.tla), one parseable `POR-CHECK …` line each:
 
   1. UNREDUCED   the exact serial run of portoy_ok; counts must equal
                  the corpus manifest pins.
@@ -23,10 +24,19 @@ one parseable `POR-CHECK …` line each:
                  and pay exactly ONE compile — zero growth-retry
                  recompiles (`window_recompiles == 0` in the serve
                  sense: no fresh compile after the first dispatch).
+  5. DEVICE POR  `--por` on the jax backend runs the ample mask INSIDE
+                 the fused device step (por.engine == "device" — no
+                 interpreter demotion): the unreduced device run must
+                 hit the manifest pins, the reduced run must cut
+                 distinct states >= 30% on BOTH the static (portoy)
+                 and dynamic-key (msgstoy) fixtures with the artifact
+                 gated against its saved baseline, and the reduced
+                 device run of the invariant rung must report the same
+                 violation line as the unreduced device run.
 
 A container without the jax backend prints `POR-CHECK SKIP …` for the
-jax legs (3, 4) and still runs the interpreter legs (1, 2) — the POR
-filter itself is device-independent.
+jax legs (3, 4, 5) and still runs the interpreter legs (1, 2) — the
+POR filter itself is device-independent.
 """
 
 from __future__ import annotations
@@ -51,9 +61,10 @@ _MIN_REDUCTION = 0.30
 
 
 def _check(cfg: str, metrics: Optional[str], extra: List[str],
-           env_extra: Dict[str, str], timeout_s: float) -> Dict:
+           env_extra: Dict[str, str], timeout_s: float,
+           spec: str = _SPEC) -> Dict:
     cmd = [sys.executable, "-m", "jaxmc", "check",
-           os.path.join(_REPO, _SPEC),
+           os.path.join(_REPO, spec),
            "--cfg", os.path.join(_REPO, cfg), "--quiet"] + extra
     if metrics:
         cmd += ["--metrics-out", metrics]
@@ -153,11 +164,13 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"POR-CHECK ok por verdict: {cfg} -> {head[0]!r}")
 
     if not _have_jax():
-        print("POR-CHECK SKIP regroup+predicted: jax backend "
+        print("POR-CHECK SKIP regroup+predicted+device: jax backend "
               "unavailable in this container")
         print(f"por-check: {'FAIL' if failures else 'ok'} "
               f"({failures} failing legs)")
         return 1 if failures else 0
+
+    from .meshbench import _gate as gate
 
     # leg 3: regroup parity on the grouped host_seen path (cap 2 forces
     # ceil(A/2) groups on the 4-arm fixture)
@@ -184,7 +197,6 @@ def main(argv: Optional[List[str]] = None) -> int:
     else:
         print(f"POR-CHECK ok regroup: counterexample byte-identical "
               f"with regrouping on/off ({len(t_on)} lines)")
-        from .meshbench import _gate as gate
         if gate(m_grp, log=print,
                 ignore_phases=("device_init", "engine_build",
                                "layout_sample", "compile_arm")):
@@ -222,6 +234,96 @@ def main(argv: Optional[List[str]] = None) -> int:
                   f"predicted<={gp['profile.predicted_states']} "
                   f"states, {fresh} compile, 0 growth recompiles "
                   f"({rp['wall_s']}s)")
+
+    # leg 5: DEVICE POR (ISSUE 18) — the ample mask runs INSIDE the
+    # fused device step (por.engine == "device", no interpreter
+    # demotion): >= 30% fewer distinct states than the unreduced
+    # device run on both the static (portoy) and dynamic-key
+    # (msgstoy) fixtures, artifact gated against its saved baseline
+    dev = ["--no-deadlock", "--backend", "jax", "--platform", "cpu",
+           "--host-seen"]
+    mcase = case_for_cfg("msgstoy.cfg")
+    mwant = (mcase.generated, mcase.distinct) if mcase else (1108, 324)
+    for spec, cfg, pins, tag in (
+            (_SPEC, _CFG_OK, want, "portoy"),
+            ("specs/msgstoy.tla", "specs/msgstoy.cfg", mwant,
+             "msgstoy")):
+        m_unr = os.path.join(args.out_dir,
+                             f"jaxmc_por_device_{tag}_unreduced.json")
+        ru = _check(cfg, m_unr, dev, {}, args.leg_timeout, spec=spec)
+        resu = (ru.get("summary") or {}).get("result") or {}
+        m_dev = os.path.join(args.out_dir,
+                             f"jaxmc_por_device_{tag}.json")
+        rd = _check(cfg, m_dev, dev + ["--por"], {}, args.leg_timeout,
+                    spec=spec)
+        resd = (rd.get("summary") or {}).get("result") or {}
+        gd = (rd.get("summary") or {}).get("gauges") or {}
+        red = 1.0 - (resd.get("distinct") or pins[1]) / pins[1]
+        if ru.get("rc") != 0 or \
+                (resu.get("generated"), resu.get("distinct")) != pins:
+            print(f"POR-CHECK FAIL device {tag}: unreduced device "
+                  f"counts {(resu.get('generated'), resu.get('distinct'))}"
+                  f" != manifest pins {pins} "
+                  f"{(ru.get('stderr') or '')[-200:]}", file=sys.stderr)
+            failures += 1
+        elif rd.get("rc") != 0 or not resd.get("ok") or \
+                gd.get("por.engine") != "device" or \
+                not gd.get("por.enabled"):
+            print(f"POR-CHECK FAIL device {tag}: rc={rd.get('rc')} "
+                  f"por.engine={gd.get('por.engine')!r} "
+                  f"por.enabled={gd.get('por.enabled')} "
+                  f"{(rd.get('stderr') or '')[-200:]}", file=sys.stderr)
+            failures += 1
+        elif red < _MIN_REDUCTION:
+            print(f"POR-CHECK FAIL device {tag}: reduction {red:.0%} "
+                  f"< {_MIN_REDUCTION:.0%} (distinct "
+                  f"{resd.get('distinct')} vs {pins[1]})",
+                  file=sys.stderr)
+            failures += 1
+        else:
+            print(f"POR-CHECK ok device {tag}: "
+                  f"{resd.get('distinct')} distinct (-{red:.0%}), "
+                  f"masked_arms={gd.get('por.device_masked_arms')}, "
+                  f"ample_ratio={gd.get('por.ample_ratio')} "
+                  f"({rd['wall_s']}s)")
+            if gate(m_dev, log=print,
+                    ignore_phases=("device_init", "engine_build",
+                                   "layout_sample", "compile_arm")):
+                failures += 1
+
+    # device verdict: the reduced device run must report the SAME
+    # violation line as the unreduced device run (trace-replay
+    # validity is pinned by tests/test_independence.py)
+    dbad = ["--backend", "jax", "--platform", "cpu", "--host-seen"]
+    vu = _check(_CFG_BAD, None, dbad, {}, args.leg_timeout)
+    vd = _check(_CFG_BAD, None, dbad + ["--por"], {}, args.leg_timeout)
+    h_u = _trace_lines(vu.get("stdout", ""))[:1]
+    h_d = _trace_lines(vd.get("stdout", ""))[:1]
+    if vu.get("rc") != 1 or vd.get("rc") != 1 or not h_u or \
+            h_u != h_d:
+        print(f"POR-CHECK FAIL device verdict: rc {vu.get('rc')}/"
+              f"{vd.get('rc')} heads {h_u} vs {h_d} "
+              f"{(vd.get('stderr') or '')[-200:]}", file=sys.stderr)
+        failures += 1
+    else:
+        print(f"POR-CHECK ok device verdict: {_CFG_BAD} -> "
+              f"{h_d[0]!r} (matches unreduced device run)")
+
+    # land the por-check leg artifacts in the persistent run ledger
+    # (ISSUE 18): the unreduced-vs-reduced trajectory per fixture —
+    # idempotent by content id, never breaks the gate
+    try:
+        from .obs import ledger as _ledger
+        arts = [os.path.join(args.out_dir, f) for f in (
+            "jaxmc_por_unreduced.json", "jaxmc_por_reduced.json",
+            "jaxmc_por_device_portoy_unreduced.json",
+            "jaxmc_por_device_portoy.json",
+            "jaxmc_por_device_msgstoy_unreduced.json",
+            "jaxmc_por_device_msgstoy.json")]
+        _ledger.import_artifacts([a for a in arts
+                                  if os.path.exists(a)])
+    except Exception:  # noqa: BLE001 — the ledger never breaks a gate
+        pass
 
     print(f"por-check: {'FAIL' if failures else 'ok'} "
           f"({failures} failing legs)")

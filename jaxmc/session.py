@@ -136,11 +136,13 @@ class SessionConfig:
     # stage — "off" (skip), "warn" (print diagnostics, continue),
     # "strict" (error diagnostics abort with exit 2 before compile)
     analyze: str = "off"
-    # partial-order reduction (ISSUE 15, opt-in): expand one
-    # globally-commuting invisible arm per state instead of every
-    # enabled arm — preserves invariant/deadlock verdicts, NOT raw
-    # counts.  Runs on the exact serial interpreter engine; a device
-    # backend with --por demotes to it with a named warning.
+    # partial-order reduction (ISSUE 15 interp, ISSUE 18 device;
+    # opt-in): expand one globally-commuting invisible arm per state
+    # instead of every enabled arm — preserves invariant/deadlock
+    # verdicts, NOT raw counts.  On device backends the ample mask is
+    # applied INSIDE the fused step (zero extra dispatches); configs
+    # the device mask cannot serve (hybrid demotions, symmetry, ...)
+    # run unreduced with a named warning, never a silent engine swap.
     por: bool = False
     # device profiling mode (ISSUE 17, obs/prof.py): None (cheap
     # counters only), "wall" or "xla".  Plumbing, not an answer-changer
@@ -513,18 +515,7 @@ class CheckSession:
             self.analyze()  # no-op when cfg.analyze == "off"
         assert self.kind == "model", "assumes sessions have no engine"
         cfg = self.cfg
-        if cfg.por and cfg.backend != "interp":
-            # POR's persistent-set filter is a per-state host decision;
-            # the device kernels expand whole frontiers per dispatch.
-            # A --por run therefore executes on the exact serial
-            # interpreter — named, never silent (the device engines
-            # would otherwise quietly ignore the reduction)
-            print("warning: --por runs on the exact interpreter engine "
-                  "(device kernels are not POR-aware); "
-                  f"--backend {cfg.backend} request demoted",
-                  file=sys.stderr)
-            self.tel.gauge("por.engine", "interp")
-        if cfg.backend == "interp" or cfg.por:
+        if cfg.backend == "interp":
             from .engine.parallel import ParallelExplorer, default_workers
             # None or 0 = auto (JAXMC_WORKERS, else min(cpu_count, 8))
             self.workers = default_workers() if not cfg.workers \
@@ -573,6 +564,7 @@ class CheckSession:
                     checkpoint_every=cfg.checkpoint_every,
                     resume_from=cfg.resume,
                     max_states=cfg.max_states,
+                    por=cfg.por,
                     res_caps=cfg.res_caps,
                     final_checkpoint=cfg.final_checkpoint,
                     seen_mode=cfg.seen,
@@ -601,7 +593,7 @@ class CheckSession:
         if final_checkpoint is not _SENTINEL:
             ex.final_checkpoint = final_checkpoint
         self.explore_count += 1
-        if self.cfg.backend == "interp" or self.cfg.por:
+        if self.cfg.backend == "interp":
             with self.tel.span("search", workers=self.workers):
                 self.result = ex.run()
         else:

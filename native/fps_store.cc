@@ -302,6 +302,42 @@ uint64_t jaxmc_fps_insert(void* p, const uint64_t* hi, const uint64_t* lo,
     return new_count;
 }
 
+// Marks out_found[i] = 1 for fingerprints PRESENT in the store; a pure
+// membership probe — nothing is inserted.  Unlike insert's out_new
+// (first in-batch occurrence wins), EVERY occurrence of an in-store
+// fingerprint is marked: callers read per-row verdicts (the device POR
+// filter masks candidate rows individually).  Same probe machinery as
+// insert: sort the batch, gallop a forward-only lower_bound per run.
+void jaxmc_fps_contains(void* p, const uint64_t* hi, const uint64_t* lo,
+                        uint64_t n, uint8_t* out_found) {
+    Store& st = *static_cast<Store*>(p);
+    std::memset(out_found, 0, n);
+    if (n == 0) return;
+
+    std::vector<uint64_t> order(n);
+    for (uint64_t i = 0; i < n; ++i) order[i] = i;
+    std::sort(order.begin(), order.end(), [&](uint64_t a, uint64_t b) {
+        Fp fa{hi[a], lo[a]}, fb{hi[b], lo[b]};
+        if (fa == fb) return a < b;
+        return fa < fb;
+    });
+
+    std::vector<RunPtr> runs = st.snapshot();
+    for (const auto& run : runs) {
+        const Fp* rd = run->data;
+        size_t rpos = 0;
+        for (uint64_t k = 0; k < n; ++k) {
+            uint64_t idx = order[k];
+            if (out_found[idx]) continue;
+            Fp f{hi[idx], lo[idx]};
+            const Fp* it = std::lower_bound(rd + rpos, rd + run->n, f);
+            rpos = (size_t)(it - rd);
+            if (rpos >= run->n) break;
+            if (rd[rpos] == f) out_found[idx] = 1;
+        }
+    }
+}
+
 // Copies the sorted store contents into hi/lo (each sized to count) —
 // the checkpoint/resume serialization surface. Reuses merge_runs (the
 // ONE k-way merge in this file) into a scratch anonymous run; an

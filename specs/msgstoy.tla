@@ -1,0 +1,36 @@
+---------------------------- MODULE msgstoy ----------------------------
+(* Raft-shaped dynamic-key fixture (ISSUE 18): `msgs` is a per-process
+   message table and every Send arm writes exactly ONE element,
+   msgs[self], so the Send arms commute at the element-atom level —
+   the independence analysis must classify them element-commuting and
+   --por must reduce the search without touching the verdicts.  Tick
+   exercises the DYNAMIC \E shape: a state-dependent filter over a
+   static base set stays one arm (the splitter cannot instantiate it),
+   whose binder key resolves to the base-set domain as a key SET.
+   Flush reads one CONSTANT-keyed element, so exactly Send(P1)
+   conflicts with it and every other Send stays por-safe. *)
+EXTENDS Naturals
+CONSTANTS Procs, Cap, T, P1
+VARIABLES msgs, clock, done
+
+Init == /\ msgs = [p \in Procs |-> 0]
+        /\ clock = [n \in 1..T |-> 0]
+        /\ done = FALSE
+
+Send(p) == /\ msgs[p] < Cap
+           /\ msgs' = [msgs EXCEPT ![p] = @ + 1]
+           /\ UNCHANGED <<clock, done>>
+
+Tick == /\ \E n \in {m \in 1..T : clock[m] < Cap} :
+               clock' = [clock EXCEPT ![n] = @ + 1]
+        /\ UNCHANGED <<msgs, done>>
+
+Flush == /\ msgs[P1] = Cap
+         /\ ~done
+         /\ done' = TRUE
+         /\ UNCHANGED <<msgs, clock>>
+
+Next == (\E p \in Procs : Send(p)) \/ Tick \/ Flush
+
+DoneOK == done \in BOOLEAN
+=======================================================================

@@ -183,6 +183,174 @@ class TestVmappedEngine:
             BatchCheckEngine(cfgs).build()
 
 
+class TestStructuralMerge:
+    """Structural batch-bound merge (ISSUE 18): the donor keeps
+    per-element EB trees — the interval-union over members — instead of
+    collapsing every container to a whole-variable summary, so the
+    shared plan never packs wider than the worst solo member."""
+
+    def _eb(self, **kw):
+        from jaxmc.analyze.bounds import EB
+        return EB(**kw)
+
+    def test_merge_eb_interval_union(self):
+        from jaxmc.analyze.bounds import merge_eb
+        a = self._eb(all=(0, 2), rng=self._eb(all=(0, 2)))
+        b = self._eb(all=(1, 5), rng=self._eb(all=(1, 5)))
+        m = merge_eb(a, b)
+        assert m.all == (0, 5)
+        assert m.rng.all == (0, 5)
+
+    def test_merge_eb_none_child_drops(self):
+        # a child proven on only one side is NOT kept: the consumer
+        # falls back to the merged covering interval, a superset for
+        # both members — never a narrower guess
+        from jaxmc.analyze.bounds import merge_eb
+        a = self._eb(all=(0, 2), rng=self._eb(all=(0, 2)))
+        b = self._eb(all=(0, 9))
+        m = merge_eb(a, b)
+        assert m.all == (0, 9) and m.rng is None
+        assert merge_eb(a, None) is None
+
+    def test_merge_eb_keys_intersect(self):
+        from jaxmc.analyze.bounds import merge_eb
+        a = self._eb(all=(0, 3), keys={"x": self._eb(all=(0, 1)),
+                                       "y": self._eb(all=(0, 3))})
+        b = self._eb(all=(0, 4), keys={"x": self._eb(all=(2, 4))})
+        m = merge_eb(a, b)
+        assert set(m.keys) == {"x"}
+        assert m.keys["x"].all == (0, 4)
+
+    def test_merge_element_bounds_any_none_member(self):
+        from jaxmc.analyze.bounds import merge_element_bounds
+        d = {"v": self._eb(all=(0, 1))}
+        assert merge_element_bounds([d, None]) == {}
+        assert merge_element_bounds([]) == {}
+        m = merge_element_bounds([d, {"v": self._eb(all=(3, 4)),
+                                      "w": self._eb(all=(0, 1))}])
+        assert set(m) == {"v"} and m["v"].all == (0, 4)
+
+    def test_merged_bounds_backfills_lane_proofs(self):
+        # lane-proven vars without a structured tree still reach pack
+        # as a covering EB — the lane precision never regresses
+        from jaxmc.backend.batch import _MergedBounds
+        mb = _MergedBounds(merged={"v": (0, 5)},
+                           merged_eb={"w": self._eb(all=(1, 2))})
+        eb = mb.element_bounds()
+        assert eb["v"].all == (0, 5) and eb["w"].all == (1, 2)
+
+    @pytest.fixture(scope="class")
+    def msgstoy_cohort(self, tmp_path_factory):
+        # same module, Cap=2 vs Cap=3: `msgs` is a per-process table,
+        # so the donor layout depends on MERGED per-element bounds
+        from jaxmc.backend.batch import BatchCheckEngine
+        spec = os.path.join(SPECS, "msgstoy.tla")
+        cfg2 = os.path.join(SPECS, "msgstoy.cfg")
+        cfg3 = str(tmp_path_factory.mktemp("msgstoy") / "cap3.cfg")
+        with open(cfg3, "w") as f:
+            f.write("INIT Init\nNEXT Next\nINVARIANT DoneOK\n"
+                    "CONSTANTS\n  Procs = {p1, p2, p3}\n  Cap = 3\n"
+                    "  T = 2\n  P1 = p1\n")
+        cfgs = [SessionConfig(spec=spec, cfg=c, backend="jax",
+                              platform="cpu", host_seen=True)
+                for c in (cfg2, cfg3)]
+        be = BatchCheckEngine(cfgs).build()
+        members = be.run()
+        solos = []
+        for c in (cfg2, cfg3):
+            from jaxmc.backend.bfs import TpuExplorer
+            eng = TpuExplorer(load_model(spec, c, False), host_seen=True)
+            solos.append((eng.run(), eng.plan.batch_descriptor()))
+        return be, members, solos
+
+    def test_donor_plan_no_wider_than_worst_solo(self, msgstoy_cohort):
+        be, members, solos = msgstoy_cohort
+        donor = members[0].engine.plan.batch_descriptor()
+        worst = max(d["bits_per_state"] for _, d in solos)
+        assert donor["bits_per_state"] <= worst
+        assert donor["proven_lanes"] >= \
+            min(d["proven_lanes"] for _, d in solos)
+
+    def test_donor_keeps_structured_proofs(self, msgstoy_cohort):
+        be, members, _solos = msgstoy_cohort
+        m0 = members[0].engine.model
+        rep = m0._bounds_report
+        eb = rep.element_bounds()
+        # the union tree: msgs rng covers BOTH members' Cap
+        assert eb["msgs"].rng.all == (0, 3)
+        # clock never makes lane_bounds (no whole-variable summary)
+        # but its structured dom proof survives the merge
+        assert "clock" not in rep.lane_bounds()
+        assert eb["clock"].dom is not None
+
+    def test_members_match_solo(self, msgstoy_cohort):
+        _be, members, solos = msgstoy_cohort
+        for mem, (sr, _d) in zip(members, solos):
+            assert mem.error is None
+            assert _result_tuple(mem.result) == _result_tuple(sr)
+
+    def test_record_cohort_element_merge_beats_lane_union(
+            self, tmp_path):
+        # the satellite fixture: a record whose fields have wildly
+        # different ranges.  The whole-variable union (0,103) widens
+        # BOTH fields to 7 bits (14 bits/state); the structural merge
+        # keeps small at (0,3) and big at (100,103) — 4 bits/state,
+        # exactly the worst solo member's plan
+        from jaxmc.analyze.bounds import (infer_state_bounds,
+                                          merge_element_bounds,
+                                          merge_lane_bounds)
+        from jaxmc.backend.batch import BatchCheckEngine
+        from jaxmc.backend.bfs import TpuExplorer
+        spec = str(tmp_path / "recbatch.tla")
+        with open(spec, "w") as f:
+            f.write(
+                "---------------- MODULE recbatch ----------------\n"
+                "EXTENDS Naturals\nCONSTANTS Lim\nVARIABLES r\n"
+                "Init == r = [small |-> 0, big |-> 100]\n"
+                "BumpS == /\\ r.small < 3\n"
+                "         /\\ r' = [r EXCEPT !.small = @ + 1]\n"
+                "BumpB == /\\ r.big < Lim\n"
+                "         /\\ r' = [r EXCEPT !.big = @ + 1]\n"
+                "Next == BumpS \\/ BumpB\n"
+                "Spec == Init /\\ [][Next]_<<r>>\n"
+                "=================================================\n")
+        paths = []
+        for tag, lim in (("a", 101), ("b", 103)):
+            p = str(tmp_path / f"{tag}.cfg")
+            with open(p, "w") as f:
+                f.write(f"SPECIFICATION Spec\nCONSTANTS\n"
+                        f"  Lim = {lim}\n")
+            paths.append(p)
+        reports = [infer_state_bounds(load_model(spec, p, True))
+                   for p in paths]
+        # the lane union widens member a's (0,101) proof AND swallows
+        # small's (0,3) into one 7-bit interval...
+        assert merge_lane_bounds(
+            [r.lane_bounds() for r in reports]) == {"r": (0, 103)}
+        # ...while the structural merge keeps each field's own width
+        meb = merge_element_bounds(
+            [r.element_bounds() for r in reports])
+        assert meb["r"].keys["small"].all == (0, 3)
+        assert meb["r"].keys["big"].all == (100, 103)
+
+        solos = []
+        for p in paths:
+            eng = TpuExplorer(load_model(spec, p, True),
+                              host_seen=True)
+            solos.append((eng.run(), eng.plan.batch_descriptor()))
+        cfgs = [SessionConfig(spec=spec, cfg=p, backend="jax",
+                              platform="cpu", host_seen=True,
+                              no_deadlock=True) for p in paths]
+        members = BatchCheckEngine(cfgs).build().run()
+        donor = members[0].engine.plan.batch_descriptor()
+        worst = max(d["bits_per_state"] for _, d in solos)
+        assert donor["bits_per_state"] <= worst
+        assert donor["bits_per_state"] < 14  # the lane-union width
+        for mem, (sr, _d) in zip(members, solos):
+            assert mem.error is None
+            assert _result_tuple(mem.result) == _result_tuple(sr)
+
+
 def prime_spool(spool, variants, opts=JAX_OPTS):
     """Queue one job per variant in a COLD spool (before any daemon
     life), so the first pop claims the whole cohort."""

@@ -126,6 +126,150 @@ class TestFootprints:
             [[0, 1], [2], [3, 4], [5, 6]]
 
 
+# ------------------------------------------------- dynamic element keys
+
+class TestDynamicKeys:
+    """ISSUE 18: symbolic key-disjointness — tuple keys, binder-domain
+    key sets, static key arithmetic, and named bail reasons."""
+
+    def test_msgstoy_send_arms_element_commuting(self):
+        from jaxmc.analyze.independence import independence_report
+        rep = independence_report(load("msgstoy", no_deadlock=True))
+        by = {lb: i for i, lb in enumerate(rep.labels)}
+        sends = [by[f"Send({p})"] for p in ("p1", "p2", "p3")]
+        for i in sends:
+            fp = rep.footprints[i]
+            assert fp.exact
+            assert ("msgs", None) not in fp.writes
+            assert fp.key_class() == "element-commuting"
+        for i in sends:
+            for j in sends:
+                if i != j:
+                    assert rep.commutes[i][j]
+        # Flush reads msgs[P1] through the CONSTANT: only Send(p1)
+        # clashes with it, the other Sends (and Tick) stay por-safe
+        assert not rep.commutes[by["Send(p1)"]][by["Flush"]]
+        assert rep.commutes[by["Send(p2)"]][by["Flush"]]
+        assert sorted(rep.por_safe) == sorted(
+            (by["Send(p2)"], by["Send(p3)"], by["Tick"]))
+
+    def test_msgstoy_dynamic_exists_binds_domain_keyset(self):
+        from jaxmc.analyze.independence import (_KeySet,
+                                                independence_report)
+        rep = independence_report(load("msgstoy", no_deadlock=True))
+        tick = rep.footprints[rep.labels.index("Tick")]
+        ks = [k for v, k in tick.writes if v == "clock"]
+        assert len(ks) == 1 and isinstance(ks[0], _KeySet)
+        assert ks[0].vals == frozenset((1, 2))  # 1..T through the cfg
+        assert tick.key_class() == "element-commuting"
+
+    def test_key_interference_rules(self):
+        from jaxmc.analyze.independence import (_interfere, _KeySet,
+                                                _TupleKey)
+        f = frozenset
+        ks12, ks23, ks45 = (_KeySet((1, 2)), _KeySet((2, 3)),
+                            _KeySet((4, 5)))
+        assert _interfere(f({("v", ks12)}), f({("v", ks23)}))
+        assert not _interfere(f({("v", ks12)}), f({("v", ks45)}))
+        assert _interfere(f({("v", ks12)}), f({("v", 2)}))
+        assert not _interfere(f({("v", ks12)}), f({("v", 3)}))
+        assert _interfere(f({("v", None)}), f({("v", ks12)}))
+        assert not _interfere(f({("v", ks12)}), f({("w", ks12)}))
+        # tuple keys compare componentwise and never equal a scalar
+        t12 = _TupleKey((1, 2))
+        assert _interfere(f({("v", t12)}), f({("v", _TupleKey((1, 2)))}))
+        assert not _interfere(f({("v", t12)}),
+                              f({("v", _TupleKey((1, 3)))}))
+        assert not _interfere(f({("v", t12)}), f({("v", 1)}))
+        assert _interfere(
+            f({("v", t12)}),
+            f({("v", _TupleKey((_KeySet((1, 9)), 2)))}))
+        assert not _interfere(
+            f({("v", t12)}),
+            f({("v", _TupleKey((_KeySet((3, 9)), 2)))}))
+
+    def test_static_key_arithmetic(self):
+        from jaxmc.analyze.independence import (_key_arith, _KeySet,
+                                                _NOKEY)
+        assert _key_arith("+", 2, 3) == 5
+        assert _key_arith("-", 7, 2) == 5
+        assert _key_arith("-", _KeySet((1, 2)), 1) == _KeySet((0, 1))
+        assert _key_arith("+", "a", 1) is _NOKEY
+        assert _key_arith("+", True, 1) is _NOKEY
+
+    def test_tuple_keys_resolve_through_split_bindings(self, tmp_path):
+        # the raft message-table shape at analysis level: arms writing
+        # distinct <<p, q>> channels commute element-wise, and static
+        # +1 arithmetic resolves split-binder keys to concrete ints
+        spec = write_spec(tmp_path, "tuptoy", r"""
+---------------------------- MODULE tuptoy ----------------------------
+EXTENDS Naturals
+CONSTANTS Procs
+VARIABLES msgs, acks
+
+Chans == {<<p, q>> : p \in Procs, q \in Procs}
+
+Init == /\ msgs = [c \in Chans |-> 0]
+        /\ acks = [n \in 1..3 |-> 0]
+
+Send(p, q) == /\ msgs[<<p, q>>] < 2
+              /\ msgs' = [msgs EXCEPT ![<<p, q>>] = @ + 1]
+              /\ UNCHANGED acks
+
+Shift(n) == /\ acks[n + 1] < 2
+            /\ acks' = [acks EXCEPT ![n + 1] = @ + 1]
+            /\ UNCHANGED msgs
+
+Next == (\E p \in Procs, q \in Procs : Send(p, q))
+          \/ (\E n \in 1..2 : Shift(n))
+=======================================================================
+""")
+        from jaxmc.analyze.independence import (_TupleKey,
+                                                independence_report)
+        cfg = parse_cfg("INIT Init\nNEXT Next\n"
+                        "CONSTANTS\n  Procs = {a, b}\n")
+        cfg.check_deadlock = False
+        m = bind_model(Loader([str(tmp_path)]).load_path(spec), cfg)
+        rep = independence_report(m)
+        by = {lb: i for i, lb in enumerate(rep.labels)}
+        sab, sba = by["Send(a, b)"], by["Send(b, a)"]
+        assert rep.commutes[sab][sba]
+        fp = rep.footprints[sab]
+        assert fp.exact and ("msgs", None) not in fp.writes
+        assert any(isinstance(k, _TupleKey) for _v, k in fp.writes)
+        # Shift(1) writes acks[2], Shift(2) writes acks[3]: disjoint
+        assert rep.commutes[by["Shift(1)"]][by["Shift(2)"]]
+        assert ("acks", 2) in rep.footprints[by["Shift(1)"]].writes
+        assert ("acks", 3) in rep.footprints[by["Shift(2)"]].writes
+        # every arm resolved to element atoms
+        assert all(fp.key_class() == "element-commuting"
+                   for fp in rep.footprints)
+
+    def test_bail_reason_named(self, tmp_path):
+        spec = write_spec(tmp_path, "bailtoy", r"""
+---------------------------- MODULE bailtoy ---------------------------
+EXTENDS Naturals
+VARIABLES x
+
+Init == x = 0
+
+Rec(n) == IF n = 0 THEN x' = x + 1 ELSE Rec(n - 1)
+
+Next == Rec(x)
+=======================================================================
+""")
+        from jaxmc.analyze.independence import independence_report
+        cfg = parse_cfg("INIT Init\nNEXT Next\n")
+        cfg.check_deadlock = False
+        m = bind_model(Loader([str(tmp_path)]).load_path(spec), cfg)
+        rep = independence_report(m)
+        fp = rep.footprints[0]
+        assert not fp.exact
+        assert fp.bail_reason and "Rec" in fp.bail_reason
+        assert "full-footprint bail" in fp.key_class()
+        assert "Rec" in fp.key_class()
+
+
 # ------------------------------------------------- per-element bounds
 
 class TestPerElementBounds:
@@ -457,11 +601,13 @@ class TestPOR:
         {"backend": "jax", "platform": "cpu"},
         {"backend": "jax", "platform": "cpu", "resident": True,
          "no_trace": True},
+        {"backend": "jax", "platform": "cpu", "host_seen": True},
     ])
     def test_por_verdict_parity_across_engines(self, scfg):
         """--por through CheckSession: every engine config reports the
-        SAME violation verdict its unreduced run reports (the reduced
-        search runs on the exact interpreter, named)."""
+        SAME violation verdict its unreduced run reports.  Since ISSUE
+        18 the jax configs run the ample mask INSIDE the fused device
+        step (por.engine == "device"), not the interpreter demotion."""
         if scfg["backend"] == "jax":
             pytest.importorskip("jax")
         from jaxmc.session import CheckSession, SessionConfig
@@ -477,9 +623,11 @@ class TestPOR:
         assert not rb.ok and not rp.ok
         assert rp.violation.kind == rb.violation.kind == "invariant"
         assert rp.distinct <= rb.distinct
-        _replays(load("portoy", "portoy_bad"), rp.violation.trace)
+        if not scfg.get("no_trace"):
+            _replays(load("portoy", "portoy_bad"), rp.violation.trace)
         if scfg["backend"] == "jax":
-            assert tel.gauges.get("por.engine") == "interp"
+            assert tel.gauges.get("por.engine") == "device"
+            assert tel.gauges.get("por.device_masked_arms", 0) > 0
         elif scfg.get("workers", 1) > 1:
             assert tel.gauges.get("parallel.fallback_reason") == "por"
 
