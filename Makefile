@@ -192,6 +192,12 @@ bench-check:
 	# (/metrics parses, per-job progress gauge moves), multi-process
 	# timeline with zero orphan spans — see trace-check below
 	$(MAKE) trace-check
+	# fleet-serving leg (ISSUE 19): multi-daemon spool under SIGKILLs —
+	# lease takeover with bit-identical resumed counts, warm-hit
+	# routing beating round-robin, 429 + Retry-After under overload,
+	# poison-job quarantine (parseable FLEET-CHECK SKIP on hosts that
+	# cannot run a fleet) — see fleet-check below
+	$(MAKE) fleet-check
 	# multi-chip parity leg (ISSUE 8): D=2 and D=4 virtual-device mesh
 	# runs must match the manifest pins bit-for-bit — see
 	# multichip-check below
@@ -369,6 +375,24 @@ serve-check:
 trace-check:
 	JAX_PLATFORMS=cpu $(PY) -m jaxmc.tracecheck
 
+# fleet-serving chaos gate (ISSUE 19): several subprocess daemons on
+# ONE durable spool.  Legs: (takeover) SIGKILL the daemon that owns a
+# slow job mid-run — a peer must steal the expired lease and finish
+# from the spool checkpoint with counts bit-identical to a solo
+# reference; (routing) identical submissions round-robined across 3
+# ports must land on the sig-warm daemon, then `obs timeline
+# --fail-on-orphans` must stitch every daemon + job trace with 0
+# orphan spans; (admission) a depth-bounded daemon under a burst
+# answers 429 + Retry-After with queue gauges while accepted jobs
+# complete; (poison) a job whose owner always dies is quarantined
+# after the cross-daemon retry budget with a named verdict.  Leg
+# artifacts land in $(BENCH_CHECK_DIR) and the run ledger.  Prints
+# one parseable `FLEET-CHECK SKIP: ...` line (exit 0) on hosts with
+# < 2 CPUs or no bindable loopback port.
+fleet-check:
+	JAX_PLATFORMS=cpu $(PY) -m jaxmc.fleetbench \
+	    --out-dir $(BENCH_CHECK_DIR)
+
 # run the checking daemon on a durable spool (jobs/results/checkpoints
 # survive restarts; SIGTERM drains gracefully — see README "Checking
 # as a service")
@@ -393,5 +417,6 @@ native:
 
 .PHONY: all check check-corpus test chaos bench bench-warm bench-tlc \
         pin-si-env bench-check bench-check-reset serve serve-check \
-        trace-check batch-check multichip-check multichip-bench \
-        backend-check por-check prof-check native lint-corpus pylint
+        trace-check fleet-check batch-check multichip-check \
+        multichip-bench backend-check por-check prof-check native \
+        lint-corpus pylint

@@ -218,6 +218,7 @@ class BatchMember:
     error: Optional[BaseException] = None
     tag: Optional[str] = None  # caller's handle (job id)
     warnings: List[str] = field(default_factory=list)
+    resumed: bool = False      # engine restored from its checkpoint
 
 
 # engine-relevant option surface every member must share (per-model
@@ -326,6 +327,10 @@ class BatchCheckEngine:
                     extra_samples=extra,
                     max_states=c0.max_states,
                     relayouts_left=0,
+                    checkpoint_path=c0.checkpoint,
+                    checkpoint_every=c0.checkpoint_every,
+                    resume_from=c0.resume,
+                    final_checkpoint=c0.final_checkpoint,
                     lift_consts=lift)
             except (CompileError, ModeError) as ex:
                 raise BatchIncompatible(
@@ -335,12 +340,17 @@ class BatchCheckEngine:
             raise BatchIncompatible(f"donor engine not batchable: "
                                     f"{reason}")
         self.members[0].engine = donor
-        for mem in self.members[1:]:
+        for mem, c in zip(self.members[1:], self.cfgs[1:]):
             mem.engine = TpuExplorer(
                 mem.model, donor=donor, log=self.log,
                 max_states=c0.max_states,
                 store_trace=not c0.no_trace,
-                progress_every=c0.progress_every)
+                progress_every=c0.progress_every,
+                checkpoint_path=c.checkpoint,
+                checkpoint_every=c.checkpoint_every,
+                resume_from=c.resume,
+                final_checkpoint=c.final_checkpoint)
+        self._validate_resumes()
         cvecs = np.stack([mem.engine._cvec for mem in self.members]) \
             if lift else np.zeros((len(self.members), 0), np.int32)
         self.dispatcher = BatchDispatcher(donor, cvecs, tel=self.tel)
@@ -354,6 +364,40 @@ class BatchCheckEngine:
         self.tel.gauge("batch.lifted_consts", list(lift))
         self.tel.gauge("batch.plan", donor.plan.batch_descriptor())
         return self
+
+    def _validate_resumes(self) -> None:
+        """Batch-scoped resume guard (ISSUE 19): a member whose
+        checkpoint cannot seed THIS cohort's merged layout (a solo
+        checkpoint, a different cohort's packing, a torn file) runs
+        FRESH instead of failing — lease takeover feeds possibly-stale
+        paths by design, so refusal is a downgrade, never an error."""
+        from ..engine.ckpt import CkptError, load_checkpoint
+        for mem in self.members:
+            eng = mem.engine
+            path = getattr(eng, "resume_from", None)
+            if not path:
+                continue
+            why = None
+            try:
+                _, ck = load_checkpoint(path, kind="device")
+                if ck.get("module") != mem.model.module.name or \
+                        ck.get("vars") != list(mem.model.vars):
+                    why = "checkpoint is for a different model"
+                elif ck.get("mode") != "host_seen":
+                    why = (f"checkpoint was written by the "
+                           f"{ck.get('mode')!r} device mode")
+                elif ck.get("layout_sig") != eng._layout_sig():
+                    why = ("lane layout differs from the checkpoint's "
+                           "(solo or different-cohort checkpoint)")
+            except (CkptError, OSError, ValueError) as ex:
+                why = str(ex)
+            if why is None:
+                mem.resumed = True
+                continue
+            eng.resume_from = None
+            self.tel.counter("batch.resume_refused")
+            self.log(f"batch member {mem.tag or '?'}: refusing "
+                     f"checkpoint {path} ({why}); running fresh")
 
     # ---- run -----------------------------------------------------------
     def run(self) -> List[BatchMember]:

@@ -70,6 +70,27 @@ tests/test_cache_guard.py):
                       (another process mid-quarantine): cold fallback
                       for this process only
 
+Fleet-serving sites (ISSUE 19, serve/{queue,daemon}.py — the chaos
+surface for `make fleet-check` and tests/test_chaos.py):
+
+    daemon_kill       the serve daemon SIGKILLs itself mid-run, right
+                      after marking jobs running (ctx: job=<id>,
+                      kind=solo|vbatch, spec=<basename>) — a peer must
+                      detect the expired lease, steal the job, and
+                      finish it bit-identically from its checkpoint;
+                      repeated deaths exhaust the cross-daemon retry
+                      budget and quarantine the job
+    lease_stall       a daemon's fleet loop skips a heartbeat/renewal
+                      tick (ctx: daemon=<id>): its leases age toward
+                      expiry while the job thread keeps running — the
+                      double-claim chaos leg (exactly one winner; the
+                      stalled daemon must drop its now-stolen results)
+    spool_io_error    an atomic spool write (job record / result /
+                      quarantine) raises (ctx: file=<basename>): the
+                      queue retries with backoff, then degrades with a
+                      named `serve.spool_degraded` event (HTTP 503,
+                      never a raw 500)
+
 Mesh sites (ISSUE 8, tpu/mesh.py — evaluated at ENGINE BUILD time, not
 per dispatch, because the routing is compiled into the jitted step):
 

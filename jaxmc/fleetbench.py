@@ -1,0 +1,615 @@
+r"""`python -m jaxmc.fleetbench` — the `make fleet-check` chaos gate.
+
+tracecheck.py proves ONE daemon's observability surface; this gate
+proves the FLEET substrate (ISSUE 19): several subprocess daemons on
+one durable spool, leased claims, crash takeover, warm-hit routing,
+admission control, and poison-job quarantine — each leg an end-to-end
+subprocess scenario with SIGKILLs, not a unit test:
+
+  takeover    a reference run on a solo spool records the ground-truth
+              counts; then 3 daemons share a fleet spool, a slow job
+              lands on one of them, and the harness SIGKILLs that
+              daemon mid-run (pid parsed from the job's `daemon` id).
+              A peer must detect the expired lease, steal the job
+              (stolen_by + requeue_note on the record), resume it from
+              the spool checkpoint, and finish with counts
+              BIT-IDENTICAL to the solo reference; survivors' /metrics
+              must show the takeover.
+  routing     daemon A is warmed on a signature, then two cold peers
+              join.  Identical submissions round-robined across all
+              three ports must land on A (submit defers cold
+              non-fast-lane sigs to the fleet scan; A adopts on warm
+              affinity inside the grace window) — A's share must beat
+              the 1/3 a round-robin placement would give it.  After a
+              clean drain, `obs timeline --fail-on-orphans` over every
+              daemon trace + per-job trace must stitch >= 3 processes
+              with ZERO orphan spans.
+  admission   a depth-bounded daemon under a submit burst: overflow
+              gets a FAST 429 with Retry-After and the queue gauges in
+              the body, the admission counter moves, and every
+              ACCEPTED job still completes.
+  poison      a job whose owner dies on every attempt (daemon_kill
+              fault, shared cross-process budget) under a respawning
+              supervisor: after JAXMC_JOB_RETRIES cross-daemon deaths
+              the job must land in spool/quarantine/<id>.json with a
+              named verdict, the spent-retry count, and fault context
+              — and GET /jobs/<id> on a live daemon must answer with
+              that verdict, not a 404.
+
+Completed-leg result artifacts are copied into --out-dir and appended
+to the run ledger (source="fleetbench", rung=<leg>).  When the host
+cannot support a fleet (fewer than 2 CPUs, or no loopback port to
+bind) the gate prints one parseable `FLEET-CHECK SKIP: <reason>` line
+and exits 0.  Exit 0 only when every leg holds; each failure prints
+one `fleet-check: FAIL: ...` line.  `make bench-check` runs this after
+the trace check.
+"""
+
+from __future__ import annotations
+
+import glob
+import io
+import json
+import os
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from .tracecheck import _SLOW_CFG, _SLOW_SPEC, _scrape, _summary_counts
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _skip(reason: str) -> Optional[str]:
+    """The skip verdict (None = the host can run a fleet)."""
+    return reason
+
+
+def _host_verdict() -> Optional[str]:
+    if os.environ.get("JAXMC_FLEET_FORCE", "").strip() in \
+            ("1", "on", "yes", "true"):
+        return None
+    if (os.cpu_count() or 1) < 2:
+        return "need >= 2 CPUs for a multi-daemon fleet " \
+               "(JAXMC_FLEET_FORCE=1 overrides)"
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+            s.bind(("127.0.0.1", 0))
+    except OSError as ex:
+        return f"cannot bind a loopback port ({ex})"
+    return None
+
+
+def _write_spec(spec_dir: str, name: str, q: int, bound: int) -> str:
+    os.makedirs(spec_dir, exist_ok=True)
+    spec = os.path.join(spec_dir, f"{name}.tla")
+    with open(spec, "w", encoding="utf-8") as fh:
+        fh.write(_SLOW_SPEC.format(q=q, bound=bound)
+                 .replace("MODULE traceload", f"MODULE {name}"))
+    with open(os.path.join(spec_dir, f"{name}.cfg"), "w",
+              encoding="utf-8") as fh:
+        fh.write(_SLOW_CFG)
+    return spec
+
+
+class _Fleet:
+    """Subprocess daemons sharing one spool, discovered through their
+    heartbeat records (the serve.json stamp is last-writer-wins, so
+    per-daemon ports only live in spool/daemons/<id>.json)."""
+
+    def __init__(self, spool: str, env: Dict[str, str],
+                 trace_dir: Optional[str] = None):
+        self.spool = spool
+        self.env = dict(env)
+        self.trace_dir = trace_dir
+        self.procs: List[subprocess.Popen] = []
+
+    def start(self, n: int = 1) -> None:
+        for _ in range(n):
+            i = len(self.procs)
+            args = [sys.executable, "-m", "jaxmc.serve", "run",
+                    "--spool", self.spool, "--workers", "1", "--quiet"]
+            if self.trace_dir:
+                args += ["--trace", os.path.join(
+                    self.trace_dir, f"daemon{i}.trace.jsonl")]
+            env = dict(os.environ, JAX_PLATFORMS="cpu", **self.env)
+            self.procs.append(subprocess.Popen(
+                args, cwd=_REPO, env=env,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+
+    def daemons(self, live_only: bool = True) -> List[Dict[str, Any]]:
+        """Heartbeat records of OUR daemons (matched by pid)."""
+        pids = {p.pid for p in self.procs
+                if not live_only or p.poll() is None}
+        out = []
+        for path in sorted(glob.glob(
+                os.path.join(self.spool, "daemons", "*.json"))):
+            try:
+                with open(path, encoding="utf-8") as fh:
+                    rec = json.load(fh)
+            except (OSError, ValueError):
+                continue
+            if rec.get("pid") in pids:
+                out.append(rec)
+        return out
+
+    def wait_up(self, n: int, timeout: float = 60.0
+                ) -> List[Dict[str, Any]]:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            recs = self.daemons()
+            if len(recs) >= n:
+                return recs
+            if all(p.poll() is not None for p in self.procs):
+                break
+            time.sleep(0.1)
+        raise AssertionError(
+            f"only {len(self.daemons())}/{n} daemons heartbeating in "
+            f"{self.spool} after {timeout:.0f}s")
+
+    def client(self, rec: Dict[str, Any]):
+        from .serve.protocol import ServeClient
+        return ServeClient(rec.get("host", "127.0.0.1"), rec["port"])
+
+    def any_client(self):
+        recs = self.daemons()
+        assert recs, f"no live daemon on {self.spool}"
+        return self.client(recs[0])
+
+    def stop(self, graceful: bool = True, timeout: float = 30.0) -> None:
+        for p in self.procs:
+            if p.poll() is None and graceful:
+                p.terminate()  # SIGTERM -> cooperative drain, exit 0
+        deadline = time.time() + timeout
+        for p in self.procs:
+            if p.poll() is None:
+                try:
+                    p.wait(max(0.1, deadline - time.time()))
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    p.wait(10)
+
+
+def _job_record(spool: str, jid: str) -> Optional[Dict[str, Any]]:
+    """Read a job record straight off the spool — robust to every
+    daemon being dead, which is the point of this gate."""
+    for sub in ("jobs", "quarantine"):
+        try:
+            with open(os.path.join(spool, sub, f"{jid}.json"),
+                      encoding="utf-8") as fh:
+                return json.load(fh)
+        except (OSError, ValueError):
+            continue
+    return None
+
+
+def _wait_spool(spool: str, jid: str, statuses: Tuple[str, ...],
+                timeout: float) -> Dict[str, Any]:
+    deadline = time.time() + timeout
+    last = None
+    while time.time() < deadline:
+        rec = _job_record(spool, jid)
+        if rec is not None:
+            last = rec.get("status")
+            if last in statuses:
+                return rec
+        time.sleep(0.15)
+    raise AssertionError(f"job {jid} still {last!r} after "
+                         f"{timeout:.0f}s (wanted {statuses})")
+
+
+def _daemon_pid(daemon_id: str) -> int:
+    """Heartbeat ids are `d<pid>-<hex>` so a chaos harness can aim a
+    SIGKILL without a side channel."""
+    return int(daemon_id[1:].split("-", 1)[0])
+
+
+def _metric_total(recs: List[Dict[str, Any]], name: str) -> float:
+    total = 0.0
+    for rec in recs:
+        try:
+            text = _scrape(rec.get("host", "127.0.0.1"), rec["port"])
+        except OSError:
+            continue
+        for ln in text.splitlines():
+            if ln.startswith(name + " "):
+                total += float(ln.rsplit(" ", 1)[1])
+    return total
+
+
+def _keep_artifact(spool: str, jid: str, out_dir: str, leg: str,
+                   rec: Optional[Dict[str, Any]] = None) -> None:
+    """Copy the leg's result artifact into --out-dir and append it to
+    the run ledger (rung = the leg name); never fails the gate."""
+    try:
+        os.makedirs(out_dir, exist_ok=True)
+        dst = os.path.join(out_dir, f"jaxmc_fleetbench_{leg}.json")
+        src = os.path.join(spool, "results", f"{jid}.json")
+        if os.path.exists(src):
+            shutil.copyfile(src, dst)
+            from .obs.ledger import append_summary
+            with open(src, encoding="utf-8") as fh:
+                append_summary(json.load(fh), source="fleetbench",
+                               rung=leg)
+        elif rec is not None:
+            with open(dst, "w", encoding="utf-8") as fh:
+                json.dump(rec, fh, indent=1)
+        print(f"fleet-check: {leg}: artifact {dst}")
+    except (OSError, ValueError) as ex:
+        print(f"fleet-check: {leg}: artifact copy skipped ({ex})",
+              file=sys.stderr)
+
+
+# ---------------------------------------------------------------- legs
+
+def _leg_takeover(work: str, out_dir: str, timeout: float,
+                  failures: List[str]) -> None:
+    spec = _write_spec(os.path.join(work, "specs"), "takeoverload",
+                      q=1500, bound=20)
+    opts = {"backend": "interp", "progress_every": 2}
+
+    # ground truth: the same job on a solo spool, no chaos
+    solo = _Fleet(os.path.join(work, "spool_solo"),
+                  {"JAXMC_SERVE_CKPT_EVERY": "0.3"})
+    solo.start(1)
+    try:
+        rec = solo.wait_up(1)[0]
+        client = solo.client(rec)
+        code, job = client.submit(spec, None, opts)
+        assert code == 200, f"solo submit failed ({code}): {job}"
+        ref = _wait_spool(solo.spool, job["id"], ("done",), timeout)
+    finally:
+        solo.stop()
+    ref_counts = (ref.get("generated"), ref.get("distinct"))
+
+    # the fleet: 3 daemons, short leases, eager checkpoints
+    fleet = _Fleet(os.path.join(work, "spool_fleet"), {
+        "JAXMC_SERVE_CKPT_EVERY": "0.3",
+        "JAXMC_LEASE_TTL": "1.5",
+        "JAXMC_LEASE_AFFINITY_GRACE": "0.2",
+    })
+    fleet.start(3)
+    try:
+        recs = fleet.wait_up(3)
+        code, job = fleet.client(recs[0]).submit(spec, None, opts)
+        assert code == 200, f"fleet submit failed ({code}): {job}"
+        jid = job["id"]
+
+        # wait until a daemon owns it, give it one checkpoint cadence,
+        # then SIGKILL the owner (pid parsed from the daemon id)
+        deadline = time.time() + timeout
+        owner = None
+        while time.time() < deadline:
+            rec = _job_record(fleet.spool, jid) or {}
+            if rec.get("status") == "running" and rec.get("daemon"):
+                owner = rec["daemon"]
+                break
+            time.sleep(0.1)
+        assert owner, f"job {jid} never started running"
+        time.sleep(1.0)  # let at least one spool checkpoint land
+        os.kill(_daemon_pid(owner), signal.SIGKILL)
+
+        done = _wait_spool(fleet.spool, jid, ("done", "failed",
+                                              "quarantined"), timeout)
+        if done.get("status") != "done":
+            failures.append(
+                f"takeover: job ended {done.get('status')!r} "
+                f"({done.get('verdict') or done.get('error')})")
+            return
+        if done.get("daemon") == owner:
+            failures.append(f"takeover: job finished on the KILLED "
+                            f"daemon {owner} — lease takeover never "
+                            f"happened")
+        if not done.get("stolen_by"):
+            failures.append("takeover: finished record carries no "
+                            "stolen_by — the peer did not go through "
+                            "the lease steal")
+        got = (done.get("generated"), done.get("distinct"))
+        if got != ref_counts:
+            failures.append(f"takeover: counts {got} != solo "
+                            f"reference {ref_counts} — the resumed "
+                            f"run diverged")
+        takeovers = _metric_total(fleet.daemons(),
+                                  "jaxmc_serve_takeovers")
+        if takeovers < 1:
+            failures.append(f"takeover: survivors report "
+                            f"{takeovers:.0f} jaxmc_serve_takeovers, "
+                            f"expected >= 1")
+        if not failures:
+            print(f"fleet-check: takeover: ok — {owner} killed "
+                  f"mid-run, {done.get('daemon')} finished with "
+                  f"identical counts {got} "
+                  f"(note={done.get('requeue_note')!r})")
+        _keep_artifact(fleet.spool, jid, out_dir, "takeover")
+    finally:
+        fleet.stop()
+
+
+def _leg_routing(work: str, out_dir: str, timeout: float,
+                 failures: List[str]) -> None:
+    spec = _write_spec(os.path.join(work, "specs"), "routeload",
+                      q=200, bound=12)
+    opts = {"backend": "interp"}
+    trace_dir = os.path.join(work, "routing_traces")
+    os.makedirs(trace_dir, exist_ok=True)
+    fleet = _Fleet(os.path.join(work, "spool_routing"), {
+        # nothing rides the fast lane, so cold sigs DEFER to the fleet
+        # scan and warm affinity decides placement
+        "JAXMC_SERVE_FASTLANE_BOUND": "0",
+        "JAXMC_LEASE_AFFINITY_GRACE": "5.0",
+    }, trace_dir=trace_dir)
+    # warm daemon A ALONE first (fleet of 1 enqueues locally)
+    fleet.start(1)
+    try:
+        rec_a = fleet.wait_up(1)[0]
+        code, job = fleet.client(rec_a).submit(spec, None, opts)
+        assert code == 200, f"warmup submit failed ({code}): {job}"
+        _wait_spool(fleet.spool, job["id"], ("done",), timeout)
+        a_id = rec_a["id"]
+
+        # two cold peers join, then identical jobs round-robin across
+        # every port — warm-hit routing must beat that placement
+        fleet.start(2)
+        recs = fleet.wait_up(3)
+        time.sleep(1.5)  # let every fleet scan see fleet_size == 3
+        jids = []
+        for i in range(4):
+            rec = recs[i % len(recs)]
+            code, job = fleet.client(rec).submit(spec, None, opts)
+            assert code == 200, \
+                f"routing submit {i} failed ({code}): {job}"
+            jids.append(job["id"])
+        owners = [_wait_spool(fleet.spool, j, ("done",),
+                              timeout).get("daemon") for j in jids]
+        share = sum(1 for o in owners if o == a_id) / len(owners)
+        if share <= 1 / 3:
+            failures.append(
+                f"routing: warm daemon {a_id} ran only "
+                f"{share:.0%} of identical jobs ({owners}) — no "
+                f"better than round-robin placement")
+        live = fleet.daemons()
+        deferred = _metric_total(live, "jaxmc_serve_jobs_deferred")
+        affine = _metric_total(live, "jaxmc_serve_affinity_adoptions")
+        if deferred < 1:
+            failures.append("routing: no submission was deferred to "
+                            "the fleet scan — the routing path never "
+                            "engaged")
+        if affine < 1:
+            failures.append("routing: no affinity adoption recorded — "
+                            "the warm daemon won by luck, not routing")
+        if not failures:
+            print(f"fleet-check: routing: ok — warm daemon took "
+                  f"{share:.0%} of 4 round-robined jobs "
+                  f"(deferred={deferred:.0f}, affine={affine:.0f})")
+        _keep_artifact(fleet.spool, jids[-1], out_dir, "routing")
+
+        # drain cleanly, then the orphan gate over EVERY trace
+        fleet.stop(graceful=True)
+        traces = sorted(glob.glob(
+            os.path.join(trace_dir, "*.trace.jsonl"))) + sorted(
+            glob.glob(os.path.join(fleet.spool, "results",
+                                   "*.trace.jsonl")))
+        from .obs.report import main as obs_main
+        buf = io.StringIO()
+        rc = obs_main(["timeline", "--fail-on-orphans"] + traces,
+                      out=buf)
+        counts = _summary_counts(buf.getvalue())
+        if rc != 0 or counts.get("orphans", -1) != 0:
+            failures.append(
+                f"routing: obs timeline found "
+                f"{counts.get('orphans')} orphan spans (rc={rc}) "
+                f"across the fleet's traces")
+        elif counts.get("processes", 0) < 3:
+            failures.append(
+                f"routing: timeline stitched only "
+                f"{counts.get('processes')} processes, expected the "
+                f"3 daemons")
+        else:
+            print(f"fleet-check: routing: timeline ok — "
+                  f"{counts['processes']} processes, "
+                  f"{counts['events']} events, 0 orphans")
+    finally:
+        fleet.stop()
+
+
+def _leg_admission(work: str, out_dir: str, timeout: float,
+                   failures: List[str]) -> None:
+    spec = _write_spec(os.path.join(work, "specs"), "admitload",
+                      q=1500, bound=20)
+    opts = {"backend": "interp"}
+    fleet = _Fleet(os.path.join(work, "spool_admission"),
+                   {"JAXMC_SERVE_MAX_DEPTH": "2"})
+    fleet.start(1)
+    try:
+        rec = fleet.wait_up(1)[0]
+        client = fleet.client(rec)
+        accepted, rejected = [], []
+        for i in range(8):
+            code, job = client.submit(spec, None, opts,
+                                      tenant="burst")
+            if code == 200:
+                accepted.append(job["id"])
+            elif code == 429:
+                rejected.append((dict(client.last_headers), job))
+            else:
+                failures.append(f"admission: submit {i} got "
+                                f"unexpected {code}: {job}")
+                return
+            time.sleep(0.05)
+        if not rejected:
+            failures.append("admission: 8 submissions into a "
+                            "depth-2 spool produced no 429")
+            return
+        headers, body = rejected[0]
+        retry = headers.get("Retry-After")
+        if not retry or float(retry) < 1:
+            failures.append(f"admission: 429 Retry-After "
+                            f"{retry!r}, expected >= 1s")
+        if body.get("reason") not in ("queue_full", "tenant_rate"):
+            failures.append(f"admission: 429 body carries no named "
+                            f"reason: {body}")
+        if body.get("reason") == "queue_full" and \
+                "queue_depth" not in body:
+            failures.append(f"admission: queue_full 429 body lacks "
+                            f"the queue gauges: {body}")
+        n429 = _metric_total([rec], "jaxmc_serve_admission_rejected")
+        if n429 < len(rejected):
+            failures.append(
+                f"admission: /metrics shows {n429:.0f} "
+                f"admission_rejected for {len(rejected)} 429s")
+        # every job the daemon ACCEPTED must still complete
+        for jid in accepted:
+            done = _wait_spool(fleet.spool, jid, ("done", "failed"),
+                               timeout)
+            if done.get("status") != "done":
+                failures.append(f"admission: accepted job {jid} "
+                                f"ended {done.get('status')!r}")
+        if not failures:
+            print(f"fleet-check: admission: ok — "
+                  f"{len(accepted)} accepted (all completed), "
+                  f"{len(rejected)} refused with 429 "
+                  f"Retry-After={retry}s "
+                  f"reason={body.get('reason')}")
+        if accepted:
+            _keep_artifact(fleet.spool, accepted[0], out_dir,
+                           "admission")
+    finally:
+        fleet.stop()
+
+
+def _leg_poison(work: str, out_dir: str, timeout: float,
+                failures: List[str]) -> None:
+    spec = _write_spec(os.path.join(work, "specs"), "poisonload",
+                      q=50, bound=6)
+    retries = 2
+    fault_state = os.path.join(work, "poison_fault_state")
+    os.makedirs(fault_state, exist_ok=True)
+    fleet = _Fleet(os.path.join(work, "spool_poison"), {
+        # every daemon that marks this spec running SIGKILLs itself;
+        # the budget latch dir is SHARED so respawned lives keep
+        # spending the same cross-daemon budget
+        "JAXMC_FAULTS": "daemon_kill:spec=poisonload.tla:n=99",
+        "JAXMC_FAULTS_STATE": fault_state,
+        "JAXMC_JOB_RETRIES": str(retries),
+        "JAXMC_LEASE_TTL": "1.0",
+        "JAXMC_LEASE_AFFINITY_GRACE": "0.1",
+        "JAXMC_SERVE_CKPT_EVERY": "0.3",
+    })
+    fleet.start(2)
+    try:
+        recs = fleet.wait_up(2)
+        code, job = fleet.client(recs[0]).submit(
+            spec, None, {"backend": "interp"})
+        assert code == 200, f"poison submit failed ({code}): {job}"
+        jid = job["id"]
+
+        # supervisor: respawn dead daemons until quarantine verdict
+        qpath = os.path.join(fleet.spool, "quarantine", f"{jid}.json")
+        deadline = time.time() + timeout
+        respawns = 0
+        while time.time() < deadline and not os.path.exists(qpath):
+            dead = sum(1 for p in fleet.procs if p.poll() is not None)
+            live = len(fleet.procs) - dead
+            while live < 2 and respawns < 8:
+                fleet.start(1)
+                live += 1
+                respawns += 1
+            time.sleep(0.2)
+        rec = _job_record(fleet.spool, jid) or {}
+        if rec.get("status") != "quarantined":
+            failures.append(
+                f"poison: job never quarantined (status "
+                f"{rec.get('status')!r} after {respawns} respawns) — "
+                f"the cross-daemon retry budget never exhausted")
+            return
+        if "poison" not in str(rec.get("verdict", "")):
+            failures.append(f"poison: quarantine verdict is not "
+                            f"named: {rec.get('verdict')!r}")
+        if rec.get("retries_spent") != retries:
+            failures.append(
+                f"poison: {rec.get('retries_spent')} retries spent, "
+                f"budget was {retries} — quarantine fired early or "
+                f"late")
+        if not rec.get("fault_context"):
+            failures.append("poison: quarantine record carries no "
+                            "fault context for triage")
+        # a live daemon must answer for the quarantined id by name
+        fleet.wait_up(1)
+        code, got = fleet.any_client().job(jid)
+        if code != 200 or got.get("status") != "quarantined":
+            failures.append(
+                f"poison: GET /jobs/{jid} on a live daemon returned "
+                f"{code} status={got.get('status')!r}, expected the "
+                f"quarantine verdict")
+        if not failures:
+            print(f"fleet-check: poison: ok — quarantined after "
+                  f"{retries} cross-daemon deaths "
+                  f"(verdict={rec.get('verdict')!r}, "
+                  f"trace_tail={len(rec.get('trace_tail', []))} "
+                  f"lines)")
+        _keep_artifact(fleet.spool, jid, out_dir, "poison", rec=rec)
+    finally:
+        fleet.stop(graceful=False)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="python -m jaxmc.fleetbench",
+        description="the make fleet-check multi-daemon chaos gate")
+    ap.add_argument("--out-dir", default="/tmp",
+                    help="where leg artifacts land (the bench-check "
+                         "run ledger imports them)")
+    ap.add_argument("--work", default=None,
+                    help="scratch root; default: a fresh temp dir")
+    ap.add_argument("--timeout", type=float, default=180.0,
+                    help="per-leg wall budget")
+    ap.add_argument("--legs", default="takeover,routing,admission,"
+                                      "poison",
+                    help="comma-separated subset to run")
+    args = ap.parse_args(argv)
+
+    verdict = _host_verdict()
+    if verdict is not None:
+        print(f"FLEET-CHECK SKIP: {verdict}")
+        return 0
+
+    work = args.work or tempfile.mkdtemp(prefix="jaxmc_fleet_check_")
+    print(f"fleet-check: scratch {work}")
+    legs = {"takeover": _leg_takeover, "routing": _leg_routing,
+            "admission": _leg_admission, "poison": _leg_poison}
+    failures: List[str] = []
+    ran = []
+    for name in args.legs.split(","):
+        name = name.strip()
+        if not name:
+            continue
+        fn = legs.get(name)
+        if fn is None:
+            failures.append(f"unknown leg {name!r}")
+            continue
+        before = len(failures)
+        try:
+            fn(work, args.out_dir, args.timeout, failures)
+        except AssertionError as ex:
+            failures.append(f"{name}: {ex}")
+        ran.append(name)
+        if len(failures) == before:
+            print(f"fleet-check: leg {name}: PASS")
+    for f in failures:
+        print(f"fleet-check: FAIL: {f}", file=sys.stderr)
+    if not failures:
+        print(f"fleet-check: PASS — legs {', '.join(ran)} all held "
+              f"(SIGKILL takeover resumed bit-identically; overload "
+              f"answers 429 + Retry-After; poison jobs quarantine "
+              f"with a named verdict)")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -128,6 +128,14 @@ def _from_metrics(s: Dict[str, Any], path: str, label: str
         # hierarchy to host-tier-only (ISSUE 12): counts stayed exact,
         # but the out-of-core ceiling shrank — flagged like a demotion
         "io_degraded": s.get("gauges", {}).get("tier.io_degraded"),
+        # fleet-serving reliability signals (ISSUE 19): rejections or
+        # spool write degradation appearing where a previous run had
+        # none is a serving regression even when every accepted job
+        # still completed — flagged like the tier degradation above
+        "admission_rejected": s.get("counters", {}).get(
+            "serve.admission_rejected"),
+        "spool_degraded": s.get("counters", {}).get(
+            "serve.spool_degraded"),
         "mode": s.get("gauges", {}).get("expand.mode"),
         "wall_s": s.get("wall_s"),
         "phases": {p["name"]: p["wall_s"] for p in s.get("phases", [])},
@@ -458,6 +466,22 @@ def find_regressions(prev: Dict[str, Any], cur: Dict[str, Any],
             f"REGRESS tier io degradation {step}: disk-tier write "
             f"failed, seen-set hierarchy ran host-tier-only "
             f"({cur['io_degraded']})")
+    if cur.get("admission_rejected") and \
+            not prev.get("admission_rejected"):
+        # accepted jobs completed, but the fleet turned clients away —
+        # capacity (or a tenant budget) regressed vs the previous run
+        flags.append(
+            f"REGRESS serve admission rejections {step}: "
+            f"{cur['admission_rejected']} submissions refused with 429 "
+            f"where the previous run refused none")
+    if cur.get("spool_degraded") and not prev.get("spool_degraded"):
+        # the durable spool exhausted its write retries: results kept
+        # flowing over HTTP but durability (restart recovery, takeover)
+        # regressed for the affected records
+        flags.append(
+            f"REGRESS serve spool degradation {step}: spool writes "
+            f"exhausted their retries ({cur['spool_degraded']} "
+            f"degradation events)")
     for name in sorted(set(prev["phases"]) & set(cur["phases"])):
         if name in ignore_phases:
             continue

@@ -496,6 +496,32 @@ jaxmc.metrics/2 artifact minus the new optional surface, so readers and
       member's proven element bounds instead of falling back to
       whole-variable summaries; `bits_per_state` never exceeds the
       worst solo member's.
+
+  (PR 19, still jaxmc.metrics/4 — all additive/optional; fleet-grade
+   serving: leases + takeover, admission control, quarantine:)
+    - serve fleet gauges: `serve.fleet_daemons` (live daemon-registry
+      records within the lease TTL), `serve.leases_held` (jobs this
+      daemon currently holds a lease on).
+    - serve fleet counters: `serve.takeovers` (expired leases this
+      daemon stole), `serve.jobs_adopted` (spool jobs pulled into the
+      local queue by the fleet scanner), `serve.jobs_deferred`
+      (submissions accepted but left unclaimed for a warmer peer),
+      `serve.affinity_adoptions` (adoptions won on sig/bsig warmth),
+      `serve.lease_lost` / `serve.lease_lost_drops` (renewals lost to
+      a thief / results discarded because the lease was lost),
+      `serve.lease_stalls` (injected fleet-tick stalls),
+      `serve.quarantined` (jobs moved to spool/quarantine after the
+      cross-daemon retry budget), `serve.admission_rejected` (429s),
+      `serve.spool_retries` / `serve.spool_degraded` (transient spool
+      write retries / writes that exhausted them).  `obs diff` flags
+      the APPEARANCE of admission_rejected and spool_degraded like
+      the tier degradation gauge (REGRESS lines).
+    - job records (serve artifacts / GET /jobs): optional `daemon`
+      (the fleet member that ran the job), `tenant` (admission
+      accounting principal), `stolen_by` + `requeue_note` (lease-
+      expiry takeover provenance); job status gains "quarantined".
+    - batch counters: `batch.resume_refused` (a cohort member's
+      checkpoint could not seed the merged layout; it ran fresh).
 """
 
 from __future__ import annotations

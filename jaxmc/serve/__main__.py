@@ -24,13 +24,16 @@ from typing import List, Optional
 def cmd_run(args) -> int:
     from .. import drain
     from .daemon import ServeDaemon
-    if getattr(args, "device_owner", False):
-        # flag -> env so the policy has ONE read site (the daemon's),
-        # and subprocess daemon tests can set it the same way
+    # flag -> env so the policy has ONE read site (the daemon's), and
+    # subprocess daemon tests can set it the same way
+    if getattr(args, "no_device_owner", False):
+        os.environ["JAXMC_SERVE_DEVICE_OWNER"] = "0"
+    elif getattr(args, "device_owner", False):
         os.environ["JAXMC_SERVE_DEVICE_OWNER"] = "1"
     daemon = ServeDaemon(args.spool, host=args.host, port=args.port,
                          workers=args.workers, trace=args.trace,
-                         metrics_out=args.metrics_out, quiet=args.quiet)
+                         metrics_out=args.metrics_out, quiet=args.quiet,
+                         checkpoint_every=args.checkpoint_every)
     daemon.start()
     # SIGTERM/SIGINT -> cooperative drain: in-flight jobs checkpoint and
     # park, queued jobs persist in the spool, exit 0 (a drained daemon
@@ -55,7 +58,14 @@ def cmd_submit(args) -> int:
         options.setdefault("no_trace", True)
     code, job = client.submit(os.path.abspath(args.spec),
                               os.path.abspath(args.cfg)
-                              if args.cfg else None, options)
+                              if args.cfg else None, options,
+                              tenant=args.tenant)
+    if code == 429:
+        print(f"error: admission refused (429): {job.get('error')} "
+              f"[Retry-After: "
+              f"{client.last_headers.get('Retry-After')}s]",
+              file=sys.stderr)
+        return 2
     if code != 200:
         print(f"error: submit failed ({code}): {job.get('error')}",
               file=sys.stderr)
@@ -174,13 +184,22 @@ def main(argv: Optional[List[str]] = None) -> int:
     r.add_argument("--metrics-out", default=None, metavar="FILE",
                    help="fleet metrics artifact written at drain")
     r.add_argument("--quiet", action="store_true")
+    r.add_argument("--checkpoint-every", type=float, default=60.0,
+                   metavar="S",
+                   help="periodic job-checkpoint cadence; the spool "
+                        "checkpoint is what a lease-expiry takeover "
+                        "resumes from (env: JAXMC_SERVE_CKPT_EVERY)")
     r.add_argument("--device-owner", action="store_true",
                    help="route device work (vmapped batches, solo "
                         "device jobs) through a spawned owner process "
                         "(ISSUE 13): the daemon never initializes jax, "
                         "a wedged/crashed dispatch kills at worst the "
-                        "owner (jobs requeue, owner respawns). Equiv: "
-                        "JAXMC_SERVE_DEVICE_OWNER=1")
+                        "owner (jobs requeue, owner respawns). THE "
+                        "DEFAULT since owner death became supervised; "
+                        "equiv: JAXMC_SERVE_DEVICE_OWNER=1")
+    r.add_argument("--no-device-owner", action="store_true",
+                   help="run device work in-process (the pre-fleet "
+                        "layout). Equiv: JAXMC_SERVE_DEVICE_OWNER=0")
     r.set_defaults(fn=cmd_run)
 
     s = sub.add_parser("submit", help="submit a job to a live daemon")
@@ -192,6 +211,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     s.add_argument("--resident", action="store_true")
     s.add_argument("--options", default=None,
                    help="extra job options as a JSON object")
+    s.add_argument("--tenant", default=None,
+                   help="admission-control accounting principal "
+                        "(per-tenant token bucket); default 'default'")
     s.add_argument("--wait", action="store_true",
                    help="poll until the job finishes; exit 0/1 like "
                         "`jaxmc check`")

@@ -54,6 +54,50 @@ from .protocol import BadJob, build_config, job_signature
 from .queue import JobQueue
 
 
+class _ArtifactSeries:
+    """Adapts a finished job's metrics ARTIFACT to the /metrics
+    done-series surface (metrics_snapshot / prof / progress_est).  The
+    device owner is the default device path since ISSUE 19, so the
+    job's live recorder finishes in the OWNER process — the daemon
+    renders the TTL-retained final series (running 0, prof sites, hbm
+    peak) from the summary the owner shipped back instead."""
+
+    progress_est = None
+
+    class _Site:
+        __slots__ = ("dispatches", "wall_s")
+
+    class _Prof:
+        __slots__ = ("sites", "hbm_peak_bytes")
+
+    def __init__(self, summary: Dict[str, Any]):
+        self._counters = dict(summary.get("counters") or {})
+        self._gauges = dict(summary.get("gauges") or {})
+        self._levels = list(summary.get("levels") or [])
+        self.t_start = summary.get("started_at") or time.time()
+        self.prof = None
+        pb = summary.get("prof")
+        if isinstance(pb, dict):
+            prof = self._Prof()
+            prof.sites = {}
+            prof.hbm_peak_bytes = \
+                (pb.get("hbm") or {}).get("peak_bytes", 0)
+            for name, sd in sorted((pb.get("sites") or {}).items()):
+                st = self._Site()
+                st.dispatches = sd.get("dispatches", 0)
+                st.wall_s = sd.get("wall_s", 0.0)
+                prof.sites[name] = st
+            self.prof = prof
+
+    def metrics_snapshot(self) -> Dict[str, Any]:
+        return {"counters": dict(self._counters),
+                "gauges": dict(self._gauges),
+                "levels": list(self._levels)}
+
+    def recent_events(self) -> List[Dict[str, Any]]:
+        return []
+
+
 class ServeDaemon:
     def __init__(self, spool: str, host: str = "127.0.0.1",
                  port: int = 0, workers: int = 2,
@@ -69,13 +113,61 @@ class ServeDaemon:
             trace_path=trace,
             meta={"command": "serve", "spool": self.q.root,
                   "env": obs.environment_meta()})
+        # spool writes surface their retry/degrade telemetry here
+        self.q.tel = self.tel
         self.log = obs.Logger(self.tel, quiet=quiet)
+        # FLEET IDENTITY (ISSUE 19): several daemons may share one
+        # spool; each carries a unique id stamped into its heartbeats,
+        # leases, and job records so takeovers are attributable
+        self.daemon_id = f"d{os.getpid()}-{os.urandom(3).hex()}"
+
+        def _fenv(name: str, default: float) -> float:
+            try:
+                return float(os.environ.get(name, "") or default)
+            except ValueError:
+                return default
+
+        # lease discipline: a claim is renewed every lease_renew
+        # seconds; a peer treats a lease unrenewed for lease_ttl as the
+        # owner's death.  Renew at ttl/3 so two missed beats still
+        # leave slack before anyone steals.
+        self.lease_ttl = max(0.2, _fenv("JAXMC_LEASE_TTL", 10.0))
+        self.lease_renew = max(0.05, _fenv("JAXMC_LEASE_RENEW",
+                                           self.lease_ttl / 3.0))
+        # bsig-affinity head start: a NON-affine thief waits this much
+        # past expiry before stealing, so the peer whose warm registry
+        # already knows the job's layout class wins ties
+        self.affinity_grace = max(0.0, _fenv(
+            "JAXMC_LEASE_AFFINITY_GRACE",
+            min(2.0, self.lease_ttl / 2.0)))
+        # cross-daemon poison budget: a job whose owner dies this many
+        # times FLEET-WIDE is quarantined, not retried forever
+        self.job_retries = max(1, int(_fenv("JAXMC_JOB_RETRIES", 3)))
+        # ADMISSION CONTROL (ISSUE 19): bounded spool depth + per-tenant
+        # token buckets priced by the analyze-cost fast lane.  Overload
+        # answers 429 + Retry-After, never an unbounded queue.
+        self.max_depth = max(1, int(_fenv("JAXMC_SERVE_MAX_DEPTH",
+                                          1000)))
+        self.tenant_burst = max(1.0, _fenv("JAXMC_SERVE_TENANT_BURST",
+                                           256.0))
+        self.tenant_rate = max(0.01, _fenv("JAXMC_SERVE_TENANT_RATE",
+                                           32.0))
+        # tenant -> [tokens, last refill time]; guarded by _cv
+        self._buckets: Dict[str, List[float]] = {}
+        # jids whose lease the fleet thread discovered LOST (stolen
+        # while we still run them): their results must not publish
+        self._lost: set = set()
+        self._fleet_thread: Optional[threading.Thread] = None
+        self._fleet_size = 1
         self.wd = obs.Watchdog(self.tel)
         self.metrics_out = metrics_out
         self.host = host
         self.port = port
         self.n_workers = max(1, int(workers))
-        self.checkpoint_every = checkpoint_every
+        # env override so subprocess daemons (fleetbench, chaos tests)
+        # can tighten the checkpoint cadence takeover resumes ride on
+        self.checkpoint_every = _fenv("JAXMC_SERVE_CKPT_EVERY",
+                                      checkpoint_every)
         # sig -> {"session": CheckSession, "completed": bool} — the warm
         # kernel registry; "completed" gates checkpoint-replay reuse.
         # Mutated ONLY under _cv (status() snapshots under it too), and
@@ -136,11 +228,14 @@ class ServeDaemon:
                 "JAXMC_SERVE_FASTLANE_BOUND", "50000") or 50000)
         except ValueError:
             self.fastlane_bound = 50000
-        # DEVICE-OWNER process (opt-in): device work leaves the daemon
-        # process entirely — see serve/owner.py
+        # DEVICE-OWNER process — ON BY DEFAULT (ISSUE 19 satellite,
+        # ROADMAP 2a): owner death is supervised (requeue + respawn +
+        # the cross-daemon retry budget), so device work leaves the
+        # daemon process unless JAXMC_SERVE_DEVICE_OWNER=0 opts out.
+        # The spawn is lazy: interp-only daemons never pay for it.
         self.owner = None
-        if os.environ.get("JAXMC_SERVE_DEVICE_OWNER", "").strip() \
-                .lower() in ("1", "on", "yes", "true"):
+        if os.environ.get("JAXMC_SERVE_DEVICE_OWNER", "1").strip() \
+                .lower() not in ("0", "off", "no", "false"):
             from .owner import DeviceOwner
             self.owner = DeviceOwner(log=self.log)
         self._batch_sigs_seen: set = set()
@@ -177,7 +272,11 @@ class ServeDaemon:
 
     # ---- lifecycle ----------------------------------------------------
     def start(self) -> "ServeDaemon":
-        requeued = self.q.recover()
+        # recovery is LEASE-AWARE (ISSUE 19): running jobs still leased
+        # by a live peer on the same spool stay theirs; expired ones
+        # spend the cross-daemon retry budget (quarantine on exhaustion)
+        requeued = self.q.recover(self.daemon_id, ttl=self.lease_ttl,
+                                  retries=self.job_retries)
         if requeued:
             self.log(f"serve: requeued {requeued} interrupted job"
                      f"{'s' if requeued != 1 else ''} from the spool")
@@ -186,13 +285,20 @@ class ServeDaemon:
             for job in sorted(self.q.queued(), key=lambda j: j["id"]):
                 self._pending.append(job["id"])
         self._start_http()
+        self.q.heartbeat(self.daemon_id, host=self.host,
+                         port=self.port, pid=os.getpid())
         self.q.stamp(host=self.host, port=self.port, pid=os.getpid(),
-                     workers=self.n_workers, status="serving")
+                     workers=self.n_workers, status="serving",
+                     daemon=self.daemon_id)
         for wi in range(self.n_workers):
             t = threading.Thread(target=self._worker_loop, args=(wi,),
                                  name=f"jaxmc-serve-w{wi}", daemon=True)
             t.start()
             self._workers.append(t)
+        self._fleet_thread = threading.Thread(
+            target=self._fleet_loop, name="jaxmc-serve-fleet",
+            daemon=True)
+        self._fleet_thread.start()
         self.wd.start()
         self._update_gauges()
         self.log(f"serve: listening on http://{self.host}:{self.port} "
@@ -210,15 +316,19 @@ class ServeDaemon:
             def log_message(self, fmt, *a):  # quiet the default stderr
                 pass
 
-            def _json(self, code: int, obj) -> None:
+            def _json(self, code: int, obj, headers=None) -> None:
                 body = json.dumps(obj).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 
             def do_POST(self):
+                from .protocol import Overloaded
+                from .queue import SpoolDegraded
                 try:
                     n = int(self.headers.get("Content-Length") or 0)
                     body = json.loads(self.rfile.read(n).decode()) \
@@ -230,6 +340,22 @@ class ServeDaemon:
                         job = daemon.submit(body)
                     except BadJob as ex:
                         return self._json(400, {"error": str(ex)})
+                    except Overloaded as ex:
+                        # the 429 contract (ISSUE 19): Retry-After in
+                        # the header AND machine-readable gauges in
+                        # the body, so clients can back off precisely
+                        return self._json(
+                            429,
+                            dict(ex.body, error=str(ex),
+                                 retry_after_s=ex.retry_after_s),
+                            headers={"Retry-After": str(max(
+                                1, int(round(ex.retry_after_s))))})
+                    except SpoolDegraded as ex:
+                        # hardened spool writes degrade with a NAMED
+                        # verdict, never a raw 500
+                        return self._json(
+                            503, {"error": str(ex),
+                                  "degraded": "spool"})
                     except RuntimeError as ex:  # draining
                         return self._json(503, {"error": str(ex)})
                     return self._json(200, job)
@@ -275,6 +401,12 @@ class ServeDaemon:
                         return self._json(200, res)
                     job = daemon.q.load(jid)
                     if job is None:
+                        # quarantined jobs answer with a NAMED verdict
+                        # (ISSUE 19): the captured fault context and
+                        # trace tail travel with it
+                        qrec = daemon.q.load_quarantined(jid)
+                        if qrec is not None:
+                            return self._json(200, qrec)
                         return self._json(404,
                                           {"error": f"no job {jid}"})
                     if job.get("status") == "done":
@@ -331,6 +463,9 @@ class ServeDaemon:
             self.initiate_drain("shutdown()")
         for t in self._workers:
             t.join(timeout=120.0)
+        if self._fleet_thread is not None:
+            self._fleet_thread.join(timeout=10.0)
+            self._fleet_thread = None
         alive = [t.name for t in self._workers if t.is_alive()]
         if alive:  # never expected: engines poll drain at every level
             self.log(f"serve: WARNING: workers still alive at shutdown: "
@@ -347,6 +482,9 @@ class ServeDaemon:
             self.owner.stop()
         self.wd.stop()
         self._update_gauges()
+        # leave the fleet cleanly: a stale heartbeat record would make
+        # peers defer submissions to a ghost until it aged out
+        self.q.remove_daemon(self.daemon_id)
         self.q.stamp(host=self.host, port=self.port, pid=os.getpid(),
                      workers=self.n_workers, status="stopped",
                      drain_reason=self._drain_reason)
@@ -364,11 +502,51 @@ class ServeDaemon:
         # smoke gate, restart tests) must not inherit a stale request
         drain.clear()
 
+    # ---- admission control (ISSUE 19) ---------------------------------
+    def _admit(self, tenant: str, charge: float) -> Tuple[bool, float]:
+        """Per-tenant token bucket: `charge` tokens (priced by the
+        analyze-cost estimate) or a (False, retry-after) rejection.
+        Buckets refill continuously at tenant_rate up to tenant_burst."""
+        now = time.time()
+        with self._cv:
+            b = self._buckets.get(tenant)
+            if b is None:
+                b = self._buckets[tenant] = [self.tenant_burst, now]
+            tokens, last = b
+            tokens = min(self.tenant_burst,
+                         tokens + (now - last) * self.tenant_rate)
+            if tokens >= charge:
+                b[0], b[1] = tokens - charge, now
+                return True, 0.0
+            b[0], b[1] = tokens, now
+            return False, (charge - tokens) / self.tenant_rate
+
+    def _reject(self, tenant: str, reason: str, retry_after: float,
+                **gauges) -> None:
+        self.tel.counter("serve.admission_rejected")
+        self.tel.event("serve.admission_rejected", tenant=tenant,
+                       reason=reason, **gauges)
+        from .protocol import Overloaded
+        raise Overloaded(
+            f"admission refused ({reason}); retry after "
+            f"{retry_after:.1f}s",
+            retry_after_s=retry_after,
+            body=dict(gauges, tenant=tenant, reason=reason))
+
     # ---- submission ---------------------------------------------------
     def submit(self, payload: Dict[str, Any]) -> Dict[str, Any]:
         if self._draining:
             raise RuntimeError("daemon is draining; resubmit to the "
                                "next daemon life (the spool persists)")
+        tenant = str(payload.get("tenant") or "default")
+        with self._cv:
+            depth = len(self._pending) + len(self._running)
+        if depth >= self.max_depth:
+            # bounded spool: overload is a FAST, attributable 429 with
+            # the queue gauges in the body — never an unbounded queue
+            self._reject(tenant, "queue_full",
+                         min(60.0, max(1.0, 0.25 * depth)),
+                         queue_depth=depth, max_depth=self.max_depth)
         cfg = build_config(payload.get("spec"), payload.get("cfg"),
                            payload.get("options"))
         # submit-time static analysis (ISSUE 9): a statically-broken
@@ -425,10 +603,36 @@ class ServeDaemon:
             if prof is not None:
                 bsig, cost = prof.bsig, prof.cost_estimate
                 fast = cost is not None and cost <= self.fastlane_bound
+        # token-bucket admission, PRICED by the fast-lane cost oracle:
+        # proven-small jobs are cheap, estimate-heavy ones cost up to
+        # 4 tokens, unpriced jobs cost 1 — so a tenant's burst budget
+        # is spent in proportion to the work it schedules
+        charge = 1.0
+        if cost is not None:
+            charge = 0.25 if fast else min(
+                4.0, 1.0 + cost / (4.0 * self.fastlane_bound))
+        ok, wait_s = self._admit(tenant, charge)
+        if not ok:
+            self._reject(tenant, "tenant_rate",
+                         max(0.1, wait_s), queue_depth=depth,
+                         cost_estimate=cost, charge=charge)
         job = self.q.new_job(cfg.spec, cfg.cfg, payload.get("options"),
                              sig, bsig=bsig, cost_estimate=cost,
-                             fast_lane=fast or None)
+                             fast_lane=fast or None, tenant=tenant)
         self.tel.counter("serve.jobs_submitted")
+        # WARM-HIT ROUTING (ISSUE 19): on a multi-daemon spool, a job
+        # whose signature is NOT warm here stays spool-only — a peer
+        # whose warm registry knows it adopts it immediately from its
+        # fleet scan, everyone else (including us) only after the
+        # affinity grace.  Single-daemon spools enqueue locally always.
+        with self._cv:
+            sig_warm = sig in self.warm
+        if not fast and not sig_warm and self._fleet_size > 1:
+            self.tel.counter("serve.jobs_deferred")
+            with self._cv:
+                self._cv.notify()
+            self._update_gauges()
+            return job
         with self._cv:
             if fast:
                 # proven-small jobs jump the queue (fast lane)
@@ -444,6 +648,138 @@ class ServeDaemon:
         self._update_gauges()
         return job
 
+    # ---- the fleet thread (ISSUE 19) -----------------------------------
+    def _fleet_loop(self) -> None:
+        """Heartbeat + lease renewal + spool scan, one thread.  The
+        `lease_stall` fault site freezes a whole tick (no heartbeat, no
+        renewals) so tests can force a live daemon's leases to expire
+        and prove the double-claim arbitration."""
+        from .. import faults
+        interval = max(0.05, min(self.lease_renew, 1.0))
+        while not self._draining:
+            if faults.fire("lease_stall", daemon=self.daemon_id):
+                self.tel.counter("serve.lease_stalls")
+                time.sleep(interval)
+                continue
+            try:
+                self._fleet_tick()
+            except Exception as ex:  # noqa: BLE001 — the fleet thread
+                # must outlive any one bad spool read
+                self.tel.event("serve.fleet_tick_error", error=str(ex))
+            time.sleep(interval)
+
+    def _fleet_tick(self) -> None:
+        self.q.heartbeat(self.daemon_id, host=self.host,
+                         port=self.port, pid=os.getpid(),
+                         running=len(self._running),
+                         warm=len(self.warm))
+        self._fleet_size = max(1, len(self.q.daemons(self.lease_ttl)))
+        # renew every lease we hold; a failed renewal means a peer
+        # stole the job (our stall outlived the TTL) — the run paths
+        # check _lost before publishing anything
+        with self._cv:
+            held = list(self._running)
+        for jid in held:
+            if self.q.renew(jid, self.daemon_id):
+                continue
+            with self._cv:
+                if jid not in self._running:
+                    continue  # finished+released between snapshot/renew
+            cur = self.q.lease(jid)
+            if cur is None and self.q.try_claim(
+                    jid, self.daemon_id, self.lease_ttl):
+                continue  # lease file vanished; re-established
+            with self._cv:
+                if jid in self._lost:
+                    continue
+                self._lost.add(jid)
+            self.tel.counter("serve.lease_lost")
+            self.tel.event("serve.lease_lost", id=jid,
+                           thief=(cur or {}).get("daemon"))
+            self.log(f"serve: lease on {jid} LOST to "
+                     f"{(cur or {}).get('daemon')} — its result will "
+                     f"be discarded here")
+        self._scan_spool()
+
+    def _scan_spool(self) -> None:
+        """Adopt spool work this daemon does not know about: queued
+        jobs other daemons deferred (bsig-affinity routing) and running
+        jobs whose lease expired (crash takeover).  Affine daemons —
+        signature warm here, or the layout class already run here —
+        move first; everyone else waits out the affinity grace."""
+        now = time.time()
+        with self._cv:
+            known = set(self._pending) | set(self._running)
+            warm_sigs = set(self.warm)
+            bsigs = set(self._batch_sigs_seen)
+        adopted = []
+        for job in self.q.list_jobs():
+            jid = job["id"]
+            if jid in known:
+                continue
+            status = job.get("status")
+            affine = job.get("sig") in warm_sigs or \
+                (job.get("bsig") and job.get("bsig") in bsigs) or \
+                bool(job.get("fast_lane"))
+            if status == "queued":
+                age = now - float(job.get("submitted_at") or 0)
+                if affine or age > self.affinity_grace or \
+                        self._fleet_size <= 1:
+                    adopted.append(jid)
+                    if affine:
+                        self.tel.counter("serve.affinity_adoptions")
+            elif status == "running":
+                cur = self.q.lease(jid)
+                expired = cur is None or cur["age"] > self.lease_ttl
+                if not expired:
+                    continue
+                if not affine and cur is not None and \
+                        cur["age"] <= self.lease_ttl + \
+                        self.affinity_grace:
+                    continue  # give an affine thief the head start
+                out = self.q.takeover(jid, self.daemon_id,
+                                      self.lease_ttl, self.job_retries)
+                if out == "requeued":
+                    self.tel.counter("serve.takeovers")
+                    self.tel.event("serve.takeover", id=jid,
+                                   dead=(cur or {}).get("daemon"))
+                    self.log(f"serve: took over {jid} from dead peer "
+                             f"{(cur or {}).get('daemon')} (lease "
+                             f"expired; resuming from its checkpoint)")
+                    adopted.append(jid)
+        if adopted:
+            with self._cv:
+                for jid in adopted:
+                    if jid not in self._pending and \
+                            jid not in self._running:
+                        self._pending.append(jid)
+                self._cv.notify_all()
+            self.tel.counter("serve.jobs_adopted", len(adopted))
+            self._update_gauges()
+
+    def _still_owned(self, jid: str) -> bool:
+        """May THIS daemon publish the job's result?  False once the
+        fleet thread saw the lease stolen, or the spool says another
+        daemon holds it now."""
+        with self._cv:
+            if jid in self._lost:
+                return False
+        return self.q.owns(jid, self.daemon_id)
+
+    def _publishable(self, jobs: List[Dict[str, Any]]) -> \
+            List[Dict[str, Any]]:
+        """Filter a finished claim down to the members whose lease we
+        still hold; dropped members were stolen mid-run (the thief's
+        re-run is the publication of record — exactly one winner)."""
+        out = []
+        for j in jobs:
+            if self._still_owned(j["id"]):
+                out.append(j)
+            else:
+                self.tel.counter("serve.lease_lost_drops")
+                self.tel.event("serve.lease_lost_drop", id=j["id"])
+        return out
+
     # ---- workers ------------------------------------------------------
     def _worker_loop(self, wi: int) -> None:
         while True:
@@ -454,6 +790,13 @@ class ServeDaemon:
                     return  # queued jobs persist for the next life
                 jid = self._pending.popleft()
                 job = self.q.load(jid)
+                if job is not None and job.get("status") != "queued":
+                    # finished/claimed through the shared spool by a
+                    # peer daemon while it sat in our local deque
+                    job = None
+                if job is not None and not self.q.try_claim(
+                        jid, self.daemon_id, self.lease_ttl):
+                    job = None  # a peer holds a live lease on it
                 followers: List[Dict[str, Any]] = []
                 xmembers: List[Dict[str, Any]] = []
                 if job is not None:
@@ -476,13 +819,20 @@ class ServeDaemon:
                         oj = self.q.load(other)
                         if oj is None:
                             rest.append(other)
+                        elif oj.get("status") != "queued":
+                            continue  # a peer already took it; drop
                         elif oj.get("sig") == job["sig"]:
-                            followers.append(oj)
+                            if self.q.try_claim(other, self.daemon_id,
+                                                self.lease_ttl):
+                                followers.append(oj)
+                            # claim lost to a peer: drop from our deque
                         elif bsig and oj.get("bsig") == bsig and \
                                 (oj.get("sig") in xsigs or
                                  len(xsigs) < self.batch_max) and \
                                 (not job.get("fast_lane") or
-                                 oj.get("fast_lane")):
+                                 oj.get("fast_lane")) and \
+                                self.q.try_claim(other, self.daemon_id,
+                                                 self.lease_ttl):
                             # a fast-lane leader claims only fast-lane
                             # members: stapling a proven-small job to a
                             # multi-minute cohort member would withhold
@@ -532,11 +882,19 @@ class ServeDaemon:
                     # claim still owns
                     self._fail_job(still[0], still[1:], err)
             finally:
+                mine = []
                 with self._cv:
                     for j in [job] + claimed:
                         cur = self._running.get(j["id"])
                         if cur is not None and cur[1] is tok:
                             self._running.pop(j["id"])
+                            mine.append(j["id"])
+                    self._lost.difference_update(
+                        j["id"] for j in [job] + claimed)
+                # drop the leases this claim still holds — requeued
+                # members released theirs when they were handed back
+                for mj in mine:
+                    self.q.release(mj, self.daemon_id)
                 self._update_gauges()
 
     def _fail_job(self, job, followers, error: str) -> None:
@@ -549,6 +907,33 @@ class ServeDaemon:
                         finished_at=time.time(),
                         batch_leader=job["id"]
                         if j is not job else None)
+
+    def _requeue_or_quarantine(self, members: List[Dict[str, Any]],
+                               note: str) -> None:
+        """Hand crashed-owner jobs back to the fleet: each spends one
+        unit of its CROSS-DAEMON retry budget and requeues; a member
+        whose budget is gone is a poison job and quarantines with the
+        fault context instead (ISSUE 19 tentpole 3)."""
+        with self._cv:
+            for j in members:
+                attempt = self.q.spend_retry(j["id"], self.job_retries)
+                if attempt is None:
+                    self._running.pop(j["id"], None)
+                    self.q.quarantine(
+                        j["id"],
+                        f"poison job: owner died {self.job_retries} "
+                        f"times across the fleet (cross-daemon retry "
+                        f"budget exhausted)",
+                        context={"note": note,
+                                 "daemon": self.daemon_id})
+                    continue
+                self.q.mark(j["id"], "queued",
+                            requeue_note=f"{note} (attempt {attempt}/"
+                                         f"{self.job_retries})")
+                self.q.release(j["id"], self.daemon_id)
+                self._running.pop(j["id"], None)
+                self._pending.append(j["id"])
+            self._cv.notify_all()
 
     def _sig_lock(self, sig: str) -> threading.Lock:
         with self._cv:
@@ -662,6 +1047,19 @@ class ServeDaemon:
                 while len(self._done_events) > self._done_events_max:
                     self._done_events.popitem(last=False)
 
+    def _register_done_artifact(self, jids: List[str],
+                                summary: Dict[str, Any]) -> None:
+        """TTL-retained /metrics series for owner-run jobs: the live
+        recorder finished in the owner process, so render the final
+        series from the shipped artifact (same prune window as the
+        in-daemon path's _unregister_job_tel)."""
+        series = _ArtifactSeries(summary)
+        with self._cv:
+            now = self._metrics_clock()
+            for j in jids:
+                self._done_series[j] = (now, series)
+                self._done_series.move_to_end(j)
+
     def _run_batch(self, job: Dict[str, Any],
                    followers: List[Dict[str, Any]]) -> None:
         jid, sig = job["id"], job["sig"]
@@ -705,10 +1103,14 @@ class ServeDaemon:
         t0 = time.time()
         for j in [job] + followers:
             self.q.mark(j["id"], "running", started_at=t0,
+                        daemon=self.daemon_id,
                         batch_leader=jid if j is not job else None)
         if followers:
             self.tel.counter("serve.batched_jobs", len(followers))
         self._update_gauges()
+        from .. import faults
+        faults.kill_self("daemon_kill", job=jid, kind="solo",
+                         spec=os.path.basename(job["spec"]))
 
         with self._cv:
             warm = self.warm.get(sig)
@@ -816,7 +1218,10 @@ class ServeDaemon:
             pass
 
         status = "drained" if drained else "done"
-        for j in [job] + followers:
+        publish = self._publishable([job] + followers)
+        if not publish:
+            return  # every member was stolen mid-run; the thief answers
+        for j in publish:
             self.q.save_result(j["id"], summary)
             self.q.mark(j["id"], status, finished_at=time.time(),
                         ok=res.ok, distinct=res.distinct,
@@ -824,14 +1229,15 @@ class ServeDaemon:
                         warm_engine=warm_engine,
                         resumed_from_checkpoint=resumed,
                         window_recompiles=window_recompiles,
+                        daemon=self.daemon_id,
                         batch_leader=jid if j is not job else None)
         if drained:
-            self.tel.counter("serve.jobs_drained", 1 + len(followers))
+            self.tel.counter("serve.jobs_drained", len(publish))
             self.log(f"serve: job {jid} drained at a safe boundary "
                      f"(checkpointed; will resume next life)")
         else:
-            self.tel.counter("serve.jobs_done", 1 + len(followers))
-            self._jobs_done += 1 + len(followers)
+            self.tel.counter("serve.jobs_done", len(publish))
+            self._jobs_done += len(publish)
             self.log(f"serve: job {jid} done in {wall:.2f}s "
                      f"(ok={res.ok}, {res.distinct} distinct, "
                      f"warm={warm_engine}, resumed={resumed}, "
@@ -849,10 +1255,14 @@ class ServeDaemon:
         jobs = [job] + followers
         for j in jobs:
             self.q.mark(j["id"], "running", started_at=t0,
+                        daemon=self.daemon_id,
                         batch_leader=jid if j is not job else None)
         if followers:
             self.tel.counter("serve.batched_jobs", len(followers))
         self._update_gauges()
+        from .. import faults
+        faults.kill_self("daemon_kill", job=jid, kind="solo",
+                         spec=os.path.basename(job["spec"]))
         md = {"spec": job["spec"], "cfg": job.get("cfg"),
               "options": job.get("options"), "sig": sig,
               "jids": [j["id"] for j in jobs],
@@ -877,41 +1287,52 @@ class ServeDaemon:
                 self.log(f"serve: device-owner died mid-job ({ex}); "
                          f"requeued {len(jobs)} job"
                          f"{'s' if len(jobs) != 1 else ''}")
-                with self._cv:
-                    for j in jobs:
-                        self.q.mark(j["id"], "queued",
-                                    requeue_note="requeued after "
-                                    f"device-owner death: {ex}")
-                        self._running.pop(j["id"], None)
-                        self._pending.append(j["id"])
-                    self._cv.notify_all()
+                self._requeue_or_quarantine(
+                    jobs, f"requeued after device-owner death: {ex}")
                 return
         if resp.get("error"):
             self._fail_job(job, followers, resp["error"])
             return
         summary = resp["summary"]
-        summary.setdefault("serve", {})["cost_estimate"] = \
-            job.get("cost_estimate")
+        sv = summary.setdefault("serve", {})
+        sv["cost_estimate"] = job.get("cost_estimate")
+        # the owner's own warm registry reports warmth now (ISSUE 19:
+        # owner is the default device path, so the warm/cold/resume
+        # counters must not go dark when work leaves the daemon)
+        warm_engine = bool(sv.get("warm_engine"))
+        resumed = bool(sv.get("resumed_from_checkpoint"))
+        if warm_engine:
+            self.tel.counter("serve.warm_hits")
+        else:
+            self.tel.counter("serve.cold_runs")
+            if resumed:
+                self.tel.counter("serve.ckpt_resumes")
         status = "drained" if resp.get("drained") else "done"
-        for j in jobs:
+        publish = self._publishable(jobs)
+        if not publish:
+            return  # stolen mid-run; the thief's re-run answers
+        for j in publish:
             self.q.save_result(j["id"], summary)
             self.q.mark(j["id"], status, finished_at=time.time(),
                         ok=resp["ok"], distinct=resp["distinct"],
                         generated=resp["generated"],
-                        warm_engine=False, device_owner=True,
-                        resumed_from_checkpoint=summary["serve"].get(
-                            "resumed_from_checkpoint", False),
+                        warm_engine=warm_engine, device_owner=True,
+                        resumed_from_checkpoint=resumed,
+                        daemon=self.daemon_id,
                         batch_leader=jid if j is not job else None)
+        self._register_done_artifact([j["id"] for j in publish],
+                                     summary)
         if status == "drained":
-            self.tel.counter("serve.jobs_drained", len(jobs))
+            self.tel.counter("serve.jobs_drained", len(publish))
             self.log(f"serve: job {jid} drained in the device owner "
                      f"(checkpointed; will resume next life)")
         else:
-            self.tel.counter("serve.jobs_done", len(jobs))
-            self._jobs_done += len(jobs)
+            self.tel.counter("serve.jobs_done", len(publish))
+            self._jobs_done += len(publish)
             self.log(f"serve: job {jid} done in the device owner "
                      f"({time.time() - t0:.2f}s, ok={resp['ok']}, "
-                     f"{resp['distinct']} distinct)")
+                     f"{resp['distinct']} distinct, "
+                     f"warm={warm_engine}, resumed={resumed})")
 
     # ---- cross-model vmapped batches (ISSUE 13) ------------------------
     def _run_vbatch(self, job: Dict[str, Any],
@@ -935,22 +1356,34 @@ class ServeDaemon:
                 groups[oj["sig"]] = []
                 order.append(oj["sig"])
             groups[oj["sig"]].append(oj)
+        # BATCH-SCOPED CHECKPOINTS (ISSUE 19 tentpole 4): each member
+        # checkpoints under a bsig-scoped key (the merged batch layout
+        # has its own lane plan — the solo `ckpt/<sig>.ck` would refuse
+        # to resume it), so a drained or stolen cohort RE-FORMS from
+        # per-member checkpoints instead of restarting solo
+        bsig = job.get("bsig") or "solo"
         desc = [{"spec": groups[s][0]["spec"],
                  "cfg": groups[s][0].get("cfg"),
                  "options": groups[s][0].get("options"),
                  "sig": s, "bsig": job.get("bsig"),
                  "jids": [j["id"] for j in groups[s]],
+                 "checkpoint": self.q.batch_ckpt_path(bsig, s),
+                 "checkpoint_every": self.checkpoint_every,
                  "trace": self._job_trace_path(groups[s][0]["id"])}
                 for s in order]
         for s in order:
             for j in groups[s]:
                 self.q.mark(j["id"], "running", started_at=t0,
+                            daemon=self.daemon_id,
                             batch_leader=jid
                             if j["id"] != jid else None,
                             bsig=job.get("bsig"))
         self.tel.counter("serve.vbatch_jobs",
                          sum(len(groups[s]) for s in order))
         self._update_gauges()
+        from .. import faults
+        faults.kill_self("daemon_kill", job=jid, kind="vbatch",
+                         spec=os.path.basename(job["spec"]))
 
         def _requeue(members: List[Dict[str, Any]], note: str,
                      strip_bsig: bool = False) -> None:
@@ -963,6 +1396,7 @@ class ServeDaemon:
                     self.q.mark(j["id"], "queued", requeue_note=note,
                                 bsig=None if strip_bsig
                                 else j.get("bsig"))
+                    self.q.release(j["id"], self.daemon_id)
                     self._running.pop(j["id"], None)
                     self._pending.append(j["id"])
                 self._cv.notify_all()
@@ -993,8 +1427,12 @@ class ServeDaemon:
                              f"({ex}); requeued "
                              f"{sum(len(groups[s]) for s in order)} "
                              f"jobs")
-                    _requeue([j for s in order for j in groups[s]],
-                             f"requeued after device-owner death: {ex}")
+                    # an owner DEATH spends the cross-daemon retry
+                    # budget; members keep their bsig so the cohort
+                    # re-forms and resumes its batch checkpoints
+                    self._requeue_or_quarantine(
+                        [j for s in order for j in groups[s]],
+                        f"requeued after device-owner death: {ex}")
                     return
             else:
                 from .owner import run_vbatch
@@ -1060,24 +1498,29 @@ class ServeDaemon:
                 failed += len(jobs)
                 continue
             summary = mres["summary"]
-            summary.setdefault("serve", {})["cost_estimate"] = \
-                jobs[0].get("cost_estimate")
+            sv = summary.setdefault("serve", {})
+            sv["cost_estimate"] = jobs[0].get("cost_estimate")
+            resumed = bool(sv.get("resumed_from_checkpoint"))
             status = "drained" if mres.get("drained") else "done"
-            for j in jobs:
+            publish = self._publishable(jobs)
+            for j in publish:
                 self.q.save_result(j["id"], summary)
                 self.q.mark(j["id"], status, finished_at=time.time(),
                             ok=mres["ok"], distinct=mres["distinct"],
                             generated=mres["generated"],
                             warm_engine=False,
-                            resumed_from_checkpoint=False,
+                            resumed_from_checkpoint=resumed,
                             batch_occupancy=occupancy,
+                            daemon=self.daemon_id,
                             batch_leader=jid
                             if j["id"] != jid else None)
+            self._register_done_artifact([j["id"] for j in publish],
+                                         summary)
             if status == "drained":
-                drained_n += len(jobs)
+                drained_n += len(publish)
             else:
-                done += len(jobs)
-                self._jobs_done += len(jobs)
+                done += len(publish)
+                self._jobs_done += len(publish)
         if drained_n:
             self.tel.counter("serve.jobs_drained", drained_n)
         if done:
@@ -1097,6 +1540,9 @@ class ServeDaemon:
         self.tel.gauge("serve.warm_sessions", len(self.warm))
         self.tel.gauge("serve.workers", self.n_workers)
         self.tel.gauge("serve.draining", self._draining)
+        # serve.fleet gauges (ISSUE 19; schema note in obs/schema.py)
+        self.tel.gauge("serve.fleet_daemons", self._fleet_size)
+        self.tel.gauge("serve.leases_held", running)
 
     def job_events(self, jid: str) -> Optional[list]:
         """Recent trace events for one job, readable MID-RUN: the live
@@ -1227,25 +1673,44 @@ class ServeDaemon:
 
     def status(self) -> Dict[str, Any]:
         self._update_gauges()
+        # ONE snapshot hold for every shared map (ISSUE 19 satellite):
+        # the /metrics TTL pruner deletes done-job series under _cv at
+        # scrape time, so rendering the per-job progress block must
+        # work from copies taken in the same critical section — never
+        # iterate a live map the pruner can mutate mid-iteration
         with self._cv:
             pending = list(self._pending)
             running = {jid: s for jid, (s, _t)
                        in self._running.items()}
             warm = {s: w["session"] for s, w in self.warm.items()}
             job_tels = dict(self._job_tels)
+            done_series = [(jid, jt) for jid, (_t, jt)
+                           in self._done_series.items()]
         # live per-job search progress (ISSUE 16): fraction/ETA from
-        # the job's estimator, `unbounded` when analyze offered none
+        # the job's estimator, `unbounded` when analyze offered none —
+        # recently-done jobs keep their final snapshot until the TTL
+        # prunes them
         progress = {}
         for jid, jt in job_tels.items():
             pe = jt.progress_est
             if pe is not None:
                 progress[jid] = pe.snapshot()
+        for jid, jt in done_series:
+            pe = jt.progress_est
+            if jid not in progress and pe is not None:
+                progress[jid] = dict(pe.snapshot(), done=True)
         return {
             "progress": progress,
             "spool": self.q.root,
             "queue_depth": len(pending),
             "pending": pending,
             "running": running,
+            "fleet": {"daemon_id": self.daemon_id,
+                      "daemons": self._fleet_size,
+                      "lease_ttl": self.lease_ttl,
+                      "lease_renew": self.lease_renew,
+                      "job_retries": self.job_retries},
+            "quarantined": len(self.q.quarantined()),
             "batch_enabled": self.batch_enabled,
             "device_owner_pid": self.owner.pid
             if self.owner is not None else None,

@@ -27,6 +27,7 @@ paths cannot drift.
 
 from __future__ import annotations
 
+import collections
 import os
 import threading
 import time
@@ -61,7 +62,10 @@ def _member_summary(res, jt, backend: str, spec: str,
     summary["serve"] = dict(
         serve_block,
         window_recompiles=sum(1 for lv in jt.levels
-                              if lv.get("fresh_compile")))
+                              if lv.get("fresh_compile")),
+        profile_hits=jt.counters.get("profile.hits", 0),
+        persistent_cache_hits=jt.counters.get(
+            "compile.persistent_cache_hits", 0))
     jt.close()
     return {"summary": summary, "ok": res.ok, "distinct": res.distinct,
             "generated": res.generated, "drained": drained}
@@ -79,6 +83,18 @@ def run_vbatch(members_desc: List[Dict[str, Any]]) -> Dict[str, Any]:
     cfgs, tels = [], []
     for md in members_desc:
         cfg = build_config(md["spec"], md.get("cfg"), md.get("options"))
+        if md.get("checkpoint"):
+            # batch-scoped per-member checkpoints (ISSUE 19): a drained
+            # or stolen cohort re-forms and resumes each member from
+            # its own bsig-scoped checkpoint; the batch engine clears
+            # any resume whose lane plan no longer matches (fresh run,
+            # never a refused job)
+            cfg.checkpoint = md["checkpoint"]
+            cfg.checkpoint_every = float(
+                md.get("checkpoint_every", 60.0))
+            cfg.final_checkpoint = True
+            if os.path.exists(md["checkpoint"]):
+                cfg.resume = md["checkpoint"]
         cfgs.append(cfg)
         tels.append(obs.Telemetry(trace_path=md.get("trace"), meta={
             "command": "serve.job", "job": md["jids"][0],
@@ -119,7 +135,9 @@ def run_vbatch(members_desc: List[Dict[str, Any]]) -> Dict[str, Any]:
         out.append(_member_summary(mem.result, jt, cfg.backend,
                                    md["spec"], {
             "sig": md.get("sig"), "bsig": md.get("bsig"),
-            "warm_engine": False, "resumed_from_checkpoint": False,
+            "warm_engine": False,
+            "resumed_from_checkpoint": bool(
+                getattr(mem, "resumed", False)),
             "batched_with": [j for m2 in members_desc
                              for j in m2["jids"]
                              if j not in md["jids"]],
@@ -136,41 +154,100 @@ def run_vbatch(members_desc: List[Dict[str, Any]]) -> Dict[str, Any]:
             "wall_s": round(wall, 6)}
 
 
+# sig -> {"session": CheckSession, "completed": bool} — the OWNER'S
+# warm registry (ISSUE 19): with the owner process on by default, the
+# already-compiled engine must live WHERE THE DEVICE IS.  The same
+# bounded-LRU discipline as the daemon's in-process registry
+# (JAXMC_SERVE_WARM_MAX), the same checkpoint-replay reuse gate.  The
+# owner serves one request at a time (the daemon serializes on the
+# pipe), so no locking is needed here.
+_WARM: "collections.OrderedDict[str, Dict[str, Any]]" = \
+    collections.OrderedDict()
+
+
+def _warm_max() -> int:
+    try:
+        return max(1, int(os.environ.get(
+            "JAXMC_SERVE_WARM_MAX", "32") or 32))
+    except ValueError:
+        return 32
+
+
+def _revalidate_profile(sess, job_tel) -> None:
+    """Confirm the durable capacity profile still matches the warm
+    engine's layout (counts as a profile hit in the job's artifact) —
+    the daemon-side warm path's check, mirrored for the owner."""
+    if sess.layout_sig and sess.model is not None:
+        from ..compile.cache import load_capacity_profile
+        desc = getattr(sess.engine, "backend_desc", None)
+        variant = desc.profile_variant() if desc is not None else ""
+        load_capacity_profile(sess.model.module.name,
+                              sess.layout_sig, tel=job_tel,
+                              variant=variant)
+
+
 def run_solo(md: Dict[str, Any]) -> Dict[str, Any]:
     """Run one solo device job in the owner process: the same
-    CheckSession flow the daemon's _run_batch drives, minus the warm
-    registry (the spool checkpoint still makes repeats incremental).
-    Returns {"summary", "ok", ...} or {"error"}."""
+    CheckSession flow the daemon's _run_batch drives, including a warm
+    registry of its own — a repeat signature replays the finalized
+    checkpoint on the already-compiled engine with zero in-window
+    recompiles.  Returns {"summary", "ok", ...} or {"error"}."""
     from ..session import CheckSession
     from .protocol import build_config
     t0 = time.time()
     cfg = build_config(md["spec"], md.get("cfg"), md.get("options"))
-    if md.get("checkpoint"):
-        cfg.checkpoint = md["checkpoint"]
+    ck = md.get("checkpoint")
+    if ck:
+        cfg.checkpoint = ck
         cfg.checkpoint_every = float(md.get("checkpoint_every", 60.0))
         cfg.final_checkpoint = True
-        if os.path.exists(md["checkpoint"]):
-            cfg.resume = md["checkpoint"]
+        if os.path.exists(ck):
+            cfg.resume = ck
     jt = obs.Telemetry(trace_path=md.get("trace"), meta={
         "command": "serve.job", "job": md["jids"][0],
         "sig": md.get("sig"), "backend": cfg.backend,
         "spec": md["spec"], "cfg": md.get("cfg"),
         "env": obs.environment_meta()})
+    sig = md.get("sig")
+    entry = _WARM.get(sig) if sig else None
+    warm_engine = bool(entry is not None and entry.get("completed")
+                       and ck and os.path.exists(ck))
     resumed = bool(cfg.resume)
     # per-JOB watchdog (ISSUE 16): the stall threshold derives from
     # this job's own level rhythm, never a neighbour's
     wd = obs.Watchdog(jt).start()
     try:
         with obs.use_local(jt):
-            sess = CheckSession(cfg, tel=jt,
-                                log=obs.Logger(jt, quiet=True))
-            sess.parse()
-            try:
-                sess.compile()
-                res = sess.explore()
-            except (RuntimeError, OSError, MemoryError,
-                    ConnectionError) as ex:
-                res = sess.demote_to_cpu(ex)
+            if warm_engine:
+                # WARM: replay the finalized checkpoint on the
+                # already-compiled engine; rebind its telemetry to
+                # THIS job's recorder first (the cold job's closed)
+                resumed = True
+                _WARM.move_to_end(sig)
+                sess = entry["session"]
+                sess.tel = jt
+                sess.log = obs.Logger(jt, quiet=True)
+                _revalidate_profile(sess, jt)
+                res = sess.explore(resume_from=ck, checkpoint_path=ck,
+                                   final_checkpoint=True)
+            else:
+                sess = CheckSession(cfg, tel=jt,
+                                    log=obs.Logger(jt, quiet=True))
+                sess.parse()
+                try:
+                    sess.compile()
+                    res = sess.explore()
+                except (RuntimeError, OSError, MemoryError,
+                        ConnectionError) as ex:
+                    res = sess.demote_to_cpu(ex)
+                if sig:
+                    drained = bool(getattr(res, "drained", False))
+                    _WARM[sig] = {"session": sess,
+                                  "completed": res.ok and
+                                  not res.truncated and not drained}
+                    _WARM.move_to_end(sig)
+                    while len(_WARM) > _warm_max():
+                        _WARM.popitem(last=False)
     except Exception as ex:  # noqa: BLE001 — the job's failure is its
         # verdict; the owner loop must survive to serve the next one
         jt.close()
@@ -178,7 +255,7 @@ def run_solo(md: Dict[str, Any]) -> Dict[str, Any]:
     finally:
         wd.stop()
     return _member_summary(res, jt, cfg.backend, md["spec"], {
-        "sig": md.get("sig"), "warm_engine": False,
+        "sig": sig, "warm_engine": warm_engine,
         "resumed_from_checkpoint": resumed,
         "device_owner": True,
         "batched_with": [],

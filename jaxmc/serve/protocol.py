@@ -2,9 +2,17 @@ r"""The serve job protocol: JSON over HTTP, plus the tiny stdlib client.
 
 Endpoints (all JSON bodies/responses; the daemon binds 127.0.0.1):
 
-  POST /jobs          {spec, cfg?, options?{...check options...}}
+  POST /jobs          {spec, cfg?, options?{...check options...},
+                       tenant?}
                       -> 200 {id, sig, status}  |  400 bad job
-                      |  503 daemon is draining
+                      |  429 admission refused (queue full or the
+                         tenant's token bucket is dry): Retry-After
+                         header + {error, retry_after_s, reason,
+                         queue_depth/…gauges} body — the client backs
+                         off and resubmits, nothing was enqueued
+                      |  503 daemon is draining, or the spool
+                         degraded ({degraded: "spool"}) after
+                         exhausting write retries
   GET  /jobs          -> {jobs: [job records]}
   GET  /jobs/<id>     -> job record (+ "result" summary once done)
   GET  /jobs/<id>/result
@@ -29,9 +37,14 @@ Endpoints (all JSON bodies/responses; the daemon binds 127.0.0.1):
   POST /drain         -> initiate the graceful drain (same path as
                          SIGTERM); 200 {draining: true}
 
-A job record: {id, sig, status: queued|running|done|failed|drained,
-submitted_at, started_at?, finished_at?, spec, cfg, options,
-batch_leader?, error?}.
+A job record: {id, sig, status: queued|running|done|failed|drained|
+quarantined, submitted_at, started_at?, finished_at?, spec, cfg,
+options, batch_leader?, error?, tenant?, daemon?, stolen_by?}.
+`daemon` names the fleet member that ran (or is running) the job;
+`stolen_by` appears after a lease-expiry takeover.  A QUARANTINED job
+(its owner died JAXMC_JOB_RETRIES times across the fleet) answers
+GET /jobs/<id> with the quarantine record: the named verdict, the
+captured fault context, and the trace tail at death.
 
 Job SIGNATURES (`job_signature`) hash the spec/cfg CONTENTS plus every
 result-affecting option (session.SessionConfig.job_signature_fields),
@@ -63,11 +76,26 @@ OPTION_FIELDS = (
     "por",
 )
 
-JOB_STATUSES = ("queued", "running", "done", "failed", "drained")
+JOB_STATUSES = ("queued", "running", "done", "failed", "drained",
+                "quarantined")
 
 
 class BadJob(ValueError):
     """A submission the daemon refuses; the message is the 400 body."""
+
+
+class Overloaded(RuntimeError):
+    """Admission control refused the submission (bounded spool depth or
+    a dry per-tenant token bucket).  Carries the machine-readable
+    backoff: the HTTP layer renders 429 + Retry-After + the queue/cost
+    gauges in `body`, so clients can distinguish 'fleet is full' from
+    'you specifically are over budget'."""
+
+    def __init__(self, msg: str, retry_after_s: float = 1.0,
+                 body: Optional[Dict[str, Any]] = None):
+        super().__init__(msg)
+        self.retry_after_s = float(retry_after_s)
+        self.body = dict(body or {})
 
 
 def build_config(spec: str, cfg: Optional[str],
@@ -123,6 +151,8 @@ class ServeClient:
         self.host = host
         self.port = port
         self.timeout = timeout
+        # response headers of the LAST request (Retry-After on a 429)
+        self.last_headers: Dict[str, str] = {}
 
     @classmethod
     def from_spool(cls, spool: str, timeout: float = 30.0
@@ -145,17 +175,23 @@ class ServeClient:
         try:
             with urllib.request.urlopen(req,
                                         timeout=self.timeout) as resp:
+                self.last_headers = dict(resp.headers.items())
                 return resp.status, json.loads(resp.read().decode())
         except urllib.error.HTTPError as ex:
+            self.last_headers = dict(ex.headers.items()) \
+                if ex.headers is not None else {}
             try:
                 return ex.code, json.loads(ex.read().decode())
             except Exception:  # noqa: BLE001 — non-JSON error body
                 return ex.code, {"error": str(ex)}
 
     def submit(self, spec: str, cfg: Optional[str] = None,
-               options: Optional[Dict[str, Any]] = None):
-        return self._request("POST", "/jobs", {
-            "spec": spec, "cfg": cfg, "options": options or {}})
+               options: Optional[Dict[str, Any]] = None,
+               tenant: Optional[str] = None):
+        body = {"spec": spec, "cfg": cfg, "options": options or {}}
+        if tenant is not None:
+            body["tenant"] = tenant
+        return self._request("POST", "/jobs", body)
 
     def job(self, jid: str):
         return self._request("GET", f"/jobs/{jid}")
@@ -178,8 +214,8 @@ class ServeClient:
         last = {}
         while time.time() < deadline:
             code, last = self.job(jid)
-            if code == 200 and last.get("status") in ("done", "failed",
-                                                      "drained"):
+            if code == 200 and last.get("status") in (
+                    "done", "failed", "drained", "quarantined"):
                 return last
             time.sleep(poll_s)
         raise TimeoutError(

@@ -416,3 +416,207 @@ class TestTraceContextChaos:
         assert rc == 0, out
         assert "orphans=0" in out
         assert "processes=3" in out
+
+
+# ------------------------------------------------ fleet serving chaos
+
+@pytest.mark.slow
+class TestFleetChaos:
+    """ISSUE 19: subprocess daemons sharing one durable spool, under
+    the daemon_kill / lease_stall fault sites.  Slow-marked (multi-
+    second subprocess scenarios) — `make fleet-check` runs the full
+    acceptance versions; these pin the two leg shapes as tests."""
+
+    def _start_daemon(self, spool, extra_env=None):
+        env = dict(os.environ, JAX_PLATFORMS="cpu", **(extra_env or {}))
+        return subprocess.Popen(
+            [sys.executable, "-m", "jaxmc.serve", "run", "--spool",
+             spool, "--workers", "1", "--quiet"],
+            cwd=REPO, env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)
+
+    def _heartbeat(self, spool, pid, timeout=120):
+        """This pid's heartbeat record (carries its id + bound port)."""
+        import glob
+        import time
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            for path in glob.glob(os.path.join(spool, "daemons",
+                                               "*.json")):
+                try:
+                    with open(path) as fh:
+                        rec = json.load(fh)
+                except (OSError, ValueError):
+                    continue
+                if rec.get("pid") == pid:
+                    return rec
+            time.sleep(0.1)
+        raise AssertionError(f"daemon pid {pid} never heartbeated")
+
+    def test_daemon_sigkill_mid_vbatch_cohort_reforms(self, tmp_path):
+        # the daemon that popped a 4-member layout-compat cohort
+        # SIGKILLs itself right after marking the members running
+        # (daemon_kill kind=vbatch); the next daemon life must steal
+        # the expired leases, RE-FORM the cohort, and answer every
+        # member with counts identical to solo runs
+        import time
+        from jaxmc.serve import JobQueue
+        from jaxmc.serve.protocol import build_config, job_signature
+        from jaxmc.session import batch_profile
+
+        spool = str(tmp_path / "spool")
+        bt = os.path.join(SPECS, "batchtoy.tla")
+        opts = {"backend": "jax", "platform": "cpu", "host_seen": True}
+        q = JobQueue(spool)
+        jids = []
+        for v in ("a", "b", "c", "d"):
+            cfg = build_config(bt, os.path.join(
+                SPECS, f"batchtoy_{v}.cfg"), opts)
+            prof = batch_profile(cfg)
+            job = q.new_job(cfg.spec, cfg.cfg, opts,
+                            job_signature(cfg),
+                            bsig=prof.bsig if prof else None,
+                            cost_estimate=prof.cost_estimate
+                            if prof else None)
+            jids.append(job["id"])
+
+        a = self._start_daemon(spool, {
+            "JAXMC_FAULTS": "daemon_kill:kind=vbatch:n=1",
+            "JAXMC_LEASE_TTL": "1.0"})
+        a.wait(timeout=240)
+        assert a.returncode in (-9, 137), \
+            f"daemon A exited {a.returncode}, expected the injected " \
+            f"SIGKILL"
+
+        b = self._start_daemon(spool, {"JAXMC_LEASE_TTL": "1.0"})
+        try:
+            rec_b = self._heartbeat(spool, b.pid)
+            recs = {}
+            deadline = time.time() + 300
+            while time.time() < deadline and len(recs) < len(jids):
+                assert b.poll() is None, "daemon B died"
+                for j in jids:
+                    rec = q.load(j)
+                    if rec and rec.get("status") == "done":
+                        recs[j] = rec
+                time.sleep(0.2)
+            assert len(recs) == len(jids), \
+                f"only {sorted(recs)} of {jids} finished"
+            for v, j in zip(("a", "b", "c", "d"), jids):
+                solo = _cli([bt, "--cfg",
+                             os.path.join(SPECS, f"batchtoy_{v}.cfg"),
+                             "--quiet"])
+                assert solo.returncode == 0, solo.stderr
+                gen, dis = _counts(solo.stdout)
+                rec = recs[j]
+                assert rec["daemon"] == rec_b["id"]
+                assert rec.get("stolen_by") == rec_b["id"]
+                assert (rec["generated"], rec["distinct"]) == \
+                    (gen, dis), f"member {v} diverged after takeover"
+                # the cohort RE-FORMED (members ran batched, not solo)
+                assert rec.get("batch_occupancy", 1) >= 2, \
+                    f"member {v} ran solo after the steal " \
+                    f"(occupancy {rec.get('batch_occupancy')})"
+        finally:
+            b.terminate()
+            try:
+                b.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                b.kill()
+
+    def test_lease_stall_double_claim_single_winner(self, tmp_path):
+        # daemon A claims a slow job but its fleet loop stalls
+        # (lease_stall): no renewals, no heartbeats, while its worker
+        # keeps running.  Peer B must steal the expired lease and win;
+        # A must DROP its late result (serve.lease_lost_drops) so
+        # exactly one daemon publishes
+        import time
+        import urllib.request
+        from jaxmc.serve import JobQueue
+        from jaxmc.serve.protocol import ServeClient
+        from jaxmc.tracecheck import _SLOW_CFG, _SLOW_SPEC
+
+        spec = str(tmp_path / "stallload.tla")
+        with open(spec, "w") as fh:
+            fh.write(_SLOW_SPEC.format(q=1500, bound=20)
+                     .replace("MODULE traceload", "MODULE stallload"))
+        with open(str(tmp_path / "stallload.cfg"), "w") as fh:
+            fh.write(_SLOW_CFG)
+        solo = _cli([spec, "--quiet"])
+        assert solo.returncode == 0, solo.stderr
+        ref = _counts(solo.stdout)
+
+        spool = str(tmp_path / "spool")
+        a = self._start_daemon(spool, {
+            "JAXMC_FAULTS": "lease_stall:n=999",
+            "JAXMC_LEASE_TTL": "1.0"})
+        b = None
+        try:
+            rec_a = self._heartbeat(spool, a.pid)
+            client = ServeClient(rec_a.get("host", "127.0.0.1"),
+                                 rec_a["port"])
+            code, job = client.submit(spec, None,
+                                      {"backend": "interp"})
+            assert code == 200, f"submit failed ({code}): {job}"
+            jid = job["id"]
+            q = JobQueue(spool)
+            deadline = time.time() + 120
+            while time.time() < deadline:
+                rec = q.load(jid) or {}
+                if rec.get("status") == "running" and \
+                        rec.get("daemon") == rec_a["id"]:
+                    break
+                time.sleep(0.1)
+            else:
+                raise AssertionError(f"A never claimed {jid}")
+
+            b = self._start_daemon(spool, {
+                "JAXMC_LEASE_TTL": "1.0",
+                "JAXMC_LEASE_AFFINITY_GRACE": "0.1"})
+            rec_b = self._heartbeat(spool, b.pid)
+            deadline = time.time() + 240
+            while time.time() < deadline:
+                rec = q.load(jid) or {}
+                if rec.get("status") == "done":
+                    break
+                time.sleep(0.2)
+            assert rec.get("status") == "done", \
+                f"job ended {rec.get('status')!r}"
+            # exactly one winner: B, through the lease steal
+            assert rec["daemon"] == rec_b["id"]
+            assert rec.get("stolen_by") == rec_b["id"]
+            assert "stolen" in rec.get("requeue_note", "")
+            assert (rec["generated"], rec["distinct"]) == ref
+            # the stalled loser must DROP its late copy at publish
+            # time (the fleet tick that counts serve.lease_lost is
+            # exactly what the stall suppresses, so the ownership
+            # check in _publishable is the arbitration under test)
+            deadline = time.time() + 120
+            stalls = drops = 0.0
+            while time.time() < deadline:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{rec_a['port']}/metrics",
+                        timeout=10) as resp:
+                    text = resp.read().decode()
+                vals = {}
+                for ln in text.splitlines():
+                    if ln.startswith("jaxmc_serve_lease_"):
+                        name, _, v = ln.rpartition(" ")
+                        vals[name] = float(v)
+                stalls = vals.get("jaxmc_serve_lease_stalls", 0.0)
+                drops = vals.get("jaxmc_serve_lease_lost_drops", 0.0)
+                if stalls >= 1 and drops >= 1:
+                    break
+                time.sleep(0.5)
+            assert stalls >= 1, "the lease_stall fault never fired"
+            assert drops >= 1, "stalled daemon published a stolen " \
+                               "job's result — two winners"
+        finally:
+            for p in (a, b):
+                if p is None:
+                    continue
+                p.terminate()
+                try:
+                    p.wait(timeout=60)
+                except subprocess.TimeoutExpired:
+                    p.kill()

@@ -420,3 +420,24 @@ class TestMetricsRetention:
         assert jid not in body2
         # fleet-level series survive the prune
         assert "jaxmc_serve_jobs_done" in body2
+
+
+class TestDeviceOwnerDefault:
+    """ISSUE 19 satellite: device work leaves the daemon process BY
+    DEFAULT now that owner death is supervised (requeue + respawn +
+    the cross-daemon retry budget); JAXMC_SERVE_DEVICE_OWNER=0 (or
+    `run --no-device-owner`) opts back into the pre-fleet in-process
+    layout.  The owner spawn itself is lazy, so constructing the
+    daemon does not fork."""
+
+    def test_owner_enabled_by_default(self, tmp_path, monkeypatch):
+        monkeypatch.delenv("JAXMC_SERVE_DEVICE_OWNER", raising=False)
+        d = ServeDaemon(str(tmp_path / "spool"), workers=1, quiet=True)
+        assert d.owner is not None
+        assert d.owner.pid is None  # lazy: nothing forked yet
+        d.owner.stop()
+
+    def test_env_zero_opts_out(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("JAXMC_SERVE_DEVICE_OWNER", "0")
+        d = ServeDaemon(str(tmp_path / "spool"), workers=1, quiet=True)
+        assert d.owner is None
